@@ -1,0 +1,14 @@
+"""Test harness config.
+
+All model/mesh tests run on CPU with 8 virtual XLA devices
+(SURVEY.md §4: mirror the reference's seam strategy; multi-chip behavior is
+validated via xla_force_host_platform_device_count). Must run before any
+``import jax`` in test modules.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,73 @@
+"""Schema contract tests.
+
+Mirrors the reference's packages/schemas/test/intent.test.ts:1-54 (accepts
+navigate, filter+sort params, rejects confidence>1, extract+csv) against the
+unified schema.
+"""
+
+import pytest
+from pydantic import ValidationError
+
+from tpu_voice_agent.schemas import (
+    INTENT_TYPES,
+    Intent,
+    ParseRequest,
+    ParseResponse,
+    ExecuteRequest,
+    parse_response_from_json,
+)
+
+
+def test_intent_vocabulary_is_19_types():
+    assert len(INTENT_TYPES) == 19
+    assert "extract_table" in INTENT_TYPES and "unknown" in INTENT_TYPES
+
+
+def test_accepts_navigate():
+    it = Intent(type="navigate", args={"url": "https://example.com"})
+    assert it.timeout_ms == 15_000 and it.retries == 0 and not it.is_risky()
+
+
+def test_accepts_filter_and_sort_params():
+    resp = ParseResponse(
+        intents=[
+            Intent(type="filter", args={"field": "price", "op": "lte", "value": 100}),
+            Intent(type="sort", args={"field": "price", "direction": "asc"}),
+        ],
+        confidence=0.92,
+    )
+    assert resp.intents[1].args["direction"] == "asc"
+
+
+def test_rejects_confidence_above_one():
+    with pytest.raises(ValidationError):
+        ParseResponse(intents=[], confidence=1.2)
+
+
+def test_rejects_retries_above_three():
+    with pytest.raises(ValidationError):
+        Intent(type="click", retries=4)
+
+
+def test_upload_is_risky_even_without_flag():
+    assert Intent(type="upload", args={"fileRef": "resume://abc"}).is_risky()
+
+
+def test_execute_request_requires_intents():
+    with pytest.raises(ValidationError):
+        ExecuteRequest(intents=[])
+
+
+def test_parse_request_context_roundtrip():
+    req = ParseRequest(text="open the second result", context={"last_query": "laptops"})
+    assert req.context["last_query"] == "laptops"
+
+
+def test_parse_response_from_json_error_envelope():
+    model, err = parse_response_from_json("{not json")
+    assert model is None and err.startswith("invalid_json")
+    model, err = parse_response_from_json(
+        '{"version":"1.0","intents":[{"type":"search","args":{"query":"4k tv"}}],'
+        '"context_updates":{},"confidence":0.9}'
+    )
+    assert err is None and model.intents[0].type == "search"

@@ -1,0 +1,29 @@
+"""tpu-voice-agent: a TPU-native voice -> intent -> browser automation framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capability contract of the
+reference microservice repo ``Nikhil-Doye/voice-enabled-browser-automation``
+(see SURVEY.md): streaming speech-to-text, schema-constrained intent parsing,
+and browser execution — with every cloud ML call replaced by an in-tree
+inference stack (streaming Whisper STT, grammar-constrained Llama decode,
+optional VLM grounding) hosted on a shared TPU device mesh.
+
+Subpackages
+-----------
+- ``schemas``   unified intent grammar (replaces reference's dual zod schemas,
+                apps/brain/src/schema.ts + packages/schemas/src/index.ts)
+- ``grammar``   JSON-schema -> regex -> DFA -> token-mask compiler for
+                constrained decoding (replaces validate-then-repair loop,
+                apps/brain/src/server.ts:110-121)
+- ``models``    Llama-family decoder, Whisper encoder-decoder, VLM grounding
+- ``ops``       Pallas TPU kernels (flash attention, paged attention, conv1d
+                audio frontend, fused constrained sampling)
+- ``parallel``  mesh construction, sharding rules, ring attention (SP/CP)
+- ``serve``     serving runtime: paged KV cache, continuous batching
+                scheduler, decode engine
+- ``audio``     log-mel frontend, resampling, endpointing
+- ``services``  brain (/parse), voice (WS /stream), executor (browser)
+- ``train``     sharded fine-tuning step (dp x tp mesh)
+- ``utils``     config cascade, tracing spans, misc
+"""
+
+__version__ = "0.1.0"

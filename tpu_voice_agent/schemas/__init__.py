@@ -1,0 +1,29 @@
+from .intents import (
+    INTENT_TYPES,
+    RISKY_INTENT_TYPES,
+    TARGET_STRATEGIES,
+    Target,
+    Intent,
+    ParseRequest,
+    ParseResponse,
+    ExecuteRequest,
+    StepResult,
+    ExecuteResponse,
+    parse_response_from_json,
+    validate_parse_response,
+)
+
+__all__ = [
+    "INTENT_TYPES",
+    "RISKY_INTENT_TYPES",
+    "TARGET_STRATEGIES",
+    "Target",
+    "Intent",
+    "ParseRequest",
+    "ParseResponse",
+    "ExecuteRequest",
+    "StepResult",
+    "ExecuteResponse",
+    "parse_response_from_json",
+    "validate_parse_response",
+]

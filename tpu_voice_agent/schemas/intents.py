@@ -1,0 +1,177 @@
+"""Unified intent grammar — single source of truth.
+
+The reference keeps two divergent zod schemas: the live one
+(apps/brain/src/schema.ts:3-69, duplicated verbatim in
+apps/executor/src/types.ts:3-50) and a legacy flat one
+(packages/schemas/src/index.ts:4-49) only used by dead code. This module
+unifies them (SURVEY.md §2 #9/#10) into one pydantic schema that serves three
+masters at once:
+
+1. wire validation for /parse and /execute payloads,
+2. the *decoding grammar* — ``tpu_voice_agent.grammar`` compiles this very
+   schema into a DFA that constrains Llama's JSON sampling token-by-token
+   (replacing the reference's validate-then-repair loop,
+   apps/brain/src/server.ts:110-121),
+3. the executor's typed step contract.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Literal
+
+from pydantic import BaseModel, ConfigDict, Field, ValidationError
+
+# The 19-value intent vocabulary (reference: apps/brain/src/schema.ts:3-23).
+INTENT_TYPES: tuple[str, ...] = (
+    "search",
+    "navigate",
+    "click",
+    "type",
+    "extract",
+    "extract_table",
+    "sort",
+    "filter",
+    "scroll",
+    "back",
+    "forward",
+    "select",
+    "wait_for",
+    "upload",
+    "screenshot",
+    "summarize",
+    "confirm",
+    "cancel",
+    "unknown",
+)
+
+# Intents that must never auto-execute without user confirmation.
+# (The reference leaves this to the model's requires_confirmation bit; we keep
+# that bit but also enforce a server-side floor for these types.)
+RISKY_INTENT_TYPES: frozenset[str] = frozenset({"upload", "confirm"})
+
+# Reference: apps/brain/src/schema.ts:25-37.
+TARGET_STRATEGIES: tuple[str, ...] = ("auto", "css", "text", "role", "aria", "xpath")
+
+IntentType = Literal[
+    "search",
+    "navigate",
+    "click",
+    "type",
+    "extract",
+    "extract_table",
+    "sort",
+    "filter",
+    "scroll",
+    "back",
+    "forward",
+    "select",
+    "wait_for",
+    "upload",
+    "screenshot",
+    "summarize",
+    "confirm",
+    "cancel",
+    "unknown",
+]
+
+TargetStrategy = Literal["auto", "css", "text", "role", "aria", "xpath"]
+
+
+class Target(BaseModel):
+    """How the executor should locate an element on the page."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    strategy: TargetStrategy = "auto"
+    value: str | None = Field(default=None, max_length=512)
+    role: str | None = Field(default=None, max_length=64)
+    name: str | None = Field(default=None, max_length=256)
+
+
+class Intent(BaseModel):
+    """One browser action (reference: apps/brain/src/schema.ts:39-50)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    type: IntentType
+    target: Target | None = None
+    args: dict[str, str | int | float | bool | None] = Field(default_factory=dict)
+    priority: int = Field(default=1, ge=1, le=5)
+    requires_confirmation: bool = False
+    timeout_ms: int = Field(default=15_000, ge=0, le=120_000)
+    retries: int = Field(default=0, ge=0, le=3)
+
+    def is_risky(self) -> bool:
+        return self.requires_confirmation or self.type in RISKY_INTENT_TYPES
+
+
+class ParseRequest(BaseModel):
+    """Reference: apps/brain/src/schema.ts:52-... {text, session_id?, context}."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    text: str = Field(min_length=1, max_length=4096)
+    session_id: str | None = None
+    context: dict[str, Any] = Field(default_factory=dict)
+
+
+class ParseResponse(BaseModel):
+    """Reference: apps/brain/src/schema.ts:52-69."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    version: str = "1.0"
+    intents: list[Intent] = Field(default_factory=list, max_length=8)
+    context_updates: dict[str, str | int | float | bool | None] = Field(default_factory=dict)
+    confidence: float = Field(ge=0.0, le=1.0)
+    tts_summary: str | None = Field(default=None, max_length=512)
+    follow_up_question: str | None = Field(default=None, max_length=512)
+
+
+class ExecuteRequest(BaseModel):
+    """Reference: apps/executor/src/types.ts:52-62."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    session_id: str | None = None
+    intents: list[Intent] = Field(min_length=1, max_length=32)
+
+
+class StepResult(BaseModel):
+    """Per-intent outcome (reference: apps/executor/src/actions.ts:14-22)."""
+
+    model_config = ConfigDict(extra="allow")
+
+    intent: Intent
+    ok: bool
+    error: str | None = None
+    data: Any = None
+    screenshot: str | None = None
+    data_paths: list[str] = Field(default_factory=list)
+    page_analysis: dict[str, Any] | None = None
+    latency_ms: float | None = None
+
+
+class ExecuteResponse(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    session_id: str
+    results: list[StepResult]
+    artifacts: dict[str, str] = Field(default_factory=dict)
+
+
+def validate_parse_response(obj: Any) -> tuple[ParseResponse | None, str | None]:
+    """Validate a decoded object against ParseResponse; (model, error)."""
+    try:
+        return ParseResponse.model_validate(obj), None
+    except ValidationError as e:
+        return None, str(e)
+
+
+def parse_response_from_json(text: str) -> tuple[ParseResponse | None, str | None]:
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError as e:
+        return None, f"invalid_json: {e}"
+    return validate_parse_response(obj)

@@ -1,0 +1,13 @@
+from .envcfg import load_env_cascade, env_str, env_int, env_bool
+from .tracing import Span, Tracer, Metrics, new_trace_id
+
+__all__ = [
+    "load_env_cascade",
+    "env_str",
+    "env_int",
+    "env_bool",
+    "Span",
+    "Tracer",
+    "Metrics",
+    "new_trace_id",
+]

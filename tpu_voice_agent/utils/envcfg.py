@@ -1,0 +1,59 @@
+"""Two-level env config cascade.
+
+The reference loads an app-local ``.env`` and then the repo-root ``../../.env``
+via dotenv (apps/voice/src/server.ts:12-13, apps/brain/src/server.ts:10-11,
+apps/executor/src/server.ts:13-14). We keep that contract: explicit process
+env wins, then app-local ``.env``, then repo-root ``.env``.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def _parse_dotenv(path: Path) -> dict[str, str]:
+    out: dict[str, str] = {}
+    if not path.is_file():
+        return out
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        val = val.strip().strip('"').strip("'")
+        out[key.strip()] = val
+    return out
+
+
+def load_env_cascade(app_dir: str | Path | None = None) -> dict[str, str]:
+    """Merge repo-root .env, then app-local .env, into os.environ (no overwrite)."""
+    merged: dict[str, str] = {}
+    root = Path(__file__).resolve().parents[2]
+    merged.update(_parse_dotenv(root / ".env"))
+    if app_dir is not None:
+        merged.update(_parse_dotenv(Path(app_dir) / ".env"))
+    for k, v in merged.items():
+        os.environ.setdefault(k, v)
+    return merged
+
+
+def env_str(name: str, default: str | None = None) -> str | None:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")

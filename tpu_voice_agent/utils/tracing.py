@@ -1,0 +1,112 @@
+"""Structured per-stage tracing + metrics.
+
+The reference has no tracing at all — observability is tagged console.log
+lines (SURVEY.md §5); the only latency numbers ever measured lived in a dead
+demo's console.table (apps/executor/src/index.js:76-93). Here every request
+carries a trace id across capture -> STT -> parse -> execute hops, and each
+stage records a span, so the BASELINE metric (voice->intent p50) is measurable
+from day one.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    start_s: float
+    end_s: float = 0.0
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_s - self.start_s) * 1e3
+
+
+class Metrics:
+    """Process-local counters + latency histograms (lock-protected)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._latencies: dict[str, list[float]] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def observe_ms(self, name: str, ms: float) -> None:
+        with self._lock:
+            self._latencies.setdefault(name, []).append(ms)
+
+    def percentile_ms(self, name: str, q: float) -> float | None:
+        with self._lock:
+            xs = sorted(self._latencies.get(name, []))
+        if not xs:
+            return None
+        idx = min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))
+        return xs[idx]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {"counters": dict(self._counters), "latency_ms": {}}
+            for k, xs in self._latencies.items():
+                s = sorted(xs)
+                out["latency_ms"][k] = {
+                    "count": len(s),
+                    "p50": s[len(s) // 2],
+                    "p95": s[min(len(s) - 1, int(0.95 * (len(s) - 1) + 0.5))],
+                    "max": s[-1],
+                }
+        return out
+
+
+class Tracer:
+    """Emits spans as one-line JSON to stderr and records into Metrics."""
+
+    def __init__(self, service: str, metrics: Metrics | None = None, emit: bool = True):
+        self.service = service
+        self.metrics = metrics or Metrics()
+        self.emit = emit
+        self.spans: list[Span] = []
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def span(self, name: str, trace_id: str | None = None, **attrs):
+        sp = Span(name=name, trace_id=trace_id or new_trace_id(), start_s=time.perf_counter(), attrs=attrs)
+        try:
+            yield sp
+        finally:
+            sp.end_s = time.perf_counter()
+            with self._lock:
+                self.spans.append(sp)
+                if len(self.spans) > 10_000:
+                    del self.spans[:5_000]
+            self.metrics.observe_ms(f"{self.service}.{name}", sp.duration_ms)
+            if self.emit:
+                print(
+                    json.dumps(
+                        {
+                            "svc": self.service,
+                            "span": name,
+                            "trace": sp.trace_id,
+                            "ms": round(sp.duration_ms, 3),
+                            **{k: v for k, v in sp.attrs.items() if isinstance(v, (str, int, float, bool))},
+                        }
+                    ),
+                    file=sys.stderr,
+                    flush=True,
+                )
