@@ -1,0 +1,181 @@
+"""Grammar engine tests: regex->DFA, schema->regex, tokenizer, token FSM.
+
+The load-bearing property: every byte string the DFA accepts validates under
+the pydantic schema (constrained decoding can then never produce invalid
+JSON), and every few-shot exemplar in the prompt is representable (the model
+is never asked to imitate something the grammar forbids).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_voice_agent.grammar import compile_regex, Tokenizer
+from tpu_voice_agent.grammar.fsm import TokenFSM, sample_dfa
+from tpu_voice_agent.grammar.intent_grammar import (
+    build_intent_fsm,
+    intent_dfa,
+    intent_regex,
+)
+from tpu_voice_agent.grammar.tokenizer import EOS_ID, BOS_ID
+from tpu_voice_agent.schemas import parse_response_from_json
+from tpu_voice_agent.services.prompts import FEWSHOTS
+
+
+# ---------------------------------------------------------------- regexlang
+
+
+@pytest.mark.parametrize(
+    "pattern,yes,no",
+    [
+        ("abc", ["abc"], ["ab", "abcd", ""]),
+        ("a|bc", ["a", "bc"], ["b", "abc"]),
+        ("a*", ["", "a", "aaaa"], ["b"]),
+        ("a+b?", ["a", "ab", "aaab"], ["", "b", "abb"]),
+        ("[a-c]{2,3}", ["ab", "abc", "ccc"], ["a", "abcd", "ad"]),
+        (r"\d{1,2}", ["7", "42"], ["", "123", "x"]),
+        (r"[^a-z]", ["A", "0", " "], ["a", "z", ""]),
+        (r"(ab){2}", ["abab"], ["ab", "ababab"]),
+        (r"a{2,}", ["aa", "aaaa"], ["a", ""]),
+        (r"\[x\]", ["[x]"], ["x"]),
+        # escaped char anchoring a range (the bug found during bring-up)
+        (r"[\]-~]", ["]", "^", "t", "~"], ["[", " "]),
+    ],
+)
+def test_regex_matches(pattern, yes, no):
+    dfa = compile_regex(pattern)
+    for s in yes:
+        assert dfa.matches(s.encode()), f"{pattern} should match {s!r}"
+    for s in no:
+        assert not dfa.matches(s.encode()), f"{pattern} should reject {s!r}"
+
+
+def test_inverted_ranges_raise():
+    with pytest.raises(ValueError):
+        compile_regex("[z-a]")
+    with pytest.raises(ValueError):
+        compile_regex("a{3,1}")
+
+
+def test_numeric_bounds_are_exact():
+    from tpu_voice_agent.grammar.jsonschema import _int_regex, _num_regex, int_range_regex
+
+    d = compile_regex(_int_regex(10, 99))
+    assert d.matches(b"10") and d.matches(b"57") and d.matches(b"99")
+    assert not d.matches(b"0") and not d.matches(b"9") and not d.matches(b"100")
+
+    d = compile_regex(_int_regex(-5, 5))
+    assert d.matches(b"-5") and d.matches(b"0") and d.matches(b"5")
+    assert not d.matches(b"-6") and not d.matches(b"6") and not d.matches(b"-999999999")
+
+    d = compile_regex(_num_regex(0, 10.0))
+    assert d.matches(b"9.999999") and d.matches(b"10.0") and d.matches(b"0.5")
+    assert not d.matches(b"10.5") and not d.matches(b"999999999") and not d.matches(b"-1")
+
+    d = compile_regex(int_range_regex(0, 120000))
+    assert d.matches(b"120000") and d.matches(b"99999") and not d.matches(b"120001")
+
+
+def test_min_items_enforced():
+    from tpu_voice_agent.grammar.jsonschema import schema_to_regex
+
+    rx = schema_to_regex({"type": "array", "items": {"type": "boolean"}, "minItems": 2, "maxItems": 4})
+    d = compile_regex(rx)
+    assert not d.matches(b"[true]")
+    assert d.matches(b"[true,false]") and d.matches(b"[true,false,true,true]")
+    assert not d.matches(b"[true,false,true,true,true]")
+
+
+def test_json_string_pattern():
+    from tpu_voice_agent.grammar.jsonschema import STRING
+
+    dfa = compile_regex(STRING)
+    assert dfa.matches(b'"hello world"')
+    assert dfa.matches(b'""')
+    assert dfa.matches(rb'"a\"b\\c\nd"')
+    assert not dfa.matches(b'"unterminated')
+    assert not dfa.matches(b'"raw"quote"')
+
+
+# ---------------------------------------------------------------- intent grammar
+
+
+def test_intent_dfa_accepts_every_fewshot():
+    dfa = intent_dfa()
+    for _, resp in FEWSHOTS:
+        payload = json.dumps(resp, separators=(",", ":")).encode()
+        assert dfa.matches(payload), f"grammar must accept fewshot: {payload[:80]}"
+
+
+def test_intent_dfa_rejects_structural_garbage():
+    dfa = intent_dfa()
+    assert not dfa.matches(b"{}")
+    assert not dfa.matches(b'{"version":"2.0","intents":[],"context_updates":{},"confidence":0.5,"tts_summary":null,"follow_up_question":null}')
+    assert not dfa.matches(b'{"version":"1.0","intents":[{"type":"fly"}],"context_updates":{},"confidence":0.5,"tts_summary":null,"follow_up_question":null}')
+
+
+def test_sampled_strings_always_validate():
+    dfa = intent_dfa()
+    rng = np.random.default_rng(1234)
+    for _ in range(100):
+        sample = sample_dfa(dfa, rng)
+        model, err = parse_response_from_json(sample.decode())
+        assert model is not None, f"DFA sample failed schema: {err} :: {sample[:120]}"
+
+
+def test_intent_regex_is_compact_json():
+    assert " " not in intent_regex().replace("[ ", "").replace(" !", "")
+
+
+# ---------------------------------------------------------------- tokenizer
+
+
+def test_tokenizer_roundtrip_ascii_and_unicode():
+    tok = Tokenizer.build(corpus=["the quick brown fox"], literals=['"type":'])
+    for text in ["hello world", '{"type":"search"}', "café ☕ non-ascii", ""]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_tokenizer_uses_schema_literals():
+    tok, _ = build_intent_fsm()
+    ids = tok.encode('{"version":"1.0","intents":[')
+    # the whole prefix is one injected literal
+    assert len(ids) == 1
+
+
+def test_tokenizer_bos_eos():
+    tok, _ = build_intent_fsm()
+    ids = tok.encode("x", bos=True, eos=True)
+    assert ids[0] == BOS_ID and ids[-1] == EOS_ID
+
+
+# ---------------------------------------------------------------- token FSM
+
+
+def test_fsm_walk_fewshots_to_accept():
+    tok, fsm = build_intent_fsm()
+    for _, resp in FEWSHOTS:
+        payload = json.dumps(resp, separators=(",", ":"))
+        state = fsm.walk(tok.encode(payload))
+        assert state >= 0 and fsm.accepting[state]
+        assert fsm.mask[state, EOS_ID], "EOS must be allowed at accept"
+
+
+def test_fsm_masks_disallow_garbage_from_start():
+    tok, fsm = build_intent_fsm()
+    start_allowed = fsm.mask[fsm.start]
+    # 'z' byte token can never start the JSON
+    z_id = tok.encode("z")[0]
+    assert not start_allowed[z_id]
+    # the canonical opening literal must be allowed
+    open_id = tok.encode('{"version":"1.0","intents":[')[0]
+    assert start_allowed[open_id]
+    assert not start_allowed[EOS_ID]
+
+
+def test_fsm_every_live_state_has_a_move():
+    _, fsm = build_intent_fsm()
+    # no live state may be a dead end with EOS disallowed (decode would stall)
+    stuck = ~fsm.mask.any(axis=1)
+    assert not stuck.any(), f"{stuck.sum()} states have no allowed token"

@@ -1,0 +1,17 @@
+from .regexlang import compile_regex, DFA
+from .jsonschema import schema_to_regex
+from .tokenizer import Tokenizer, train_bpe
+from .fsm import TokenFSM
+from .intent_grammar import build_intent_fsm, intent_regex, default_tokenizer
+
+__all__ = [
+    "compile_regex",
+    "DFA",
+    "schema_to_regex",
+    "Tokenizer",
+    "train_bpe",
+    "TokenFSM",
+    "build_intent_fsm",
+    "intent_regex",
+    "default_tokenizer",
+]

@@ -1,0 +1,113 @@
+"""Token-level FSM: lift a byte DFA to a (state, token) transition table.
+
+The table is the device-side artifact of grammar-constrained decoding: at each
+decode step the engine gathers ``mask[state]`` (a vocab-sized boolean row) and
+adds ``-inf`` to disallowed logits — per-sequence FSM state advances with a
+second gather. No host round-trip per token (SURVEY.md §7 hard part #1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .regexlang import DFA
+from .tokenizer import Tokenizer, EOS_ID, BOS_ID, PAD_ID
+
+
+class TokenFSM:
+    """Dense (num_states, vocab) transition + mask tables.
+
+    Attributes:
+      next_state: int32 (S, V); -1 = dead/disallowed. EOS column loops in place
+                  on accepting states.
+      mask:       bool (S, V); True = token allowed in this state (EOS allowed
+                  exactly on accepting states).
+      start:      start state id.
+    """
+
+    def __init__(self, dfa: DFA, tokenizer: Tokenizer):
+        S = dfa.num_states
+        V = tokenizer.vocab_size
+        # byte-expanded transitions: (S, 256)
+        trans_b = dfa.trans[:, dfa.class_of]
+        next_tab = np.full((S, V), -1, dtype=np.int32)
+
+        identity = np.arange(S, dtype=np.int32)
+        # Iterative DFS over the vocab trie; vec[s] = DFA state reached from s
+        # after consuming the trie prefix (-1 = dead). Vectorized over states.
+        stack: list[tuple[dict, np.ndarray]] = [(tokenizer._trie, identity)]
+        while stack:
+            node, vec = stack.pop()
+            alive = vec >= 0
+            for key, child in node.items():
+                if key == -1:
+                    next_tab[:, child] = vec
+                else:
+                    nvec = np.where(alive, trans_b[np.maximum(vec, 0), key], -1)
+                    if (nvec >= 0).any():
+                        stack.append((child, nvec))
+
+        next_tab[:, PAD_ID] = -1
+        next_tab[:, BOS_ID] = -1
+        # EOS: allowed on accepting states; keeps the state (finished seqs are
+        # excluded from further grammar stepping by the engine).
+        next_tab[:, EOS_ID] = np.where(dfa.accepting, identity, -1)
+
+        self.next_state = next_tab
+        self.mask = next_tab >= 0
+        self.start = dfa.start
+        self.num_states = S
+        self.vocab_size = V
+        self.accepting = dfa.accepting.copy()
+
+    def allowed(self, state: int) -> np.ndarray:
+        return self.mask[state]
+
+    def step(self, state: int, token_id: int) -> int:
+        return int(self.next_state[state, token_id])
+
+    def walk(self, token_ids: list[int]) -> int:
+        s = self.start
+        for t in token_ids:
+            s = self.step(s, t)
+            if s < 0:
+                return s
+        return s
+
+
+def sample_dfa(dfa: DFA, rng: np.random.Generator, max_len: int = 4000) -> bytes:
+    """Random-walk the DFA to an accepting state (test/debug helper)."""
+    # representative bytes per class
+    by_class: dict[int, list[int]] = {}
+    for b in range(256):
+        by_class.setdefault(int(dfa.class_of[b]), []).append(b)
+    out = bytearray()
+    s = dfa.start
+    for _ in range(max_len):
+        if dfa.accepting[s] and rng.random() < 0.3:
+            return bytes(out)
+        classes = np.nonzero(dfa.trans[s] >= 0)[0]
+        if len(classes) == 0:
+            if dfa.accepting[s]:
+                return bytes(out)
+            raise RuntimeError("stuck in non-accepting state with no moves")
+        c = int(rng.choice(classes))
+        b = int(rng.choice(by_class[c]))
+        out.append(b)
+        s = int(dfa.trans[s, c])
+    # budget exhausted: walk greedily toward accept by preferring structural bytes
+    for _ in range(2000):
+        if dfa.accepting[s]:
+            return bytes(out)
+        classes = np.nonzero(dfa.trans[s] >= 0)[0]
+        # prefer classes containing closing punctuation to terminate quickly
+        pick = None
+        for c in classes:
+            if any(ch in by_class[int(c)] for ch in (0x22, 0x5D, 0x7D, 0x2C, 0x3A)):
+                pick = int(c)
+                break
+        c = pick if pick is not None else int(classes[0])
+        b = by_class[c][0]
+        out.append(b)
+        s = int(dfa.trans[s, c])
+    raise RuntimeError("could not reach accepting state")

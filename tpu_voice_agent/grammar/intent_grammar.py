@@ -1,0 +1,119 @@
+"""The intent grammar: ParseResponse schema -> regex -> DFA -> TokenFSM.
+
+Single source of truth: ``schemas.ParseResponse`` (pydantic). Everything here
+is derived and cached at process level. The reference instead *hoped* the LLM
+emitted valid JSON and re-asked on failure (apps/brain/src/server.ts:110-121).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ..schemas import INTENT_TYPES, TARGET_STRATEGIES, ParseResponse
+from .jsonschema import schema_to_regex
+from .regexlang import DFA, compile_regex
+from .tokenizer import Tokenizer
+from .fsm import TokenFSM
+
+
+def schema_literals() -> list[str]:
+    """Vocab pieces that make intent JSON decode in few tokens."""
+    lits: list[str] = []
+    keys = [
+        "version",
+        "intents",
+        "type",
+        "target",
+        "strategy",
+        "value",
+        "role",
+        "name",
+        "args",
+        "priority",
+        "requires_confirmation",
+        "timeout_ms",
+        "retries",
+        "context_updates",
+        "confidence",
+        "tts_summary",
+        "follow_up_question",
+        "text",
+        "context",
+        "session_id",
+        "query",
+        "url",
+        "field",
+        "direction",
+        "index",
+        "fileRef",
+        "format",
+        "last_query",
+    ]
+    for k in keys:
+        lits.append(f'"{k}":')
+        lits.append(f',"{k}":')
+    for t in INTENT_TYPES:
+        lits.append(f'"{t}"')
+    for s in TARGET_STRATEGIES:
+        lits.append(f'"{s}"')
+    lits += [
+        '{"version":"1.0","intents":[',
+        '{"type":',
+        'null,',
+        "null}",
+        "null",
+        "true",
+        "false",
+        "true,",
+        "false,",
+        '":null',
+        "[]",
+        "{}",
+        "}]",
+        "},{",
+        '":{"',
+        '"},',
+        '"}',
+        '{"',
+        '":"',
+        '","',
+        "15000",
+        "10000",
+        "0.9",
+        "0.8",
+        ":1,",
+        ":0,",
+        ":0}",
+        "<|system|>\n",
+        "<|user|>\n",
+        "<|assistant|>\n",
+    ]
+    return lits
+
+
+def intent_regex() -> str:
+    schema = ParseResponse.model_json_schema()
+    return schema_to_regex(schema, overrides={"version": r'"1\.0"'})
+
+
+@lru_cache(maxsize=1)
+def intent_dfa() -> DFA:
+    return compile_regex(intent_regex())
+
+
+@lru_cache(maxsize=1)
+def default_tokenizer() -> Tokenizer:
+    from ..services.prompts import corpus_for_tokenizer
+
+    return Tokenizer.build(
+        corpus=corpus_for_tokenizer(),
+        literals=schema_literals(),
+        vocab_size=4096,
+    )
+
+
+@lru_cache(maxsize=1)
+def build_intent_fsm() -> tuple[Tokenizer, TokenFSM]:
+    tok = default_tokenizer()
+    fsm = TokenFSM(intent_dfa(), tok)
+    return tok, fsm

@@ -84,9 +84,9 @@ class Target(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
     strategy: TargetStrategy = "auto"
-    value: str | None = Field(default=None, max_length=512)
-    role: str | None = Field(default=None, max_length=64)
-    name: str | None = Field(default=None, max_length=256)
+    value: str | None = Field(default=None, max_length=4096)
+    role: str | None = Field(default=None, max_length=4096)
+    name: str | None = Field(default=None, max_length=4096)
 
 
 class Intent(BaseModel):
@@ -125,8 +125,8 @@ class ParseResponse(BaseModel):
     intents: list[Intent] = Field(default_factory=list, max_length=8)
     context_updates: dict[str, str | int | float | bool | None] = Field(default_factory=dict)
     confidence: float = Field(ge=0.0, le=1.0)
-    tts_summary: str | None = Field(default=None, max_length=512)
-    follow_up_question: str | None = Field(default=None, max_length=512)
+    tts_summary: str | None = Field(default=None, max_length=4096)
+    follow_up_question: str | None = Field(default=None, max_length=4096)
 
 
 class ExecuteRequest(BaseModel):
