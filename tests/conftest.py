@@ -2,13 +2,20 @@
 
 All model/mesh tests run on CPU with 8 virtual XLA devices
 (SURVEY.md §4: mirror the reference's seam strategy; multi-chip behavior is
-validated via xla_force_host_platform_device_count). Must run before any
-``import jax`` in test modules.
+validated via xla_force_host_platform_device_count).
+
+NOTE: this environment's axon TPU plugin force-prepends itself to
+``jax_platforms`` regardless of the JAX_PLATFORMS env var, so we must also
+override the config after import — before any backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

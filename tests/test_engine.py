@@ -1,0 +1,75 @@
+"""Decode engine: grammar-constrained generation always yields valid intents.
+
+The money test: a RANDOM-weight tiny model (worst-case language model) must
+still emit schema-valid ParseResponse JSON under the grammar constraint —
+the property that lets the brain service drop the reference's repair loop.
+"""
+
+import jax
+import pytest
+
+from tpu_voice_agent.schemas import parse_response_from_json
+from tpu_voice_agent.serve import DecodeEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(preset="test-tiny", max_len=1024, prefill_buckets=(64, 128, 256, 512))
+
+
+def test_constrained_generation_is_always_valid(engine):
+    res = engine.generate("parse this: search for shoes", max_new_tokens=400, greedy=True)
+    assert res.finished, f"decode should reach EOS, got {res.steps} steps: {res.text[:120]}"
+    model, err = parse_response_from_json(res.text)
+    assert model is not None, f"constrained output failed validation: {err}"
+
+
+def test_constrained_sampling_is_always_valid(engine):
+    res = engine.generate(
+        "anything at all", max_new_tokens=400, greedy=False, temperature=1.5
+    )
+    assert res.finished
+    model, err = parse_response_from_json(res.text)
+    assert model is not None, err
+
+
+def test_engine_is_reusable_across_requests(engine):
+    """Cache reuse across requests must not leak previous-request state."""
+    r1 = engine.generate("first request with a long utterance to parse", max_new_tokens=300)
+    r2 = engine.generate("x", max_new_tokens=300)
+    for r in (r1, r2):
+        model, err = parse_response_from_json(r.text)
+        assert model is not None, err
+
+
+def test_device_loop_matches_stepwise_greedy(engine):
+    """The on-device while_loop generation must produce exactly the host
+    stepwise loop's tokens under greedy decoding."""
+    prompt = "search for usb hubs then screenshot"
+    a = engine.generate(prompt, max_new_tokens=300, greedy=True)
+    b = engine.generate_stepwise(prompt, max_new_tokens=300, greedy=True)
+    assert a.token_ids == b.token_ids
+
+
+def test_prompt_too_long_raises(engine):
+    with pytest.raises(ValueError):
+        engine.generate("word " * 2000)
+
+
+def test_truncation_reports_unfinished(engine):
+    res = engine.generate("truncate me", max_new_tokens=300, byte_budget=25)
+    assert not res.finished, "byte-budget truncation must not report finished"
+
+
+def test_dp_mesh_requires_divisible_batch_slots():
+    from tpu_voice_agent.parallel.mesh import make_mesh
+    from tpu_voice_agent.serve import DecodeEngine
+
+    with pytest.raises(ValueError, match="divisible"):
+        DecodeEngine(preset="test-tiny", mesh=make_mesh(dp=2, tp=1), batch_slots=1)
+
+
+def test_generation_result_stats(engine):
+    res = engine.generate("measure me", max_new_tokens=300)
+    assert res.prefill_ms > 0 and res.steps > 0
+    assert res.tokens_per_s > 0

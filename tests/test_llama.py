@@ -1,0 +1,122 @@
+"""Model correctness: KV-cache decode equivalence and TP-sharded equivalence.
+
+Mirrors the reference's seam strategy (SURVEY.md §4): everything runs on CPU
+with 8 virtual devices; multi-chip behavior is validated on a (1, tp) mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.models.llama import (
+    LlamaConfig,
+    PRESETS,
+    forward,
+    init_kv_cache,
+    init_params,
+    param_count,
+)
+from tpu_voice_agent.parallel.mesh import (
+    default_rules,
+    kv_cache_shardings,
+    make_mesh,
+    param_shardings,
+)
+
+CFG = LlamaConfig(
+    vocab_size=64, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def test_param_count_matches_preset_scale():
+    from dataclasses import replace
+
+    # with their real vocabs (32k / 128k) the presets hit the advertised sizes
+    assert 1.0e9 < param_count(replace(PRESETS["tinyllama-1.1b"], vocab_size=32000)) < 1.3e9
+    assert 7.5e9 < param_count(replace(PRESETS["llama3-8b"], vocab_size=128256)) < 8.5e9
+
+
+def test_full_forward_shapes(params):
+    T = 8
+    tokens = jnp.arange(T, dtype=jnp.int32)[None, :] % CFG.vocab_size
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    cache = init_kv_cache(CFG, 1, CFG.max_seq_len, dtype=jnp.float32)
+    logits, cache2 = forward(params, CFG, tokens, positions, cache)
+    assert logits.shape == (1, T, CFG.vocab_size)
+    assert cache2["k"].shape == (CFG.n_layers, 1, CFG.max_seq_len, CFG.n_kv_heads, CFG.head_dim)
+
+
+def test_incremental_decode_matches_full_forward(params):
+    """Token-by-token decode through the KV cache must reproduce the full
+    (teacher-forced) forward logits — validates cache writes, RoPE positions,
+    and causal masking in one shot."""
+    T = 12
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, size=(1, T)), dtype=jnp.int32)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    cache = init_kv_cache(CFG, 1, CFG.max_seq_len, dtype=jnp.float32)
+    full_logits, _ = forward(params, CFG, tokens, positions, cache)
+
+    cache = init_kv_cache(CFG, 1, CFG.max_seq_len, dtype=jnp.float32)
+    step_logits = []
+    for t in range(T):
+        lg, cache = forward(
+            params, CFG, tokens[:, t : t + 1], positions[:, t : t + 1], cache
+        )
+        step_logits.append(lg[:, 0, :])
+    step_logits = jnp.stack(step_logits, axis=1)
+
+    np.testing.assert_allclose(
+        np.asarray(full_logits), np.asarray(step_logits), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_padded_prefill_matches_exact(params):
+    """Pad tokens written past the frontier must never leak into real logits."""
+    T = 6
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, CFG.vocab_size, size=(1, T))
+    cache = init_kv_cache(CFG, 1, CFG.max_seq_len, dtype=jnp.float32)
+    exact, _ = forward(
+        params, CFG, jnp.asarray(toks, jnp.int32), jnp.arange(T, dtype=jnp.int32)[None, :], cache
+    )
+    padded = np.zeros((1, 16), dtype=np.int32)
+    padded[0, :T] = toks
+    cache = init_kv_cache(CFG, 1, CFG.max_seq_len, dtype=jnp.float32)
+    pad_logits, _ = forward(
+        params, CFG, jnp.asarray(padded), jnp.arange(16, dtype=jnp.int32)[None, :], cache
+    )
+    np.testing.assert_allclose(
+        np.asarray(exact[:, :T]), np.asarray(pad_logits[:, :T]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_tp_sharded_forward_matches_unsharded(params):
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    mesh = make_mesh(dp=1, tp=2)
+    rules = default_rules(mesh, CFG.n_kv_heads, CFG.n_heads)
+    sharded_params = jax.device_put(params, param_shardings(mesh, CFG.n_kv_heads))
+    cache = init_kv_cache(CFG, 1, CFG.max_seq_len, dtype=jnp.float32)
+    sharded_cache = jax.device_put(cache, kv_cache_shardings(mesh, CFG.n_kv_heads))
+
+    T = 8
+    tokens = (jnp.arange(T, dtype=jnp.int32)[None, :] * 3) % CFG.vocab_size
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    ref_logits, _ = forward(params, CFG, tokens, positions, cache)
+    tp_logits, _ = forward(sharded_params, CFG, tokens, positions, sharded_cache, rules)
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(tp_logits), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_mesh_too_big_raises():
+    with pytest.raises(ValueError):
+        make_mesh(dp=4, tp=4)

@@ -1,0 +1,3 @@
+from .llama import LlamaConfig, init_params, forward, init_kv_cache, PRESETS
+
+__all__ = ["LlamaConfig", "init_params", "forward", "init_kv_cache", "PRESETS"]

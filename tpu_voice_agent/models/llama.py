@@ -1,0 +1,232 @@
+"""Llama-family decoder, TPU-first functional JAX.
+
+This is the in-tree replacement for the reference's cloud LLM call
+(apps/brain/src/llm.ts:19-30). Design choices for the TPU:
+
+- params are a flat pytree with layers *stacked* on a leading axis and the
+  forward pass is a ``lax.scan`` over layers: one trace regardless of depth,
+  fast compiles for 70B-class configs, and remat-friendly for training
+- all matmuls run in bfloat16 with float32 accumulation on the MXU
+  (``preferred_element_type``); softmax/norms in float32 on the VPU
+- static shapes everywhere: the KV cache is a dense ``(L, B, S, n_kv, hd)``
+  ring the engine buckets by sequence length; attention uses position masks,
+  never dynamic slice sizes
+- grouped-query attention + RoPE, SwiGLU MLP, RMSNorm (Llama 2/3 and
+  TinyLlama all instantiate from ``LlamaConfig``)
+- tensor-parallel sharding is injected from the outside via
+  ``parallel.ShardingRules`` constraints; the math code never mentions a mesh
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------- config
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 4096
+    dim: int = 2048
+    n_layers: int = 22
+    n_heads: int = 32
+    n_kv_heads: int = 4
+    ffn_dim: int = 5632
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# Parameter-count-faithful presets; vocab_size is overridden from the
+# tokenizer at engine start.
+PRESETS: dict[str, LlamaConfig] = {
+    "test-tiny": LlamaConfig(dim=128, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=256, max_seq_len=256),
+    "tinyllama-1.1b": LlamaConfig(dim=2048, n_layers=22, n_heads=32, n_kv_heads=4, ffn_dim=5632),
+    "llama3-8b": LlamaConfig(
+        dim=4096, n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336, rope_theta=500_000.0, max_seq_len=8192
+    ),
+    "llama3-70b": LlamaConfig(
+        dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672, rope_theta=500_000.0, max_seq_len=8192
+    ),
+}
+
+
+# ---------------------------------------------------------------- params
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    """Random init. Layer weights are stacked on a leading n_layers axis."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    nq, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=dtype)
+
+    def w_init(key, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5)
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    ks = jax.random.split(k_layers, 8)
+    return {
+        "embed": w_init(k_embed, cfg.vocab_size, d, scale=d**-0.5),
+        "layers": {
+            "attn_norm": norm_init(L, d),
+            "wq": w_init(ks[0], L, d, nq * hd),
+            "wk": w_init(ks[1], L, d, nkv * hd),
+            "wv": w_init(ks[2], L, d, nkv * hd),
+            "wo": w_init(ks[3], L, nq * hd, d),
+            "mlp_norm": norm_init(L, d),
+            "w_gate": w_init(ks[4], L, d, f),
+            "w_up": w_init(ks[5], L, d, f),
+            "w_down": w_init(ks[6], L, f, d),
+        },
+        "final_norm": norm_init(d),
+        "lm_head": w_init(k_head, d, cfg.vocab_size),
+    }
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+
+
+# ---------------------------------------------------------------- ops
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def rope_tables(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
+    """cos/sin for rotary embedding; positions (B, T) -> (B, T, hd//2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, T, H, hd); rotate pairs (split-half convention)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c, s = cos[:, :, None, :], sin[:, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _attend(q, k_cache, v_cache, q_positions, kv_len_mask):
+    """GQA attention of q (B,T,nq,hd) against the full cache (B,S,nkv,hd).
+
+    kv_len_mask: (B, S) bool — which cache slots hold valid keys.
+    Causality: key_position <= query_position, tracked via positions stored
+    implicitly by slot index (slot i holds the token at position i).
+    """
+    B, T, nq, hd = q.shape
+    S = k_cache.shape[1]
+    nkv = k_cache.shape[2]
+    group = nq // nkv
+
+    qg = q.reshape(B, T, nkv, group, hd)
+    scores = jnp.einsum("btkgh,bskh->bkgts", qg, k_cache, preferred_element_type=jnp.float32)
+    scores = scores * (hd**-0.5)
+
+    slot_pos = jnp.arange(S)[None, None, :]  # (1, 1, S)
+    causal = slot_pos <= q_positions[:, :, None]  # (B, T, S)
+    mask = causal & kv_len_mask[:, None, :]
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v_cache.dtype), v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, T, nq * hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------- forward
+
+
+@partial(jax.jit, static_argnames=("cfg", "rules"))
+def forward(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, T) int32
+    positions: jax.Array,  # (B, T) int32 — absolute positions of `tokens`
+    kv_cache: dict,  # (L, B, S, nkv, hd)
+    rules=None,  # parallel.ShardingRules | None
+) -> tuple[jax.Array, dict]:
+    """Unified prefill/decode forward.
+
+    Writes k/v for `tokens` into cache slots [positions], attends over the
+    whole cache with causal+validity masks, returns logits (B, T, V) and the
+    updated cache. T is static per bucket; prefill uses T=bucket, decode T=1.
+    Padding tokens must carry position == their slot and are masked out by
+    the caller via `positions` (slots beyond a sequence's length are simply
+    never attended to because kv_len_mask derives from written positions).
+    """
+    B, T = tokens.shape
+    S = kv_cache["k"].shape[2]
+    cs = lambda x, name: rules.constrain(x, name) if rules is not None else x
+
+    x = params["embed"][tokens]  # (B, T, D)
+    x = cs(x, "act")
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    # validity mask: slot s valid if s <= max written position for that seq.
+    # caller guarantees contiguous writes, so max(positions) is the frontier.
+    frontier = jnp.max(positions, axis=1)  # (B,)
+    kv_len_mask = jnp.arange(S)[None, :] <= frontier[:, None]  # (B, S)
+
+    batch_idx = jnp.arange(B)[:, None]  # (B, 1) for scatter
+
+    def layer(x, layer_in):
+        p, k_cache, v_cache = layer_in
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        h = cs(h, "act")
+        q = jnp.einsum("btd,dh->bth", h, p["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.einsum("btd,dh->bth", h, p["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.einsum("btd,dh->bth", h, p["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = cs(q.reshape(B, T, cfg.n_heads, cfg.head_dim), "heads")
+        k = cs(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
+        v = cs(v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        k_cache = k_cache.at[batch_idx, positions].set(k)
+        v_cache = v_cache.at[batch_idx, positions].set(v)
+
+        attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
+        attn = jnp.einsum("bth,hd->btd", attn, p["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + cs(attn, "act")
+
+        h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        gate = jnp.einsum("btd,df->btf", h, p["w_gate"], preferred_element_type=jnp.float32)
+        up = jnp.einsum("btd,df->btf", h, p["w_up"], preferred_element_type=jnp.float32)
+        act = (jax.nn.silu(gate) * up).astype(x.dtype)
+        act = cs(act, "ffn")
+        down = jnp.einsum("btf,fd->btd", act, p["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + cs(down, "act")
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp),
+        x,
+        (params["layers"], kv_cache["k"], kv_cache["v"]),
+    )
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"], preferred_element_type=jnp.float32)
+    logits = cs(logits, "logits")
+    return logits, {"k": new_k, "v": new_v}
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    per_layer = d * (cfg.n_heads * hd) + 2 * d * (cfg.n_kv_heads * hd) + (cfg.n_heads * hd) * d
+    per_layer += 3 * d * f + 2 * d
+    return cfg.vocab_size * d * 2 + cfg.n_layers * per_layer + d

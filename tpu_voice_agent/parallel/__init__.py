@@ -1,0 +1,9 @@
+from .mesh import make_mesh, ShardingRules, default_rules, param_shardings, kv_cache_shardings
+
+__all__ = [
+    "make_mesh",
+    "ShardingRules",
+    "default_rules",
+    "param_shardings",
+    "kv_cache_shardings",
+]

@@ -1,0 +1,100 @@
+"""Device mesh + sharding rules (the NCCL/MPI replacement).
+
+The reference's "distributed backend" is HTTP/JSON between four Node
+processes on localhost (SURVEY.md §2 audit table). Here intra-model
+communication is XLA collectives over ICI, expressed declaratively: a
+``Mesh`` with (dp, tp) axes — sp for sequence parallelism lives in
+``parallel.ring`` — plus NamedSharding rules for params, activations, and KV
+cache. ``jax.jit`` inserts all-reduce/all-gather where the shardings demand;
+multi-host extends the same mesh over DCN via ``jax.distributed.initialize``.
+
+Tensor-parallel layout (Megatron-style, collective-minimal):
+- wq/wk/wv and w_gate/w_up shard their OUTPUT dim over tp (column parallel)
+- wo and w_down shard their INPUT dim over tp (row parallel) -> one psum per
+  attention block and one per MLP block, inserted automatically by XLA
+- embed is replicated (vocab is small for the intent grammar); lm_head
+  shards vocab and logits gather at the end
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: int = 1, tp: int = 1, devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if dp * tp > len(devices):
+        raise ValueError(f"mesh {dp}x{tp} needs {dp * tp} devices, have {len(devices)}")
+    arr = np.array(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(arr, ("dp", "tp"))
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Named activation-sharding constraints, injected into model forward.
+
+    Hashable (jit-static). ``specs`` maps constraint-point names used inside
+    model code to PartitionSpecs; absent names are unconstrained.
+    """
+
+    mesh: Mesh
+    specs: tuple[tuple[str, P], ...]
+
+    def constrain(self, x: jax.Array, name: str):
+        for key, spec in self.specs:
+            if key == name:
+                return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
+        return x
+
+
+def default_rules(mesh: Mesh, n_kv_heads: int, n_heads: int) -> ShardingRules:
+    tp = mesh.shape["tp"]
+    specs: list[tuple[str, P]] = [
+        ("act", P("dp", None, None)),
+        ("logits", P("dp", None, None)),
+        ("ffn", P("dp", None, "tp")),
+    ]
+    if n_heads % tp == 0:
+        specs.append(("heads", P("dp", None, "tp", None)))
+    if n_kv_heads % tp == 0:
+        specs.append(("kv_heads", P("dp", None, "tp", None)))
+    return ShardingRules(mesh=mesh, specs=tuple(specs))
+
+
+def param_shardings(mesh: Mesh, n_kv_heads: int) -> dict:
+    """NamedSharding pytree matching models.llama.init_params structure."""
+    tp_ok_kv = n_kv_heads % mesh.shape["tp"] == 0
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    col = ns(None, None, "tp")  # (L, d, out) shard out
+    row = ns(None, "tp", None)  # (L, in, d) shard in
+    rep2 = ns(None, None)
+    return {
+        "embed": rep2,
+        "layers": {
+            "attn_norm": rep2,
+            "wq": col,
+            "wk": col if tp_ok_kv else ns(None, None, None),
+            "wv": col if tp_ok_kv else ns(None, None, None),
+            "wo": row,
+            "mlp_norm": rep2,
+            "w_gate": col,
+            "w_up": col,
+            "w_down": row,
+        },
+        "final_norm": ns(None),
+        "lm_head": ns(None, "tp"),
+    }
+
+
+def kv_cache_shardings(mesh: Mesh, n_kv_heads: int) -> dict:
+    tp_ok = n_kv_heads % mesh.shape["tp"] == 0
+    spec = P(None, "dp", None, "tp", None) if tp_ok else P(None, "dp", None, None, None)
+    ns = NamedSharding(mesh, spec)
+    return {"k": ns, "v": ns}

@@ -1,0 +1,3 @@
+from .engine import DecodeEngine, GenerationResult
+
+__all__ = ["DecodeEngine", "GenerationResult"]
