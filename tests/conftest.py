@@ -19,3 +19,22 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_engine():
+    """Shared tiny random-weight engine (compile once per test session)."""
+    from tpu_voice_agent.serve import DecodeEngine
+
+    return DecodeEngine(preset="test-tiny", max_len=2048, prefill_buckets=(64, 128, 256, 512, 1024))
+
+
+@pytest.fixture(scope="session")
+def tiny_batch_engine():
+    from tpu_voice_agent.serve import DecodeEngine
+
+    return DecodeEngine(
+        preset="test-tiny", max_len=1024, batch_slots=3, prefill_buckets=(64, 128, 256, 512)
+    )

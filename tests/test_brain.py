@@ -1,0 +1,136 @@
+"""Brain service contract tests.
+
+Mirrors the reference's apps/brain/test/parse.test.ts:1-101 — valid search
+parse, upload+confirmation+tts, follow-up question with low confidence — plus
+the error envelopes (400/422/500) against the real HTTP socket.
+"""
+
+import httpx
+import pytest
+
+from tpu_voice_agent.services.brain import (
+    EngineParser,
+    ParserError,
+    RuleBasedParser,
+    build_app,
+)
+from tests.http_helper import AppServer
+
+
+@pytest.fixture(scope="module")
+def rule_server():
+    with AppServer(build_app(RuleBasedParser())) as srv:
+        yield srv
+
+
+def test_health(rule_server):
+    r = httpx.get(rule_server.url + "/health")
+    assert r.status_code == 200 and r.json()["ok"] is True
+
+
+def test_parse_search(rule_server):
+    r = httpx.post(
+        rule_server.url + "/parse",
+        json={"text": "search for wireless headphones", "context": {}},
+    )
+    assert r.status_code == 200
+    body = r.json()
+    assert body["intents"][0]["type"] == "search"
+    assert body["intents"][0]["args"]["query"] == "wireless headphones"
+    assert body["context_updates"]["last_query"] == "wireless headphones"
+    assert 0 <= body["confidence"] <= 1
+
+
+def test_parse_upload_requires_confirmation(rule_server):
+    r = httpx.post(
+        rule_server.url + "/parse",
+        json={"text": "upload my resume and submit the form", "context": {}},
+    )
+    body = r.json()
+    assert r.status_code == 200
+    assert body["intents"][0]["type"] == "upload"
+    assert body["intents"][0]["requires_confirmation"] is True
+    assert body["tts_summary"]
+
+
+def test_parse_gibberish_low_confidence_follow_up(rule_server):
+    r = httpx.post(
+        rule_server.url + "/parse", json={"text": "florble the wug", "context": {}}
+    )
+    body = r.json()
+    assert body["intents"][0]["type"] == "unknown"
+    assert body["confidence"] <= 0.5
+    assert body["follow_up_question"]
+
+
+def test_invalid_request_400(rule_server):
+    r = httpx.post(rule_server.url + "/parse", json={"context": {}})
+    assert r.status_code == 400
+    assert r.json()["error"] == "invalid_request"
+    r = httpx.post(
+        rule_server.url + "/parse",
+        content=b"{not json",
+        headers={"content-type": "application/json"},
+    )
+    assert r.status_code == 400
+
+
+def test_trace_id_propagates(rule_server):
+    r = httpx.post(
+        rule_server.url + "/parse",
+        json={"text": "go back", "context": {}},
+        headers={"x-trace-id": "deadbeef"},
+    )
+    assert r.headers.get("x-trace-id") == "deadbeef"
+
+
+class _FailingParser:
+    def __init__(self, kind):
+        self.kind = kind
+
+    def parse(self, text, context):
+        if self.kind == "boom":
+            raise RuntimeError("engine fell over")
+        raise ParserError(self.kind, "nope")
+
+
+def test_parser_422_and_500_envelopes():
+    with AppServer(build_app(_FailingParser("schema_validation_failed"))) as srv:
+        r = httpx.post(srv.url + "/parse", json={"text": "x", "context": {}})
+        assert r.status_code == 422 and r.json()["error"] == "schema_validation_failed"
+    with AppServer(build_app(_FailingParser("boom"))) as srv:
+        r = httpx.post(srv.url + "/parse", json={"text": "x", "context": {}})
+        assert r.status_code == 500 and r.json()["error"] == "llm_error"
+
+
+def test_concurrent_parses_do_not_interleave(rule_server):
+    """Racing requests share one parser; the serialization lock must keep
+    each response self-consistent."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def post(q):
+        return httpx.post(
+            rule_server.url + "/parse", json={"text": f"search for {q}", "context": {}}
+        ).json()
+
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        results = list(ex.map(post, ["ants", "bees", "cats", "dogs"]))
+    for q, body in zip(["ants", "bees", "cats", "dogs"], results):
+        assert body["intents"][0]["args"]["query"] == q
+
+
+def test_engine_parser_end_to_end_http(tiny_engine):
+    """The full TPU-shaped path over a real socket: HTTP -> prompt render ->
+    grammar-constrained decode -> schema-validated ParseResponse."""
+    with AppServer(build_app(EngineParser(tiny_engine, max_new_tokens=300))) as srv:
+        r = httpx.post(
+            srv.url + "/parse",
+            json={"text": "search for 4k monitors", "context": {}},
+            timeout=180,
+        )
+        assert r.status_code in (200, 422)  # tiny random weights may truncate
+        if r.status_code == 200:
+            body = r.json()
+            assert "intents" in body and isinstance(body["intents"], list)
+        else:
+            assert r.json()["error"] == "schema_validation_failed"

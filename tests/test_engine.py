@@ -12,9 +12,9 @@ from tpu_voice_agent.schemas import parse_response_from_json
 from tpu_voice_agent.serve import DecodeEngine
 
 
-@pytest.fixture(scope="module")
-def engine():
-    return DecodeEngine(preset="test-tiny", max_len=1024, prefill_buckets=(64, 128, 256, 512))
+@pytest.fixture()
+def engine(tiny_engine):
+    return tiny_engine
 
 
 def test_constrained_generation_is_always_valid(engine):

@@ -90,16 +90,19 @@ def _first_token(last_logits, fsm_state, mask_table, next_table, key, temperatur
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "rules", "max_new", "greedy", "constrained"),
+    static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained"),
     donate_argnames=("cache",),
 )
-def _generate_loop(
+def chunk_decode_loop(
     params,
     cfg: LlamaConfig,
     cache,
-    last_logits,  # (B, V) prefill logits at the last prompt position
-    start_pos,  # (B,) int32 first decode write slot
+    cur,  # (B,) current token per row
+    pos,  # (B,) next write slot per row
     fsm_state,  # (B,) int32
+    active,  # (B,) bool -- row is mid-generation
+    nbytes,  # (B,) bytes emitted so far
+    tokens_left,  # (B,) remaining token budget per row
     mask_table,
     next_table,
     byte_len_table,  # (V,) int32 bytes each token contributes
@@ -107,68 +110,67 @@ def _generate_loop(
     temperature,
     byte_budget: jax.Array,  # scalar int32
     rules=None,
-    max_new: int = 512,
+    chunk_steps: int = 32,
     greedy: bool = True,
     constrained: bool = True,
 ):
-    """Whole-generation device loop: one host dispatch per utterance.
+    """THE decode loop: advance every active row by up to chunk_steps tokens
+    entirely on device.
 
-    The per-step host round trip is fatal here — the TPU sits behind a
-    tunnel, so a host-driven loop pays ~wire-latency per token. Everything
-    (sampling, grammar stepping, EOS/byte-budget exit) stays on device; the
-    host gets back (tokens, count, finished) once.
+    One host dispatch per chunk -- per-token host round trips are fatal when
+    the chip sits behind a tunnel. Single-request generation calls this with
+    B=1 and chunk_steps=max_new_tokens; the continuous batcher calls it with
+    B=slots and a small chunk so new requests join at chunk boundaries. Idle
+    rows park their cache writes in the trash slot (max_len - 1).
+
+    Returns (emitted (B, chunk_steps), counts, eos_flags, cache, cur, pos,
+    fsm_state, active, nbytes, tokens_left). eos is True only for rows that
+    sampled EOS (clean finish) -- budget/length truncation leaves it False.
     """
-    B = last_logits.shape[0]
+    B = cur.shape[0]
     max_len = cache["k"].shape[2]
+    out = jnp.full((B, chunk_steps), PAD_ID, dtype=jnp.int32)
+    # rows already stopped before the loop: EOS right at admission
+    eos0 = (~active) & (cur == EOS_ID)
 
-    key, k0 = jax.random.split(key)
-    tok0, fsm0 = _mask_sample_advance(
-        last_logits, fsm_state, mask_table, next_table, k0, temperature, greedy, constrained
-    )
-
-    out_buf = jnp.zeros((B, max_new), dtype=jnp.int32)
-    eos0 = tok0 == EOS_ID
-    carry0 = (
-        cache,
-        tok0,
-        start_pos,
-        fsm0,
-        out_buf,
-        jnp.zeros((B,), jnp.int32),  # n emitted
-        eos0,  # done (any stop reason)
-        eos0,  # eos (clean finish only)
-        jnp.zeros((B,), jnp.int32),  # bytes emitted
-        key,
-        jnp.zeros((), jnp.int32),  # step
-    )
+    carry0 = (cache, cur, pos, fsm_state, active, eos0, nbytes, tokens_left, out,
+              jnp.zeros((B,), jnp.int32), key, jnp.zeros((), jnp.int32))
 
     def cond(c):
-        done, step = c[6], c[10]
-        return jnp.logical_and(step < max_new, ~jnp.all(done))
+        active, step = c[4], c[11]
+        return jnp.logical_and(step < chunk_steps, jnp.any(active))
 
     def body(c):
-        cache, cur, pos, state, buf, n, done, eos, nbytes, key, step = c
-        # record cur for unfinished seqs
-        live = ~done
-        buf = buf.at[jnp.arange(B), jnp.minimum(n, max_new - 1)].set(
-            jnp.where(live, cur, buf[jnp.arange(B), jnp.minimum(n, max_new - 1)])
+        cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
+        # record current token for active rows
+        out = out.at[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)].set(
+            jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)])
         )
-        n = n + live.astype(jnp.int32)
-        nbytes = nbytes + jnp.where(live, byte_len_table[cur], 0)
+        n = n + active.astype(jnp.int32)
+        nbytes = nbytes + jnp.where(active, byte_len_table[cur], 0)
+        left = left - active.astype(jnp.int32)
 
-        logits, cache = forward(params, cfg, cur[:, None], pos[:, None], cache, rules)
+        # idle rows park their writes in the trash slot
+        write_pos = jnp.where(active, pos, max_len - 1)
+        step_tok = jnp.where(active, cur, PAD_ID)
+        logits, cache = forward(params, cfg, step_tok[:, None], write_pos[:, None], cache, rules)
         key, k = jax.random.split(key)
-        nxt, state = _mask_sample_advance(
+        nxt, state_next = _mask_sample_advance(
             logits[:, 0, :], state, mask_table, next_table, k, temperature, greedy, constrained
         )
+        state = jnp.where(active, state_next, state)
+        cur = jnp.where(active, nxt, cur)
+        pos = jnp.where(active, pos + 1, pos)
 
-        pos_next = jnp.where(live, pos + 1, pos)
-        eos = eos | (live & (nxt == EOS_ID))
-        done = done | (nxt == EOS_ID) | (nbytes >= byte_budget) | (pos_next >= max_len - 1)
-        return (cache, nxt, pos_next, state, buf, n, done, eos, nbytes, key, step + 1)
+        eos = eos | (active & (cur == EOS_ID))
+        stop = (cur == EOS_ID) | (nbytes >= byte_budget) | (pos >= max_len - 1) | (left <= 0)
+        active = active & ~stop
+        return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
 
-    cache, _, _, _, buf, n, _, eos, _, _, _ = jax.lax.while_loop(cond, body, carry0)
-    return buf, n, eos, cache
+    (cache, cur, pos, state, active, eos, nbytes, left, out, n, _, _) = jax.lax.while_loop(
+        cond, body, carry0
+    )
+    return out, n, eos, cache, cur, pos, state, active, nbytes, left
 
 
 class DecodeEngine:
@@ -273,17 +275,25 @@ class DecodeEngine:
         t0 = time.perf_counter()
         last_logits, n = self._prefill(prompt)
         fsm_state = jnp.full((1,), self.fsm.start, dtype=jnp.int32)
-        self._rng, key = jax.random.split(self._rng)
-        last_logits.block_until_ready()
+        self._rng, k0 = jax.random.split(self._rng)
+        tok0, fsm0 = _first_token(
+            last_logits, fsm_state, self.mask_table, self.next_table, k0,
+            jnp.float32(temperature), greedy=greedy, constrained=constrained,
+        )
+        tok0.block_until_ready()
         prefill_ms = (time.perf_counter() - t0) * 1e3
 
         t1 = time.perf_counter()
-        buf, count, eos, self.cache = _generate_loop(
-            self.params, self.cfg, self.cache, last_logits,
-            jnp.full((1,), n, dtype=jnp.int32), fsm_state,
+        self._rng, key = jax.random.split(self._rng)
+        buf, count, eos, self.cache, *_ = chunk_decode_loop(
+            self.params, self.cfg, self.cache,
+            tok0, jnp.full((1,), n, dtype=jnp.int32), fsm0,
+            tok0 != EOS_ID,  # active
+            jnp.zeros((1,), jnp.int32),  # nbytes
+            jnp.full((1,), max_new_tokens, dtype=jnp.int32),  # tokens_left
             self.mask_table, self.next_table, self.byte_len_table,
             key, jnp.float32(temperature), jnp.int32(byte_budget),
-            rules=self.rules, max_new=max_new_tokens,
+            rules=self.rules, chunk_steps=max_new_tokens,
             greedy=greedy, constrained=constrained,
         )
         count_h = int(jax.device_get(count)[0])
@@ -348,6 +358,11 @@ class DecodeEngine:
             )
             pos += 1
             steps += 1
+        else:
+            # token budget exhausted: the final sampled-but-unemitted token
+            # may be a clean EOS (parity with the device loop's eos flag)
+            if int(jax.device_get(cur)[0]) == EOS_ID:
+                finished = True
         decode_ms = (time.perf_counter() - t1) * 1e3
 
         return GenerationResult(

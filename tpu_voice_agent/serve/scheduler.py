@@ -1,0 +1,196 @@
+"""Continuous-batching scheduler: the TPU replacement for event-loop concurrency.
+
+The reference's concurrency story is four Node event loops and a per-client
+debounce (SURVEY.md §2 strategy table, "request-level concurrency"). Here the
+equivalent is slot-based continuous batching on one device mesh:
+
+- the KV cache holds `batch_slots` independent sequences (cache row = slot)
+- admission: a new request prefills into a free slot while other slots keep
+  their state; rows not being written aim their cache writes at a dedicated
+  trash slot (S-1), so no masked-write path is needed in the model
+- decode advances ALL active slots together in chunked on-device loops
+  (`chunk_steps` per dispatch): one host round-trip per chunk, not per token
+  — critical over a tunneled chip — while keeping admission latency bounded
+  by chunk_steps * per-token time
+- per-slot grammar FSM state rides along on device; finished slots park
+
+This is SURVEY.md §7 step 2's "continuous-batching scheduler" and hard part
+(1): per-sequence FSM state with vectorized logit masks, no host round-trip
+per token.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..grammar.tokenizer import PAD_ID
+from ..models.llama import forward
+from .engine import DecodeEngine, GenerationResult, _mask_sample_advance, chunk_decode_loop
+
+
+
+
+@dataclass
+class _Slot:
+    request_id: int = -1
+    token_ids: list = field(default_factory=list)
+    start_s: float = 0.0
+    prefill_ms: float = 0.0
+    prompt_len: int = 0
+    eos: bool = False
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over a DecodeEngine's model+cache.
+
+    Synchronous core (submit/step/drain); services wrap it with a thread or
+    asyncio executor. Every admitted request decodes concurrently with the
+    others; new arrivals join at chunk boundaries.
+    """
+
+    def __init__(self, engine: DecodeEngine, chunk_steps: int = 32,
+                 greedy: bool = True, temperature: float = 0.7,
+                 byte_budget: int = 3900, max_new_tokens: int = 512):
+        if engine.batch_slots < 1:
+            raise ValueError("engine needs at least one batch slot")
+        self.engine = engine
+        self.B = engine.batch_slots
+        self.chunk_steps = chunk_steps
+        self.greedy = greedy
+        self.temperature = temperature
+        self.byte_budget = byte_budget
+        self.max_new_tokens = max_new_tokens
+
+        S = engine.max_len
+        # device-resident per-slot state
+        self.cur = jnp.full((self.B,), PAD_ID, dtype=jnp.int32)
+        self.pos = jnp.full((self.B,), S - 1, dtype=jnp.int32)
+        self.fsm = jnp.zeros((self.B,), dtype=jnp.int32)
+        self.active = jnp.zeros((self.B,), dtype=bool)
+        self.nbytes = jnp.zeros((self.B,), dtype=jnp.int32)
+        self.tokens_left = jnp.zeros((self.B,), dtype=jnp.int32)
+
+        self.slots: list[_Slot] = [_Slot() for _ in range(self.B)]
+        self.pending: list[tuple[int, str]] = []
+        self.results: dict[int, GenerationResult] = {}
+        self._next_id = 0
+        self._rng = jax.random.PRNGKey(1234)
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, prompt: str) -> int:
+        rid = self._next_id
+        self._next_id += 1
+        self.pending.append((rid, prompt))
+        return rid
+
+    def _free_slot(self) -> int | None:
+        act = np.asarray(jax.device_get(self.active))
+        for b in range(self.B):
+            if not act[b] and self.slots[b].request_id < 0:
+                return b
+        return None
+
+    def _admit(self, slot: int, rid: int, prompt: str) -> None:
+        eng = self.engine
+        t0 = time.perf_counter()
+        ids = eng.tokenizer.encode(prompt, bos=True)
+        n = len(ids)
+        bucket = eng._bucket(n)
+        S = eng.max_len
+        tokens = np.full((self.B, bucket), PAD_ID, dtype=np.int32)
+        positions = np.full((self.B, bucket), S - 1, dtype=np.int32)  # trash for others
+        tokens[slot, :n] = ids
+        positions[slot] = np.arange(bucket)
+
+        logits, eng.cache = forward(
+            eng.params, eng.cfg, jnp.asarray(tokens), jnp.asarray(positions), eng.cache, eng.rules
+        )
+        last_logits = logits[:, n - 1, :]  # only row `slot` meaningful
+        self._rng, k = jax.random.split(self._rng)
+        start_state = jnp.full((self.B,), self.engine.fsm.start, dtype=jnp.int32)
+        tok0, fsm0 = _mask_sample_advance(
+            last_logits, start_state, eng.mask_table, eng.next_table, k,
+            jnp.float32(self.temperature), self.greedy, True,
+        )
+        onehot = jnp.arange(self.B) == slot
+        self.cur = jnp.where(onehot, tok0, self.cur)
+        self.fsm = jnp.where(onehot, fsm0, self.fsm)
+        self.pos = jnp.where(onehot, n, self.pos)
+        self.nbytes = jnp.where(onehot, 0, self.nbytes)
+        self.tokens_left = jnp.where(onehot, self.max_new_tokens, self.tokens_left)
+        self.active = self.active | onehot
+
+        sl = self.slots[slot]
+        sl.request_id = rid
+        sl.token_ids = []
+        sl.start_s = t0
+        sl.prompt_len = n
+        sl.prefill_ms = (time.perf_counter() - t0) * 1e3
+        sl.eos = False
+
+    # ------------------------------------------------------------ step
+
+    def step(self) -> None:
+        """Admit pending requests into free slots, then run one chunk."""
+        while self.pending:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            rid, prompt = self.pending.pop(0)
+            self._admit(slot, rid, prompt)
+
+        if not bool(np.asarray(jax.device_get(self.active)).any()):
+            return
+
+        eng = self.engine
+        self._rng, k = jax.random.split(self._rng)
+        (out, n, eos, eng.cache, self.cur, self.pos, self.fsm, self.active,
+         self.nbytes, self.tokens_left) = chunk_decode_loop(
+            eng.params, eng.cfg, eng.cache,
+            self.cur, self.pos, self.fsm, self.active, self.nbytes, self.tokens_left,
+            eng.mask_table, eng.next_table, eng.byte_len_table,
+            k, jnp.float32(self.temperature), jnp.int32(self.byte_budget),
+            rules=eng.rules, chunk_steps=self.chunk_steps,
+            greedy=self.greedy, constrained=True,
+        )
+        out_h = np.asarray(jax.device_get(out))
+        n_h = np.asarray(jax.device_get(n))
+        act_h = np.asarray(jax.device_get(self.active))
+        eos_h = np.asarray(jax.device_get(eos))
+
+        for b in range(self.B):
+            sl = self.slots[b]
+            if sl.request_id < 0:
+                continue
+            sl.token_ids.extend(int(t) for t in out_h[b, : n_h[b]])
+            if not act_h[b]:
+                # slot stopped this chunk: clean EOS, or truncation by
+                # byte/token/length budget (eos flag distinguishes them)
+                self.results[sl.request_id] = GenerationResult(
+                    text=self.engine.tokenizer.decode(sl.token_ids),
+                    token_ids=list(sl.token_ids),
+                    prefill_ms=sl.prefill_ms,
+                    decode_ms=(time.perf_counter() - sl.start_s) * 1e3 - sl.prefill_ms,
+                    steps=len(sl.token_ids),
+                    finished=bool(eos_h[b]),
+                )
+                self.slots[b] = _Slot()
+
+    # ------------------------------------------------------------ drain
+
+    def run_until_done(self, max_chunks: int = 1000) -> None:
+        for _ in range(max_chunks):
+            if not self.pending and not any(s.request_id >= 0 for s in self.slots):
+                break
+            self.step()
+
+    def generate_many(self, prompts: list[str]) -> list[GenerationResult]:
+        ids = [self.submit(p) for p in prompts]
+        self.run_until_done()
+        return [self.results.pop(i) for i in ids]

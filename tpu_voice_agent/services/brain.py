@@ -1,0 +1,237 @@
+"""Brain service: text + context -> validated intent plan.
+
+Capability parity with the reference brain (apps/brain/src/server.ts:84-142):
+``POST /parse`` takes ``{text, session_id?, context}`` and returns a
+``ParseResponse``; error envelopes match the reference contract —
+400 ``invalid_request``, 422 ``schema_validation_failed``, 500 ``llm_error``
+(server.ts:91-95, :122-136). What changed underneath: the OpenAI call
+(llm.ts:19-30) is replaced by the in-tree grammar-constrained TPU decode, so
+the reference's validate-then-repair loop (server.ts:110-121) is structurally
+unnecessary — the only residual failure mode is token-budget truncation.
+
+Parser backends (the test seam, mirroring the reference's mocked
+``callLLMJSON``):
+- ``EngineParser``   — DecodeEngine on TPU (or any jax backend)
+- ``RuleBasedParser`` — deterministic keyword heuristics; offline mode and
+  the fake backend for tests (reference analog: null-Deepgram-key mode)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import threading
+from typing import Protocol
+
+from aiohttp import web
+
+from ..schemas import Intent, ParseRequest, ParseResponse, Target, parse_response_from_json
+from ..utils import Tracer, load_env_cascade, new_trace_id
+from .prompts import render_prompt
+
+
+class IntentParser(Protocol):
+    def parse(self, text: str, context: dict) -> ParseResponse: ...
+
+
+class ParserError(Exception):
+    def __init__(self, kind: str, detail: str):
+        super().__init__(detail)
+        self.kind = kind  # "schema_validation_failed" | "llm_error"
+        self.detail = detail
+
+
+# ---------------------------------------------------------------- backends
+
+
+class EngineParser:
+    """Grammar-constrained decode on the in-tree engine."""
+
+    def __init__(self, engine, max_new_tokens: int = 512):
+        self.engine = engine
+        self.max_new_tokens = max_new_tokens
+
+    def parse(self, text: str, context: dict) -> ParseResponse:
+        prompt = render_prompt(text, context)
+        try:
+            res = self.engine.generate(
+                prompt, max_new_tokens=self.max_new_tokens, greedy=True, constrained=True
+            )
+        except ValueError as e:  # prompt too long etc.
+            raise ParserError("llm_error", str(e)) from e
+        if not res.finished:
+            raise ParserError(
+                "schema_validation_failed",
+                f"decode truncated after {res.steps} tokens (no EOS)",
+            )
+        model, err = parse_response_from_json(res.text)
+        if model is None:
+            # unreachable under the grammar; kept as a hard backstop
+            raise ParserError("schema_validation_failed", err or "invalid")
+        return model
+
+
+class RuleBasedParser:
+    """Deterministic heuristic parser — offline mode + test fake.
+
+    Covers the same command families as the prompt few-shots so the service
+    contract can be exercised with zero model dependencies.
+    """
+
+    _URL = re.compile(r"(https?://\S+|\b[\w-]+\.(?:com|org|net|io|dev)\b)", re.I)
+
+    def parse(self, text: str, context: dict) -> ParseResponse:
+        t = text.strip().lower()
+        intents: list[Intent] = []
+        ctx_updates: dict = {}
+        tts = None
+        follow_up = None
+        confidence = 0.9
+
+        def add(type_: str, **kw):
+            intents.append(Intent(type=type_, **kw))
+
+        m = re.search(r"(?:search(?: for)?|find|look for)\s+(.+)", t)
+        url = self._URL.search(text)
+        if m:
+            q = m.group(1).strip(" .!?")
+            add("search", args={"query": q})
+            ctx_updates["last_query"] = q
+            tts = f"Searching for {q}"
+        elif url and ("open" in t or "navigate" in t or "go to" in t):
+            u = url.group(0)
+            if not u.startswith("http"):
+                u = "https://" + u
+            add("navigate", args={"url": u})
+            tts = f"Opening {u}"
+        elif "upload" in t:
+            add("upload", args={"fileRef": None}, requires_confirmation=True)
+            if "submit" in t:
+                add("click", target=Target(strategy="text", value="Submit"), requires_confirmation=True)
+            tts = "I will upload after you confirm"
+        elif (m := re.search(r"sort(?:ed)?(?: these)?(?: by)?\s+(\w+)", t)):
+            direction = "desc" if ("high to low" in t or "descending" in t) else "asc"
+            add("sort", args={"field": m.group(1), "direction": direction})
+            tts = f"Sorting by {m.group(1)}"
+        elif (m := re.search(r"open the (first|second|third|\d+\w*) (?:result|item|link)", t)):
+            idx = {"first": 1, "second": 2, "third": 3}.get(m.group(1))
+            if idx is None:
+                idx = int(re.sub(r"\D", "", m.group(1)) or 1)
+            add("click", target=Target(strategy="auto", role="link"), args={"index": idx})
+            tts = f"Opening result {idx}"
+        elif (m := re.search(r"click(?: on)?(?: the)?\s+(.+?)(?: button| link)?$", t)):
+            add("click", target=Target(strategy="text", value=m.group(1).strip(" .!?")))
+            tts = f"Clicking {m.group(1).strip(' .!?')}"
+        elif "screenshot" in t:
+            add("screenshot")
+            tts = "Taking a screenshot"
+        elif "scroll" in t:
+            add("scroll", args={"direction": "up" if "up" in t else "down"})
+        elif re.search(r"\bgo back\b|\bback\b", t):
+            add("back")
+        elif "extract" in t and "table" in t:
+            add("extract_table", args={"format": "csv"})
+            tts = "Extracting the table"
+        elif "summarize" in t or "summary" in t:
+            add("summarize")
+        elif "cancel" in t:
+            add("cancel")
+        else:
+            add("unknown")
+            confidence = 0.3
+            follow_up = "I did not catch a browser action - could you rephrase?"
+
+        return ParseResponse(
+            intents=intents,
+            context_updates=ctx_updates,
+            confidence=confidence,
+            tts_summary=tts,
+            follow_up_question=follow_up,
+        )
+
+
+# ---------------------------------------------------------------- app
+
+
+def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Application:
+    tracer = tracer or Tracer("brain", emit=False)
+    app = web.Application()
+    # The engine owns one KV cache and RNG; concurrent parses on a shared
+    # backend must serialize (batched concurrency belongs to the scheduler,
+    # not to racing threads over one cache).
+    parse_lock = threading.Lock()
+
+    def locked_parse(text: str, context: dict) -> ParseResponse:
+        with parse_lock:
+            return parser.parse(text, context)
+
+    async def health(_req: web.Request) -> web.Response:
+        return web.json_response({"ok": True, "service": "brain"})
+
+    async def parse(req: web.Request) -> web.Response:
+        trace_id = req.headers.get("x-trace-id", new_trace_id())
+        headers = {"x-trace-id": trace_id}
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": "invalid_request", "detail": "body must be JSON"},
+                status=400, headers=headers,
+            )
+        try:
+            preq = ParseRequest.model_validate(body)
+        except Exception as e:
+            return web.json_response(
+                {"error": "invalid_request", "detail": str(e)[:500]},
+                status=400, headers=headers,
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            with tracer.span("parse", trace_id=trace_id, chars=len(preq.text)):
+                resp = await loop.run_in_executor(
+                    None, locked_parse, preq.text, preq.context
+                )
+        except ParserError as e:
+            status = 422 if e.kind == "schema_validation_failed" else 500
+            return web.json_response(
+                {"error": e.kind, "detail": e.detail[:500]}, status=status,
+                headers={"x-trace-id": trace_id},
+            )
+        except Exception as e:  # engine crash etc.
+            return web.json_response(
+                {"error": "llm_error", "detail": str(e)[:500]}, status=500,
+                headers={"x-trace-id": trace_id},
+            )
+        return web.json_response(
+            resp.model_dump(), headers={"x-trace-id": trace_id}
+        )
+
+    app.router.add_get("/health", health)
+    app.router.add_post("/parse", parse)
+    return app
+
+
+def make_parser_from_env() -> IntentParser:
+    backend = os.environ.get("BRAIN_BACKEND", "rule")
+    if backend == "rule":
+        return RuleBasedParser()
+    if backend.startswith("engine"):
+        from ..serve import DecodeEngine
+
+        preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
+        return EngineParser(DecodeEngine(preset=preset))
+    raise ValueError(f"unknown BRAIN_BACKEND {backend!r}")
+
+
+def main() -> None:
+    load_env_cascade()
+    port = int(os.environ.get("BRAIN_PORT", "8090"))
+    parser = make_parser_from_env()
+    app = build_app(parser, Tracer("brain"))
+    web.run_app(app, port=port)
+
+
+if __name__ == "__main__":
+    main()
