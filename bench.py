@@ -1,0 +1,78 @@
+"""Benchmark: voice->intent parse latency on the flagship in-tree model.
+
+Measures the BASELINE.md primary metric on real hardware: p50 latency of a
+full grammar-constrained intent parse (prompt prefill + constrained decode of
+a representative 64-token intent JSON) on a TinyLlama-1.1B-class decoder in
+bfloat16. 64 tokens is the measured length scale of real intent plans under
+the schema tokenizer (the few-shot exemplars span 29-60 tokens).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline = 800ms-north-star / measured-p50 (>1.0 beats the target).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    devices = jax.devices()
+    on_tpu = any("tpu" in str(d).lower() for d in devices)
+    print(f"[bench] devices: {devices}", file=sys.stderr)
+
+    from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.services.prompts import render_prompt
+
+    preset = "tinyllama-1.1b" if on_tpu else "test-tiny"
+    engine = DecodeEngine(preset=preset, max_len=2048, prefill_buckets=(1024,))
+
+    utterances = [
+        "search for wireless headphones",
+        "sort these by price from low to high",
+        "open the second result and take a screenshot",
+        "filter results under one hundred dollars",
+        "upload my resume and submit the form",
+    ]
+    prompts = [render_prompt(u, {"last_query": None}) for u in utterances]
+
+    # warmup: compile prefill bucket + decode loop
+    for p in prompts[:2]:
+        engine.generate(p, max_new_tokens=64, greedy=True)
+
+    lat_ms = []
+    for i in range(15):
+        p = prompts[i % len(prompts)]
+        t0 = time.perf_counter()
+        res = engine.generate(p, max_new_tokens=64, greedy=True)
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+        if i == 0:
+            print(
+                f"[bench] first: prefill {res.prefill_ms:.1f}ms decode {res.decode_ms:.1f}ms "
+                f"steps {res.steps}",
+                file=sys.stderr,
+            )
+    p50 = float(np.percentile(lat_ms, 50))
+    print(
+        f"[bench] p50 {p50:.1f}ms p95 {float(np.percentile(lat_ms, 95)):.1f}ms over {len(lat_ms)} runs",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "voice_to_intent_p50_64tok",
+                "value": round(p50, 2),
+                "unit": "ms",
+                "vs_baseline": round(800.0 / p50, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -151,7 +151,7 @@ def _attend(q, k_cache, v_cache, q_positions, kv_len_mask):
 # ---------------------------------------------------------------- forward
 
 
-@partial(jax.jit, static_argnames=("cfg", "rules"))
+@partial(jax.jit, static_argnames=("cfg", "rules", "remat"))
 def forward(
     params: dict,
     cfg: LlamaConfig,
@@ -159,6 +159,7 @@ def forward(
     positions: jax.Array,  # (B, T) int32 — absolute positions of `tokens`
     kv_cache: dict,  # (L, B, S, nkv, hd)
     rules=None,  # parallel.ShardingRules | None
+    remat: bool = False,  # rematerialize layer activations (training)
 ) -> tuple[jax.Array, dict]:
     """Unified prefill/decode forward.
 
@@ -213,8 +214,9 @@ def forward(
         x = x + cs(down, "act")
         return x, (k_cache, v_cache)
 
+    layer_fn = jax.checkpoint(layer) if remat else layer
     x, (new_k, new_v) = jax.lax.scan(
-        lambda carry, inp: layer(carry, inp),
+        lambda carry, inp: layer_fn(carry, inp),
         x,
         (params["layers"], kv_cache["k"], kv_cache["v"]),
     )

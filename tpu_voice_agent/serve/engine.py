@@ -35,6 +35,7 @@ class GenerationResult:
     decode_ms: float
     steps: int
     finished: bool  # True only if EOS was reached (truncation => False)
+    error: str | None = None  # per-request failure (e.g. prompt too long)
 
     @property
     def tokens_per_s(self) -> float:

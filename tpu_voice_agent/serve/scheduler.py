@@ -89,8 +89,7 @@ class ContinuousBatcher:
         self.pending.append((rid, prompt))
         return rid
 
-    def _free_slot(self) -> int | None:
-        act = np.asarray(jax.device_get(self.active))
+    def _free_slot(self, act: np.ndarray) -> int | None:
         for b in range(self.B):
             if not act[b] and self.slots[b].request_id < 0:
                 return b
@@ -138,14 +137,24 @@ class ContinuousBatcher:
 
     def step(self) -> None:
         """Admit pending requests into free slots, then run one chunk."""
+        act = np.asarray(jax.device_get(self.active))
         while self.pending:
-            slot = self._free_slot()
+            slot = self._free_slot(act)
             if slot is None:
                 break
             rid, prompt = self.pending.pop(0)
-            self._admit(slot, rid, prompt)
+            try:
+                self._admit(slot, rid, prompt)
+                act[slot] = True
+            except ValueError as e:
+                # per-request isolation: an oversized prompt fails alone,
+                # never the batch (mirrors the executor's per-step try/catch)
+                self.results[rid] = GenerationResult(
+                    text="", token_ids=[], prefill_ms=0.0, decode_ms=0.0,
+                    steps=0, finished=False, error=str(e),
+                )
 
-        if not bool(np.asarray(jax.device_get(self.active)).any()):
+        if not act.any():
             return
 
         eng = self.engine
@@ -159,10 +168,10 @@ class ContinuousBatcher:
             rules=eng.rules, chunk_steps=self.chunk_steps,
             greedy=self.greedy, constrained=True,
         )
-        out_h = np.asarray(jax.device_get(out))
-        n_h = np.asarray(jax.device_get(n))
-        act_h = np.asarray(jax.device_get(self.active))
-        eos_h = np.asarray(jax.device_get(eos))
+        # one transfer for everything the host needs this chunk
+        out_h, n_h, act_h, eos_h = (
+            np.asarray(x) for x in jax.device_get((out, n, self.active, eos))
+        )
 
         for b in range(self.B):
             sl = self.slots[b]
@@ -184,7 +193,13 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------ drain
 
-    def run_until_done(self, max_chunks: int = 1000) -> None:
+    def run_until_done(self, max_chunks: int | None = None) -> None:
+        if max_chunks is None:
+            # worst case: every request decodes its full token budget
+            import math
+
+            per_req = math.ceil(self.max_new_tokens / self.chunk_steps) + 1
+            max_chunks = per_req * (len(self.pending) + self.B) + self.B
         for _ in range(max_chunks):
             if not self.pending and not any(s.request_id >= 0 for s in self.slots):
                 break
@@ -193,4 +208,13 @@ class ContinuousBatcher:
     def generate_many(self, prompts: list[str]) -> list[GenerationResult]:
         ids = [self.submit(p) for p in prompts]
         self.run_until_done()
-        return [self.results.pop(i) for i in ids]
+        return [
+            self.results.pop(
+                i,
+                GenerationResult(
+                    text="", token_ids=[], prefill_ms=0.0, decode_ms=0.0,
+                    steps=0, finished=False, error="scheduler gave up (chunk cap)",
+                ),
+            )
+            for i in ids
+        ]

@@ -1,0 +1,3 @@
+from .step import TrainState, make_train_step, loss_fn
+
+__all__ = ["TrainState", "make_train_step", "loss_fn"]

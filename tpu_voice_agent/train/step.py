@@ -1,0 +1,64 @@
+"""Sharded fine-tuning step (dp x tp) for the intent-parse model.
+
+The reference has no training path (its models are cloud APIs); this module
+exists so the framework can adapt its in-tree models to the intent domain
+(e.g. distill the few-shot prompt into the weights and shrink prefill to
+near-zero). Design: pure-functional train step jitted over the same mesh and
+param shardings the serving engine uses — batch sharded over dp, weights
+column/row-sharded over tp, gradients reduced by XLA collectives over ICI.
+Remat (jax.checkpoint) wraps the layer scan body to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.llama import LlamaConfig, forward, init_kv_cache
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: int = 0
+
+
+def loss_fn(params, cfg: LlamaConfig, tokens, loss_mask, rules=None):
+    """Next-token cross-entropy over (B, T) tokens; mask excludes prompt/pad.
+
+    Teacher-forced full forward reuses the serving `forward` (a throwaway KV
+    cache of length T keeps shapes static and small).
+    """
+    B, T = tokens.shape
+    cache = init_kv_cache(cfg, B, T, dtype=jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    logits, _ = forward(params, cfg, tokens, positions, cache, rules, remat=True)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1, :].astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_train_step(cfg: LlamaConfig, optimizer=None, rules=None):
+    """Build (init_state, train_step). train_step is jit-ready; shardings come
+    from the params/opt-state placements (jit infers) plus activation rules."""
+    optimizer = optimizer or optax.adamw(1e-5, weight_decay=0.01)
+
+    def init_state(params) -> TrainState:
+        return TrainState(params=params, opt_state=optimizer.init(params), step=0)
+
+    @partial(jax.jit, static_argnames=(), donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens, loss_mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens, loss_mask, rules)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init_state, train_step
