@@ -1,0 +1,210 @@
+"""Intent interpreter tests over the fake page.
+
+Extends the reference's executor test (apps/executor/test/actions.test.ts:
+drive runIntents with navigate/wait_for/extract_table against a stub page)
+to the FULL 19-intent vocabulary, including the 8 the reference dropped.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpu_voice_agent.schemas import Intent, Target
+from tpu_voice_agent.services.executor import FakePage, run_intents
+from tpu_voice_agent.services.executor.page import FakeElement
+
+
+def rich_page() -> FakePage:
+    return FakePage(
+        elements=[
+            FakeElement("#search", tag="input", etype="search", placeholder="Search products"),
+            FakeElement("#add-to-cart", tag="button", text="Add to Cart", role="button", name="Add to Cart"),
+            FakeElement("#submit", tag="button", text="Submit", role="button", name="Submit"),
+            FakeElement("a.result1", tag="a", text="First result"),
+            FakeElement("a.result2", tag="a", text="Second result"),
+            FakeElement("#sortsel", tag="select", name="sort", options=["Featured", "Price Low to High", "Price High to Low"]),
+            FakeElement("#minprice", tag="input", name="min-price"),
+            FakeElement("#maxprice", tag="input", name="max-price"),
+            FakeElement("#fileinput", tag="input", etype="file", attrs={"type": "file"}),
+            FakeElement(".results", tag="div", text="results container"),
+            FakeElement("#sizesel", tag="select", name="size", options=["Small", "Large"]),
+        ]
+    )
+
+
+@pytest.fixture()
+def page():
+    return rich_page()
+
+
+def run(page, tmp_path, *intents, uploads_dir=None):
+    return run_intents(page, tmp_path / "art", list(intents), uploads_dir=uploads_dir)
+
+
+def test_reference_chain_navigate_wait_extract(page, tmp_path):
+    """The reference's own test scenario, but wait_for actually works here."""
+    results = run(
+        page, tmp_path,
+        Intent(type="navigate", args={"url": "shop.example.com"}),
+        Intent(type="wait_for", target=Target(strategy="css", value=".results")),
+        Intent(type="extract_table", args={"format": "csv"}),
+    )
+    assert [r.ok for r in results] == [True, True, True]
+    assert page.url == "https://shop.example.com"
+    assert results[2].data["count"] == 2
+    json_path = Path(results[2].data_paths[0])
+    assert json.loads(json_path.read_text())[0]["title"] == "Fake Product A"
+    assert any(p.endswith(".csv") for p in results[2].data_paths)
+    # full-page screenshot after every step (reference actions.ts:37-41)
+    assert all(r.screenshot and Path(r.screenshot).exists() for r in results)
+
+
+def test_search_fills_box_and_presses_enter(page, tmp_path):
+    (res,) = run(page, tmp_path, Intent(type="search", args={"query": "laptops"}))
+    assert res.ok
+    assert ("fill", "#search", "laptops") in page.actions
+    assert ("press", "#search", "Enter") in page.actions
+
+
+def test_click_strategies(page, tmp_path):
+    results = run(
+        page, tmp_path,
+        Intent(type="click", target=Target(strategy="css", value="#add-to-cart")),
+        Intent(type="click", target=Target(strategy="text", value="Submit")),
+        Intent(type="click", target=Target(strategy="role", role="button", name="Add to Cart")),
+        Intent(type="click", args={"index": 2}),  # auto: second analyzed link
+        Intent(type="click", args={"text": "Add to Cart"}),  # auto: analyzed text
+    )
+    assert [r.ok for r in results] == [True] * 5
+    assert results[3].data["selector"] == "a.result2"
+
+
+def test_sort_selects_direction_option(page, tmp_path):
+    (res,) = run(page, tmp_path, Intent(type="sort", args={"field": "price", "direction": "asc"}))
+    assert res.ok and res.data["option"] == "Price Low to High"
+    (res,) = run(page, tmp_path, Intent(type="sort", args={"field": "price", "direction": "desc"}))
+    assert res.ok and res.data["option"] == "Price High to Low"
+
+
+def test_filter_price_lte_fills_max_input(page, tmp_path):
+    (res,) = run(
+        page, tmp_path,
+        Intent(type="filter", args={"field": "price", "op": "lte", "value": 100}),
+    )
+    assert res.ok
+    assert ("fill", "#maxprice", "100") in page.actions
+
+
+def test_type_select_scroll_back_forward(page, tmp_path):
+    results = run(
+        page, tmp_path,
+        Intent(type="navigate", args={"url": "a.com"}),
+        Intent(type="navigate", args={"url": "b.com"}),
+        Intent(type="back"),
+        Intent(type="forward"),
+        Intent(type="scroll", args={"direction": "down", "amount": 2}),
+        Intent(type="select", target=Target(strategy="css", value="#sizesel"), args={"label": "Large"}),
+        Intent(type="type", target=Target(strategy="css", value="#search"), args={"text": "hi"}),
+    )
+    assert all(r.ok for r in results), [r.error for r in results]
+    assert page.url == "https://b.com"
+    assert ("scroll_by", 0, 1600) in page.actions
+    assert ("select_option", "#sizesel", "Large") in page.actions
+
+
+def test_upload_resolves_resume_ref(page, tmp_path):
+    uploads = tmp_path / "uploads"
+    uploads.mkdir()
+    (uploads / "abc123.pdf").write_bytes(b"%PDF fake")
+    (res,) = run(
+        page, tmp_path,
+        Intent(type="upload", args={"fileRef": "resume://abc123"}, requires_confirmation=True),
+        uploads_dir=uploads,
+    )
+    assert res.ok, res.error
+    assert res.data["path"].endswith("abc123.pdf")
+    assert any(a[0] == "set_input_files" for a in page.actions)
+
+
+def test_upload_missing_file_fails_cleanly(page, tmp_path):
+    (res,) = run(
+        page, tmp_path,
+        Intent(type="upload", args={"fileRef": "resume://deadbeef0000"}),
+        uploads_dir=tmp_path,
+    )
+    assert not res.ok and "not found" in res.error
+
+
+def test_upload_rejects_hostile_refs(page, tmp_path):
+    for ref in ("resume://../../../etc/passwd", "resume://*", "resume://x"):
+        (res,) = run(page, tmp_path, Intent(type="upload", args={"fileRef": ref}), uploads_dir=tmp_path)
+        assert not res.ok and "malformed" in res.error, ref
+
+
+def test_screenshot_summarize_extract_confirm_cancel_unknown(page, tmp_path):
+    results = run(
+        page, tmp_path,
+        Intent(type="screenshot"),
+        Intent(type="summarize"),
+        Intent(type="extract"),
+        Intent(type="confirm"),
+        Intent(type="cancel"),
+        Intent(type="unknown"),
+    )
+    oks = [r.ok for r in results]
+    assert oks == [True, True, True, True, True, False]
+    assert Path(results[0].data["path"]).exists()
+    assert results[1].data["word_count"] > 0
+    assert "unsupported" in results[5].error
+
+
+def test_step_errors_do_not_abort_batch(page, tmp_path):
+    page.fail_next = "click"
+    results = run(
+        page, tmp_path,
+        Intent(type="click", target=Target(strategy="css", value="#add-to-cart")),
+        Intent(type="screenshot"),
+    )
+    assert not results[0].ok and results[1].ok
+
+
+def test_retries_recover_from_transient_fault(page, tmp_path):
+    page.fail_next = "click"
+    (res,) = run(
+        page, tmp_path,
+        Intent(type="click", target=Target(strategy="css", value="#add-to-cart"), retries=1),
+    )
+    assert res.ok  # second attempt succeeded
+
+
+def test_all_19_intent_types_have_an_implementation(tmp_path):
+    """No schema-legal intent may hit an 'unsupported' branch except unknown."""
+    from tpu_voice_agent.schemas import INTENT_TYPES
+
+    uploads = tmp_path / "up"
+    uploads.mkdir()
+    (uploads / "abcdef.txt").write_text("x")
+    arg_map = {
+        "search": {"query": "q"},
+        "navigate": {"url": "x.com"},
+        "type": {"selector": "#search", "text": "t"},
+        "sort": {"field": "price", "direction": "asc"},
+        "filter": {"field": "price", "op": "lte", "value": 5},
+        "scroll": {},
+        "select": {"selector": "#sizesel", "label": "Small"},
+        "wait_for": {"selector": ".results"},
+        "upload": {"fileRef": "resume://abcdef"},
+        "extract_table": {},
+        "click": {"text": "Submit"},
+    }
+    for t in INTENT_TYPES:
+        page = rich_page()
+        (res,) = run_intents(
+            page, tmp_path / f"art_{t}", [Intent(type=t, args=arg_map.get(t, {}))],
+            uploads_dir=uploads,
+        )
+        if t == "unknown":
+            assert not res.ok
+        else:
+            assert res.ok, f"{t} failed: {res.error}"

@@ -1,0 +1,130 @@
+"""Session manager + executor HTTP service tests (real socket, fake page)."""
+
+import io
+
+import httpx
+import pytest
+
+from tpu_voice_agent.services.executor import FakePage, SessionManager, build_app
+from tpu_voice_agent.services.executor.page import FakeElement
+from tests.http_helper import AppServer
+
+
+def fake_factory():
+    return FakePage(
+        elements=[
+            FakeElement("#search", tag="input", etype="search", placeholder="Search"),
+            FakeElement("#fileinput", tag="input", etype="file"),
+            FakeElement(".results", tag="div", text="ok"),
+        ]
+    )
+
+
+# ---------------------------------------------------------------- sessions
+
+
+def test_session_reuse_and_close(tmp_path):
+    m = SessionManager(page_factory=fake_factory, artifacts_root=str(tmp_path / "a"),
+                      uploads_dir=str(tmp_path / "u"))
+    s1 = m.open()
+    s2 = m.open(s1.id)
+    assert s1 is s2
+    assert m.close(s1.id) and not m.close(s1.id)
+
+
+def test_dead_session_recreated_on_reuse(tmp_path):
+    m = SessionManager(page_factory=fake_factory, artifacts_root=str(tmp_path / "a"),
+                      uploads_dir=str(tmp_path / "u"))
+    s1 = m.open("sess1")
+    s1.page.closed = True  # browser died
+    s2 = m.open("sess1")
+    assert s2.page is not s1.page and s2.id == "sess1"
+
+
+def test_idle_sessions_evicted(tmp_path):
+    m = SessionManager(page_factory=fake_factory, artifacts_root=str(tmp_path / "a"),
+                      uploads_dir=str(tmp_path / "u"), idle_ttl_s=0.0)
+    m.open("old")
+    assert m.evict_idle() == 1
+    assert "old" not in m.sessions
+
+
+# ---------------------------------------------------------------- http
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("exec")
+    manager = SessionManager(
+        page_factory=fake_factory,
+        artifacts_root=str(tmp / "artifacts"),
+        uploads_dir=str(tmp / "uploads"),
+    )
+    with AppServer(build_app(manager)) as srv:
+        yield srv
+
+
+def test_health(server):
+    r = httpx.get(server.url + "/health")
+    assert r.status_code == 200 and r.json()["service"] == "executor"
+
+
+def test_execute_search_and_session_reuse(server):
+    r = httpx.post(
+        server.url + "/execute",
+        json={"intents": [{"type": "search", "args": {"query": "tvs"}}]},
+    )
+    assert r.status_code == 200
+    body = r.json()
+    sid = body["session_id"]
+    assert body["results"][0]["ok"] and body["artifacts"]["dir"]
+
+    r2 = httpx.post(
+        server.url + "/execute",
+        json={"session_id": sid, "intents": [{"type": "screenshot"}]},
+    )
+    assert r2.json()["session_id"] == sid
+
+
+def test_upload_then_execute_upload_intent(server):
+    """The full confirm-flow seam (reference SURVEY.md §3.5): multipart upload
+    returns a resume:// ref, which the upload intent resolves and applies."""
+    files = {"file": ("resume.pdf", io.BytesIO(b"%PDF fake resume"), "application/pdf")}
+    up = httpx.post(server.url + "/uploads", files=files)
+    assert up.status_code == 200
+    ref = up.json()["fileRef"]
+    assert ref.startswith("resume://")
+
+    r = httpx.post(
+        server.url + "/execute",
+        json={"intents": [{"type": "upload", "args": {"fileRef": ref}}]},
+    )
+    res = r.json()["results"][0]
+    assert res["ok"], res["error"]
+    assert res["data"]["path"].endswith(".pdf")
+
+
+def test_execute_invalid_request_400(server):
+    r = httpx.post(server.url + "/execute", json={"intents": []})
+    assert r.status_code == 400 and r.json()["error"] == "invalid_request"
+
+
+def test_close_session(server):
+    r = httpx.post(
+        server.url + "/execute", json={"intents": [{"type": "screenshot"}]}
+    )
+    sid = r.json()["session_id"]
+    assert httpx.post(server.url + "/close", json={"session_id": sid}).json()["ok"]
+    assert not httpx.post(server.url + "/close", json={"session_id": sid}).json()["ok"]
+
+
+def test_step_error_isolated_in_http_response(server):
+    r = httpx.post(
+        server.url + "/execute",
+        json={"intents": [
+            {"type": "click", "target": {"strategy": "css", "value": "#missing"}},
+            {"type": "screenshot"},
+        ]},
+    )
+    results = r.json()["results"]
+    assert not results[0]["ok"] and results[1]["ok"]

@@ -1,0 +1,6 @@
+from .page import PageLike, FakePage
+from .actions import run_intents
+from .session import SessionManager
+from .server import build_app
+
+__all__ = ["PageLike", "FakePage", "run_intents", "SessionManager", "build_app"]

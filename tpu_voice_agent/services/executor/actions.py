@@ -1,0 +1,409 @@
+"""Intent interpreter: ALL 19 intent types.
+
+The reference's live interpreter (apps/executor/src/actions.ts:28-304)
+implements 11 cases and silently drops 8 that its own brain emits
+(wait_for, upload, forward, select, summarize, extract, confirm, cancel —
+SURVEY.md §2 #13); their intended semantics survive only in the stale
+compiled actions.js (#14). This interpreter covers the full vocabulary:
+
+- sequential execution, per-step try/catch so one failure never aborts the
+  batch (actions.ts:295-298), per-intent retries honored
+- full-page screenshot after every step (actions.ts:37-41)
+- lazy one-shot DOM analysis cached until navigation (actions.ts:44-54)
+- upload resolves ``resume://<uuid>`` against the uploads dir and calls
+  set_input_files (legacy actions.js:185-199)
+- select tries label first, then value (legacy actions.js:137-147)
+- extract_table uses the card heuristic (price-regex + closest product
+  container, legacy actions.js:200-238) and writes JSON + CSV artifacts
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from pathlib import Path
+from typing import Any
+
+from ...schemas import Intent, StepResult
+from .artifacts import write_csv, write_json
+from .dom_analyzer import analyze_page
+from .page import PageLike
+
+# card-heuristic extraction: find price-looking text, walk up to a product
+# container, take its first line as the title (legacy actions.js:200-238)
+EXTRACT_CARDS_JS = """/* __EXTRACT_CARDS__ */ (() => {
+  const price = /\\$\\s?\\d[\\d,]*(\\.\\d{2})?/;
+  const seen = new Set(); const rows = [];
+  const nodes = Array.from(document.querySelectorAll('[data-sku], li, article, .sku-item, .product, .item, [data-testid*="product"]'));
+  for (const n of nodes) {
+    const t = n.innerText || '';
+    if (!price.test(t)) continue;
+    const key = t.slice(0, 60);
+    if (seen.has(key)) continue; seen.add(key);
+    const title = t.split('\\n').map(s => s.trim()).filter(Boolean)[0] || '';
+    rows.push({title: title.split(/\\s+/).slice(0, 8).join(' '),
+               price: (t.match(price) || [''])[0]});
+    if (rows.length >= 50) break;
+  }
+  return rows;
+})()"""
+
+SEARCH_FALLBACK_SELECTORS = [
+    'input[aria-label="Search"]',
+    "input[type=search]",
+    'input[placeholder*="Search" i]',
+    'input[name="q"]',
+    "[role=search] input",
+]
+
+
+class _AnalysisCache:
+    def __init__(self, page: PageLike):
+        self.page = page
+        self._analysis: dict | None = None
+
+    def get(self) -> dict:
+        if self._analysis is None:
+            self._analysis = analyze_page(self.page)
+        return self._analysis
+
+    def invalidate(self) -> None:
+        self._analysis = None
+
+    def peek(self) -> dict | None:
+        """Current analysis without forcing a scan."""
+        return self._analysis
+
+
+def _norm_url(url: str) -> str:
+    if not re.match(r"^https?://", url):
+        return "https://" + url
+    return url
+
+
+def _do_search(page: PageLike, cache: _AnalysisCache, query: str, timeout_ms: int) -> dict:
+    analysis = cache.get()
+    boxes = analysis.get("searchElements") or []
+    if boxes:
+        sel = boxes[0]["selector"]
+    else:
+        sel = None
+        for cand in SEARCH_FALLBACK_SELECTORS:
+            try:
+                page.wait_for_selector(cand, timeout_ms=1000)
+                sel = cand
+                break
+            except Exception:
+                continue
+        if sel is None:
+            raise RuntimeError("no search box found on page")
+    page.fill(sel, query)
+    page.press(sel, "Enter")
+    cache.invalidate()
+    return {"selector": sel, "query": query}
+
+
+def _do_click(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
+    tgt = intent.target
+    args = intent.args
+    if tgt is not None and tgt.strategy in ("css", "xpath") and tgt.value:
+        page.click_selector(tgt.value, timeout_ms=intent.timeout_ms)
+        return {"by": "selector", "selector": tgt.value}
+    if tgt is not None and tgt.strategy in ("role", "aria") and (tgt.role or tgt.value):
+        page.click_role(tgt.role or "button", tgt.name or tgt.value, timeout_ms=intent.timeout_ms)
+        return {"by": "role", "role": tgt.role, "name": tgt.name}
+    if tgt is not None and tgt.strategy == "text" and tgt.value:
+        page.click_text(tgt.value, timeout_ms=intent.timeout_ms)
+        return {"by": "text", "text": tgt.value}
+    # auto strategy: indexed link, then text match over analyzed elements
+    idx = args.get("index")
+    if idx is not None:
+        links = cache.get().get("links") or []
+        i = int(idx) - 1
+        if not 0 <= i < len(links):
+            raise RuntimeError(f"link index {idx} out of range ({len(links)} links)")
+        page.click_selector(links[i]["selector"], timeout_ms=intent.timeout_ms)
+        cache.invalidate()
+        return {"by": "index", "index": idx, "selector": links[i]["selector"]}
+    text = (tgt.value if tgt else None) or args.get("text") or (tgt.name if tgt else None)
+    if not text:
+        raise RuntimeError("click needs a target (selector/text/role/index)")
+    analysis = cache.get()
+    for bucket in ("buttons", "links"):
+        for el in analysis.get(bucket) or []:
+            if str(text).lower() in (el.get("text") or "").lower():
+                page.click_selector(el["selector"], timeout_ms=intent.timeout_ms)
+                return {"by": "analyzed_text", "text": text, "selector": el["selector"]}
+    page.click_text(str(text), timeout_ms=intent.timeout_ms)
+    return {"by": "text", "text": text}
+
+
+def _do_click_and_invalidate(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
+    # any click may navigate, so the cached analysis is always suspect after
+    data = _do_click(page, cache, intent)
+    cache.invalidate()
+    return data
+
+
+def _do_sort(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
+    field = str(intent.args.get("field", "price"))
+    direction = str(intent.args.get("direction", "asc"))
+    phrase = "low to high" if direction == "asc" else "high to low"
+    filters = cache.get().get("filters") or []
+    for f in filters:
+        if f.get("kind") != "dropdown":
+            continue
+        ident = " ".join(
+            str(x) for x in (f.get("selector"), (f.get("attributes") or {}).get("name"), f.get("text"))
+        ).lower()
+        if "sort" not in ident:
+            continue
+        for opt in f.get("options") or []:
+            ol = str(opt).lower()
+            if phrase in ol or (field.lower() in ol and (direction in ol or phrase in ol)):
+                page.select_option(f["selector"], opt)
+                cache.invalidate()
+                return {"selector": f["selector"], "option": opt}
+        opts = f.get("options") or []
+        if opts:
+            page.select_option(f["selector"], opts[0])
+            cache.invalidate()
+            return {"selector": f["selector"], "option": opts[0], "note": "no direction match"}
+    # generic fallback: click visible sort-by text (legacy actions.js:77-101)
+    page.click_text(f"sort by {field}")
+    cache.invalidate()
+    return {"by": "text", "text": f"sort by {field}"}
+
+
+def _do_filter(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
+    args = intent.args
+    field = str(args.get("field", ""))
+    op = str(args.get("op", "lte"))
+    value = args.get("value")
+    filters = cache.get().get("filters") or []
+    if "price" in field.lower() and value is not None:
+        for f in filters:
+            if f.get("kind") == "price_range":
+                inputs = f.get("inputs") or []
+                # lte fills the max input (second), gte the min (first)
+                target = inputs[-1] if op in ("lte", "lt", "max") else inputs[0]
+                page.fill(target["selector"], str(value))
+                page.press(target["selector"], "Enter")
+                cache.invalidate()
+                return {"kind": "price_range", "selector": target["selector"], "value": value}
+    # dropdown filter whose identity mentions the field
+    for f in filters:
+        if f.get("kind") != "dropdown":
+            continue
+        ident = " ".join(
+            str(x) for x in (f.get("selector"), (f.get("attributes") or {}).get("name"))
+        ).lower()
+        if field.lower() in ident:
+            for opt in f.get("options") or []:
+                if value is not None and str(value).lower() in str(opt).lower():
+                    page.select_option(f["selector"], opt)
+                    cache.invalidate()
+                    return {"kind": "dropdown", "selector": f["selector"], "option": opt}
+    raise RuntimeError(f"no matching filter control for field={field!r} op={op!r}")
+
+
+def _do_extract_table(page: PageLike, dir_: str, step: int, fmt: str) -> tuple[dict, list[str]]:
+    rows = page.evaluate(EXTRACT_CARDS_JS) or []
+    paths = [write_json(dir_, f"extract_{step}", rows)]
+    if fmt in ("csv", "both", ""):
+        paths.append(write_csv(dir_, f"extract_{step}", rows))
+    return {"rows": rows, "count": len(rows)}, paths
+
+
+def run_intents(
+    page: PageLike,
+    artifacts_dir: str | Path,
+    intents: list[Intent],
+    uploads_dir: str | Path | None = None,
+    screenshot_each_step: bool = True,
+) -> list[StepResult]:
+    """Sequential interpreter; one StepResult per intent, errors isolated."""
+    dir_ = str(artifacts_dir)
+    Path(dir_).mkdir(parents=True, exist_ok=True)
+    cache = _AnalysisCache(page)
+    results: list[StepResult] = []
+
+    for step, intent in enumerate(intents):
+        t0 = time.perf_counter()
+        attempts = intent.retries + 1
+        last_err: str | None = None
+        ok = False
+        data: Any = None
+        data_paths: list[str] = []
+        analysis_out: dict | None = None
+
+        for _attempt in range(attempts):
+            try:
+                data, data_paths = _run_one(page, cache, intent, dir_, step, uploads_dir)
+                ok = True
+                last_err = None
+                break
+            except Exception as e:
+                last_err = f"{type(e).__name__}: {e}"
+
+        # expose the analysis this step ran against (if one was computed),
+        # mirroring the reference's StepResult.pageAnalysis
+        analysis_out = cache.peek()
+
+        shot = None
+        if ok and intent.type == "screenshot" and isinstance(data, dict):
+            shot = data.get("path")  # already captured; don't pay for a twin
+        elif screenshot_each_step:
+            try:
+                shot = str(Path(dir_) / f"step_{step}.png")
+                page.screenshot(shot, full_page=True)
+            except Exception:
+                shot = None
+
+        results.append(
+            StepResult(
+                intent=intent,
+                ok=ok,
+                error=last_err,
+                data=data,
+                screenshot=shot,
+                data_paths=data_paths,
+                page_analysis=analysis_out,
+                latency_ms=(time.perf_counter() - t0) * 1e3,
+            )
+        )
+    return results
+
+
+def _run_one(
+    page: PageLike,
+    cache: _AnalysisCache,
+    intent: Intent,
+    dir_: str,
+    step: int,
+    uploads_dir: str | Path | None,
+) -> tuple[Any, list[str]]:
+    t = intent.type
+    args = intent.args
+    tgt = intent.target
+    data: Any = None
+    paths: list[str] = []
+
+    if t == "navigate":
+        url = _norm_url(str(args.get("url") or (tgt.value if tgt else "") or ""))
+        if url == "https://":
+            raise RuntimeError("navigate needs args.url")
+        page.goto(url, timeout_ms=intent.timeout_ms)
+        cache.invalidate()
+        data = {"url": url}
+
+    elif t == "search":
+        query = str(args.get("query") or "")
+        if not query:
+            raise RuntimeError("search needs args.query")
+        data = _do_search(page, cache, query, intent.timeout_ms)
+
+    elif t == "click":
+        data = _do_click_and_invalidate(page, cache, intent)
+
+    elif t == "type":
+        text = str(args.get("text") or "")
+        sel = (tgt.value if tgt and tgt.value else None) or args.get("selector")
+        if sel is None:
+            analysis = cache.get()
+            forms = analysis.get("forms") or []
+            inputs = (forms[0].get("inputs") if forms else None) or analysis.get("searchElements") or []
+            if not inputs:
+                raise RuntimeError("type needs a target selector")
+            sel = inputs[0]["selector"]
+        page.fill(str(sel), text)
+        data = {"selector": sel, "chars": len(text)}
+
+    elif t == "extract":
+        body = page.evaluate("document.body.innerText") or ""
+        data = {"text": str(body)[:2000]}
+        paths.append(write_json(dir_, f"extract_{step}", data))
+
+    elif t == "extract_table":
+        data, paths = _do_extract_table(page, dir_, step, str(args.get("format") or "csv"))
+
+    elif t == "sort":
+        data = _do_sort(page, cache, intent)
+
+    elif t == "filter":
+        data = _do_filter(page, cache, intent)
+
+    elif t == "scroll":
+        direction = str(args.get("direction", "down"))
+        amount = int(args.get("amount", 1) or 1)
+        dy = 800 * amount * (1 if direction == "down" else -1)
+        page.scroll_by(0, dy)
+        data = {"dy": dy}
+
+    elif t == "back":
+        page.go_back()
+        cache.invalidate()
+
+    elif t == "forward":
+        page.go_forward()
+        cache.invalidate()
+
+    elif t == "select":
+        sel = (tgt.value if tgt and tgt.value else None) or args.get("selector")
+        choice = args.get("label") or args.get("value") or args.get("option")
+        if not sel or choice is None:
+            raise RuntimeError("select needs a selector and label/value")
+        page.select_option(str(sel), str(choice))
+        data = {"selector": sel, "choice": choice}
+
+    elif t == "wait_for":
+        sel = (tgt.value if tgt and tgt.value else None) or args.get("selector")
+        if not sel:
+            raise RuntimeError("wait_for needs a selector")
+        page.wait_for_selector(str(sel), timeout_ms=intent.timeout_ms)
+        data = {"selector": sel}
+
+    elif t == "upload":
+        ref = str(args.get("fileRef") or "")
+        if not ref.startswith("resume://"):
+            raise RuntimeError("upload needs args.fileRef (resume://<id>)")
+        if uploads_dir is None:
+            raise RuntimeError("no uploads dir configured")
+        stem = ref.removeprefix("resume://")
+        # refs are hex uids minted by save_upload; anything else (globs,
+        # path traversal) is hostile input
+        if not re.fullmatch(r"[0-9a-f]{6,32}", stem):
+            raise RuntimeError(f"malformed fileRef {ref!r}")
+        matches = sorted(Path(uploads_dir).glob(f"{stem}*"))
+        if not matches:
+            raise RuntimeError(f"uploaded file {ref} not found")
+        sel = (tgt.value if tgt and tgt.value else None) or "input[type=file]"
+        page.set_input_files(str(sel), str(matches[0]))
+        data = {"selector": sel, "path": str(matches[0])}
+
+    elif t == "screenshot":
+        path = str(Path(dir_) / f"screenshot_{step}.png")
+        page.screenshot(path, full_page=True)
+        paths.append(path)
+        data = {"path": path}
+
+    elif t == "summarize":
+        body = str(page.evaluate("document.body.innerText") or "")
+        title = str(page.evaluate("document.title") or "")
+        words = body.split()
+        data = {
+            "title": title,
+            "summary": " ".join(words[:120]) + (" ..." if len(words) > 120 else ""),
+            "word_count": len(words),
+        }
+
+    elif t == "confirm":
+        data = {"acknowledged": True}
+
+    elif t == "cancel":
+        data = {"cancelled": True}
+
+    else:  # "unknown" and anything future
+        raise RuntimeError(f"unsupported intent type: {t}")
+
+    return data, paths

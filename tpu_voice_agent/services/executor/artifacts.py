@@ -1,0 +1,33 @@
+"""Artifact writers (reference: apps/executor/src/artifacts.ts:4-26)."""
+
+from __future__ import annotations
+
+import csv
+import json
+from pathlib import Path
+
+
+def write_json(dir_: str | Path, name: str, data) -> str:
+    path = Path(dir_) / f"{name}.json"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(data, indent=2, default=str))
+    return str(path)
+
+
+def write_csv(dir_: str | Path, name: str, rows: list[dict]) -> str:
+    path = Path(dir_) / f"{name}.csv"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    if not rows:
+        path.write_text("")
+        return str(path)
+    keys: list[str] = []
+    for row in rows:
+        for k in row:
+            if k not in keys:
+                keys.append(k)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=keys)
+        w.writeheader()
+        for row in rows:
+            w.writerow({k: row.get(k, "") for k in keys})
+    return str(path)

@@ -1,0 +1,358 @@
+"""In-tree Chrome DevTools Protocol driver.
+
+The reference drives Chrome through Playwright (apps/executor/src/
+session.ts:47-53) or Browserbase's remote CDP endpoint (:35-44). This module
+talks CDP directly over a websocket — no vendored browser toolkit — and
+implements the ``PageLike`` surface the interpreter needs. It connects to:
+
+- ``CDP_URL``: an already-running Chrome (local ``http://127.0.0.1:9222`` or
+  a remote browser provider's wss endpoint — the Browserbase-style path), or
+- ``EXECUTOR_CHROME_BIN``: a binary to launch with --remote-debugging-port.
+
+The async protocol core runs on a dedicated thread; PageLike methods are
+synchronous wrappers (the interpreter is sequential by design).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+import subprocess
+import threading
+import time
+from typing import Any
+
+import aiohttp
+
+
+class CDPError(RuntimeError):
+    pass
+
+
+class _CDPConn:
+    """One websocket connection speaking CDP; request/response by id + events."""
+
+    def __init__(self, ws_url: str):
+        self.ws_url = ws_url
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run_loop, daemon=True)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._events: list[dict] = []
+        self._events_lock = threading.Lock()
+        self._next_id = 1
+        self._ws = None
+        self._session: aiohttp.ClientSession | None = None
+        self._ready = threading.Event()
+        self._err: Exception | None = None
+        self._thread.start()
+        if not self._ready.wait(timeout=20):
+            raise CDPError("timeout connecting to CDP websocket")
+        if self._err:
+            raise CDPError(str(self._err))
+
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._connect())
+        except Exception as e:
+            self._err = e
+            self._ready.set()
+            return
+        self._ready.set()
+        self._loop.run_forever()
+
+    async def _connect(self) -> None:
+        self._session = aiohttp.ClientSession()
+        self._ws = await self._session.ws_connect(self.ws_url, max_msg_size=64 * 1024 * 1024)
+        asyncio.ensure_future(self._reader(), loop=self._loop)
+
+    async def _reader(self) -> None:
+        async for msg in self._ws:
+            if msg.type != aiohttp.WSMsgType.TEXT:
+                break
+            obj = json.loads(msg.data)
+            if "id" in obj and obj["id"] in self._pending:
+                fut = self._pending.pop(obj["id"])
+                if not fut.done():
+                    fut.set_result(obj)
+            else:
+                with self._events_lock:
+                    self._events.append(obj)
+                    if len(self._events) > 500:
+                        del self._events[:250]
+
+    def call(self, method: str, params: dict | None = None, timeout_s: float = 30.0) -> dict:
+        async def _send():
+            mid = self._next_id
+            self._next_id += 1
+            fut = self._loop.create_future()
+            self._pending[mid] = fut
+            await self._ws.send_str(json.dumps({"id": mid, "method": method, "params": params or {}}))
+            return await asyncio.wait_for(fut, timeout=timeout_s)
+
+        res = asyncio.run_coroutine_threadsafe(_send(), self._loop).result(timeout=timeout_s + 5)
+        if "error" in res:
+            raise CDPError(f"{method}: {res['error'].get('message')}")
+        return res.get("result", {})
+
+    def clear_events(self, name: str) -> None:
+        """Drop buffered events of this type (e.g. stale loadEventFired from a
+        previous navigation, which would otherwise satisfy the next wait)."""
+        with self._events_lock:
+            self._events[:] = [e for e in self._events if e.get("method") != name]
+
+    def wait_event(self, name: str, timeout_s: float) -> dict | None:
+        """Wait for—and CONSUME—the next event of this type."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._events_lock:
+                for i, ev in enumerate(self._events):
+                    if ev.get("method") == name:
+                        del self._events[i]
+                        return ev
+            time.sleep(0.05)
+        return None
+
+    def close(self) -> None:
+        async def _close():
+            if self._ws is not None:
+                await self._ws.close()
+            if self._session is not None:
+                await self._session.close()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_close(), self._loop).result(timeout=5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class CDPPage:
+    """PageLike over a CDP target."""
+
+    def __init__(self, conn: _CDPConn, browser_proc: subprocess.Popen | None = None):
+        self.conn = conn
+        self.browser_proc = browser_proc
+        self.closed = False
+        self.url = "about:blank"
+        self.title = ""
+        self.conn.call("Page.enable")
+        self.conn.call("Runtime.enable")
+        self.conn.call("DOM.enable")
+
+    # ------------------------------------------------------------ connect
+
+    @classmethod
+    def connect(cls, cdp_url: str | None = None, chrome_bin: str | None = None) -> "CDPPage":
+        proc = None
+        if cdp_url is None:
+            if chrome_bin is None:
+                raise CDPError("need CDP_URL or EXECUTOR_CHROME_BIN")
+            port = int(os.environ.get("CDP_PORT", "9222"))
+            proc = subprocess.Popen(
+                [
+                    chrome_bin,
+                    f"--remote-debugging-port={port}",
+                    "--headless=new",
+                    "--no-sandbox",
+                    "--disable-gpu",
+                    "--no-first-run",
+                    "about:blank",
+                ],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            cdp_url = f"http://127.0.0.1:{port}"
+            time.sleep(1.0)
+        try:
+            ws_url = cls._resolve_ws_url(cdp_url)
+            return cls(_CDPConn(ws_url), browser_proc=proc)
+        except Exception:
+            if proc is not None:  # don't orphan a launched browser
+                proc.kill()
+            raise
+
+    @staticmethod
+    def _resolve_ws_url(cdp_url: str) -> str:
+        if cdp_url.startswith(("ws://", "wss://")):
+            return cdp_url
+        # http endpoint: create/list a page target
+        import urllib.request
+
+        for _ in range(20):
+            try:
+                with urllib.request.urlopen(cdp_url.rstrip("/") + "/json/list", timeout=3) as r:
+                    targets = json.loads(r.read())
+                pages = [t for t in targets if t.get("type") == "page"]
+                if pages:
+                    return pages[0]["webSocketDebuggerUrl"]
+            except Exception:
+                time.sleep(0.5)
+        raise CDPError(f"no page target found at {cdp_url}")
+
+    # ------------------------------------------------------------ PageLike
+
+    def goto(self, url: str, timeout_ms: int = 15000) -> None:
+        self.conn.clear_events("Page.loadEventFired")
+        self.conn.call("Page.navigate", {"url": url}, timeout_s=timeout_ms / 1e3)
+        self.conn.wait_event("Page.loadEventFired", timeout_s=timeout_ms / 1e3)
+        self.url = url
+        self.title = str(self.evaluate("document.title") or "")
+
+    def evaluate(self, js: str) -> Any:
+        res = self.conn.call(
+            "Runtime.evaluate",
+            {"expression": js, "returnByValue": True, "awaitPromise": True},
+        )
+        exc = res.get("exceptionDetails")
+        if exc:
+            raise CDPError(f"evaluate failed: {exc.get('text')}")
+        return res.get("result", {}).get("value")
+
+    def _js_click(self, finder_js: str, what: str) -> None:
+        ok = self.evaluate(
+            f"(() => {{ const el = {finder_js}; if (!el) return false;"
+            "el.scrollIntoView({block:'center'}); el.click(); return true; })()"
+        )
+        if not ok:
+            raise CDPError(f"no element matches {what}")
+
+    def click_selector(self, selector: str, timeout_ms: int = 5000) -> None:
+        self.wait_for_selector(selector, timeout_ms)
+        self._js_click(f"document.querySelector({json.dumps(selector)})", selector)
+
+    def click_text(self, text: str, timeout_ms: int = 5000) -> None:
+        finder = (
+            "Array.from(document.querySelectorAll('a, button, [role=button], input[type=submit]'))"
+            f".find(e => (e.innerText || e.value || '').toLowerCase().includes({json.dumps(text.lower())}))"
+        )
+        self._js_click(finder, f"text={text!r}")
+
+    def click_role(self, role: str, name: str | None, timeout_ms: int = 5000) -> None:
+        name_js = json.dumps((name or "").lower())
+        finder = (
+            f"Array.from(document.querySelectorAll('[role={json.dumps(role)}], {role}'))"
+            f".find(e => !{name_js} || (e.getAttribute('aria-label') || e.innerText || '')"
+            f".toLowerCase().includes({name_js}))"
+        )
+        self._js_click(finder, f"role={role} name={name}")
+
+    def fill(self, selector: str, value: str) -> None:
+        ok = self.evaluate(
+            f"(() => {{ const el = document.querySelector({json.dumps(selector)});"
+            "if (!el) return false; el.focus();"
+            f"el.value = {json.dumps(value)};"
+            "el.dispatchEvent(new Event('input', {bubbles: true}));"
+            "el.dispatchEvent(new Event('change', {bubbles: true})); return true; })()"
+        )
+        if not ok:
+            raise CDPError(f"no element matches {selector}")
+
+    def press(self, selector: str, key: str) -> None:
+        self.evaluate(
+            f"(() => {{ const el = document.querySelector({json.dumps(selector)});"
+            "if (el) el.focus(); })()"
+        )
+        if key == "Enter":
+            for ev_type in ("rawKeyDown", "char", "keyUp"):
+                self.conn.call(
+                    "Input.dispatchKeyEvent",
+                    {
+                        "type": ev_type,
+                        "key": "Enter",
+                        "code": "Enter",
+                        "text": "\r" if ev_type == "char" else "",
+                        "windowsVirtualKeyCode": 13,
+                    },
+                )
+        else:
+            self.conn.call("Input.dispatchKeyEvent", {"type": "keyDown", "key": key})
+            self.conn.call("Input.dispatchKeyEvent", {"type": "keyUp", "key": key})
+
+    def select_option(self, selector: str, label_or_value: str) -> None:
+        ok = self.evaluate(
+            f"(() => {{ const el = document.querySelector({json.dumps(selector)});"
+            "if (!el || el.tagName !== 'SELECT') return false;"
+            f"const want = {json.dumps(label_or_value)};"
+            "let opt = Array.from(el.options).find(o => o.label === want) ||"
+            "          Array.from(el.options).find(o => o.value === want);"
+            "if (!opt) return false; el.value = opt.value;"
+            "el.dispatchEvent(new Event('change', {bubbles: true})); return true; })()"
+        )
+        if not ok:
+            raise CDPError(f"cannot select {label_or_value!r} in {selector}")
+
+    def wait_for_selector(self, selector: str, timeout_ms: int = 15000) -> None:
+        deadline = time.time() + timeout_ms / 1e3
+        probe = (
+            f"(() => {{ const el = document.querySelector({json.dumps(selector)});"
+            "if (!el) return false; const r = el.getBoundingClientRect();"
+            "return r.width > 0 && r.height > 0; })()"
+        )
+        while time.time() < deadline:
+            if self.evaluate(probe):
+                return
+            time.sleep(0.1)
+        raise CDPError(f"timeout waiting for {selector}")
+
+    def set_input_files(self, selector: str, path: str) -> None:
+        doc = self.conn.call("DOM.getDocument")
+        node = self.conn.call(
+            "DOM.querySelector",
+            {"nodeId": doc["root"]["nodeId"], "selector": selector},
+        )
+        if not node.get("nodeId"):
+            raise CDPError(f"no element matches {selector}")
+        self.conn.call(
+            "DOM.setFileInputFiles", {"files": [path], "nodeId": node["nodeId"]}
+        )
+
+    def scroll_by(self, dx: int, dy: int) -> None:
+        self.evaluate(f"window.scrollBy({dx}, {dy})")
+
+    def go_back(self) -> None:
+        self._history_step(-1)
+
+    def go_forward(self) -> None:
+        self._history_step(+1)
+
+    def _history_step(self, delta: int) -> None:
+        hist = self.conn.call("Page.getNavigationHistory")
+        idx = hist["currentIndex"] + delta
+        entries = hist["entries"]
+        if 0 <= idx < len(entries):
+            self.conn.call("Page.navigateToHistoryEntry", {"entryId": entries[idx]["id"]})
+            self.url = entries[idx].get("url", self.url)
+
+    def screenshot(self, path: str, full_page: bool = True) -> None:
+        params: dict = {"format": "png"}
+        if full_page:
+            try:
+                metrics = self.conn.call("Page.getLayoutMetrics")
+                size = metrics.get("cssContentSize") or metrics.get("contentSize") or {}
+                if size:
+                    params["clip"] = {
+                        "x": 0,
+                        "y": 0,
+                        "width": min(size.get("width", 1280), 4096),
+                        "height": min(size.get("height", 720), 8192),
+                        "scale": 1,
+                    }
+                    params["captureBeyondViewport"] = True
+            except CDPError:
+                pass
+        res = self.conn.call("Page.captureScreenshot", params, timeout_s=30)
+        with open(path, "wb") as f:
+            f.write(base64.b64decode(res["data"]))
+
+    def close(self) -> None:
+        self.closed = True
+        self.conn.close()
+        if self.browser_proc is not None:
+            self.browser_proc.terminate()
+            try:
+                self.browser_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.browser_proc.kill()

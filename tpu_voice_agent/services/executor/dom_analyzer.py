@@ -1,0 +1,120 @@
+"""Structured page analysis (reference: apps/executor/src/dom-analyzer.ts:34-448).
+
+Six scans produce the PageAnalysis dict the interpreter uses to ground
+auto-strategy targets: search inputs, buttons, links, forms, filters, nav.
+Each scan is a self-contained JS snippet executed via ``page.evaluate``; the
+``__SCAN__:<kind>`` marker lets the FakePage answer them without a JS engine.
+The selector priority matches the reference: id > data-testid > name > tag
+(dom-analyzer.ts:78-86); visibility = positive client rect.
+
+This structured-DOM representation is the component a Qwen2-VL screenshot
+grounding head augments (SURVEY.md §2 #15).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_COMMON_JS = """
+const sel = (el) => {
+  if (el.id) return '#' + CSS.escape(el.id);
+  if (el.dataset && el.dataset.testid) return `[data-testid="${el.dataset.testid}"]`;
+  if (el.name) return `${el.tagName.toLowerCase()}[name="${el.name}"]`;
+  let s = el.tagName.toLowerCase();
+  const sib = el.parentElement ? Array.from(el.parentElement.children).filter(c => c.tagName === el.tagName) : [];
+  if (sib.length > 1) s += `:nth-of-type(${sib.indexOf(el) + 1})`;
+  return s;
+};
+const vis = (el) => { const r = el.getBoundingClientRect(); return r.width > 0 && r.height > 0; };
+const info = (el) => ({
+  selector: sel(el), type: el.type || el.tagName.toLowerCase(),
+  text: (el.innerText || el.value || '').trim().slice(0, 120),
+  placeholder: el.placeholder || '',
+  attributes: {role: el.getAttribute('role') || '', name: el.name || '',
+               'aria-label': el.getAttribute('aria-label') || ''},
+  isVisible: vis(el), isEnabled: !el.disabled,
+});
+"""
+
+
+def _scan_js(kind: str, body: str) -> str:
+    return f"/* __SCAN__: {kind} */ (() => {{ {_COMMON_JS} {body} }})()"
+
+
+SCANS: dict[str, str] = {
+    "search": _scan_js(
+        "search",
+        """
+        const cands = Array.from(document.querySelectorAll(
+          'input[type=search], input[type=text], input:not([type])'));
+        return cands.filter(el => vis(el) && (
+          el.type === 'search' ||
+          /search|find|query/i.test(el.placeholder || '') ||
+          /search|query/i.test(el.getAttribute('aria-label') || '') ||
+          el.name === 'q' || /search/i.test(el.id || '')
+        )).map(info);
+        """,
+    ),
+    "buttons": _scan_js(
+        "buttons",
+        """
+        const els = Array.from(document.querySelectorAll(
+          'button, input[type=submit], input[type=button], [role=button]'));
+        return els.filter(vis).map(info);
+        """,
+    ),
+    "links": _scan_js(
+        "links",
+        "return Array.from(document.querySelectorAll('a[href]')).filter(vis).slice(0, 80).map(info);",
+    ),
+    "forms": _scan_js(
+        "forms",
+        """
+        return Array.from(document.querySelectorAll('form')).filter(vis).map(f => {
+          const d = info(f);
+          d.inputs = Array.from(f.querySelectorAll('input, select, textarea')).filter(vis).map(info);
+          const sub = f.querySelector('button[type=submit], input[type=submit], button');
+          d.submit = sub ? info(sub) : null;
+          return d;
+        });
+        """,
+    ),
+    "filters": _scan_js(
+        "filters",
+        """
+        const out = [];
+        // price-range pairs: >=2 visible numeric inputs mentioning price
+        const price = Array.from(document.querySelectorAll('input')).filter(el =>
+          vis(el) && /price|min|max/i.test((el.name||'') + (el.id||'') + (el.placeholder||'')));
+        if (price.length >= 2) out.push({kind: 'price_range', inputs: price.map(info)});
+        for (const s of Array.from(document.querySelectorAll('select')).filter(vis)) {
+          const d = info(s); d.kind = 'dropdown';
+          d.options = Array.from(s.options).map(o => o.label || o.value);
+          out.push(d);
+        }
+        return out;
+        """,
+    ),
+    "nav": _scan_js(
+        "nav",
+        """
+        const els = Array.from(document.querySelectorAll('nav a, [role=navigation] a, header a'));
+        return els.filter(vis).slice(0, 40).map(info);
+        """,
+    ),
+}
+
+
+def analyze_page(page) -> dict[str, Any]:
+    """Run all scans; returns the PageAnalysis dict
+    {url,title,searchElements,buttons,links,forms,filters,navigationElements}."""
+    return {
+        "url": page.evaluate("location.href") or getattr(page, "url", ""),
+        "title": page.evaluate("document.title") or getattr(page, "title", ""),
+        "searchElements": page.evaluate(SCANS["search"]) or [],
+        "buttons": page.evaluate(SCANS["buttons"]) or [],
+        "links": page.evaluate(SCANS["links"]) or [],
+        "forms": page.evaluate(SCANS["forms"]) or [],
+        "filters": page.evaluate(SCANS["filters"]) or [],
+        "navigationElements": page.evaluate(SCANS["nav"]) or [],
+    }

@@ -1,0 +1,273 @@
+"""Browser page abstraction + in-memory fake.
+
+The reference drives Playwright's ``Page`` directly and fakes it in tests
+with an object of vi.fn() stubs (apps/executor/test/actions.test.ts:5-24).
+Here the interpreter is written against ``PageLike`` — the minimal operation
+set the 19 intents need — with two implementations:
+
+- ``cdp.CDPPage``: real Chrome over the DevTools protocol (in-tree client;
+  the Playwright dependency is gone)
+- ``FakePage``: a scriptable in-memory page for tests and for running the
+  full service stack on boxes with no browser (this TPU host, CI)
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Protocol
+
+
+class PageLike(Protocol):
+    url: str
+    title: str
+
+    def goto(self, url: str, timeout_ms: int = 15000) -> None: ...
+    def evaluate(self, js: str) -> Any: ...
+    def click_selector(self, selector: str, timeout_ms: int = 5000) -> None: ...
+    def click_text(self, text: str, timeout_ms: int = 5000) -> None: ...
+    def click_role(self, role: str, name: str | None, timeout_ms: int = 5000) -> None: ...
+    def fill(self, selector: str, value: str) -> None: ...
+    def press(self, selector: str, key: str) -> None: ...
+    def select_option(self, selector: str, label_or_value: str) -> None: ...
+    def wait_for_selector(self, selector: str, timeout_ms: int = 15000) -> None: ...
+    def set_input_files(self, selector: str, path: str) -> None: ...
+    def scroll_by(self, dx: int, dy: int) -> None: ...
+    def go_back(self) -> None: ...
+    def go_forward(self) -> None: ...
+    def screenshot(self, path: str, full_page: bool = True) -> None: ...
+    def close(self) -> None: ...
+
+
+@dataclass
+class FakeElement:
+    selector: str
+    tag: str = "div"
+    text: str = ""
+    etype: str = ""
+    placeholder: str = ""
+    role: str = ""
+    name: str = ""
+    value: str = ""
+    options: list[str] = field(default_factory=list)
+    visible: bool = True
+    attrs: dict[str, str] = field(default_factory=dict)
+
+
+class FakePage:
+    """Scriptable page: a flat element list + an action log.
+
+    ``evaluate`` understands the DOM-analyzer scan markers (see
+    dom_analyzer.py) and a few generic snippets; everything else returns
+    None. Tests assert on ``actions`` — the same style as the reference's
+    vi.fn() page.
+    """
+
+    def __init__(self, elements: list[FakeElement] | None = None, url: str = "about:blank"):
+        self.url = url
+        self.title = "Fake Page"
+        self.elements: list[FakeElement] = elements or []
+        self.actions: list[tuple] = []
+        self.history: list[str] = [url]
+        self._fwd: list[str] = []
+        self.closed = False
+        self.fail_next: str | None = None  # operation name to fail once (fault injection)
+        self.extract_rows: list[dict] = [
+            {"title": "Fake Product A", "price": "$19.99"},
+            {"title": "Fake Product B", "price": "$24.50"},
+        ]
+
+    # ---------------------------------------------------------- helpers
+
+    @classmethod
+    def demo(cls) -> "FakePage":
+        """A small scripted storefront so the fake-page service mode supports
+        every intent family out of the box (offline demos, voice e2e)."""
+        return cls(
+            elements=[
+                FakeElement("#search", tag="input", etype="search", placeholder="Search products"),
+                FakeElement("#add-to-cart", tag="button", text="Add to Cart", role="button", name="Add to Cart"),
+                FakeElement("#checkout", tag="button", text="Checkout", role="button", name="Checkout"),
+                FakeElement("a.r1", tag="a", text="First result"),
+                FakeElement("a.r2", tag="a", text="Second result"),
+                FakeElement("a.r3", tag="a", text="Third result"),
+                FakeElement(
+                    "#sort", tag="select", name="sort",
+                    options=["Featured", "Price Low to High", "Price High to Low"],
+                ),
+                FakeElement("#minprice", tag="input", name="min-price"),
+                FakeElement("#maxprice", tag="input", name="max-price"),
+                FakeElement("#file", tag="input", etype="file"),
+                FakeElement(".results", tag="div", text="demo results"),
+            ],
+            url="https://demo.local/shop",
+        )
+
+    def _maybe_fail(self, op: str) -> None:
+        if self.fail_next == op:
+            self.fail_next = None
+            raise RuntimeError(f"injected fault in {op}")
+
+    def find(self, selector: str) -> FakeElement | None:
+        for el in self.elements:
+            if el.selector == selector:
+                return el
+        return None
+
+    # ---------------------------------------------------------- PageLike
+
+    def goto(self, url: str, timeout_ms: int = 15000) -> None:
+        self._maybe_fail("goto")
+        self.actions.append(("goto", url))
+        self.history.append(url)
+        self._fwd.clear()
+        self.url = url
+        self.title = f"Fake: {url}"
+
+    def evaluate(self, js: str):
+        self._maybe_fail("evaluate")
+        self.actions.append(("evaluate", js[:60]))
+        if "__SCAN__" in js:
+            kind = js.split("__SCAN__:", 1)[1].split("*", 1)[0].strip()
+            return self._scan(kind)
+        if "__EXTRACT_CARDS__" in js:
+            return self.extract_rows
+        if "document.title" in js:
+            return self.title
+        if "location.href" in js:
+            return self.url
+        if "document.body.innerText" in js:
+            return " ".join(el.text for el in self.elements if el.text) or "fake body text"
+        return None
+
+    def _info(self, el: FakeElement) -> dict:
+        return {
+            "selector": el.selector,
+            "type": el.etype or el.tag,
+            "text": el.text,
+            "placeholder": el.placeholder,
+            "attributes": {"role": el.role, "name": el.name, **el.attrs},
+            "isVisible": el.visible,
+            "isEnabled": True,
+        }
+
+    def _scan(self, kind: str) -> list[dict]:
+        visible = [el for el in self.elements if el.visible]
+        if kind == "filters":
+            # mirror dom_analyzer's shape: one grouped price_range entry plus
+            # a kind='dropdown' entry per select
+            out: list[dict] = []
+            price_inputs = [
+                self._info(el)
+                for el in visible
+                if el.tag == "input" and ("price" in el.name.lower() or "price" in el.selector.lower())
+            ]
+            if len(price_inputs) >= 2:
+                out.append({"kind": "price_range", "inputs": price_inputs})
+            for el in visible:
+                if el.tag == "select":
+                    d = self._info(el)
+                    d["kind"] = "dropdown"
+                    d["options"] = list(el.options)
+                    out.append(d)
+            return out
+        out = []
+        for el in visible:
+            d = self._info(el)
+            if kind == "search" and (
+                el.etype == "search"
+                or "search" in el.placeholder.lower()
+                or el.attrs.get("name") == "q"
+            ):
+                out.append(d)
+            elif kind == "buttons" and (el.tag == "button" or el.role == "button"):
+                out.append(d)
+            elif kind == "links" and el.tag == "a":
+                out.append(d)
+            elif kind == "forms" and el.tag == "form":
+                out.append(d)
+            elif kind == "nav" and el.role == "navigation":
+                out.append(d)
+        return out
+
+    def click_selector(self, selector: str, timeout_ms: int = 5000) -> None:
+        self._maybe_fail("click")
+        if self.find(selector) is None:
+            raise RuntimeError(f"no element matches {selector}")
+        self.actions.append(("click_selector", selector))
+
+    def click_text(self, text: str, timeout_ms: int = 5000) -> None:
+        self._maybe_fail("click")
+        for el in self.elements:
+            if text.lower() in el.text.lower():
+                self.actions.append(("click_text", text, el.selector))
+                return
+        raise RuntimeError(f"no element with text {text!r}")
+
+    def click_role(self, role: str, name: str | None, timeout_ms: int = 5000) -> None:
+        self._maybe_fail("click")
+        for el in self.elements:
+            if el.role == role and (name is None or name.lower() in (el.name or el.text).lower()):
+                self.actions.append(("click_role", role, name, el.selector))
+                return
+        raise RuntimeError(f"no element with role={role} name={name}")
+
+    def fill(self, selector: str, value: str) -> None:
+        self._maybe_fail("fill")
+        el = self.find(selector)
+        if el is None:
+            raise RuntimeError(f"no element matches {selector}")
+        el.value = value
+        self.actions.append(("fill", selector, value))
+
+    def press(self, selector: str, key: str) -> None:
+        self.actions.append(("press", selector, key))
+
+    def select_option(self, selector: str, label_or_value: str) -> None:
+        self._maybe_fail("select")
+        el = self.find(selector)
+        if el is None or (el.options and label_or_value not in el.options):
+            raise RuntimeError(f"cannot select {label_or_value!r} in {selector}")
+        el.value = label_or_value
+        self.actions.append(("select_option", selector, label_or_value))
+
+    def wait_for_selector(self, selector: str, timeout_ms: int = 15000) -> None:
+        self._maybe_fail("wait_for")
+        if self.find(selector) is None:
+            raise RuntimeError(f"timeout waiting for {selector}")
+        self.actions.append(("wait_for_selector", selector))
+
+    def set_input_files(self, selector: str, path: str) -> None:
+        self._maybe_fail("upload")
+        self.actions.append(("set_input_files", selector, path))
+
+    def scroll_by(self, dx: int, dy: int) -> None:
+        self.actions.append(("scroll_by", dx, dy))
+
+    def go_back(self) -> None:
+        if len(self.history) > 1:
+            self._fwd.append(self.history.pop())
+            self.url = self.history[-1]
+        self.actions.append(("go_back",))
+
+    def go_forward(self) -> None:
+        if self._fwd:
+            self.url = self._fwd.pop()
+            self.history.append(self.url)
+        self.actions.append(("go_forward",))
+
+    def screenshot(self, path: str, full_page: bool = True) -> None:
+        self._maybe_fail("screenshot")
+        with open(path, "wb") as f:
+            # 1x1 transparent PNG
+            f.write(
+                bytes.fromhex(
+                    "89504e470d0a1a0a0000000d49484452000000010000000108060000001f15c489"
+                    "0000000d49444154789c626001000000ffff03000006000557bfabd40000000049454e44ae426082"
+                )
+            )
+        self.actions.append(("screenshot", path))
+
+    def close(self) -> None:
+        self.closed = True
+        self.actions.append(("close",))

@@ -1,0 +1,122 @@
+"""Executor HTTP service (reference: apps/executor/src/server.ts:23-100).
+
+Routes: GET /health, POST /execute, POST /uploads (multipart), POST /close.
+Same response envelope as the reference: /execute returns
+``{session_id, results[], artifacts: {dir}}``; /uploads returns
+``{fileRef: "resume://<id>", path}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+
+from aiohttp import web
+
+from ...schemas import ExecuteRequest
+from ...utils import Tracer, load_env_cascade, new_trace_id
+from .actions import run_intents
+from .session import SessionManager
+
+
+def build_app(manager: SessionManager | None = None, tracer: Tracer | None = None) -> web.Application:
+    manager = manager or SessionManager()
+    tracer = tracer or Tracer("executor", emit=False)
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    # sessions are single-browser resources; serialize intent batches per proc
+    exec_lock = threading.Lock()
+
+    async def health(_req: web.Request) -> web.Response:
+        return web.json_response(
+            {"ok": True, "service": "executor", "sessions": len(manager.sessions)}
+        )
+
+    async def execute(req: web.Request) -> web.Response:
+        trace_id = req.headers.get("x-trace-id", new_trace_id())
+        headers = {"x-trace-id": trace_id}
+        try:
+            body = await req.json()
+        except Exception:
+            return web.json_response(
+                {"error": "invalid_request", "detail": "body must be JSON"},
+                status=400, headers=headers,
+            )
+        try:
+            ereq = ExecuteRequest.model_validate(body)
+        except Exception as e:
+            return web.json_response(
+                {"error": "invalid_request", "detail": str(e)[:500]},
+                status=400, headers=headers,
+            )
+
+        def work():
+            with exec_lock:
+                session = manager.open(ereq.session_id)
+                with tracer.span("execute", trace_id=trace_id, intents=len(ereq.intents)):
+                    results = run_intents(
+                        session.page,
+                        session.artifacts_dir,
+                        ereq.intents,
+                        uploads_dir=manager.uploads_dir,
+                    )
+                return session, results
+
+        try:
+            session, results = await asyncio.get_running_loop().run_in_executor(None, work)
+        except Exception as e:
+            return web.json_response(
+                {"error": "execution_error", "detail": str(e)[:500]},
+                status=500, headers=headers,
+            )
+        return web.json_response(
+            {
+                "session_id": session.id,
+                "results": [r.model_dump() for r in results],
+                "artifacts": {"dir": session.artifacts_dir},
+            },
+            headers=headers,
+        )
+
+    async def uploads(req: web.Request) -> web.Response:
+        try:
+            reader = await req.multipart()
+        except Exception:
+            return web.json_response(
+                {"error": "invalid_request", "detail": "expected multipart/form-data"},
+                status=400,
+            )
+        async for part in reader:
+            if part.name in ("file", "upload") or part.filename:
+                data = await part.read(decode=False)
+                file_ref, path = manager.save_upload(part.filename or "upload.bin", data)
+                return web.json_response({"fileRef": file_ref, "path": path})
+        return web.json_response(
+            {"error": "invalid_request", "detail": "no file part"}, status=400
+        )
+
+    async def close(req: web.Request) -> web.Response:
+        try:
+            body = await req.json()
+        except Exception:
+            body = {}
+        sid = body.get("session_id")
+        ok = manager.close(sid) if sid else False
+        return web.json_response({"ok": ok})
+
+    app.router.add_get("/health", health)
+    app.router.add_post("/execute", execute)
+    app.router.add_post("/uploads", uploads)
+    app.router.add_post("/close", close)
+    return app
+
+
+def main() -> None:
+    load_env_cascade()
+    port = int(os.environ.get("EXECUTOR_PORT", "7081"))
+    app = build_app(tracer=Tracer("executor"))
+    web.run_app(app, port=port)
+
+
+if __name__ == "__main__":
+    main()

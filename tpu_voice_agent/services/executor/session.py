@@ -1,0 +1,121 @@
+"""Browser session manager (reference: apps/executor/src/session.ts:19-73).
+
+Improvements over the reference: sessions expire after an idle TTL instead of
+leaking until /close (session.ts has no eviction), and a dead page is
+detected and replaced on reuse (the reference only recreates on a Map miss,
+README.md:273-276).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable
+
+from .page import FakePage, PageLike
+
+
+@dataclass
+class Session:
+    id: str
+    page: PageLike
+    artifacts_dir: str
+    created_s: float = field(default_factory=time.time)
+    last_used_s: float = field(default_factory=time.time)
+
+
+class SessionManager:
+    def __init__(
+        self,
+        page_factory: Callable[[], PageLike] | None = None,
+        artifacts_root: str | None = None,
+        uploads_dir: str | None = None,
+        idle_ttl_s: float = 1800.0,
+    ):
+        self.page_factory = page_factory or default_page_factory_from_env()
+        self.artifacts_root = artifacts_root or os.environ.get("ARTIFACTS_DIR", ".artifacts")
+        self.uploads_dir = uploads_dir or os.environ.get("UPLOADS_DIR", ".uploads")
+        self.idle_ttl_s = idle_ttl_s
+        self.sessions: dict[str, Session] = {}
+        Path(self.uploads_dir).mkdir(parents=True, exist_ok=True)
+
+    def _alive(self, s: Session) -> bool:
+        try:
+            return not getattr(s.page, "closed", False)
+        except Exception:
+            return False
+
+    def open(self, session_id: str | None = None) -> Session:
+        self.evict_idle()
+        if session_id and session_id in self.sessions:
+            s = self.sessions[session_id]
+            if self._alive(s):
+                s.last_used_s = time.time()
+                return s
+            # dead browser: recreate under the same id (fixes reference gap)
+            try:
+                s.page.close()
+            except Exception:
+                pass
+            del self.sessions[session_id]
+        sid = session_id or uuid.uuid4().hex[:12]
+        art_dir = str(Path(self.artifacts_root) / sid)
+        Path(art_dir).mkdir(parents=True, exist_ok=True)
+        s = Session(id=sid, page=self.page_factory(), artifacts_dir=art_dir)
+        self.sessions[sid] = s
+        return s
+
+    def close(self, session_id: str) -> bool:
+        s = self.sessions.pop(session_id, None)
+        if s is None:
+            return False
+        try:
+            s.page.close()
+        except Exception:
+            pass
+        return True
+
+    def close_all(self) -> None:
+        for sid in list(self.sessions):
+            self.close(sid)
+
+    def evict_idle(self) -> int:
+        now = time.time()
+        evicted = 0
+        for sid, s in list(self.sessions.items()):
+            if now - s.last_used_s > self.idle_ttl_s:
+                self.close(sid)
+                evicted += 1
+        return evicted
+
+    # ------------------------------------------------------------ uploads
+
+    def save_upload(self, filename: str, data: bytes) -> tuple[str, str]:
+        """Store an uploaded file; returns (fileRef, path).
+        Reference: apps/executor/src/server.ts:34-66."""
+        ext = Path(filename).suffix[:16]
+        uid = uuid.uuid4().hex[:12]
+        path = Path(self.uploads_dir) / f"{uid}{ext}"
+        path.write_bytes(data)
+        return f"resume://{uid}", str(path)
+
+
+def default_page_factory_from_env() -> Callable[[], PageLike]:
+    """FakePage when EXECUTOR_FAKE_PAGE=1 or no Chrome endpoint; CDP otherwise.
+
+    CDP_URL points at a running Chrome's devtools endpoint
+    (ws://... or http://host:9222); EXECUTOR_CHROME_BIN launches one.
+    """
+    if os.environ.get("EXECUTOR_FAKE_PAGE", "").lower() in ("1", "true", "yes"):
+        return FakePage.demo
+    cdp_url = os.environ.get("CDP_URL")
+    chrome_bin = os.environ.get("EXECUTOR_CHROME_BIN")
+    if cdp_url or chrome_bin:
+        from .cdp import CDPPage
+
+        return lambda: CDPPage.connect(cdp_url=cdp_url, chrome_bin=chrome_bin)
+    # no browser available on this host: fall back to the scripted fake
+    return FakePage.demo
