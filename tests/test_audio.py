@@ -1,0 +1,61 @@
+"""Audio frontend: mel spectrogram physics + endpointing behavior."""
+
+import numpy as np
+
+from tpu_voice_agent.audio import EnergyEndpointer, MelConfig, log_mel_spectrogram, mel_filterbank
+from tpu_voice_agent.audio.mel import pcm16_to_float
+
+
+def tone(freq_hz: float, dur_s: float, sr: int = 16_000, amp: float = 0.5) -> np.ndarray:
+    t = np.arange(int(dur_s * sr)) / sr
+    return (amp * np.sin(2 * np.pi * freq_hz * t)).astype(np.float32)
+
+
+def test_mel_shape_and_range():
+    cfg = MelConfig()
+    spec = np.asarray(log_mel_spectrogram(tone(440, 1.0), cfg))
+    assert spec.shape == (101, 80)  # 1 s @ hop 160 (+1 centered frame)
+    assert np.isfinite(spec).all()
+    # whisper normalization keeps values in a small band around [-1, 1]
+    assert spec.max() <= 1.5 and spec.min() >= -3.0
+
+
+def test_mel_tone_energy_lands_in_right_band():
+    """A 440 Hz tone must peak in a low mel bin; 4 kHz far higher."""
+    cfg = MelConfig()
+    lo = np.asarray(log_mel_spectrogram(tone(440, 0.5), cfg)).mean(axis=0)
+    hi = np.asarray(log_mel_spectrogram(tone(4000, 0.5), cfg)).mean(axis=0)
+    assert lo.argmax() < 20
+    assert hi.argmax() > 40
+    assert hi.argmax() > lo.argmax()
+
+
+def test_mel_filterbank_covers_spectrum():
+    fb = mel_filterbank(MelConfig())
+    assert fb.shape == (201, 80)
+    # every mel bin has some support; no all-zero filter
+    assert (fb.sum(axis=0) > 0).all()
+
+
+def test_pcm16_roundtrip():
+    samples = (np.array([0, 16384, -16384, 32767], dtype="<i2")).tobytes()
+    out = pcm16_to_float(samples)
+    np.testing.assert_allclose(out, [0.0, 0.5, -0.5, 0.99997], atol=1e-4)
+
+
+def test_endpointer_finalizes_after_trailing_silence():
+    ep = EnergyEndpointer(trailing_silence_ms=200, min_speech_ms=100)
+    speech = tone(300, 0.5, amp=0.3)
+    silence = np.zeros(16_000 // 2, dtype=np.float32)
+    assert not ep.feed(speech)  # still talking
+    assert ep.in_speech
+    assert ep.feed(silence)  # utterance closed
+    assert not ep.in_speech
+
+
+def test_endpointer_ignores_short_blips():
+    ep = EnergyEndpointer(trailing_silence_ms=200, min_speech_ms=300)
+    blip = tone(300, 0.05, amp=0.3)  # 50 ms < min_speech
+    silence = np.zeros(16_000, dtype=np.float32)
+    ep.feed(blip)
+    assert not ep.feed(silence)

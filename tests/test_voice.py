@@ -1,0 +1,160 @@
+"""Voice service: WS event vocabulary + the FULL pipeline end to end.
+
+The crown-jewel test boots all three real services (voice with scripted STT,
+brain with the rule parser, executor with the fake page) on real sockets and
+pushes binary audio frames through the WS: audio -> transcript_final ->
+intent -> auto-execute -> execution_result, and the risky path ->
+confirmation_required -> confirm_execute -> execution_result. This is the
+integration test the reference never had (SURVEY.md §4: "no integration or
+e2e tests").
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+import aiohttp
+
+from tpu_voice_agent.serve.stt import NullSTT
+from tpu_voice_agent.services.brain import RuleBasedParser, build_app as build_brain
+from tpu_voice_agent.services.executor import SessionManager, build_app as build_executor
+from tpu_voice_agent.services.executor.page import FakePage
+from tpu_voice_agent.services.voice import VoiceConfig, build_app as build_voice
+from tests.http_helper import AppServer
+
+PCM_SILENCE = (np.zeros(1600, dtype="<i2")).tobytes()  # 100 ms
+
+
+def ws_session(voice_url, inbound, expect_types, timeout_s=30.0):
+    """Connect to /stream, send frames, collect events until all expected
+    types were seen (or timeout). Returns the ordered event list."""
+
+    async def run():
+        events = []
+        seen = set()
+        async with aiohttp.ClientSession() as sess:
+            async with sess.ws_connect(voice_url.replace("http", "ws") + "/stream") as ws:
+                for kind, payload in inbound:
+                    if kind == "binary":
+                        await ws.send_bytes(payload)
+                    else:
+                        await ws.send_json(payload)
+                end = asyncio.get_event_loop().time() + timeout_s
+                while asyncio.get_event_loop().time() < end:
+                    try:
+                        msg = await ws.receive(timeout=1.0)
+                    except asyncio.TimeoutError:
+                        continue
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        break
+                    ev = json.loads(msg.data)
+                    events.append(ev)
+                    seen.add(ev["type"])
+                    if set(expect_types) <= seen:
+                        break
+        return events
+
+    return asyncio.run(run())
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """voice + brain + executor on real sockets."""
+    tmp = tmp_path_factory.mktemp("stack")
+    brain = AppServer(build_brain(RuleBasedParser())).__enter__()
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp / "art"),
+        uploads_dir=str(tmp / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+
+    scripted: list = []
+
+    def stt_factory():
+        return NullSTT(scripted=list(scripted))
+
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url, stt_factory=stt_factory))
+    ).__enter__()
+    yield {"voice": voice, "brain": brain, "executor": executor, "scripted": scripted}
+    for srv in (voice, executor, brain):
+        srv.__exit__(None, None, None)
+
+
+def test_first_frame_warns_in_null_mode(stack):
+    events = ws_session(stack["voice"].url, [], ["warn"], timeout_s=5)
+    assert events[0]["type"] == "warn"
+
+
+def test_full_pipeline_audio_to_execution(stack):
+    stack["scripted"][:] = [("partial", "search for"), ("final", "search for laptops")]
+    events = ws_session(
+        stack["voice"].url,
+        [("binary", PCM_SILENCE), ("binary", PCM_SILENCE)],
+        ["execution_result"],
+    )
+    types = [e["type"] for e in events]
+    assert "transcript_partial" in types
+    assert "transcript_final" in types
+    assert "intent" in types and "tts" in types
+    intent_ev = next(e for e in events if e["type"] == "intent")
+    assert intent_ev["data"]["intents"][0]["type"] == "search"
+    result_ev = next(e for e in events if e["type"] == "execution_result")
+    assert result_ev["data"]["results"][0]["ok"]
+    assert result_ev["data"]["session_id"]
+
+
+def test_risky_path_requires_confirmation_then_executes(stack):
+    stack["scripted"][:] = [("final", "upload my resume and submit the form")]
+    events = ws_session(
+        stack["voice"].url, [("binary", PCM_SILENCE)], ["confirmation_required"]
+    )
+    conf = next(e for e in events if e["type"] == "confirmation_required")
+    risky = conf["intents"]
+    assert all(i["requires_confirmation"] for i in risky)
+    assert not any(e["type"] == "execution_result" for e in events)
+
+    # user approves: send confirm_execute with a safe screenshot instead of
+    # the upload (no stored file in this test)
+    events2 = ws_session(
+        stack["voice"].url,
+        [("json", {"type": "confirm_execute", "intents": [{"type": "screenshot"}]})],
+        ["execution_result"],
+    )
+    res = next(e for e in events2 if e["type"] == "execution_result")
+    assert res["data"]["results"][0]["ok"]
+
+
+def test_typed_text_command_path(stack):
+    events = ws_session(
+        stack["voice"].url,
+        [("json", {"type": "text", "text": "take a screenshot"})],
+        ["execution_result"],
+    )
+    assert any(e["type"] == "transcript_final" for e in events)
+    assert any(e["type"] == "execution_result" for e in events)
+
+
+def test_context_update_control_frame(stack):
+    events = ws_session(
+        stack["voice"].url,
+        [("json", {"type": "context_update", "data": {"last_query": "tvs"}})],
+        ["info"],
+        timeout_s=5,
+    )
+    assert any(e["type"] == "info" and "context" in e.get("message", "") for e in events)
+
+
+def test_bad_control_frame_warns_not_crashes(stack):
+    # the null-mode warn fires first, so collect for a fixed window instead
+    # of stopping at the first warn
+    events = ws_session(
+        stack["voice"].url,
+        [("json", {"type": "florble"})],
+        ["__collect_until_timeout__"],
+        timeout_s=3,
+    )
+    warns = [e for e in events if e["type"] == "warn"]
+    assert any("unknown control" in e.get("message", "") for e in warns)

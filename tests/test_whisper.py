@@ -1,0 +1,92 @@
+"""Whisper model correctness: encoder shapes, decoder cache equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.models.whisper import (
+    PRESETS,
+    WhisperConfig,
+    compute_cross_kv,
+    decoder_forward,
+    encoder_forward,
+    init_params,
+    init_self_cache,
+    param_count,
+)
+
+CFG = WhisperConfig(
+    vocab_size=64, d_model=64, n_heads=4, enc_layers=2, dec_layers=2,
+    max_audio_frames=64, max_text_len=32,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mel = jax.random.normal(jax.random.PRNGKey(1), (1, CFG.max_audio_frames, CFG.n_mels))
+    enc = encoder_forward(params, CFG, mel)
+    cross = compute_cross_kv(params, CFG, enc)
+    mask = jnp.ones((1, enc.shape[1]), dtype=bool)
+    return params, enc, cross, mask
+
+
+def test_encoder_halves_time_axis(setup):
+    _, enc, _, _ = setup
+    assert enc.shape == (1, CFG.max_audio_frames // 2, CFG.d_model)
+    assert np.isfinite(np.asarray(enc)).all()
+
+
+def test_cross_kv_shape(setup):
+    _, enc, cross, _ = setup
+    assert cross["k"].shape == (CFG.dec_layers, 1, enc.shape[1], CFG.n_heads, CFG.head_dim)
+
+
+def test_decoder_incremental_matches_teacher_forced(setup):
+    params, _, cross, mask = setup
+    T = 10
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (1, T)), jnp.int32)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    cache = init_self_cache(CFG, 1, dtype=jnp.float32)
+    full, _ = decoder_forward(params, CFG, tokens, positions, cache, cross, mask)
+
+    cache = init_self_cache(CFG, 1, dtype=jnp.float32)
+    steps = []
+    for t in range(T):
+        lg, cache = decoder_forward(
+            params, CFG, tokens[:, t : t + 1], positions[:, t : t + 1], cache, cross, mask
+        )
+        steps.append(lg[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(jnp.stack(steps, 1)), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_encoder_mask_hides_padding(setup):
+    """Cross-attention must ignore masked encoder frames entirely."""
+    params, enc, cross, _ = setup
+    half = enc.shape[1] // 2
+    mask_half = jnp.arange(enc.shape[1])[None, :] < half
+
+    # corrupt the masked-out frames of the cross K/V; logits must not change
+    corrupted = {
+        "k": cross["k"].at[:, :, half:].set(99.0),
+        "v": cross["v"].at[:, :, half:].set(-99.0),
+    }
+    tok = jnp.zeros((1, 1), jnp.int32)
+    pos = jnp.zeros((1, 1), jnp.int32)
+    a, _ = decoder_forward(params, CFG, tok, pos, init_self_cache(CFG, 1, dtype=jnp.float32),
+                           cross, mask_half)
+    b, _ = decoder_forward(params, CFG, tok, pos, init_self_cache(CFG, 1, dtype=jnp.float32),
+                           corrupted, mask_half)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_large_v3_param_scale():
+    from dataclasses import replace
+
+    cfg = replace(PRESETS["whisper-large-v3"], vocab_size=51_866)
+    assert 1.3e9 < param_count(cfg) < 1.8e9

@@ -1,0 +1,4 @@
+from .mel import MelConfig, log_mel_spectrogram, mel_filterbank
+from .endpoint import EnergyEndpointer
+
+__all__ = ["MelConfig", "log_mel_spectrogram", "mel_filterbank", "EnergyEndpointer"]

@@ -1,0 +1,66 @@
+"""Energy-based endpointing.
+
+The reference adds a fixed 1000 ms debounce to EVERY command
+(apps/voice/src/server.ts:229) — the single largest latency constant in its
+pipeline. This endpointer closes an utterance after `trailing_silence_ms` of
+sub-threshold energy instead, typically clawing back 600-700 ms. A model-free
+adaptive noise floor keeps it robust to mic gain differences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class EnergyEndpointer:
+    def __init__(
+        self,
+        sample_rate: int = 16_000,
+        frame_ms: int = 20,
+        trailing_silence_ms: int = 350,
+        min_speech_ms: int = 200,
+        threshold_mult: float = 3.0,
+    ):
+        self.sr = sample_rate
+        self.frame = int(sample_rate * frame_ms / 1000)
+        self.trailing_frames = max(1, trailing_silence_ms // frame_ms)
+        self.min_speech_frames = max(1, min_speech_ms // frame_ms)
+        self.threshold_mult = threshold_mult
+        self.noise_floor = 1e-4
+        self._buf = np.zeros(0, dtype=np.float32)
+        self._speech_frames = 0
+        self._silence_run = 0
+        self.in_speech = False
+
+    def reset(self) -> None:
+        self._buf = np.zeros(0, dtype=np.float32)
+        self._speech_frames = 0
+        self._silence_run = 0
+        self.in_speech = False
+
+    def feed(self, samples: np.ndarray) -> bool:
+        """Feed float32 samples; True when an utterance just ended."""
+        self._buf = np.concatenate([self._buf, samples.astype(np.float32)])
+        ended = False
+        while len(self._buf) >= self.frame:
+            frame, self._buf = self._buf[: self.frame], self._buf[self.frame :]
+            rms = float(np.sqrt(np.mean(frame * frame) + 1e-12))
+            threshold = self.noise_floor * self.threshold_mult
+            if rms > threshold:
+                self.in_speech = True
+                self._speech_frames += 1
+                self._silence_run = 0
+            else:
+                # adapt the noise floor on silence only
+                self.noise_floor = 0.95 * self.noise_floor + 0.05 * max(rms, 1e-6)
+                if self.in_speech:
+                    self._silence_run += 1
+                    if (
+                        self._silence_run >= self.trailing_frames
+                        and self._speech_frames >= self.min_speech_frames
+                    ):
+                        ended = True
+                        self.in_speech = False
+                        self._speech_frames = 0
+                        self._silence_run = 0
+        return ended

@@ -1,0 +1,226 @@
+"""Streaming speech-to-text engine on the in-tree Whisper models.
+
+Replaces the reference's Deepgram live client (apps/voice/src/deepgram.ts).
+Design:
+
+- audio accumulates host-side; every `partial_interval_s` of new speech the
+  current utterance window is re-transcribed and emitted as a partial
+  (the reference's interim_results analog)
+- the energy endpointer closes the utterance -> final transcript (replacing
+  the fixed 1 s debounce, SURVEY.md §6)
+- transcription = mel (matmul STFT) -> encoder (audio-frame buckets) ->
+  cross-KV precompute -> greedy on-device decode loop (one dispatch, same
+  tunnel-latency discipline as the intent engine)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..audio.endpoint import EnergyEndpointer
+from ..audio.mel import MelConfig, log_mel_spectrogram
+from ..grammar.intent_grammar import default_tokenizer
+from ..grammar.tokenizer import BOS_ID, EOS_ID, PAD_ID
+from ..models.whisper import (
+    PRESETS,
+    WhisperConfig,
+    compute_cross_kv,
+    decoder_forward,
+    encoder_forward,
+    init_params,
+    init_self_cache,
+)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new"), donate_argnames=("self_cache",))
+def _stt_decode_loop(
+    params,
+    cfg: WhisperConfig,
+    self_cache,
+    cross_kv,
+    enc_mask,
+    max_new: int = 64,
+):
+    """Greedy decode until EOS, fully on device."""
+    B = enc_mask.shape[0]
+    bos = jnp.full((B, 1), BOS_ID, dtype=jnp.int32)
+    logits, self_cache = decoder_forward(
+        params, cfg, bos, jnp.zeros((B, 1), jnp.int32), self_cache, cross_kv, enc_mask
+    )
+    tok0 = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+
+    out = jnp.full((B, max_new), PAD_ID, dtype=jnp.int32)
+    carry0 = (self_cache, tok0, jnp.ones((B,), jnp.int32), out,
+              jnp.zeros((B,), jnp.int32), tok0 == EOS_ID, jnp.zeros((), jnp.int32))
+
+    def cond(c):
+        done, step = c[5], c[6]
+        return jnp.logical_and(step < max_new, ~jnp.all(done))
+
+    def body(c):
+        cache, cur, pos, out, n, done, step = c
+        live = ~done
+        out = out.at[jnp.arange(B), jnp.minimum(n, max_new - 1)].set(
+            jnp.where(live, cur, out[jnp.arange(B), jnp.minimum(n, max_new - 1)])
+        )
+        n = n + live.astype(jnp.int32)
+        logits, cache = decoder_forward(
+            params, cfg, cur[:, None], pos[:, None], cache, cross_kv, enc_mask
+        )
+        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        pos = jnp.where(live, pos + 1, pos)
+        done = done | (nxt == EOS_ID) | (pos >= cfg.max_text_len - 1)
+        return (cache, jnp.where(live, nxt, cur), pos, out, n, done, step + 1)
+
+    self_cache, _, _, out, n, _, _ = jax.lax.while_loop(cond, body, carry0)
+    return out, n, self_cache
+
+
+@dataclass
+class TranscribeResult:
+    text: str
+    encode_ms: float
+    decode_ms: float
+    n_frames: int
+
+
+class SpeechEngine:
+    """Whisper encoder-decoder with audio-length buckets."""
+
+    def __init__(
+        self,
+        preset: str = "whisper-test",
+        cfg: WhisperConfig | None = None,
+        seed: int = 0,
+        frame_buckets: tuple[int, ...] = (100, 300, 1000, 3000),
+        max_new_tokens: int = 64,
+        mel_cfg: MelConfig = MelConfig(),
+    ):
+        self.tokenizer = default_tokenizer()
+        base = cfg or PRESETS[preset]
+        self.cfg = replace(base, vocab_size=self.tokenizer.vocab_size)
+        self.mel_cfg = mel_cfg
+        self.frame_buckets = tuple(b for b in frame_buckets if b <= self.cfg.max_audio_frames)
+        self.max_new_tokens = max_new_tokens
+        self.params = jax.jit(partial(init_params, self.cfg))(jax.random.PRNGKey(seed))
+
+    def load_params(self, params) -> None:
+        self.params = params
+
+    def _bucket(self, n_frames: int) -> int:
+        for b in self.frame_buckets:
+            if n_frames <= b:
+                return b
+        return self.frame_buckets[-1]
+
+    def transcribe(self, audio: np.ndarray) -> TranscribeResult:
+        """audio: float32 mono 16 kHz. Longer than the top bucket -> keep the
+        most recent window (streaming semantics)."""
+        hop = self.mel_cfg.hop
+        n_frames = max(1, len(audio) // hop)
+        bucket = self._bucket(n_frames)
+        want = bucket * hop
+        if len(audio) > want:
+            audio = audio[-want:]
+            n_frames = bucket
+        padded = np.zeros(want, dtype=np.float32)
+        padded[: len(audio)] = audio
+
+        t0 = time.perf_counter()
+        mel = log_mel_spectrogram(jnp.asarray(padded), self.mel_cfg)[None, :bucket]
+        enc_out = encoder_forward(self.params, self.cfg, mel)
+        cross_kv = compute_cross_kv(self.params, self.cfg, enc_out)
+        valid = jnp.arange(enc_out.shape[1])[None, :] < max(1, n_frames // 2)
+        enc_out.block_until_ready()
+        encode_ms = (time.perf_counter() - t0) * 1e3
+
+        t1 = time.perf_counter()
+        cache = init_self_cache(self.cfg, 1)
+        out, n, _ = _stt_decode_loop(
+            self.params, self.cfg, cache, cross_kv, valid, max_new=self.max_new_tokens
+        )
+        n_h = int(jax.device_get(n)[0])
+        ids = [int(t) for t in np.asarray(jax.device_get(out))[0, :n_h]]
+        decode_ms = (time.perf_counter() - t1) * 1e3
+        return TranscribeResult(
+            text=self.tokenizer.decode(ids).strip(),
+            encode_ms=encode_ms,
+            decode_ms=decode_ms,
+            n_frames=n_frames,
+        )
+
+
+class StreamingSTT:
+    """Utterance-windowed streaming wrapper: feed PCM, get partial/final events.
+
+    Events: ("partial", text) while speech continues; ("final", text) when the
+    endpointer closes the utterance (the 1 s debounce replacement).
+    """
+
+    def __init__(
+        self,
+        engine: SpeechEngine,
+        partial_interval_s: float = 0.5,
+        endpointer: EnergyEndpointer | None = None,
+    ):
+        self.engine = engine
+        self.partial_interval_s = partial_interval_s
+        self.endpointer = endpointer or EnergyEndpointer(sample_rate=engine.mel_cfg.sample_rate)
+        self._buf = np.zeros(0, dtype=np.float32)
+        self._since_partial = 0.0
+
+    def reset(self) -> None:
+        self._buf = np.zeros(0, dtype=np.float32)
+        self._since_partial = 0.0
+        self.endpointer.reset()
+
+    def feed(self, samples: np.ndarray) -> list[tuple[str, str]]:
+        sr = self.engine.mel_cfg.sample_rate
+        events: list[tuple[str, str]] = []
+        ended = self.endpointer.feed(samples)
+        self._buf = np.concatenate([self._buf, samples.astype(np.float32)])
+        self._since_partial += len(samples) / sr
+
+        # bound the buffer: outside speech only the top transcription window
+        # matters, so an open mic on silence cannot grow memory (and each
+        # append stays O(window), not O(session))
+        max_samples = self.engine.frame_buckets[-1] * self.engine.mel_cfg.hop
+        if not self.endpointer.in_speech and len(self._buf) > max_samples:
+            self._buf = self._buf[-max_samples:]
+
+        if ended:
+            res = self.engine.transcribe(self._buf)
+            if res.text:
+                events.append(("final", res.text))
+            self._buf = np.zeros(0, dtype=np.float32)
+            self._since_partial = 0.0
+        elif self.endpointer.in_speech and self._since_partial >= self.partial_interval_s:
+            self._since_partial = 0.0
+            res = self.engine.transcribe(self._buf)
+            if res.text:
+                events.append(("partial", res.text))
+        return events
+
+
+class NullSTT:
+    """Offline stand-in (reference analog: the null-Deepgram-key passthrough,
+    apps/voice/src/server.ts:68-72). Scripted transcripts for tests."""
+
+    def __init__(self, scripted: list[tuple[str, str]] | None = None):
+        self.scripted = list(scripted or [])
+        self.fed_samples = 0
+
+    def reset(self) -> None:
+        self.fed_samples = 0
+
+    def feed(self, samples: np.ndarray) -> list[tuple[str, str]]:
+        self.fed_samples += len(samples)
+        if self.scripted:
+            return [self.scripted.pop(0)]
+        return []

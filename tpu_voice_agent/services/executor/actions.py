@@ -88,9 +88,10 @@ def _do_search(page: PageLike, cache: _AnalysisCache, query: str, timeout_ms: in
         sel = boxes[0]["selector"]
     else:
         sel = None
+        probe_ms = max(500, timeout_ms // len(SEARCH_FALLBACK_SELECTORS))
         for cand in SEARCH_FALLBACK_SELECTORS:
             try:
-                page.wait_for_selector(cand, timeout_ms=1000)
+                page.wait_for_selector(cand, timeout_ms=probe_ms)
                 sel = cand
                 break
             except Exception:

@@ -151,53 +151,76 @@ class CDPPage:
             if chrome_bin is None:
                 raise CDPError("need CDP_URL or EXECUTOR_CHROME_BIN")
             port = int(os.environ.get("CDP_PORT", "9222"))
-            proc = subprocess.Popen(
-                [
-                    chrome_bin,
-                    f"--remote-debugging-port={port}",
-                    "--headless=new",
-                    "--no-sandbox",
-                    "--disable-gpu",
-                    "--no-first-run",
-                    "about:blank",
-                ],
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
-            )
             cdp_url = f"http://127.0.0.1:{port}"
-            time.sleep(1.0)
+            if not cls._endpoint_alive(cdp_url):
+                proc = subprocess.Popen(
+                    [
+                        chrome_bin,
+                        f"--remote-debugging-port={port}",
+                        "--headless=new",
+                        "--no-sandbox",
+                        "--disable-gpu",
+                        "--no-first-run",
+                        "about:blank",
+                    ],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+                time.sleep(1.0)
         try:
-            ws_url = cls._resolve_ws_url(cdp_url)
-            return cls(_CDPConn(ws_url), browser_proc=proc)
+            ws_url, target_id = cls._new_target(cdp_url)
+            page = cls(_CDPConn(ws_url), browser_proc=proc)
+            page._target_id = target_id
+            page._http_endpoint = cdp_url if not cdp_url.startswith("ws") else None
+            return page
         except Exception:
             if proc is not None:  # don't orphan a launched browser
                 proc.kill()
             raise
 
     @staticmethod
-    def _resolve_ws_url(cdp_url: str) -> str:
-        if cdp_url.startswith(("ws://", "wss://")):
-            return cdp_url
-        # http endpoint: create/list a page target
+    def _endpoint_alive(cdp_url: str) -> bool:
         import urllib.request
 
+        try:
+            with urllib.request.urlopen(cdp_url.rstrip("/") + "/json/version", timeout=2):
+                return True
+        except Exception:
+            return False
+
+    @staticmethod
+    def _new_target(cdp_url: str) -> tuple[str, str | None]:
+        """Create a FRESH page target per session — sessions must never share
+        a tab. Falls back to the first existing page only for direct ws URLs
+        (remote providers hand out per-session sockets already)."""
+        if cdp_url.startswith(("ws://", "wss://")):
+            return cdp_url, None
+        import urllib.request
+
+        base = cdp_url.rstrip("/")
+        last_err: Exception | None = None
         for _ in range(20):
-            try:
-                with urllib.request.urlopen(cdp_url.rstrip("/") + "/json/list", timeout=3) as r:
-                    targets = json.loads(r.read())
-                pages = [t for t in targets if t.get("type") == "page"]
-                if pages:
-                    return pages[0]["webSocketDebuggerUrl"]
-            except Exception:
-                time.sleep(0.5)
-        raise CDPError(f"no page target found at {cdp_url}")
+            # Chrome 111+: PUT /json/new; older: GET
+            for method in ("PUT", "GET"):
+                try:
+                    req = urllib.request.Request(base + "/json/new?about:blank", method=method)
+                    with urllib.request.urlopen(req, timeout=3) as r:
+                        t = json.loads(r.read())
+                    return t["webSocketDebuggerUrl"], t.get("id")
+                except Exception as e:
+                    last_err = e
+            time.sleep(0.5)
+        raise CDPError(f"could not create a page target at {cdp_url}: {last_err}")
 
     # ------------------------------------------------------------ PageLike
 
     def goto(self, url: str, timeout_ms: int = 15000) -> None:
         self.conn.clear_events("Page.loadEventFired")
-        self.conn.call("Page.navigate", {"url": url}, timeout_s=timeout_ms / 1e3)
-        self.conn.wait_event("Page.loadEventFired", timeout_s=timeout_ms / 1e3)
+        res = self.conn.call("Page.navigate", {"url": url}, timeout_s=timeout_ms / 1e3)
+        if res.get("errorText"):
+            raise CDPError(f"navigation to {url} failed: {res['errorText']}")
+        if self.conn.wait_event("Page.loadEventFired", timeout_s=timeout_ms / 1e3) is None:
+            raise CDPError(f"navigation to {url} timed out after {timeout_ms} ms")
         self.url = url
         self.title = str(self.evaluate("document.title") or "")
 
@@ -349,6 +372,16 @@ class CDPPage:
 
     def close(self) -> None:
         self.closed = True
+        # close our tab (not the shared browser) when we know its target id
+        if getattr(self, "_target_id", None) and getattr(self, "_http_endpoint", None):
+            import urllib.request
+
+            try:
+                urllib.request.urlopen(
+                    f"{self._http_endpoint.rstrip('/')}/json/close/{self._target_id}", timeout=3
+                )
+            except Exception:
+                pass
         self.conn.close()
         if self.browser_proc is not None:
             self.browser_proc.terminate()

@@ -101,7 +101,13 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
         except Exception:
             body = {}
         sid = body.get("session_id")
-        ok = manager.close(sid) if sid else False
+
+        def work():
+            # under exec_lock so a session is never torn down mid-batch
+            with exec_lock:
+                return manager.close(sid) if sid else False
+
+        ok = await asyncio.get_running_loop().run_in_executor(None, work)
         return web.json_response({"ok": ok})
 
     app.router.add_get("/health", health)

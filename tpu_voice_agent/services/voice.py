@@ -1,0 +1,238 @@
+"""Voice orchestrator: WS /stream — audio in, typed events out.
+
+Capability parity with the reference voice service (apps/voice/src/server.ts:
+60-304): binary WS frames carry PCM16 @ 16 kHz mono; JSON frames carry
+control messages; the server emits the same typed event vocabulary —
+``transcript_partial/transcript_final/intent/tts/execution_result/
+execution_error/confirmation_required/info/warn/error``. What changed:
+
+- Deepgram (deepgram.ts) -> in-tree streaming Whisper (serve.stt); the
+  null-STT mode mirrors the reference's null-API-key passthrough
+- the fixed 1 s final-transcript debounce (server.ts:229) -> energy
+  endpointing inside StreamingSTT (SURVEY.md §6's biggest latency constant)
+- safety gating: intents that are risky (requires_confirmation or the
+  server-side floor, schemas.RISKY_INTENT_TYPES) emit confirmation_required;
+  safe intents auto-execute against the executor, and the returned
+  session_id is threaded into subsequent executions (server.ts:173-211)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+
+import httpx
+import numpy as np
+from aiohttp import WSMsgType, web
+
+from ..audio.mel import pcm16_to_float
+from ..schemas import Intent, ParseResponse
+from ..utils import Tracer, load_env_cascade, new_trace_id
+
+
+class VoiceConfig:
+    def __init__(
+        self,
+        brain_url: str | None = None,
+        executor_url: str | None = None,
+        stt_factory=None,
+    ):
+        self.brain_url = brain_url or os.environ.get("BRAIN_URL", "http://127.0.0.1:8090")
+        self.executor_url = executor_url or os.environ.get("EXECUTOR_URL", "http://127.0.0.1:7081")
+        self.stt_factory = stt_factory or stt_factory_from_env()
+
+
+def stt_factory_from_env():
+    """VOICE_STT=null (default, no model) or whisper:<preset>."""
+    spec = os.environ.get("VOICE_STT", "null")
+    if spec == "null":
+        from ..serve.stt import NullSTT
+
+        return lambda: NullSTT()
+    if spec.startswith("whisper"):
+        from ..serve.stt import SpeechEngine, StreamingSTT
+
+        preset = spec.split(":", 1)[1] if ":" in spec else "whisper-tiny"
+        engine = SpeechEngine(preset=preset)
+        lock = threading.Lock()
+
+        class LockedStreaming(StreamingSTT):
+            def feed(self, samples):
+                with lock:
+                    return super().feed(samples)
+
+        return lambda: LockedStreaming(engine)
+    raise ValueError(f"unknown VOICE_STT {spec!r}")
+
+
+class ClientState:
+    def __init__(self, stt):
+        self.stt = stt
+        self.context: dict = {}
+        self.session_id: str | None = None
+        self.trace_id = new_trace_id()
+        # serializes executor calls per client so the first execution's
+        # session_id is threaded into the next (back-to-back commands must
+        # share one browser session)
+        self.exec_lock = asyncio.Lock()
+
+
+def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> web.Application:
+    cfg = cfg or VoiceConfig()
+    tracer = tracer or Tracer("voice", emit=False)
+    app = web.Application()
+
+    async def health(_req: web.Request) -> web.Response:
+        return web.json_response({"ok": True, "service": "voice"})
+
+    async def send(ws: web.WebSocketResponse, type_: str, **payload) -> None:
+        if not ws.closed:
+            await ws.send_json({"type": type_, **payload})
+
+    async def handle_final(ws, state: ClientState, text: str, http: httpx.AsyncClient) -> None:
+        """transcript final -> brain -> gate -> executor (the hot path)."""
+        with tracer.span("parse_roundtrip", trace_id=state.trace_id, chars=len(text)):
+            try:
+                r = await http.post(
+                    cfg.brain_url + "/parse",
+                    json={"text": text, "session_id": state.session_id, "context": state.context},
+                    headers={"x-trace-id": state.trace_id},
+                    timeout=60.0,
+                )
+            except Exception as e:
+                await send(ws, "error", message=f"brain unreachable: {e}")
+                return
+        if r.status_code != 200:
+            await send(ws, "error", message=f"brain error {r.status_code}", detail=r.text[:300])
+            return
+        try:
+            parsed = ParseResponse.model_validate(r.json())
+        except Exception as e:
+            await send(ws, "error", message=f"brain returned invalid payload: {e}")
+            return
+
+        await send(ws, "intent", data=parsed.model_dump())
+        if parsed.tts_summary:
+            await send(ws, "tts", text=parsed.tts_summary)
+        if parsed.follow_up_question:
+            await send(ws, "tts", text=parsed.follow_up_question)
+        # merge context updates (server.ts:162-170)
+        state.context.update({k: v for k, v in parsed.context_updates.items()})
+
+        safe = [i for i in parsed.intents if not i.is_risky() and i.type != "unknown"]
+        risky = [i for i in parsed.intents if i.is_risky()]
+        if risky:
+            await send(
+                ws, "confirmation_required",
+                intents=[i.model_dump() for i in risky],
+                session_id=state.session_id,
+            )
+        if safe:
+            asyncio.ensure_future(execute_and_report(ws, state, safe, http))
+
+    async def execute_and_report(ws, state: ClientState, intents: list[Intent], http) -> None:
+        async with state.exec_lock:
+            await _execute_locked(ws, state, intents, http)
+
+    async def _execute_locked(ws, state: ClientState, intents: list[Intent], http) -> None:
+        try:
+            r = await http.post(
+                cfg.executor_url + "/execute",
+                json={
+                    "session_id": state.session_id,
+                    "intents": [i.model_dump() for i in intents],
+                },
+                headers={"x-trace-id": state.trace_id},
+                timeout=120.0,
+            )
+        except Exception as e:
+            await send(ws, "execution_error", message=str(e))
+            return
+        if r.status_code != 200:
+            await send(ws, "execution_error", message=f"executor {r.status_code}", detail=r.text[:300])
+            return
+        body = r.json()
+        state.session_id = body.get("session_id") or state.session_id
+        await send(ws, "execution_result", data=body)
+
+    async def stream(req: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse(max_msg_size=8 * 1024 * 1024)
+        await ws.prepare(req)
+        state = ClientState(cfg.stt_factory())
+
+        from ..serve.stt import NullSTT
+
+        if isinstance(state.stt, NullSTT):
+            await send(ws, "warn", message="no STT model loaded; running in null mode")
+        else:
+            await send(ws, "info", message="listening")
+
+        loop = asyncio.get_running_loop()
+        async with httpx.AsyncClient() as http:
+            async for msg in ws:
+                if msg.type == WSMsgType.BINARY:
+                    try:
+                        samples = pcm16_to_float(msg.data)
+                        # STT may run a model; keep the event loop responsive
+                        events = await loop.run_in_executor(None, state.stt.feed, samples)
+                    except Exception as e:
+                        # a truncated PCM packet must not kill the session
+                        await send(ws, "warn", message=f"bad audio frame: {e}")
+                        continue
+                    for kind, text in events:
+                        if kind == "partial":
+                            await send(ws, "transcript_partial", text=text)
+                        else:
+                            await send(ws, "transcript_final", text=text)
+                            await handle_final(ws, state, text, http)
+                elif msg.type == WSMsgType.TEXT:
+                    try:
+                        ctrl = json.loads(msg.data)
+                    except json.JSONDecodeError:
+                        await send(ws, "warn", message="bad control frame")
+                        continue
+                    ctype = ctrl.get("type")
+                    if ctype == "context_update":
+                        state.context.update(ctrl.get("data") or {})
+                        await send(ws, "info", message="context updated")
+                    elif ctype == "text":
+                        # typed command path: same pipeline minus STT
+                        text = str(ctrl.get("text") or "")
+                        if text:
+                            await send(ws, "transcript_final", text=text)
+                            await handle_final(ws, state, text, http)
+                    elif ctype == "confirm_execute":
+                        # UI approved risky intents: execute them now
+                        try:
+                            intents = [Intent.model_validate(i) for i in ctrl.get("intents") or []]
+                        except Exception as e:
+                            await send(ws, "warn", message=f"bad intents: {e}")
+                            continue
+                        if intents:
+                            await execute_and_report(ws, state, intents, http)
+                    elif ctype == "reset":
+                        state.stt.reset()
+                        state.context = {}
+                        await send(ws, "info", message="state reset")
+                    else:
+                        await send(ws, "warn", message=f"unknown control type {ctype!r}")
+                elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                    break
+        return ws
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/stream", stream)
+    return app
+
+
+def main() -> None:
+    load_env_cascade()
+    port = int(os.environ.get("VOICE_PORT", "7072"))
+    app = build_app(tracer=Tracer("voice"))
+    web.run_app(app, port=port)
+
+
+if __name__ == "__main__":
+    main()
