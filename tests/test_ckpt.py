@@ -1,0 +1,112 @@
+"""Checkpoint I/O: orbax round-trip (incl. sharded restore) + HF import."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.ckpt import llama_from_hf_state, restore_params, save_params
+from tpu_voice_agent.models.llama import LlamaConfig, forward, init_kv_cache, init_params
+
+CFG = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                  ffn_dim=64, max_seq_len=32)
+
+
+class TestOrbaxRoundTrip:
+    def test_save_restore(self, tmp_path):
+        params = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+        save_params(tmp_path / "ck", params)
+        back = restore_params(tmp_path / "ck")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params, back,
+        )
+
+    def test_sharded_restore(self, tmp_path):
+        from tpu_voice_agent.parallel.mesh import make_mesh, param_shardings
+
+        params = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+        save_params(tmp_path / "ck", params)
+        mesh = make_mesh(dp=1, tp=2)
+        sh = param_shardings(mesh, CFG.n_kv_heads)
+        like = jax.eval_shape(lambda: params)
+        back = restore_params(tmp_path / "ck", shardings=sh, params_like=like)
+        assert "tp" in str(back["layers"]["wq"].sharding)
+        np.testing.assert_array_equal(np.asarray(back["embed"]), np.asarray(params["embed"]))
+
+    def test_restore_with_shardings_requires_like(self, tmp_path):
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        save_params(tmp_path / "ck", params)
+        with pytest.raises(ValueError, match="params_like"):
+            restore_params(tmp_path / "ck", shardings={})
+
+
+def _fake_hf_state(cfg: LlamaConfig, tied: bool, rng) -> dict:
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    st = {
+        "model.embed_tokens.weight": rng.standard_normal((cfg.vocab_size, d), np.float32),
+        "model.norm.weight": np.ones(d, np.float32),
+    }
+    if not tied:
+        st["lm_head.weight"] = rng.standard_normal((cfg.vocab_size, d)).astype(np.float32)
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        st[p + "input_layernorm.weight"] = np.ones(d, np.float32)
+        st[p + "post_attention_layernorm.weight"] = np.ones(d, np.float32)
+        st[p + "self_attn.q_proj.weight"] = rng.standard_normal((cfg.n_heads * hd, d)).astype(np.float32)
+        st[p + "self_attn.k_proj.weight"] = rng.standard_normal((cfg.n_kv_heads * hd, d)).astype(np.float32)
+        st[p + "self_attn.v_proj.weight"] = rng.standard_normal((cfg.n_kv_heads * hd, d)).astype(np.float32)
+        st[p + "self_attn.o_proj.weight"] = rng.standard_normal((d, cfg.n_heads * hd)).astype(np.float32)
+        st[p + "mlp.gate_proj.weight"] = rng.standard_normal((f, d)).astype(np.float32)
+        st[p + "mlp.up_proj.weight"] = rng.standard_normal((f, d)).astype(np.float32)
+        st[p + "mlp.down_proj.weight"] = rng.standard_normal((d, f)).astype(np.float32)
+    return st
+
+
+class TestHFImport:
+    @pytest.mark.parametrize("tied", [False, True])
+    def test_import_shapes_and_forward(self, tied):
+        rng = np.random.default_rng(0)
+        params = llama_from_hf_state(_fake_hf_state(CFG, tied, rng), CFG, dtype=jnp.float32)
+        ref = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+        assert jax.tree.structure(params) == jax.tree.structure(ref)
+        jax.tree.map(lambda a, b: (_ for _ in ()).throw(AssertionError())
+                     if a.shape != b.shape else None, params, ref)
+        cache = init_kv_cache(CFG, 1, 16, dtype=jnp.float32)
+        toks = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        pos = jnp.arange(4, dtype=jnp.int32)[None]
+        logits, _ = forward(params, CFG, toks, pos, cache)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_transpose_correctness(self):
+        """q_proj row i of HF == column i of our wq (transposed layout)."""
+        rng = np.random.default_rng(1)
+        st = _fake_hf_state(CFG, False, rng)
+        params = llama_from_hf_state(st, CFG, dtype=jnp.float32)
+        hf_q0 = st["model.layers.0.self_attn.q_proj.weight"]
+        np.testing.assert_array_equal(np.asarray(params["layers"]["wq"][0]), hf_q0.T)
+
+    def test_missing_tensor_raises(self):
+        rng = np.random.default_rng(2)
+        st = _fake_hf_state(CFG, False, rng)
+        del st["model.layers.1.mlp.up_proj.weight"]
+        with pytest.raises(KeyError, match="up_proj"):
+            llama_from_hf_state(st, CFG)
+
+    def test_shape_mismatch_raises(self):
+        rng = np.random.default_rng(3)
+        st = _fake_hf_state(CFG, False, rng)
+        st["model.norm.weight"] = np.ones(7, np.float32)
+        with pytest.raises(ValueError, match="shape"):
+            llama_from_hf_state(st, CFG)
+
+    def test_safetensors_dir_round_trip(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        rng = np.random.default_rng(4)
+        st = _fake_hf_state(CFG, False, rng)
+        save_file(st, str(tmp_path / "model.safetensors"))
+        params = llama_from_hf_state(str(tmp_path), CFG, dtype=jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(params["embed"]), st["model.embed_tokens.weight"]
+        )

@@ -1,0 +1,105 @@
+"""Pallas kernels vs their pure-jnp reference twins (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.ops import (
+    attention_reference,
+    decode_attention,
+    decode_attention_reference,
+    flash_attention,
+    masked_argmax,
+    masked_argmax_reference,
+)
+
+
+def _qkv(key, B, T, S, nq, nkv, hd, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, nq, hd), dtype)
+    k = jax.random.normal(kk, (B, S, nkv, hd), dtype)
+    v = jax.random.normal(kv, (B, S, nkv, hd), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(0), 2, 64, 64, 8, 4, 32)
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_kv_len_masks_padded_keys(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1), 1, 32, 48, 4, 4, 16)
+        out = flash_attention(q, k, v, causal=False, kv_len=40, block_q=16, block_k=16)
+        ref = attention_reference(q[:, :, :, :], k[:, :40], v[:, :40], causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_ragged_blocks(self):
+        # T, S not multiples of the block sizes
+        q, k, v = _qkv(jax.random.PRNGKey(2), 1, 50, 50, 4, 2, 32)
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_bf16_io(self):
+        q, k, v = _qkv(jax.random.PRNGKey(3), 1, 32, 32, 4, 4, 32, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        assert out.dtype == jnp.bfloat16
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+        )
+
+
+class TestDecodeAttention:
+    def test_matches_reference_ragged_lengths(self):
+        key = jax.random.PRNGKey(4)
+        B, S, nq, nkv, hd = 3, 64, 8, 2, 32
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, nq, hd))
+        kc = jax.random.normal(kk, (B, S, nkv, hd))
+        vc = jax.random.normal(kv, (B, S, nkv, hd))
+        kv_len = jnp.asarray([1, 17, 64], jnp.int32)  # per-row frontiers
+        out = decode_attention(q, kc, vc, kv_len, block_k=16)
+        ref = decode_attention_reference(q, kc, vc, kv_len)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_single_row_single_key(self):
+        q = jnp.ones((1, 4, 16))
+        kc = jnp.ones((1, 32, 4, 16))
+        vc = jnp.full((1, 32, 4, 16), 2.0)
+        out = decode_attention(q, kc, vc, jnp.asarray([1], jnp.int32), block_k=16)
+        # only one valid key -> output == its value
+        np.testing.assert_allclose(np.asarray(out), 2.0, atol=1e-6)
+
+
+class TestMaskedArgmax:
+    def test_matches_reference(self):
+        key = jax.random.PRNGKey(5)
+        B, V, S = 4, 300, 7
+        logits = jax.random.normal(key, (B, V))
+        mask = jax.random.bernoulli(jax.random.PRNGKey(6), 0.3, (S, V))
+        mask = mask.at[:, 0].set(True)  # no all-masked state
+        state = jnp.asarray([0, 3, 6, 2], jnp.int32)
+        out = masked_argmax(logits, state, mask)
+        ref = masked_argmax_reference(logits, state, mask)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_mask_forces_choice(self):
+        logits = jnp.asarray([[0.0, 100.0, 1.0, 2.0]])
+        mask = jnp.asarray([[True, False, False, True]])  # best unmasked is idx 3
+        out = masked_argmax(logits, jnp.zeros((1,), jnp.int32), mask)
+        assert int(out[0]) == 3
+
+    def test_engine_fsm_tables(self, tiny_engine):
+        """The real intent-grammar tables round-trip through the kernel."""
+        eng = tiny_engine
+        V = eng.tokenizer.vocab_size
+        logits = jax.random.normal(jax.random.PRNGKey(7), (2, V))
+        state = jnp.asarray([eng.fsm.start, eng.fsm.start], jnp.int32)
+        out = masked_argmax(logits, state, eng.mask_table)
+        ref = masked_argmax_reference(logits, state, eng.mask_table)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

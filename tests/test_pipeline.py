@@ -1,0 +1,45 @@
+"""Pipeline-parallel llama forward vs single-device forward (8-dev CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.models.llama import LlamaConfig, forward, init_kv_cache, init_params
+from tpu_voice_agent.parallel.pipeline import llama_pp_forward, pp_mesh, stage_params
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig(vocab_size=128, dim=64, n_layers=8, n_heads=4,
+                      n_kv_heads=2, ffn_dim=128, max_seq_len=32)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+    return cfg, params, tokens
+
+
+class TestPipelineForward:
+    @pytest.mark.parametrize("pp,n_micro", [(2, 2), (4, 2), (8, 4)])
+    def test_matches_single_device(self, setup, pp, n_micro):
+        cfg, params, tokens = setup
+        mesh = pp_mesh(pp)
+        logits_pp = llama_pp_forward(params, cfg, tokens, mesh, n_micro=n_micro)
+
+        B, T = tokens.shape
+        cache = init_kv_cache(cfg, B, T, dtype=jnp.float32)
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+        logits_ref, _ = forward(params, cfg, tokens, positions, cache)
+        np.testing.assert_allclose(
+            np.asarray(logits_pp), np.asarray(logits_ref), atol=2e-4, rtol=2e-4
+        )
+
+    def test_rejects_indivisible_layers(self, setup):
+        cfg, params, _ = setup
+        with pytest.raises(ValueError, match="stages"):
+            stage_params(params["layers"], 3)
+
+    def test_rejects_indivisible_batch(self, setup):
+        cfg, params, tokens = setup
+        with pytest.raises(ValueError, match="microbatch"):
+            llama_pp_forward(params, cfg, tokens, pp_mesh(2), n_micro=3)

@@ -1,0 +1,102 @@
+"""Sequence-parallel attention (ring / Ulysses) vs full attention, on the
+8-virtual-device CPU mesh (conftest sets xla_force_host_platform_device_count)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.ops import attention_reference
+from tpu_voice_agent.parallel.ring import ring_attention, sp_mesh, ulysses_attention
+
+
+def _qkv(key, B, T, nq, nkv, hd):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (B, T, nq, hd)),
+        jax.random.normal(kk, (B, T, nkv, hd)),
+        jax.random.normal(kv, (B, T, nkv, hd)),
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return sp_mesh(8)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_full_attention(self, mesh8, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(0), 2, 64, 8, 4, 32)
+        out = ring_attention(q, k, v, mesh8, causal=causal)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_output_sharded_over_sp(self, mesh8):
+        q, k, v = _qkv(jax.random.PRNGKey(1), 1, 32, 4, 4, 16)
+        out = ring_attention(q, k, v, mesh8, causal=True)
+        assert "sp" in str(out.sharding)
+
+    def test_two_device_ring(self):
+        mesh = sp_mesh(2)
+        q, k, v = _qkv(jax.random.PRNGKey(2), 1, 16, 4, 2, 16)
+        out = ring_attention(q, k, v, mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_full_attention(self, mesh8, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(3), 2, 64, 16, 8, 32)
+        out = ulysses_attention(q, k, v, mesh8, causal=causal)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_rejects_indivisible_heads(self, mesh8):
+        q, k, v = _qkv(jax.random.PRNGKey(4), 1, 32, 6, 6, 16)
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, k, v, mesh8)
+
+
+class TestLlamaPallasParity:
+    """llama.forward attn_impl='pallas' must match the XLA path."""
+
+    def test_prefill_and_decode_parity(self):
+        from tpu_voice_agent.models.llama import (
+            LlamaConfig, forward, init_kv_cache, init_params,
+        )
+
+        cfg = LlamaConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, ffn_dim=128, max_seq_len=64)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        T = 16
+        tokens = jnp.asarray(rng.integers(0, 128, (1, T)), jnp.int32)
+        positions = jnp.arange(T, dtype=jnp.int32)[None]
+
+        outs = {}
+        for impl in ("xla", "pallas"):
+            cache = init_kv_cache(cfg, 1, 64, dtype=jnp.float32)
+            logits, cache = forward(params, cfg, tokens, positions, cache, attn_impl=impl)
+            # one decode step on top
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            logits2, _ = forward(params, cfg, nxt[:, None],
+                                 jnp.full((1, 1), T, jnp.int32), cache, attn_impl=impl)
+            outs[impl] = (np.asarray(logits), np.asarray(logits2))
+
+        np.testing.assert_allclose(outs["xla"][0], outs["pallas"][0], atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(outs["xla"][1], outs["pallas"][1], atol=1e-4, rtol=1e-4)
+
+    def test_engine_pallas_generates_valid_intent_json(self):
+        """End-to-end: a pallas-kernel engine still emits grammar-valid JSON."""
+        import json
+
+        from tpu_voice_agent.serve import DecodeEngine
+
+        eng = DecodeEngine(preset="test-tiny", max_len=512, prefill_buckets=(64,),
+                           kernels="pallas")
+        res = eng.generate("parse this", max_new_tokens=96)
+        if res.finished:
+            json.loads(res.text)  # grammar guarantees parseability on clean finish
+        assert res.steps > 0

@@ -1,0 +1,14 @@
+"""Checkpoint I/O: Orbax save/restore + HF safetensors import.
+
+The reference has no model weights at all (SURVEY.md §5 "Checkpoint /
+resume": its persistent state is browser sessions and a context dict). In
+this framework "checkpoint" regains its normal meaning: Orbax for
+save/restore of param pytrees (sharding-aware restore onto a mesh), and a
+converter from Hugging Face Llama safetensors into the stacked-layer layout
+models/llama.py uses.
+"""
+
+from .orbax_io import restore_params, save_params
+from .hf_import import llama_from_hf_state, llama_hf_key_map
+
+__all__ = ["save_params", "restore_params", "llama_from_hf_state", "llama_hf_key_map"]

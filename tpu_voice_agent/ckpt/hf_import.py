@@ -1,0 +1,106 @@
+"""Hugging Face Llama safetensors -> stacked-layer param tree.
+
+HF checkpoints store one tensor per layer per projection with (out, in)
+weight layout; models/llama.py wants layers stacked on a leading axis with
+(in, out) matmul layout (einsum "btd,dh->bth"). The converter transposes
+and stacks. RoPE conventions agree (both use the split-half rotation), so
+no permutation of head dims is needed.
+
+Works from either a loaded state dict (numpy arrays) or a directory of
+``*.safetensors`` shards.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig
+
+
+def llama_hf_key_map(layer: int) -> dict[str, str]:
+    """Our per-layer leaf name -> HF tensor name, for layer ``layer``."""
+    p = f"model.layers.{layer}."
+    return {
+        "attn_norm": p + "input_layernorm.weight",
+        "wq": p + "self_attn.q_proj.weight",
+        "wk": p + "self_attn.k_proj.weight",
+        "wv": p + "self_attn.v_proj.weight",
+        "wo": p + "self_attn.o_proj.weight",
+        "mlp_norm": p + "post_attention_layernorm.weight",
+        "w_gate": p + "mlp.gate_proj.weight",
+        "w_up": p + "mlp.up_proj.weight",
+        "w_down": p + "mlp.down_proj.weight",
+    }
+
+
+_TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
+
+def _load_state_dir(path: str) -> dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    state: dict[str, np.ndarray] = {}
+    files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    for f in files:
+        with safe_open(f, framework="np") as sf:
+            for k in sf.keys():
+                state[k] = sf.get_tensor(k)
+    return state
+
+
+def llama_from_hf_state(
+    state: dict[str, np.ndarray] | str,
+    cfg: LlamaConfig,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Convert an HF Llama state dict (or a safetensors directory path) into
+    the models/llama.py param tree. Validates every shape against ``cfg``."""
+    if isinstance(state, str):
+        state = _load_state_dir(state)
+
+    def get(name: str, want: tuple[int, ...], transpose: bool) -> jnp.ndarray:
+        if name not in state:
+            raise KeyError(f"HF checkpoint missing tensor {name}")
+        a = np.asarray(state[name])
+        if transpose and a.ndim == 2:
+            a = a.T
+        if tuple(a.shape) != want:
+            raise ValueError(f"{name}: shape {a.shape}, config wants {want}")
+        return jnp.asarray(a, dtype=dtype)
+
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    nq, nkv = cfg.n_heads, cfg.n_kv_heads
+    want = {
+        "attn_norm": (d,),
+        "wq": (d, nq * hd),
+        "wk": (d, nkv * hd),
+        "wv": (d, nkv * hd),
+        "wo": (nq * hd, d),
+        "mlp_norm": (d,),
+        "w_gate": (d, f),
+        "w_up": (d, f),
+        "w_down": (f, d),
+    }
+    stacked: dict[str, list] = {k: [] for k in want}
+    for layer in range(cfg.n_layers):
+        for ours, hf_name in llama_hf_key_map(layer).items():
+            stacked[ours].append(get(hf_name, want[ours], ours in _TRANSPOSED))
+
+    embed = get("model.embed_tokens.weight", (cfg.vocab_size, d), transpose=False)
+    head_name = "lm_head.weight"
+    if head_name in state:
+        lm_head = get(head_name, (d, cfg.vocab_size), transpose=True)
+    else:  # tied embeddings (TinyLlama, Llama-3.2-1B style)
+        lm_head = embed.T
+    return {
+        "embed": embed,
+        "layers": {k: jnp.stack(v) for k, v in stacked.items()},
+        "final_norm": get("model.norm.weight", (d,), transpose=False),
+        "lm_head": lm_head,
+    }

@@ -1,0 +1,43 @@
+"""Orbax param checkpointing, sharding-aware.
+
+``save_params`` writes any param pytree; ``restore_params`` restores it,
+optionally placing leaves directly onto mesh shardings (so a 70B restore
+never materializes unsharded copies on one host).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def save_params(path: str | os.PathLike, params) -> None:
+    """Write ``params`` to ``path`` (a directory; created/overwritten)."""
+    path = ocp.test_utils.erase_and_create_empty(os.path.abspath(path))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path / "params", params)
+        ckptr.wait_until_finished()
+
+
+def restore_params(path: str | os.PathLike, shardings=None, params_like=None):
+    """Restore the pytree written by ``save_params``.
+
+    ``shardings``: optional pytree of ``NamedSharding`` matching the params
+    structure — leaves stream from disk straight onto their mesh placement.
+    ``params_like``: optional abstract pytree (e.g. from ``jax.eval_shape``)
+    declaring dtypes/shapes; required if shardings is given without concrete
+    reference arrays.
+    """
+    path = os.path.join(os.path.abspath(path), "params")
+    with ocp.StandardCheckpointer() as ckptr:
+        if shardings is None:
+            return ckptr.restore(path)
+        if params_like is None:
+            raise ValueError("restore with shardings requires params_like (abstract pytree)")
+        abstract = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            params_like, shardings,
+        )
+        return ckptr.restore(path, abstract)

@@ -151,7 +151,7 @@ def _attend(q, k_cache, v_cache, q_positions, kv_len_mask):
 # ---------------------------------------------------------------- forward
 
 
-@partial(jax.jit, static_argnames=("cfg", "rules", "remat"))
+@partial(jax.jit, static_argnames=("cfg", "rules", "remat", "attn_impl", "fresh_block"))
 def forward(
     params: dict,
     cfg: LlamaConfig,
@@ -160,6 +160,8 @@ def forward(
     kv_cache: dict,  # (L, B, S, nkv, hd)
     rules=None,  # parallel.ShardingRules | None
     remat: bool = False,  # rematerialize layer activations (training)
+    attn_impl: str = "xla",  # "xla" | "pallas" (ops.flash_attention / decode_attention)
+    fresh_block: bool = False,  # caller asserts this T>1 block starts a sequence at pos 0
 ) -> tuple[jax.Array, dict]:
     """Unified prefill/decode forward.
 
@@ -169,6 +171,15 @@ def forward(
     Padding tokens must carry position == their slot and are masked out by
     the caller via `positions` (slots beyond a sequence's length are simply
     never attended to because kv_len_mask derives from written positions).
+
+    ``attn_impl="pallas"`` routes attention through the Pallas kernels:
+    T == 1 steps use ops.decode_attention against the cache with per-row
+    frontiers; T > 1 steps use ops.flash_attention over the current block's
+    k/v — but ONLY when the caller passes ``fresh_block=True``, its static
+    promise that the block starts a fresh sequence at position 0 (the
+    engine's prefill and the scheduler's admit both do). A mid-sequence
+    T > 1 block without the flag takes the exact XLA cache path instead of
+    silently computing block-local attention.
     """
     B, T = tokens.shape
     S = kv_cache["k"].shape[2]
@@ -201,7 +212,20 @@ def forward(
         k_cache = k_cache.at[batch_idx, positions].set(k)
         v_cache = v_cache.at[batch_idx, positions].set(v)
 
-        attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
+        if attn_impl == "pallas" and T == 1:
+            from ..ops import decode_attention
+
+            # per-row frontiers; idle rows park writes at slot 0 so this
+            # stays proportional to real context (see chunk_decode_loop)
+            attn = decode_attention(q[:, 0], k_cache, v_cache, frontier + 1).reshape(B, T, -1)
+        elif attn_impl == "pallas" and fresh_block:
+            from ..ops import flash_attention
+
+            # fresh sequence starting at position 0: attention over the
+            # block's own k/v is exactly attention over the cache
+            attn = flash_attention(q, k, v, causal=True).reshape(B, T, -1)
+        else:
+            attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
         attn = jnp.einsum("bth,hd->btd", attn, p["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + cs(attn, "act")
 

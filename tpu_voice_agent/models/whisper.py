@@ -158,10 +158,16 @@ def _proj(x, w, b=None):
 # ---------------------------------------------------------------- encoder
 
 
-@partial(jax.jit, static_argnames=("cfg", "rules"))
-def encoder_forward(params: dict, cfg: WhisperConfig, mel: jax.Array, rules=None) -> jax.Array:
+@partial(jax.jit, static_argnames=("cfg", "rules", "attn_impl"))
+def encoder_forward(
+    params: dict, cfg: WhisperConfig, mel: jax.Array, rules=None, attn_impl: str = "xla"
+) -> jax.Array:
     """mel (B, T, n_mels) -> (B, T//2, d_model). T must equal max_audio_frames
-    for the bucket being compiled (pad with the mel floor)."""
+    for the bucket being compiled (pad with the mel floor).
+
+    ``attn_impl="pallas"`` routes self-attention through ops.flash_attention
+    (non-causal) — the encoder's (T/2)^2 attention is the dominant cost at
+    whisper-large's 1500 frames."""
     p = params["encoder"]
     cs = lambda x, name: rules.constrain(x, name) if rules is not None else x
     dn = ("NWC", "WIO", "NWC")
@@ -186,7 +192,16 @@ def encoder_forward(params: dict, cfg: WhisperConfig, mel: jax.Array, rules=None
         q = _proj(h, a["wq"], a["bq"])
         k = _proj(h, a["wk"])
         v = _proj(h, a["wv"], a["bv"])
-        attn = _mha(q, k, v, None, nh, hd)
+        if attn_impl == "pallas":
+            from ..ops import flash_attention
+
+            B, T2l, _ = q.shape
+            attn = flash_attention(
+                q.reshape(B, T2l, nh, hd), k.reshape(B, T2l, nh, hd),
+                v.reshape(B, T2l, nh, hd), causal=False,
+            ).reshape(B, T2l, nh * hd)
+        else:
+            attn = _mha(q, k, v, None, nh, hd)
         x = x + cs(_proj(attn, a["wo"], a["bo"]), "act")
         h = layer_norm(x, {"g": lp["ln2"]["g"], "b": lp["ln2"]["b"]}, cfg.norm_eps)
         h = jax.nn.gelu(_proj(h, lp["w1"], lp["b1"]))

@@ -1,0 +1,23 @@
+"""Native (C++) host-side runtime pieces, ctypes-bound.
+
+The reference has zero native code (SURVEY.md §2: all TS/JS; its heavy
+lifting is cloud APIs). This package holds the host-side hot paths that
+should not run in Python: audio decode/resample/RMS and the energy
+endpointer. The TPU compute path stays JAX/Pallas; this is the IO layer
+around it.
+
+Everything degrades gracefully: if the compiler or the .so is unavailable,
+``NATIVE_AVAILABLE`` is False and the pure-numpy twins in ``audio/`` are
+used instead — same seam style as the reference's null-key STT fake
+(SURVEY.md §4).
+"""
+
+from .frontend import (
+    NATIVE_AVAILABLE,
+    NativeEndpointer,
+    pcm16_to_float,
+    resample,
+    rms,
+)
+
+__all__ = ["NATIVE_AVAILABLE", "NativeEndpointer", "pcm16_to_float", "resample", "rms"]

@@ -1,0 +1,193 @@
+// Native audio frontend: PCM16 decode, rational resampler, RMS, endpointer.
+//
+// The reference's audio path is browser JS (apps/web/src/App.tsx:7-32:
+// floatTo16BitPCM + nearest-neighbor decimation "resampleTo16k") feeding a
+// cloud STT. Here the host-side audio hot path is C++: proper windowed-sinc
+// polyphase resampling (the reference's nearest-neighbor decimation aliases),
+// branch-free PCM conversion, and the energy endpointer that replaces the
+// reference's fixed 1 s debounce (apps/voice/src/server.ts:229).
+//
+// Built as a plain shared library, bound via ctypes (no pybind11 in image).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- helpers
+
+double bessel_i0(double x) {
+  // series expansion; converges fast for the beta range we use
+  double sum = 1.0, term = 1.0;
+  const double x2 = x * x / 4.0;
+  for (int k = 1; k < 64; ++k) {
+    term *= x2 / (static_cast<double>(k) * k);
+    sum += term;
+    if (term < 1e-16 * sum) break;
+  }
+  return sum;
+}
+
+int64_t gcd64(int64_t a, int64_t b) {
+  while (b) {
+    int64_t t = a % b;
+    a = b;
+    b = t;
+  }
+  return a;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- pcm/rms
+
+void vg_pcm16_to_float(const int16_t* in, int64_t n, float* out) {
+  constexpr float kScale = 1.0f / 32768.0f;
+  for (int64_t i = 0; i < n; ++i) out[i] = static_cast<float>(in[i]) * kScale;
+}
+
+double vg_rms(const float* in, int64_t n) {
+  if (n <= 0) return 0.0;
+  double acc = 0.0;
+  for (int64_t i = 0; i < n; ++i) acc += static_cast<double>(in[i]) * in[i];
+  return std::sqrt(acc / static_cast<double>(n));
+}
+
+// ---------------------------------------------------------------- resample
+
+int64_t vg_resample_len(int64_t n_in, int32_t sr_in, int32_t sr_out) {
+  if (n_in <= 0 || sr_in <= 0 || sr_out <= 0) return 0;
+  return (n_in * sr_out) / sr_in;
+}
+
+// Windowed-sinc resampler (Kaiser beta=8, 16 taps/side), arbitrary rational
+// ratio. Cutoff at 0.45 * min(sr_in, sr_out) to suppress aliasing on
+// downsample (the 48k->16k browser-mic case).
+int64_t vg_resample(const float* in, int64_t n_in, int32_t sr_in, int32_t sr_out,
+                    float* out) {
+  const int64_t n_out = vg_resample_len(n_in, sr_in, sr_out);
+  if (n_out == 0) return 0;
+  if (sr_in == sr_out) {
+    std::memcpy(out, in, sizeof(float) * static_cast<size_t>(n_in));
+    return n_in;
+  }
+  const double ratio = static_cast<double>(sr_in) / sr_out;  // input step per output
+  const double cutoff = 0.45 * std::min(sr_in, sr_out) / static_cast<double>(sr_in);
+  const int taps = 16;
+  const double beta = 8.0;
+  const double i0b = bessel_i0(beta);
+
+  for (int64_t t = 0; t < n_out; ++t) {
+    const double pos = t * ratio;
+    const int64_t center = static_cast<int64_t>(std::floor(pos));
+    double acc = 0.0, wsum = 0.0;
+    for (int64_t j = center - taps + 1; j <= center + taps; ++j) {
+      const double x = pos - static_cast<double>(j);  // in (-taps, taps]
+      const double snc_arg = 2.0 * cutoff * x;
+      double snc = (std::fabs(snc_arg) < 1e-12)
+                       ? 1.0
+                       : std::sin(M_PI * snc_arg) / (M_PI * snc_arg);
+      const double w_arg = x / taps;
+      if (std::fabs(w_arg) > 1.0) continue;
+      const double kaiser = bessel_i0(beta * std::sqrt(1.0 - w_arg * w_arg)) / i0b;
+      const double w = snc * kaiser * 2.0 * cutoff;
+      wsum += w;
+      const int64_t jc = j < 0 ? 0 : (j >= n_in ? n_in - 1 : j);  // clamp edges
+      acc += w * in[jc];
+    }
+    // normalize by the window sum so DC passes at unit gain
+    out[t] = static_cast<float>(acc / (wsum > 1e-12 ? wsum : 1.0));
+  }
+  return n_out;
+}
+
+// ---------------------------------------------------------------- endpointer
+
+// Mirrors tpu_voice_agent/audio/endpoint.py::EnergyEndpointer semantics.
+struct VgEndpointer {
+  int frame;
+  int trailing_frames;
+  int min_speech_frames;
+  double threshold_mult;
+  double noise_floor;
+  std::vector<float> buf;
+  int speech_frames;
+  int silence_run;
+  bool in_speech;
+};
+
+void* vg_endpointer_new(int32_t sample_rate, int32_t frame_ms,
+                        int32_t trailing_silence_ms, int32_t min_speech_ms,
+                        double threshold_mult) {
+  auto* e = new VgEndpointer();
+  e->frame = sample_rate * frame_ms / 1000;
+  e->trailing_frames = std::max(1, trailing_silence_ms / frame_ms);
+  e->min_speech_frames = std::max(1, min_speech_ms / frame_ms);
+  e->threshold_mult = threshold_mult;
+  e->noise_floor = 1e-4;
+  e->speech_frames = 0;
+  e->silence_run = 0;
+  e->in_speech = false;
+  return e;
+}
+
+void vg_endpointer_free(void* h) { delete static_cast<VgEndpointer*>(h); }
+
+void vg_endpointer_reset(void* h) {
+  auto* e = static_cast<VgEndpointer*>(h);
+  e->buf.clear();
+  e->speech_frames = 0;
+  e->silence_run = 0;
+  e->in_speech = false;
+}
+
+int32_t vg_endpointer_in_speech(void* h) {
+  return static_cast<VgEndpointer*>(h)->in_speech ? 1 : 0;
+}
+
+double vg_endpointer_noise_floor(void* h) {
+  return static_cast<VgEndpointer*>(h)->noise_floor;
+}
+
+// Feed samples; returns 1 if an utterance just ended.
+int32_t vg_endpointer_feed(void* h, const float* samples, int64_t n) {
+  auto* e = static_cast<VgEndpointer*>(h);
+  e->buf.insert(e->buf.end(), samples, samples + n);
+  bool ended = false;
+  size_t off = 0;
+  while (e->buf.size() - off >= static_cast<size_t>(e->frame)) {
+    double acc = 0.0;
+    for (int i = 0; i < e->frame; ++i) {
+      const double s = e->buf[off + i];
+      acc += s * s;
+    }
+    off += static_cast<size_t>(e->frame);
+    const double rms = std::sqrt(acc / e->frame + 1e-12);
+    const double threshold = e->noise_floor * e->threshold_mult;
+    if (rms > threshold) {
+      e->in_speech = true;
+      e->speech_frames += 1;
+      e->silence_run = 0;
+    } else {
+      e->noise_floor = 0.95 * e->noise_floor + 0.05 * std::max(rms, 1e-6);
+      if (e->in_speech) {
+        e->silence_run += 1;
+        if (e->silence_run >= e->trailing_frames &&
+            e->speech_frames >= e->min_speech_frames) {
+          ended = true;
+          e->in_speech = false;
+          e->speech_frames = 0;
+          e->silence_run = 0;
+        }
+      }
+    }
+  }
+  e->buf.erase(e->buf.begin(), e->buf.begin() + static_cast<int64_t>(off));
+  return ended ? 1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,32 @@
+"""Pallas TPU kernels for the hot ops, with XLA reference twins.
+
+The reference repo has no native/compute layer at all — its FLOPs live in
+Deepgram/OpenAI cloud services (SURVEY.md §2 "Native components": none).
+Here the hot ops of the in-tree models get hand-written Pallas kernels:
+
+- ``flash_attention``: blockwise online-softmax attention for prefill /
+  training / the Whisper encoder (never materializes the (T, S) score matrix
+  in HBM)
+- ``decode_attention``: single-token GQA attention against the dense KV
+  cache, the per-step hot op of the decode loop
+- ``masked_argmax``: fused grammar-mask + argmax over the vocab, the
+  sampling half of grammar-constrained decoding
+
+Every kernel has a pure-jnp reference twin (``*_reference``) used for
+correctness tests and as the CPU fallback; kernels run under
+``interpret=True`` on CPU so the whole suite exercises kernel code paths
+without a chip.
+"""
+
+from .flash_attention import flash_attention, attention_reference
+from .decode_attention import decode_attention, decode_attention_reference
+from .grammar_mask import masked_argmax, masked_argmax_reference
+
+__all__ = [
+    "flash_attention",
+    "attention_reference",
+    "decode_attention",
+    "decode_attention_reference",
+    "masked_argmax",
+    "masked_argmax_reference",
+]

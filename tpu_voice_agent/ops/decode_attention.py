@@ -1,0 +1,160 @@
+"""Single-token decode attention against the dense KV cache (Pallas, TPU).
+
+The per-step hot op of the decode loop: one query token per sequence attends
+over that sequence's full cache. Per-row valid lengths are dynamic (rows in a
+continuous batch are at different positions), so ``kv_len`` rides in SMEM and
+gates tiles at run time — tiles entirely beyond a row's frontier are skipped,
+which makes step cost proportional to the row's actual context, not the
+cache capacity.
+
+Layout: q heads are grouped by their kv head (GQA), so each grid cell
+computes a (group, block_k) score tile on the MXU with the kv block loaded
+once for the whole group.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _on_cpu() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _decode_kernel(
+    kv_len_ref,  # SMEM (B,) int32 — all rows' valid key counts
+    q_ref,  # (1, 1, group, hd)
+    k_ref,  # (1, 1, block_k, hd)
+    v_ref,  # (1, 1, block_k, hd)
+    o_ref,  # (1, 1, group, hd)
+    acc_ref,  # VMEM (group, hd) f32
+    m_ref,  # VMEM (group, 128) f32
+    l_ref,  # VMEM (group, 128) f32
+    *,
+    scale: float,
+    group: int,
+    block_k: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    kv_len = kv_len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * block_k < kv_len)
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32)  # (group, hd)
+        k = k_ref[0, 0].astype(jnp.float32)  # (bk, hd)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (group, bk)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (group, block_k), 1)
+        s = jnp.where(k_pos < kv_len, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_k", "interpret"))
+def decode_attention(
+    q: jax.Array,  # (B, nq, hd) — one query token per row
+    k_cache: jax.Array,  # (B, S, nkv, hd)
+    v_cache: jax.Array,  # (B, S, nkv, hd)
+    kv_len: jax.Array,  # (B,) int32 — valid keys per row (frontier + 1)
+    *,
+    scale: float | None = None,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Returns (B, nq, hd) in q.dtype."""
+    B, nq, hd = q.shape
+    S, nkv = k_cache.shape[1], k_cache.shape[2]
+    assert nq % nkv == 0
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+    block_k = min(block_k, S)
+
+    pad_s = (-S) % block_k
+    kt = jnp.moveaxis(k_cache, 2, 1)  # (B, nkv, S, hd)
+    vt = jnp.moveaxis(v_cache, 2, 1)
+    if pad_s:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+    Sp = kt.shape[2]
+    qg = q.reshape(B, nkv, group, hd)
+
+    grid = (B, nkv, Sp // block_k)
+    kernel = functools.partial(_decode_kernel, scale=scale, group=group, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B,), lambda b, h, j: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, group, hd), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, hd), lambda b, h, j: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, hd), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kv_len.astype(jnp.int32), qg, kt, vt)
+    return out.reshape(B, nq, hd)
+
+
+def decode_attention_reference(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    kv_len: jax.Array,
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Pure-jnp twin of ``decode_attention``."""
+    B, nq, hd = q.shape
+    S, nkv = k_cache.shape[1], k_cache.shape[2]
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    qg = q.reshape(B, nkv, group, hd)
+    scores = jnp.einsum("bkgh,bskh->bkgs", qg, k_cache, preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(S)[None, :] < kv_len[:, None]  # (B, S)
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgs,bskh->bkgh", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, nq, hd).astype(q.dtype)

@@ -1,0 +1,119 @@
+"""Fused grammar-mask + argmax over the vocab (Pallas, TPU).
+
+The greedy half of grammar-constrained sampling: for each sequence, gather
+its FSM state's row of the (n_states, V) mask table and argmax the masked
+logits — without ever materializing the masked logits in HBM. The per-row
+FSM state rides as a scalar-prefetch operand so the *BlockSpec index map*
+does the gather: each grid cell streams the mask tile for exactly the state
+its row is in.
+
+TPU tiling: vocab rows are viewed as (V/128, 128) so every block is a
+(SUB, 128) tile (f32-legal 8x128 multiples) — a flat (1, V) block would
+violate Mosaic's sublane constraint.
+
+This replaces the XLA path ``argmax(where(mask_table[state], logits, -inf))``
+(serve/engine.py ``_mask_sample_advance``) for greedy decoding; temperature
+sampling stays in XLA (``jax.random.categorical``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_SUB = 8
+_TILE = _SUB * _LANE  # vocab elements per grid cell
+
+
+def _on_cpu() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _argmax_kernel(
+    state_ref,  # scalar prefetch (B,) int32
+    logits_ref,  # (1, SUB, 128) f32 tile of row b
+    mask_ref,  # (1, SUB, 128) bool tile of row state[b]
+    idx_out_ref,  # SMEM (B,) int32 — written at this grid row's slot
+    best_val_ref,  # SMEM (1,) f32
+    best_idx_ref,  # SMEM (1,) int32
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        best_val_ref[0] = -jnp.inf
+        best_idx_ref[0] = 0
+
+    s = jnp.where(mask_ref[0], logits_ref[0].astype(jnp.float32), -1e30)  # (SUB, 128)
+    sub = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    idx = j * _TILE + sub * _LANE + lane
+    tile_max = jnp.max(s)
+    # first index achieving the max (argmax tie-break parity with jnp.argmax)
+    tile_arg = jnp.min(jnp.where(s == tile_max, idx, jnp.iinfo(jnp.int32).max))
+
+    # strict > keeps the first occurrence across tiles
+    @pl.when(tile_max > best_val_ref[0])
+    def _update():
+        best_val_ref[0] = tile_max
+        best_idx_ref[0] = tile_arg
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        idx_out_ref[b] = best_idx_ref[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_argmax(
+    logits: jax.Array,  # (B, V) float
+    fsm_state: jax.Array,  # (B,) int32
+    mask_table: jax.Array,  # (n_states, V) bool
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Returns (B,) int32 = argmax_v(logits[b, v] where mask_table[state[b], v])."""
+    B, V = logits.shape
+    S = mask_table.shape[0]
+    interpret = interpret if interpret is not None else _on_cpu()
+    pad_v = (-V) % _TILE
+    if pad_v:
+        logits = jnp.pad(logits, ((0, 0), (0, pad_v)), constant_values=-jnp.inf)
+        mask_table = jnp.pad(mask_table, ((0, 0), (0, pad_v)))
+    Vp = logits.shape[1]
+    logits3 = logits.reshape(B, Vp // _LANE, _LANE)
+    mask3 = mask_table.reshape(S, Vp // _LANE, _LANE)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Vp // _TILE),
+        in_specs=[
+            pl.BlockSpec((1, _SUB, _LANE), lambda b, j, state: (b, j, 0)),
+            pl.BlockSpec((1, _SUB, _LANE), lambda b, j, state: (state[b], j, 0)),
+        ],
+        out_specs=pl.BlockSpec((B,), lambda b, j, state: (0,), memory_space=pltpu.SMEM),
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+    )
+    return pl.pallas_call(
+        _argmax_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B,), jnp.int32),
+        interpret=interpret,
+    )(fsm_state.astype(jnp.int32), logits3, mask3)
+
+
+def masked_argmax_reference(
+    logits: jax.Array, fsm_state: jax.Array, mask_table: jax.Array
+) -> jax.Array:
+    """Pure-jnp twin (the engine's original XLA path)."""
+    masked = jnp.where(mask_table[fsm_state], logits, -jnp.inf)
+    return jnp.argmax(masked, axis=-1).astype(jnp.int32)

@@ -1,4 +1,12 @@
 from .mesh import make_mesh, ShardingRules, default_rules, param_shardings, kv_cache_shardings
+from .ring import ring_attention, sp_mesh, ulysses_attention
+from .pipeline import (
+    llama_pp_forward,
+    pipeline_apply,
+    pp_mesh,
+    stage_param_shardings,
+    stage_params,
+)
 
 __all__ = [
     "make_mesh",
@@ -6,4 +14,12 @@ __all__ = [
     "default_rules",
     "param_shardings",
     "kv_cache_shardings",
+    "ring_attention",
+    "ulysses_attention",
+    "sp_mesh",
+    "llama_pp_forward",
+    "pipeline_apply",
+    "pp_mesh",
+    "stage_params",
+    "stage_param_shardings",
 ]

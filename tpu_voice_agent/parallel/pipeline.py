@@ -1,0 +1,153 @@
+"""Pipeline parallelism over a ``pp`` mesh axis (the 70B planner config).
+
+The reference's only "pipeline" is its 4-process request pipeline
+(SURVEY.md §2 audit table: UI→voice→brain→executor). Real model pipeline
+parallelism enters here for Llama-3-70B-class planners that don't fit one
+TP group: the stacked layer axis is split into S stages sharded over "pp",
+and a GPipe schedule runs n_micro microbatches through the ring with one
+``ppermute`` hop per tick.
+
+Everything is shard_map + fori_loop: one trace, static shapes, collectives
+on ICI. Bubble ticks compute on garbage activations that are never read
+(cheaper than predication on TPU, and XLA overlaps the ppermute with the
+next tick's compute).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models.llama import LlamaConfig, _attend, apply_rope, rms_norm, rope_tables
+
+
+def pp_mesh(pp: int, devices: list | None = None) -> Mesh:
+    """1-D pipeline mesh."""
+    devices = devices if devices is not None else jax.devices()
+    if pp > len(devices):
+        raise ValueError(f"pp={pp} needs {pp} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:pp]), ("pp",))
+
+
+def stage_params(layer_params: dict, n_stages: int) -> dict:
+    """Reshape stacked layer params (L, ...) -> (S, L/S, ...) for pp sharding."""
+    L = jax.tree.leaves(layer_params)[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"n_layers ({L}) must divide into {n_stages} stages")
+    return jax.tree.map(lambda a: a.reshape(n_stages, L // n_stages, *a.shape[1:]), layer_params)
+
+
+def stage_param_shardings(mesh: Mesh, layer_params: dict) -> dict:
+    """NamedSharding pytree for ``stage_params`` output: stage axis on pp."""
+    return jax.tree.map(
+        lambda a: NamedSharding(mesh, P("pp", *([None] * a.ndim))), layer_params
+    )
+
+
+def pipeline_apply(staged_params, x_micro: jax.Array, stage_fn, mesh: Mesh) -> jax.Array:
+    """Run microbatches (n_micro, mb, ...) through S pipeline stages.
+
+    ``staged_params``: pytree with leading stage axis S, sharded over "pp".
+    ``stage_fn(local_params, x) -> y`` applies one stage's layers.
+    Returns (n_micro, mb, ...) with the last stage's outputs (replicated).
+    """
+    S = mesh.shape["pp"]
+
+    def local(sp, x0):
+        sp = jax.tree.map(lambda a: a[0], sp)  # (1, L/S, ...) -> (L/S, ...)
+        s = jax.lax.axis_index("pp")
+        n_micro = x0.shape[0]
+        ticks = n_micro + S - 1
+        fwd = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(t, carry):
+            act_in, outbuf = carry
+            m = t - s  # microbatch index this stage works on
+            my_in = jnp.where(s == 0, x0[jnp.clip(t, 0, n_micro - 1)], act_in)
+            out = stage_fn(sp, my_in)
+            write = jnp.logical_and(jnp.logical_and(m >= 0, m < n_micro), s == S - 1)
+            mi = jnp.clip(m, 0, n_micro - 1)
+            outbuf = outbuf.at[mi].set(jnp.where(write, out, outbuf[mi]))
+            act_next = jax.lax.ppermute(out, "pp", fwd) if S > 1 else out
+            return act_next, outbuf
+
+        # mark the carries as device-varying up front (shard_map vma tracking:
+        # they become varying inside the loop via axis_index / ppermute)
+        act0 = jax.lax.pcast(jnp.zeros_like(x0[0]), ("pp",), to="varying")
+        outbuf0 = jax.lax.pcast(jnp.zeros_like(x0), ("pp",), to="varying")
+        _, outbuf = jax.lax.fori_loop(0, ticks, tick, (act0, outbuf0))
+        # only the last stage wrote outputs; psum replicates them everywhere
+        return jax.lax.psum(outbuf, "pp")
+
+    in_spec = jax.tree.map(lambda _: P("pp"), staged_params)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(in_spec, P()), out_specs=P(),
+    )(staged_params, x_micro)
+
+
+def _decoder_block(x, p, cfg: LlamaConfig, cos, sin):
+    """One no-cache decoder block (training / full-sequence forward). Math
+    mirrors models.llama.forward's layer exactly (parity-tested)."""
+    B, T, _ = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("btd,dh->bth", h, p["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+    k = jnp.einsum("btd,dh->bth", h, p["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+    v = jnp.einsum("btd,dh->bth", h, p["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+    q = apply_rope(q.reshape(B, T, cfg.n_heads, cfg.head_dim), cos, sin)
+    k = apply_rope(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), cos, sin)
+    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    kv_valid = jnp.ones((B, T), dtype=bool)
+    attn = _attend(q, k, v, positions, kv_valid)
+    attn = jnp.einsum("bth,hd->btd", attn, p["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+    x = x + attn
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("btd,df->btf", h, p["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.einsum("btd,df->btf", h, p["w_up"], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    down = jnp.einsum("btf,fd->btd", act, p["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+    return x + down
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"))
+def llama_pp_forward(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, T) int32; B % n_micro == 0
+    mesh: Mesh,
+    n_micro: int = 2,
+) -> jax.Array:
+    """Full-sequence logits with the layer stack pipelined over "pp".
+
+    Embedding / final norm / lm_head are replicated (tiny next to 70B's layer
+    stack); layers run through the GPipe schedule. Matches the single-device
+    ``models.llama.forward`` logits on a fresh cache (see tests/test_pipeline).
+    """
+    B, T = tokens.shape
+    if B % n_micro:
+        raise ValueError(f"batch {B} must divide into {n_micro} microbatches")
+    S = mesh.shape["pp"]
+
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (1, T))
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    def stage_fn(local_layers, x):
+        def body(x, p):
+            return _decoder_block(x, p, cfg, cos, sin), None
+
+        y, _ = jax.lax.scan(body, x, local_layers)
+        return y
+
+    staged = stage_params(params["layers"], S)
+    x_micro = x.reshape(n_micro, B // n_micro, T, cfg.dim)
+    y = pipeline_apply(staged, x_micro, stage_fn, mesh).reshape(B, T, cfg.dim)
+
+    y = rms_norm(y, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum("btd,dv->btv", y, params["lm_head"], preferred_element_type=jnp.float32)

@@ -43,10 +43,19 @@ class GenerationResult:
 
 
 def _mask_sample_advance(logits, fsm_state, mask_table, next_table, key, temperature,
-                         greedy: bool, constrained: bool):
+                         greedy: bool, constrained: bool, kernels: str = "xla"):
     """The one sampling block: grammar-mask logits, pick a token, advance the
     FSM. Shared by the fused decode step, the prefill first-token pick, and
-    the device generation loop (jit-inlined at every call site)."""
+    the device generation loop (jit-inlined at every call site).
+
+    kernels="pallas" routes the greedy constrained path through the fused
+    ops.masked_argmax kernel (mask gather + argmax, no (B, V) masked-logits
+    materialization)."""
+    if constrained and greedy and kernels == "pallas":
+        from ..ops import masked_argmax
+
+        tok = masked_argmax(logits, fsm_state, mask_table)
+        return tok, next_table[fsm_state, tok]
     if constrained:
         logits = jnp.where(mask_table[fsm_state], logits, -jnp.inf)
     if greedy:
@@ -58,7 +67,7 @@ def _mask_sample_advance(logits, fsm_state, mask_table, next_table, key, tempera
     return tok, fsm_state
 
 
-@partial(jax.jit, static_argnames=("cfg", "rules", "greedy", "constrained"))
+@partial(jax.jit, static_argnames=("cfg", "rules", "greedy", "constrained", "kernels"))
 def _decode_step(
     params,
     cfg: LlamaConfig,
@@ -73,25 +82,29 @@ def _decode_step(
     rules=None,
     greedy: bool = True,
     constrained: bool = True,
+    kernels: str = "xla",
 ):
-    logits, cache = forward(params, cfg, token[:, None], pos[:, None], cache, rules)
+    logits, cache = forward(params, cfg, token[:, None], pos[:, None], cache, rules,
+                            attn_impl=kernels)
     nxt, fsm_state = _mask_sample_advance(
-        logits[:, 0, :], fsm_state, mask_table, next_table, key, temperature, greedy, constrained
+        logits[:, 0, :], fsm_state, mask_table, next_table, key, temperature, greedy,
+        constrained, kernels
     )
     return nxt, cache, fsm_state
 
 
-@partial(jax.jit, static_argnames=("greedy", "constrained"))
+@partial(jax.jit, static_argnames=("greedy", "constrained", "kernels"))
 def _first_token(last_logits, fsm_state, mask_table, next_table, key, temperature,
-                 greedy: bool = True, constrained: bool = True):
+                 greedy: bool = True, constrained: bool = True, kernels: str = "xla"):
     return _mask_sample_advance(
-        last_logits, fsm_state, mask_table, next_table, key, temperature, greedy, constrained
+        last_logits, fsm_state, mask_table, next_table, key, temperature, greedy,
+        constrained, kernels
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained"),
+    static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained", "kernels"),
     donate_argnames=("cache",),
 )
 def chunk_decode_loop(
@@ -114,6 +127,7 @@ def chunk_decode_loop(
     chunk_steps: int = 32,
     greedy: bool = True,
     constrained: bool = True,
+    kernels: str = "xla",
 ):
     """THE decode loop: advance every active row by up to chunk_steps tokens
     entirely on device.
@@ -122,7 +136,8 @@ def chunk_decode_loop(
     the chip sits behind a tunnel. Single-request generation calls this with
     B=1 and chunk_steps=max_new_tokens; the continuous batcher calls it with
     B=slots and a small chunk so new requests join at chunk boundaries. Idle
-    rows park their cache writes in the trash slot (max_len - 1).
+    rows park their cache writes in slot 0 of their own dead cache line —
+    keeping their attention frontier (and pallas decode cost) at 1 slot.
 
     Returns (emitted (B, chunk_steps), counts, eos_flags, cache, cur, pos,
     fsm_state, active, nbytes, tokens_left). eos is True only for rows that
@@ -151,13 +166,15 @@ def chunk_decode_loop(
         nbytes = nbytes + jnp.where(active, byte_len_table[cur], 0)
         left = left - active.astype(jnp.int32)
 
-        # idle rows park their writes in the trash slot
-        write_pos = jnp.where(active, pos, max_len - 1)
+        # idle rows park their writes at slot 0 of their own (dead) line
+        write_pos = jnp.where(active, pos, 0)
         step_tok = jnp.where(active, cur, PAD_ID)
-        logits, cache = forward(params, cfg, step_tok[:, None], write_pos[:, None], cache, rules)
+        logits, cache = forward(params, cfg, step_tok[:, None], write_pos[:, None], cache, rules,
+                                attn_impl=kernels)
         key, k = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
-            logits[:, 0, :], state, mask_table, next_table, k, temperature, greedy, constrained
+            logits[:, 0, :], state, mask_table, next_table, k, temperature, greedy,
+            constrained, kernels
         )
         state = jnp.where(active, state_next, state)
         cur = jnp.where(active, nxt, cur)
@@ -186,7 +203,16 @@ class DecodeEngine:
         max_len: int = 2048,
         batch_slots: int = 1,
         prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048),
+        kernels: str = "auto",  # "auto" | "xla" | "pallas"
     ):
+        if kernels == "auto":
+            # pallas kernels are single-device pallas_calls (no shard_map
+            # wrapper yet): on a mesh they would force GSPMD to replicate
+            # their operands, so auto only picks them off-mesh
+            kernels = "pallas" if (jax.default_backend() == "tpu" and mesh is None) else "xla"
+        if kernels == "pallas" and mesh is not None:
+            raise ValueError("kernels='pallas' is single-device; use kernels='xla' on a mesh")
+        self.kernels = kernels
         self.tokenizer, self.fsm = build_intent_fsm()
         base = cfg or PRESETS[preset]
         self.cfg = replace(base, vocab_size=self.tokenizer.vocab_size, max_seq_len=max_len)
@@ -255,7 +281,8 @@ class DecodeEngine:
         tokens[0, :n] = ids
         positions = np.arange(bucket, dtype=np.int32)[None, :]
         logits, self.cache = forward(
-            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions), self.cache, self.rules
+            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions), self.cache,
+            self.rules, attn_impl=self.kernels, fresh_block=True,
         )
         return logits[:, n - 1, :], n
 
@@ -280,6 +307,7 @@ class DecodeEngine:
         tok0, fsm0 = _first_token(
             last_logits, fsm_state, self.mask_table, self.next_table, k0,
             jnp.float32(temperature), greedy=greedy, constrained=constrained,
+            kernels=self.kernels,
         )
         tok0.block_until_ready()
         prefill_ms = (time.perf_counter() - t0) * 1e3
@@ -295,7 +323,7 @@ class DecodeEngine:
             self.mask_table, self.next_table, self.byte_len_table,
             key, jnp.float32(temperature), jnp.int32(byte_budget),
             rules=self.rules, chunk_steps=max_new_tokens,
-            greedy=greedy, constrained=constrained,
+            greedy=greedy, constrained=constrained, kernels=self.kernels,
         )
         count_h = int(jax.device_get(count)[0])
         out_ids = [int(t) for t in np.asarray(jax.device_get(buf))[0, :count_h]]
@@ -330,6 +358,7 @@ class DecodeEngine:
         tok, fsm_state = _first_token(
             last_logits, fsm_state, self.mask_table, self.next_table, k0,
             jnp.float32(temperature), greedy=greedy, constrained=constrained,
+            kernels=self.kernels,
         )
         tok.block_until_ready()
         prefill_ms = (time.perf_counter() - t0) * 1e3
@@ -356,6 +385,7 @@ class DecodeEngine:
                 cur, jnp.full((1,), pos, dtype=jnp.int32), fsm_state,
                 self.mask_table, self.next_table, k, jnp.float32(temperature),
                 rules=self.rules, greedy=greedy, constrained=constrained,
+                kernels=self.kernels,
             )
             pos += 1
             steps += 1

@@ -108,14 +108,15 @@ class ContinuousBatcher:
         positions[slot] = np.arange(bucket)
 
         logits, eng.cache = forward(
-            eng.params, eng.cfg, jnp.asarray(tokens), jnp.asarray(positions), eng.cache, eng.rules
+            eng.params, eng.cfg, jnp.asarray(tokens), jnp.asarray(positions), eng.cache,
+            eng.rules, attn_impl=eng.kernels, fresh_block=True,
         )
         last_logits = logits[:, n - 1, :]  # only row `slot` meaningful
         self._rng, k = jax.random.split(self._rng)
         start_state = jnp.full((self.B,), self.engine.fsm.start, dtype=jnp.int32)
         tok0, fsm0 = _mask_sample_advance(
             last_logits, start_state, eng.mask_table, eng.next_table, k,
-            jnp.float32(self.temperature), self.greedy, True,
+            jnp.float32(self.temperature), self.greedy, True, eng.kernels,
         )
         onehot = jnp.arange(self.B) == slot
         self.cur = jnp.where(onehot, tok0, self.cur)
@@ -166,7 +167,7 @@ class ContinuousBatcher:
             eng.mask_table, eng.next_table, eng.byte_len_table,
             k, jnp.float32(self.temperature), jnp.int32(self.byte_budget),
             rules=eng.rules, chunk_steps=self.chunk_steps,
-            greedy=self.greedy, constrained=True,
+            greedy=self.greedy, constrained=True, kernels=eng.kernels,
         )
         # one transfer for everything the host needs this chunk
         out_h, n_h, act_h, eos_h = (

@@ -101,7 +101,11 @@ class SpeechEngine:
         frame_buckets: tuple[int, ...] = (100, 300, 1000, 3000),
         max_new_tokens: int = 64,
         mel_cfg: MelConfig = MelConfig(),
+        kernels: str = "auto",  # "auto" | "xla" | "pallas" (encoder flash attention)
     ):
+        if kernels == "auto":
+            kernels = "pallas" if jax.default_backend() == "tpu" else "xla"
+        self.kernels = kernels
         self.tokenizer = default_tokenizer()
         base = cfg or PRESETS[preset]
         self.cfg = replace(base, vocab_size=self.tokenizer.vocab_size)
@@ -134,7 +138,7 @@ class SpeechEngine:
 
         t0 = time.perf_counter()
         mel = log_mel_spectrogram(jnp.asarray(padded), self.mel_cfg)[None, :bucket]
-        enc_out = encoder_forward(self.params, self.cfg, mel)
+        enc_out = encoder_forward(self.params, self.cfg, mel, attn_impl=self.kernels)
         cross_kv = compute_cross_kv(self.params, self.cfg, enc_out)
         valid = jnp.arange(enc_out.shape[1])[None, :] < max(1, n_frames // 2)
         enc_out.block_until_ready()

@@ -1,0 +1,5 @@
+"""``python -m tpu_voice_agent.services.executor`` entry point."""
+
+from .server import main
+
+main()
