@@ -1,0 +1,159 @@
+"""Qwen2-VL grounding head: model correctness + executor bridge.
+
+BASELINE config 5 / SURVEY.md §2 #15: the VL head augments the DOM
+analyzer's structured page representation. Everything runs on CPU per the
+reference's seam strategy (SURVEY.md §4).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.models.qwen2vl import (
+    PRESETS,
+    embed_tokens,
+    forward_embeds,
+    init_kv_cache,
+    init_params,
+    mrope_tables,
+    text_positions3,
+    vision_forward,
+    vision_token_positions,
+)
+
+CFG = PRESETS["qwen2vl-test"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def test_vision_forward_shapes(params):
+    v = CFG.vision
+    img = jnp.asarray(np.random.default_rng(0).random((2, v.img_size, v.img_size, 3)), jnp.float32)
+    out = vision_forward(params["vision"], v, img)
+    assert out.shape == (2, v.n_tokens, CFG.dim)
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_mrope_equal_streams_is_1d_rope():
+    """Text tokens carry t==h==w; M-RoPE must then reduce to plain RoPE."""
+    from tpu_voice_agent.models.llama import rope_tables
+
+    pos = jnp.arange(6, dtype=jnp.int32)[None, :]
+    pos3 = jnp.broadcast_to(pos[None], (3, 1, 6))
+    cos3, sin3 = mrope_tables(pos3, CFG.head_dim, CFG.rope_theta, CFG.mrope_sections)
+    cos1, sin1 = rope_tables(pos, CFG.head_dim, CFG.rope_theta)
+    np.testing.assert_allclose(np.asarray(cos3), np.asarray(cos1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sin3), np.asarray(sin1), rtol=1e-6)
+
+
+def test_incremental_decode_matches_full_forward(params):
+    """Prefill-then-decode through the KV cache must reproduce teacher-forced
+    logits — validates cache slots, M-RoPE positions, and causality."""
+    T = 10
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(3, CFG.vocab_size, (1, T)), jnp.int32)
+    emb = embed_tokens(params, ids)
+    slots = jnp.arange(T, dtype=jnp.int32)[None]
+    pos3 = text_positions3(0, T)
+
+    cache = init_kv_cache(CFG, 1, 32, dtype=jnp.float32)
+    full_logits, _ = forward_embeds(params, CFG, emb, slots, pos3, cache)
+
+    cache = init_kv_cache(CFG, 1, 32, dtype=jnp.float32)
+    step_logits = []
+    for t in range(T):
+        lg, cache = forward_embeds(
+            params, CFG, emb[:, t:t + 1], slots[:, t:t + 1], pos3[:, :, t:t + 1], cache
+        )
+        step_logits.append(lg[:, 0])
+    inc = jnp.stack(step_logits, axis=1)
+    np.testing.assert_allclose(np.asarray(inc), np.asarray(full_logits), atol=2e-3, rtol=2e-2)
+
+
+def test_vision_token_positions_grid():
+    p = vision_token_positions(CFG.vision)
+    gm = CFG.vision.merged_grid
+    assert p.shape == (3, gm * gm)
+    assert p[0].max() == 0 and p[1].max() == gm - 1 and p[2].max() == gm - 1
+
+
+# ---------------------------------------------------------------- grounding
+
+
+def test_grounding_engine_emits_grammar_valid_point():
+    from tpu_voice_agent.serve.grounding import GroundingEngine
+
+    eng = GroundingEngine(preset="qwen2vl-test", max_len=192)
+    img = (np.random.default_rng(0).random((240, 320, 3)) * 255).astype(np.uint8)
+    res = eng.ground(img, "click the search box", max_new_tokens=40)
+    if res.raw and res.steps < 40:  # finished inside the budget => must parse
+        obj = json.loads(res.raw)
+        assert 0 <= obj["point"][0] <= 999 and 0 <= obj["point"][1] <= 999
+    assert 0 <= res.x_norm <= 999 and 0 <= res.y_norm <= 999
+
+
+def test_letterbox_point_roundtrip():
+    from tpu_voice_agent.serve.grounding import GroundingEngine, GroundingResult, letterbox
+
+    img = np.zeros((200, 400, 3), np.uint8)
+    boxed, scale, pad_x, pad_y = letterbox(img, 112)
+    assert boxed.shape == (112, 112, 3)
+    # a landscape page centers vertically: pad_y > 0, pad_x == 0
+    assert pad_x == 0 and pad_y > 0
+    res = GroundingResult(x_norm=500, y_norm=500, label="", raw="", vision_ms=0,
+                          prefill_ms=0, decode_ms=0, steps=0)
+    x, y = GroundingEngine.to_page_px(res, 400, 200)
+    assert abs(x - 200) < 2 and abs(y - 100) < 2  # center maps to center
+
+
+def test_element_at_point_prefers_smallest_bbox():
+    from tpu_voice_agent.services.executor.grounding import element_at_point
+
+    analysis = {
+        "buttons": [
+            {"selector": "#big", "isVisible": True, "bbox": {"x": 0, "y": 0, "w": 500, "h": 500}},
+            {"selector": "#small", "isVisible": True, "bbox": {"x": 90, "y": 90, "w": 40, "h": 20}},
+        ],
+        "links": [
+            {"selector": "#hidden", "isVisible": False, "bbox": {"x": 0, "y": 0, "w": 999, "h": 999}},
+        ],
+    }
+    hit = element_at_point(analysis, 100, 100)
+    assert hit is not None and hit["selector"] == "#small"
+    assert element_at_point(analysis, 600, 600) is None
+
+
+def test_grounded_click_through_interpreter(tmp_path):
+    """Auto-strategy click with no DOM text match routes through the injected
+    grounder and clicks the selector whose bbox encloses the point."""
+    from tpu_voice_agent.schemas import Intent
+    from tpu_voice_agent.services.executor.actions import run_intents
+    from tpu_voice_agent.services.executor.page import FakeElement, FakePage
+
+    page = FakePage(
+        elements=[
+            FakeElement("#buy", tag="button", text="Buy now", role="button",
+                        name="Buy now", bbox=(100, 200, 80, 30)),
+        ],
+        url="https://demo.local/item",
+    )
+    calls = []
+
+    def grounder(image, instruction):
+        calls.append(instruction)
+        return 120.0, 210.0, "buy button"
+
+    intents = [Intent(type="click", args={"text": "purchase this item"})]
+    results = run_intents(page, tmp_path, intents, grounder=grounder,
+                          screenshot_each_step=False)
+    assert results[0].ok, results[0].error
+    assert calls == ["purchase this item"]
+    assert results[0].data["by"] == "grounded_selector"
+    assert results[0].data["selector"] == "#buy"
+    assert ("click_selector", "#buy") in page.actions

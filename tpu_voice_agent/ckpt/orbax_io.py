@@ -14,10 +14,17 @@ import orbax.checkpoint as ocp
 
 
 def save_params(path: str | os.PathLike, params) -> None:
-    """Write ``params`` to ``path`` (a directory; created/overwritten)."""
-    path = ocp.test_utils.erase_and_create_empty(os.path.abspath(path))
+    """Write ``params`` to ``path`` (a directory; created if needed). Only
+    the ``params`` subtree is replaced — never the whole target directory."""
+    import shutil
+
+    root = os.path.abspath(path)
+    os.makedirs(root, exist_ok=True)
+    target = os.path.join(root, "params")
+    if os.path.exists(target):
+        shutil.rmtree(target)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path / "params", params)
+        ckptr.save(target, params)
         ckptr.wait_until_finished()
 
 

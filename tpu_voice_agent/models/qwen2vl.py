@@ -1,0 +1,435 @@
+"""Qwen2-VL-family vision-language model, TPU-first functional JAX.
+
+This is the screenshot-grounding head that augments the executor's
+structured DOM analyzer (reference: apps/executor/src/dom-analyzer.ts:34-448
+— SURVEY.md §2 #15 calls it "the structured page representation a Qwen2-VL
+grounding head would replace/augment", BASELINE config 5). The reference has
+no vision model at all; selector resolution there is six $$eval DOM scans.
+Here a screenshot plus a natural-language instruction grounds to a page
+point, which the executor maps back onto the analyzed DOM.
+
+Design language matches models/llama.py / models/whisper.py:
+
+- static shapes: screenshots are letterboxed to a fixed square grid per
+  preset, so the vision tower compiles exactly once (no dynamic-resolution
+  patch counts — the reference hardware target is XLA, not eager CUDA)
+- patchify is a reshape + one big matmul (MXU-friendly), not a conv gather
+- vision tower uses 2D rotary positions (row/col each get half the rotary
+  dims); a 2x2 patch merger MLP projects into the text embedding space
+- the text decoder is Qwen2-style: Llama skeleton (GQA + SwiGLU + RMSNorm)
+  plus q/k/v biases and multimodal M-RoPE — rotary dims split into
+  (temporal, height, width) sections, vision tokens carrying their grid
+  coordinates and text tokens carrying sequential positions
+- layers are stacked and scanned (one trace at any depth); bf16 matmuls
+  with f32 accumulation; sharding injected via parallel.ShardingRules
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import rms_norm
+
+# ---------------------------------------------------------------- config
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    img_size: int = 448  # static square input (letterbox upstream)
+    patch_size: int = 14
+    merge_size: int = 2  # 2x2 patch merge into one text token
+    d_model: int = 1280
+    n_heads: int = 16
+    n_layers: int = 32
+    norm_eps: float = 1e-6
+
+    @property
+    def grid(self) -> int:
+        return self.img_size // self.patch_size
+
+    @property
+    def n_patches(self) -> int:
+        return self.grid * self.grid
+
+    @property
+    def merged_grid(self) -> int:
+        return self.grid // self.merge_size
+
+    @property
+    def n_tokens(self) -> int:
+        return self.merged_grid * self.merged_grid
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return 4 * self.d_model
+
+
+@dataclass(frozen=True)
+class Qwen2VLConfig:
+    vocab_size: int = 4096
+    dim: int = 3584
+    n_layers: int = 28
+    n_heads: int = 28
+    n_kv_heads: int = 4
+    ffn_dim: int = 18944
+    max_seq_len: int = 2048
+    rope_theta: float = 1_000_000.0
+    norm_eps: float = 1e-6
+    mrope_sections: tuple[int, int, int] = (16, 24, 24)  # sums to head_dim//2
+    vision: VisionConfig = VisionConfig()
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+PRESETS: dict[str, Qwen2VLConfig] = {
+    # tiny CPU-test config: 112px image -> 8x8 patches -> 16 vision tokens
+    "qwen2vl-test": Qwen2VLConfig(
+        vocab_size=512,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_dim=128,
+        max_seq_len=256,
+        mrope_sections=(4, 2, 2),
+        vision=VisionConfig(img_size=112, patch_size=14, d_model=32, n_heads=2, n_layers=2),
+    ),
+    "qwen2-vl-2b": Qwen2VLConfig(
+        vocab_size=4096,
+        dim=1536,
+        n_layers=28,
+        n_heads=12,
+        n_kv_heads=2,
+        ffn_dim=8960,
+        mrope_sections=(16, 24, 24),
+        vision=VisionConfig(),
+    ),
+    "qwen2-vl-7b": Qwen2VLConfig(
+        vocab_size=4096,
+        dim=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        ffn_dim=18944,
+        mrope_sections=(16, 24, 24),
+        vision=VisionConfig(),
+    ),
+}
+
+
+# ---------------------------------------------------------------- params
+
+
+def init_vision_params(cfg: VisionConfig, out_dim: int, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    d, hd, L = cfg.d_model, cfg.head_dim, cfg.n_layers
+    patch_in = cfg.patch_size * cfg.patch_size * 3
+    merged_in = cfg.merge_size * cfg.merge_size * d
+    ks = jax.random.split(key, 12)
+
+    def w(key, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5)
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    ones = lambda *s: jnp.ones(s, dtype=dtype)
+    zeros = lambda *s: jnp.zeros(s, dtype=dtype)
+    return {
+        "patch_embed": w(ks[0], patch_in, d),
+        "layers": {
+            "ln1": ones(L, d),
+            "wq": w(ks[1], L, d, d),
+            "bq": zeros(L, d),
+            "wk": w(ks[2], L, d, d),
+            "bk": zeros(L, d),
+            "wv": w(ks[3], L, d, d),
+            "bv": zeros(L, d),
+            "wo": w(ks[4], L, d, d),
+            "ln2": ones(L, d),
+            "w_up": w(ks[5], L, d, cfg.ffn_dim),
+            "b_up": zeros(L, cfg.ffn_dim),
+            "w_down": w(ks[6], L, cfg.ffn_dim, d),
+            "b_down": zeros(L, d),
+        },
+        "merger": {
+            "ln": ones(d),
+            "w1": w(ks[7], merged_in, merged_in),
+            "b1": zeros(merged_in),
+            "w2": w(ks[8], merged_in, out_dim),
+            "b2": zeros(out_dim),
+        },
+    }
+
+
+def init_params(cfg: Qwen2VLConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    """Random init; text decoder layers stacked on a leading axis."""
+    k_vis, k_embed, k_layers, k_head = jax.random.split(key, 4)
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    nq, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    ks = jax.random.split(k_layers, 8)
+
+    def w(key, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5)
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    ones = lambda *s: jnp.ones(s, dtype=dtype)
+    zeros = lambda *s: jnp.zeros(s, dtype=dtype)
+    return {
+        "vision": init_vision_params(cfg.vision, d, k_vis, dtype=dtype),
+        "embed": w(k_embed, cfg.vocab_size, d, scale=d**-0.5),
+        "layers": {
+            "attn_norm": ones(L, d),
+            "wq": w(ks[0], L, d, nq * hd),
+            "bq": zeros(L, nq * hd),
+            "wk": w(ks[1], L, d, nkv * hd),
+            "bk": zeros(L, nkv * hd),
+            "wv": w(ks[2], L, d, nkv * hd),
+            "bv": zeros(L, nkv * hd),
+            "wo": w(ks[3], L, nq * hd, d),
+            "mlp_norm": ones(L, d),
+            "w_gate": w(ks[4], L, d, f),
+            "w_up": w(ks[5], L, d, f),
+            "w_down": w(ks[6], L, f, d),
+        },
+        "final_norm": ones(d),
+        "lm_head": w(k_head, d, cfg.vocab_size),
+    }
+
+
+def init_kv_cache(cfg: Qwen2VLConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+
+
+# ---------------------------------------------------------------- vision tower
+
+
+def _rope2d_tables(cfg: VisionConfig) -> tuple[np.ndarray, np.ndarray]:
+    """cos/sin (N, head_dim//2): first half of rotary dims from the patch
+    row, second half from the patch column (2D rotary, no learned pos)."""
+    g, hd = cfg.grid, cfg.head_dim
+    quarter = hd // 4
+    inv_freq = 1.0 / (10_000.0 ** (np.arange(quarter, dtype=np.float32) / quarter))
+    rows = np.repeat(np.arange(g, dtype=np.float32), g)  # (N,)
+    cols = np.tile(np.arange(g, dtype=np.float32), g)  # (N,)
+    angles = np.concatenate(
+        [rows[:, None] * inv_freq[None, :], cols[:, None] * inv_freq[None, :]], axis=-1
+    )  # (N, hd//2)
+    return np.cos(angles), np.sin(angles)
+
+
+def _rope_rotate(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x (B, N, H, hd), cos/sin (N, hd//2) — split-half rotation."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c, s = cos[None, :, None, :], sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def patchify(cfg: VisionConfig, images: jax.Array) -> jax.Array:
+    """(B, H, W, 3) float in [0,1] -> (B, N, p*p*3). Pure reshape/transpose:
+    the patch embedding becomes one big matmul on the MXU."""
+    B = images.shape[0]
+    g, p = cfg.grid, cfg.patch_size
+    x = images.reshape(B, g, p, g, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, gh, gw, p, p, 3)
+    return x.reshape(B, g * g, p * p * 3)
+
+
+@partial(jax.jit, static_argnames=("cfg", "rules"))
+def vision_forward(params: dict, cfg: VisionConfig, images: jax.Array, rules=None) -> jax.Array:
+    """(B, H, W, 3) -> merged vision embeds (B, n_tokens, out_dim)."""
+    cs = lambda x, name: rules.constrain(x, name) if rules is not None else x
+    B = images.shape[0]
+    N, d, nh, hd = cfg.n_patches, cfg.d_model, cfg.n_heads, cfg.head_dim
+
+    mean = jnp.asarray([0.481, 0.458, 0.408], jnp.float32)
+    std = jnp.asarray([0.269, 0.261, 0.276], jnp.float32)
+    images = (images.astype(jnp.float32) - mean) / std
+
+    patches = patchify(cfg, images).astype(jnp.bfloat16)
+    x = jnp.einsum("bnp,pd->bnd", patches, params["patch_embed"],
+                   preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    x = cs(x, "act")
+
+    cos_np, sin_np = _rope2d_tables(cfg)
+    cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+
+    def layer(x, p):
+        h = rms_norm(x, p["ln1"], cfg.norm_eps)
+        q = (jnp.einsum("bnd,dh->bnh", h, p["wq"], preferred_element_type=jnp.float32)
+             + p["bq"].astype(jnp.float32)).astype(x.dtype).reshape(B, N, nh, hd)
+        k = (jnp.einsum("bnd,dh->bnh", h, p["wk"], preferred_element_type=jnp.float32)
+             + p["bk"].astype(jnp.float32)).astype(x.dtype).reshape(B, N, nh, hd)
+        v = (jnp.einsum("bnd,dh->bnh", h, p["wv"], preferred_element_type=jnp.float32)
+             + p["bv"].astype(jnp.float32)).astype(x.dtype).reshape(B, N, nh, hd)
+        q = _rope_rotate(q, cos, sin)
+        k = _rope_rotate(k, cos, sin)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(scores * (hd**-0.5), axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32)
+        attn = attn.reshape(B, N, d).astype(x.dtype)
+        attn = jnp.einsum("bnh,hd->bnd", attn, p["wo"],
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + attn
+        h = rms_norm(x, p["ln2"], cfg.norm_eps)
+        u = (jnp.einsum("bnd,df->bnf", h, p["w_up"], preferred_element_type=jnp.float32)
+             + p["b_up"].astype(jnp.float32))
+        u = jax.nn.gelu(u).astype(x.dtype)
+        dn = (jnp.einsum("bnf,fd->bnd", u, p["w_down"], preferred_element_type=jnp.float32)
+              + p["b_down"].astype(jnp.float32)).astype(x.dtype)
+        return x + dn, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+
+    # 2x2 merge: (B, gh, gw, d) -> (B, gh/2, 2, gw/2, 2, d) -> (B, Nm, 4d)
+    g, m = cfg.grid, cfg.merge_size
+    gm = cfg.merged_grid
+    x = rms_norm(x, params["merger"]["ln"], cfg.norm_eps)
+    x = x.reshape(B, gm, m, gm, m, d).transpose(0, 1, 3, 2, 4, 5).reshape(B, gm * gm, m * m * d)
+    h = (jnp.einsum("bnm,mo->bno", x, params["merger"]["w1"],
+                    preferred_element_type=jnp.float32) + params["merger"]["b1"].astype(jnp.float32))
+    h = jax.nn.gelu(h).astype(jnp.bfloat16)
+    out = (jnp.einsum("bno,od->bnd", h, params["merger"]["w2"],
+                      preferred_element_type=jnp.float32) + params["merger"]["b2"].astype(jnp.float32))
+    return cs(out.astype(jnp.bfloat16), "act")
+
+
+def vision_token_positions(cfg: VisionConfig) -> np.ndarray:
+    """(3, n_tokens) M-RoPE positions for the merged vision tokens:
+    temporal=0, height=row, width=col on the merged grid."""
+    gm = cfg.merged_grid
+    rows = np.repeat(np.arange(gm), gm)
+    cols = np.tile(np.arange(gm), gm)
+    return np.stack([np.zeros_like(rows), rows, cols]).astype(np.int32)
+
+
+# ---------------------------------------------------------------- M-RoPE decoder
+
+
+def mrope_tables(
+    positions3: jax.Array, head_dim: int, theta: float, sections: tuple[int, int, int]
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin (B, T, head_dim//2) from (3, B, T) t/h/w positions.
+
+    The rotary frequency axis is split into three contiguous sections;
+    section i takes its angles from position stream i. Text tokens carry
+    identical t/h/w so they reduce to standard 1D RoPE.
+    """
+    half = head_dim // 2
+    assert sum(sections) == half, (sections, head_dim)
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    bounds = np.cumsum((0,) + tuple(sections))
+    sec_of_dim = np.zeros(half, dtype=np.int32)
+    for i in range(3):
+        sec_of_dim[bounds[i]:bounds[i + 1]] = i
+    pos = positions3.astype(jnp.float32)[jnp.asarray(sec_of_dim)]  # (half, B, T)
+    angles = jnp.moveaxis(pos, 0, -1) * inv_freq  # (B, T, half)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _apply_rope3(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x (B, T, H, hd); cos/sin (B, T, hd//2) — split-half convention."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c, s = cos[:, :, None, :], sin[:, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg", "rules"))
+def forward_embeds(
+    params: dict,
+    cfg: Qwen2VLConfig,
+    embeds: jax.Array,  # (B, T, D) input embeddings (vision + text mixed)
+    slots: jax.Array,  # (B, T) int32 cache slot of each token (sequence index)
+    positions3: jax.Array,  # (3, B, T) int32 M-RoPE t/h/w positions
+    kv_cache: dict,
+    rules=None,
+) -> tuple[jax.Array, dict]:
+    """Unified prefill/decode forward over input embeddings.
+
+    `slots` drives cache writes and causality (slot i == i-th token of the
+    sequence, exactly like models.llama positions); `positions3` only feeds
+    rotary angles. Returns logits (B, T, V) and the updated cache.
+    """
+    B, T, D = embeds.shape
+    S = kv_cache["k"].shape[2]
+    nq, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cs = lambda x, name: rules.constrain(x, name) if rules is not None else x
+
+    x = cs(embeds, "act")
+    cos, sin = mrope_tables(positions3, hd, cfg.rope_theta, cfg.mrope_sections)
+
+    frontier = jnp.max(slots, axis=1)  # (B,)
+    kv_len_mask = jnp.arange(S)[None, :] <= frontier[:, None]
+    batch_idx = jnp.arange(B)[:, None]
+
+    def layer(x, layer_in):
+        p, k_cache, v_cache = layer_in
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        h = cs(h, "act")
+        q = (jnp.einsum("btd,dh->bth", h, p["wq"], preferred_element_type=jnp.float32)
+             + p["bq"].astype(jnp.float32)).astype(x.dtype)
+        k = (jnp.einsum("btd,dh->bth", h, p["wk"], preferred_element_type=jnp.float32)
+             + p["bk"].astype(jnp.float32)).astype(x.dtype)
+        v = (jnp.einsum("btd,dh->bth", h, p["wv"], preferred_element_type=jnp.float32)
+             + p["bv"].astype(jnp.float32)).astype(x.dtype)
+        q = cs(q.reshape(B, T, nq, hd), "heads")
+        k = cs(k.reshape(B, T, nkv, hd), "kv_heads")
+        v = cs(v.reshape(B, T, nkv, hd), "kv_heads")
+        q = _apply_rope3(q, cos, sin)
+        k = _apply_rope3(k, cos, sin)
+
+        k_cache = k_cache.at[batch_idx, slots].set(k)
+        v_cache = v_cache.at[batch_idx, slots].set(v)
+
+        group = nq // nkv
+        qg = q.reshape(B, T, nkv, group, hd)
+        scores = jnp.einsum("btkgh,bskh->bkgts", qg, k_cache,
+                            preferred_element_type=jnp.float32) * (hd**-0.5)
+        slot_pos = jnp.arange(S)[None, None, :]
+        mask = (slot_pos <= slots[:, :, None]) & kv_len_mask[:, None, :]
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v_cache.dtype), v_cache,
+                          preferred_element_type=jnp.float32)
+        attn = attn.reshape(B, T, nq * hd).astype(x.dtype)
+        attn = jnp.einsum("bth,hd->btd", attn, p["wo"],
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + cs(attn, "act")
+
+        h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        h = cs(h, "act")
+        gate = jnp.einsum("btd,df->btf", h, p["w_gate"], preferred_element_type=jnp.float32)
+        up = jnp.einsum("btd,df->btf", h, p["w_up"], preferred_element_type=jnp.float32)
+        ff = (jax.nn.silu(gate) * up).astype(x.dtype)
+        ff = cs(ff, "ffn")
+        down = jnp.einsum("btf,fd->btd", ff, p["w_down"],
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + cs(down, "act")
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"], preferred_element_type=jnp.float32)
+    return cs(logits, "logits"), {"k": k_new, "v": v_new}
+
+
+def embed_tokens(params: dict, tokens: jax.Array) -> jax.Array:
+    return params["embed"][tokens]
+
+
+def text_positions3(start: int, length: int, batch: int = 1) -> jax.Array:
+    """(3, B, T) sequential text positions: t == h == w (reduces to 1D RoPE)."""
+    pos = jnp.arange(start, start + length, dtype=jnp.int32)[None, :]
+    pos = jnp.broadcast_to(pos, (batch, length))
+    return jnp.broadcast_to(pos[None], (3, batch, length))

@@ -7,17 +7,24 @@ endpointer. The TPU compute path stays JAX/Pallas; this is the IO layer
 around it.
 
 Everything degrades gracefully: if the compiler or the .so is unavailable,
-``NATIVE_AVAILABLE`` is False and the pure-numpy twins in ``audio/`` are
+``native_available()`` is False and the pure-numpy twins in ``audio/`` are
 used instead — same seam style as the reference's null-key STT fake
 (SURVEY.md §4).
 """
 
+from . import frontend
 from .frontend import (
-    NATIVE_AVAILABLE,
     NativeEndpointer,
     pcm16_to_float,
     resample,
     rms,
 )
 
-__all__ = ["NATIVE_AVAILABLE", "NativeEndpointer", "pcm16_to_float", "resample", "rms"]
+
+def native_available() -> bool:
+    """True once the C++ frontend .so has been built+loaded (lazy, so a
+    module-level by-value snapshot would always read False)."""
+    return frontend.NATIVE_AVAILABLE
+
+
+__all__ = ["native_available", "NativeEndpointer", "pcm16_to_float", "resample", "rms"]

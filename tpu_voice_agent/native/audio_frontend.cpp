@@ -64,9 +64,14 @@ int64_t vg_resample_len(int64_t n_in, int32_t sr_in, int32_t sr_out) {
   return (n_in * sr_out) / sr_in;
 }
 
-// Windowed-sinc resampler (Kaiser beta=8, 16 taps/side), arbitrary rational
-// ratio. Cutoff at 0.45 * min(sr_in, sr_out) to suppress aliasing on
+// Windowed-sinc polyphase resampler (Kaiser beta=8, 16 taps/side), arbitrary
+// rational ratio. Cutoff at 0.45 * min(sr_in, sr_out) to suppress aliasing on
 // downsample (the 48k->16k browser-mic case).
+//
+// After gcd reduction the fractional offset of output t repeats with period
+// L = sr_out/g, so the (sinc * Kaiser) weights are precomputed once per
+// phase and the per-sample inner loop is a pure multiply-accumulate — no
+// bessel_i0/sin in the hot path.
 int64_t vg_resample(const float* in, int64_t n_in, int32_t sr_in, int32_t sr_out,
                     float* out) {
   const int64_t n_out = vg_resample_len(n_in, sr_in, sr_out);
@@ -75,32 +80,60 @@ int64_t vg_resample(const float* in, int64_t n_in, int32_t sr_in, int32_t sr_out
     std::memcpy(out, in, sizeof(float) * static_cast<size_t>(n_in));
     return n_in;
   }
-  const double ratio = static_cast<double>(sr_in) / sr_out;  // input step per output
   const double cutoff = 0.45 * std::min(sr_in, sr_out) / static_cast<double>(sr_in);
   const int taps = 16;
+  const int ntaps = 2 * taps;
   const double beta = 8.0;
   const double i0b = bessel_i0(beta);
 
-  for (int64_t t = 0; t < n_out; ++t) {
-    const double pos = t * ratio;
-    const int64_t center = static_cast<int64_t>(std::floor(pos));
-    double acc = 0.0, wsum = 0.0;
-    for (int64_t j = center - taps + 1; j <= center + taps; ++j) {
-      const double x = pos - static_cast<double>(j);  // in (-taps, taps]
-      const double snc_arg = 2.0 * cutoff * x;
-      double snc = (std::fabs(snc_arg) < 1e-12)
-                       ? 1.0
-                       : std::sin(M_PI * snc_arg) / (M_PI * snc_arg);
-      const double w_arg = x / taps;
-      if (std::fabs(w_arg) > 1.0) continue;
-      const double kaiser = bessel_i0(beta * std::sqrt(1.0 - w_arg * w_arg)) / i0b;
-      const double w = snc * kaiser * 2.0 * cutoff;
-      wsum += w;
-      const int64_t jc = j < 0 ? 0 : (j >= n_in ? n_in - 1 : j);  // clamp edges
-      acc += w * in[jc];
+  // weight at signed distance x from the output position, in (-taps, taps]
+  auto weight = [&](double x) -> double {
+    const double w_arg = x / taps;
+    if (std::fabs(w_arg) > 1.0) return 0.0;
+    const double snc_arg = 2.0 * cutoff * x;
+    const double snc = (std::fabs(snc_arg) < 1e-12)
+                           ? 1.0
+                           : std::sin(M_PI * snc_arg) / (M_PI * snc_arg);
+    const double kaiser = bessel_i0(beta * std::sqrt(1.0 - w_arg * w_arg)) / i0b;
+    return snc * kaiser * 2.0 * cutoff;
+  };
+
+  const int64_t g = gcd64(sr_in, sr_out);
+  const int64_t L = sr_out / g;  // distinct phases
+  const int64_t M = sr_in / g;   // input step numerator: pos(t) = t*M/L
+
+  // phase table: normalized weights, tap i at input index center-taps+1+i
+  std::vector<double> table(static_cast<size_t>(L) * ntaps);
+  for (int64_t p = 0; p < L; ++p) {
+    const double frac = static_cast<double>(p) / L;
+    double* row = &table[static_cast<size_t>(p) * ntaps];
+    double wsum = 0.0;
+    for (int i = 0; i < ntaps; ++i) {
+      row[i] = weight(frac + taps - 1 - i);
+      wsum += row[i];
     }
     // normalize by the window sum so DC passes at unit gain
-    out[t] = static_cast<float>(acc / (wsum > 1e-12 ? wsum : 1.0));
+    const double inv = wsum > 1e-12 ? 1.0 / wsum : 1.0;
+    for (int i = 0; i < ntaps; ++i) row[i] *= inv;
+  }
+
+  for (int64_t t = 0; t < n_out; ++t) {
+    const int64_t num = t * M;
+    const int64_t center = num / L;
+    const double* w = &table[static_cast<size_t>(num % L) * ntaps];
+    const int64_t j0 = center - taps + 1;
+    double acc = 0.0;
+    if (j0 >= 0 && j0 + ntaps <= n_in) {  // interior: branch-free MAC
+      const float* s = in + j0;
+      for (int i = 0; i < ntaps; ++i) acc += w[i] * s[i];
+    } else {  // edges: clamp
+      for (int i = 0; i < ntaps; ++i) {
+        const int64_t j = j0 + i;
+        const int64_t jc = j < 0 ? 0 : (j >= n_in ? n_in - 1 : j);
+        acc += w[i] * in[jc];
+      }
+    }
+    out[t] = static_cast<float>(acc);
   }
   return n_out;
 }

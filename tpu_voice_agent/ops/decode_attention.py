@@ -30,21 +30,22 @@ def _on_cpu() -> bool:
 
 def _decode_kernel(
     kv_len_ref,  # SMEM (B,) int32 — all rows' valid key counts
-    q_ref,  # (1, 1, group, hd)
-    k_ref,  # (1, 1, block_k, hd)
-    v_ref,  # (1, 1, block_k, hd)
-    o_ref,  # (1, 1, group, hd)
-    acc_ref,  # VMEM (group, hd) f32
-    m_ref,  # VMEM (group, 128) f32
-    l_ref,  # VMEM (group, 128) f32
+    q_ref,  # (1, nkv, group, hd)
+    k_ref,  # (1, block_k, nkv, hd) — sliced straight from the (B,S,nkv,hd) cache
+    v_ref,  # (1, block_k, nkv, hd)
+    o_ref,  # (1, nkv, group, hd)
+    acc_ref,  # VMEM (nkv, group, hd) f32
+    m_ref,  # VMEM (nkv, group, 128) f32
+    l_ref,  # VMEM (nkv, group, 128) f32
     *,
     scale: float,
+    nkv: int,
     group: int,
     block_k: int,
 ):
     b = pl.program_id(0)
-    j = pl.program_id(2)
-    nj = pl.num_programs(2)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
     kv_len = kv_len_ref[b]
 
     @pl.when(j == 0)
@@ -55,32 +56,34 @@ def _decode_kernel(
 
     @pl.when(j * block_k < kv_len)
     def _tile():
-        q = q_ref[0, 0].astype(jnp.float32)  # (group, hd)
-        k = k_ref[0, 0].astype(jnp.float32)  # (bk, hd)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (group, bk)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (group, block_k), 1)
-        s = jnp.where(k_pos < kv_len, s, _NEG_INF)
+        valid = k_pos < kv_len
+        for h in range(nkv):  # static unroll; nkv is small (GQA)
+            q = q_ref[0, h].astype(jnp.float32)  # (group, hd)
+            k = k_ref[0, :, h].astype(jnp.float32)  # (bk, hd)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # (group, bk)
+            s = jnp.where(valid, s, _NEG_INF)
 
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, v_ref[0, :, h].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
 
     @pl.when(j == nj - 1)
     def _finish():
-        l = l_ref[:, :1]
-        o_ref[0, 0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        l = l_ref[:, :, :1]
+        o_ref[0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "block_k", "interpret"))
@@ -101,37 +104,41 @@ def decode_attention(
     group = nq // nkv
     scale = scale if scale is not None else hd**-0.5
     interpret = interpret if interpret is not None else _on_cpu()
-    block_k = min(block_k, S)
 
-    pad_s = (-S) % block_k
-    kt = jnp.moveaxis(k_cache, 2, 1)  # (B, nkv, S, hd)
-    vt = jnp.moveaxis(v_cache, 2, 1)
-    if pad_s:
-        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
-        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
-    Sp = kt.shape[2]
-    qg = q.reshape(B, nkv, group, hd)
+    # Blocks DMA straight out of the cache's native (B, S, nkv, hd) layout —
+    # no moveaxis/pad relayout of the full cache per step (the step's HBM
+    # traffic must stay proportional to the attended keys, not capacity).
+    # All kv heads ride in each block (TPU tiling wants the second-minor
+    # block dim equal to the array dim) and the small GQA head loop unrolls
+    # in-kernel. block_k must divide S: take the largest divisor <= block_k.
+    bk = min(block_k, S)
+    while S % bk:
+        bk -= 1
+    block_k = bk
+    qg = q.reshape(B, nkv, group, hd)  # reshape only — no copy
 
-    grid = (B, nkv, Sp // block_k)
-    kernel = functools.partial(_decode_kernel, scale=scale, group=group, block_k=block_k)
+    grid = (B, S // block_k)
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, nkv=nkv, group=group, block_k=block_k
+    )
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((B,), lambda b, h, j: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, group, hd), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((B,), lambda b, j: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, nkv, group, hd), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, nkv, hd), lambda b, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, block_k, nkv, hd), lambda b, j: (b, j, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, hd), lambda b, h, j: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, nkv, group, hd), lambda b, j: (b, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((group, hd), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((nkv, group, hd), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(kv_len.astype(jnp.int32), qg, kt, vt)
+    )(kv_len.astype(jnp.int32), qg, k_cache, v_cache)
     return out.reshape(B, nq, hd)
 
 

@@ -1,3 +1,4 @@
 from .engine import DecodeEngine, GenerationResult
+from .grounding import GroundingEngine, GroundingResult
 
-__all__ = ["DecodeEngine", "GenerationResult"]
+__all__ = ["DecodeEngine", "GenerationResult", "GroundingEngine", "GroundingResult"]

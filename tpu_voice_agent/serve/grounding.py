@@ -1,0 +1,219 @@
+"""Screenshot-grounding engine: Qwen2-VL + grammar-constrained point decode.
+
+BASELINE config 5 / SURVEY.md §7 step 7: the reference resolves click/extract
+targets purely by DOM scans (apps/executor/src/dom-analyzer.ts:34-448); this
+engine grounds a natural-language instruction against a raw screenshot and
+returns a normalized page point, which the executor maps back onto the
+analyzed DOM (services/executor/grounding.py). Zero cloud calls.
+
+Same serving design as serve.engine.DecodeEngine:
+- static shapes: the screenshot letterboxes to the preset's fixed square, so
+  the vision tower is one compiled XLA program; the decoder prefill pads to
+  one bucket and the per-token step is a single fused jit
+  [forward -> grammar mask -> argmax -> FSM advance]
+- output is grammar-constrained to ``{"point":[x,y],"label":"..."}`` with
+  x/y in 0..999 per-mille page coordinates (the grammar guarantees it
+  parses; no repair loop)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..grammar.fsm import TokenFSM
+from ..grammar.regexlang import compile_regex
+from ..grammar.tokenizer import BOS_ID, EOS_ID, Tokenizer
+from ..models.qwen2vl import (
+    PRESETS,
+    Qwen2VLConfig,
+    embed_tokens,
+    forward_embeds,
+    init_kv_cache,
+    init_params,
+    text_positions3,
+    vision_forward,
+    vision_token_positions,
+)
+
+GROUNDING_REGEX = r'\{"point":\[[0-9]{1,3},[0-9]{1,3}\],"label":"[a-zA-Z0-9 _.,-]{0,48}"\}'
+
+
+def grounding_literals() -> list[str]:
+    return ['{"point":[', '],"label":"', '"}', ",", '"point"', '"label"']
+
+
+@lru_cache(maxsize=1)
+def build_grounding_fsm() -> tuple[Tokenizer, TokenFSM]:
+    corpus = [
+        "click the search box",
+        "open the second result",
+        "press the add to cart button",
+        "select the sort by price dropdown",
+        "where should I click to submit the form",
+        '{"point":[512,88],"label":"search input"}',
+    ]
+    tok = Tokenizer.build(corpus=corpus, literals=grounding_literals(), vocab_size=512)
+    fsm = TokenFSM(compile_regex(GROUNDING_REGEX), tok)
+    return tok, fsm
+
+
+@dataclass
+class GroundingResult:
+    x_norm: int  # 0..999 per-mille across page width
+    y_norm: int
+    label: str
+    raw: str
+    vision_ms: float
+    prefill_ms: float
+    decode_ms: float
+    steps: int
+    ok: bool = True  # False when decode truncated before closing the JSON
+
+
+def letterbox(image: np.ndarray, size: int) -> tuple[np.ndarray, float, int, int]:
+    """Nearest-neighbor letterbox of (H, W, 3) uint8/float to (size, size, 3)
+    float32 in [0,1]. Returns (img, scale, pad_x, pad_y) so per-mille model
+    coordinates map back to source pixels:
+      src_x = (x_norm/1000 * size - pad_x) / scale
+    """
+    h, w = image.shape[:2]
+    img = image.astype(np.float32)
+    if img.max() > 1.5:
+        img = img / 255.0
+    scale = size / max(h, w)
+    nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
+    ys = np.clip((np.arange(nh) / scale).astype(np.int64), 0, h - 1)
+    xs = np.clip((np.arange(nw) / scale).astype(np.int64), 0, w - 1)
+    resized = img[ys][:, xs]
+    pad_y, pad_x = (size - nh) // 2, (size - nw) // 2
+    out = np.zeros((size, size, 3), dtype=np.float32)
+    out[pad_y:pad_y + nh, pad_x:pad_x + nw] = resized[..., :3]
+    return out, scale, pad_x, pad_y
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _ground_decode_step(params, cfg: Qwen2VLConfig, cache, token, slot, pos_start,
+                        fsm_state, mask_table, next_table):
+    """One fused constrained decode step (greedy)."""
+    emb = embed_tokens(params, token[:, None])  # (B, 1, D)
+    slots = slot[:, None]
+    pos3 = jnp.broadcast_to((pos_start + slot)[None, :, None], (3, slot.shape[0], 1))
+    logits, cache = forward_embeds(params, cfg, emb, slots, pos3, cache)
+    logits = logits[:, -1]
+    masked = jnp.where(mask_table[fsm_state], logits, -jnp.inf)
+    tok = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+    fsm_state = next_table[fsm_state, tok]
+    return tok, fsm_state, cache
+
+
+class GroundingEngine:
+    """Single-request screenshot grounding on the local device/mesh.
+
+    ``params`` may be loaded from an Orbax/HF checkpoint via ckpt.hf_import;
+    random init keeps the engine usable for shape/latency work and tests.
+    """
+
+    def __init__(self, preset: str = "qwen2vl-test", max_len: int = 256,
+                 params: dict | None = None, seed: int = 0):
+        self.tok, self.fsm = build_grounding_fsm()
+        base = PRESETS[preset]
+        from dataclasses import replace
+
+        self.cfg = replace(base, vocab_size=self.tok.vocab_size, max_seq_len=max_len)
+        self.max_len = max_len
+        self.params = params if params is not None else init_params(
+            self.cfg, jax.random.PRNGKey(seed))
+        self.mask_table = jnp.asarray(self.fsm.mask)
+        self.next_table = jnp.asarray(np.maximum(self.fsm.next_state, 0))
+        self._vis_pos = vision_token_positions(self.cfg.vision)
+
+    def _prompt_ids(self, instruction: str) -> list[int]:
+        text = (f"<|user|>\nGround this instruction to one page point: "
+                f"{instruction}\n<|assistant|>\n")
+        return self.tok.encode(text, bos=False, eos=False)
+
+    def ground(self, image: np.ndarray, instruction: str,
+               max_new_tokens: int = 48) -> GroundingResult:
+        cfg = self.cfg
+        t0 = time.perf_counter()
+        img, scale, pad_x, pad_y = letterbox(image, cfg.vision.img_size)
+        vis = vision_forward(self.params["vision"], cfg.vision, jnp.asarray(img)[None])
+        vis.block_until_ready()
+        t1 = time.perf_counter()
+
+        ids = [BOS_ID] + self._prompt_ids(instruction)
+        nv = cfg.vision.n_tokens
+        total = nv + len(ids)
+        if total + max_new_tokens > self.max_len:
+            raise ValueError(f"prompt too long: {total}+{max_new_tokens} > {self.max_len}")
+
+        txt = embed_tokens(self.params, jnp.asarray(ids, jnp.int32)[None])
+        embeds = jnp.concatenate([vis, txt], axis=1)  # (1, total, D)
+        slots = jnp.arange(total, dtype=jnp.int32)[None]
+        # M-RoPE: vision tokens carry grid coords; text continues after the
+        # largest vision position (merged grid side), sequentially.
+        gm = cfg.vision.merged_grid
+        vp = jnp.asarray(self._vis_pos)[:, None, :]  # (3, 1, nv)
+        tp = text_positions3(gm, len(ids), batch=1)
+        pos3 = jnp.concatenate([vp, tp], axis=2)
+
+        cache = init_kv_cache(cfg, 1, self.max_len)
+        logits, cache = forward_embeds(self.params, cfg, embeds, slots, pos3, cache)
+        state = jnp.asarray([self.fsm.start], jnp.int32)
+        first_logits = logits[:, -1]
+        masked = jnp.where(self.mask_table[state], first_logits, -jnp.inf)
+        token = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+        state = self.next_table[state, token]
+        token.block_until_ready()
+        t2 = time.perf_counter()
+
+        # text M-RoPE positions continue from gm + len(ids); slot from total
+        pos_start = jnp.asarray([gm + len(ids) - total], jnp.int32)  # pos = start + slot
+        out_ids: list[int] = [int(token[0])]
+        slot = jnp.asarray([total], jnp.int32)
+        steps = 1
+        for _ in range(max_new_tokens - 1):
+            token, state, cache = _ground_decode_step(
+                self.params, cfg, cache, token, slot, pos_start,
+                state, self.mask_table, self.next_table)
+            tid = int(token[0])
+            steps += 1
+            if tid == EOS_ID:
+                break
+            out_ids.append(tid)
+            slot = slot + 1
+        t3 = time.perf_counter()
+
+        raw = self.tok.decode(out_ids)
+        x_norm, y_norm, label, ok = 500, 500, "", True
+        try:
+            obj = json.loads(raw)
+            x_norm = min(999, int(obj["point"][0]))
+            y_norm = min(999, int(obj["point"][1]))
+            label = str(obj.get("label", ""))
+        except (json.JSONDecodeError, KeyError, IndexError, TypeError):
+            ok = False  # grammar guarantees shape; truncation is the only miss
+        return GroundingResult(
+            x_norm=x_norm, y_norm=y_norm, label=label, raw=raw,
+            vision_ms=(t1 - t0) * 1e3, prefill_ms=(t2 - t1) * 1e3,
+            decode_ms=(t3 - t2) * 1e3, steps=steps, ok=ok,
+        )
+
+    @staticmethod
+    def to_page_px(res: GroundingResult, page_w: int, page_h: int) -> tuple[float, float]:
+        """Per-mille model coords -> source-page pixels (inverts letterbox)."""
+        size = 1000.0
+        # letterbox params recomputed from page dims (same math as letterbox())
+        scale = 1.0 / max(page_w, page_h)  # normalized: model square == 1.0
+        nw, nh = page_w * scale, page_h * scale
+        pad_x, pad_y = (1.0 - nw) / 2, (1.0 - nh) / 2
+        x = (res.x_norm / size - pad_x) / scale
+        y = (res.y_norm / size - pad_y) / scale
+        return float(np.clip(x, 0, page_w - 1)), float(np.clip(y, 0, page_h - 1))

@@ -58,8 +58,9 @@ SEARCH_FALLBACK_SELECTORS = [
 
 
 class _AnalysisCache:
-    def __init__(self, page: PageLike):
+    def __init__(self, page: PageLike, grounder=None):
         self.page = page
+        self.grounder = grounder  # executor.grounding.Grounder | None
         self._analysis: dict | None = None
 
     def get(self) -> dict:
@@ -135,6 +136,19 @@ def _do_click(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
             if str(text).lower() in (el.get("text") or "").lower():
                 page.click_selector(el["selector"], timeout_ms=intent.timeout_ms)
                 return {"by": "analyzed_text", "text": text, "selector": el["selector"]}
+    grounder = getattr(cache, "grounder", None)
+    if grounder is not None:
+        # no DOM match: ask the VL grounding head (SURVEY.md §2 #15 augment)
+        import tempfile
+
+        from .grounding import grounded_click
+
+        shot = str(Path(tempfile.gettempdir()) / "ground_shot.png")
+        try:
+            return grounded_click(page, analysis, grounder, str(text), shot,
+                                  timeout_ms=intent.timeout_ms)
+        except Exception:
+            pass  # fall through to the plain text click
     page.click_text(str(text), timeout_ms=intent.timeout_ms)
     return {"by": "text", "text": text}
 
@@ -222,11 +236,12 @@ def run_intents(
     intents: list[Intent],
     uploads_dir: str | Path | None = None,
     screenshot_each_step: bool = True,
+    grounder=None,  # executor.grounding.Grounder | None — VL click fallback
 ) -> list[StepResult]:
     """Sequential interpreter; one StepResult per intent, errors isolated."""
     dir_ = str(artifacts_dir)
     Path(dir_).mkdir(parents=True, exist_ok=True)
-    cache = _AnalysisCache(page)
+    cache = _AnalysisCache(page, grounder=grounder)
     results: list[StepResult] = []
 
     for step, intent in enumerate(intents):

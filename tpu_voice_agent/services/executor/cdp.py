@@ -262,6 +262,14 @@ class CDPPage:
         )
         self._js_click(finder, f"role={role} name={name}")
 
+    def click_at(self, x: float, y: float) -> None:
+        """Trusted synthetic click at viewport coordinates (grounding path)."""
+        for ev in ("mousePressed", "mouseReleased"):
+            self.conn.call(
+                "Input.dispatchMouseEvent",
+                {"type": ev, "x": x, "y": y, "button": "left", "clickCount": 1},
+            )
+
     def fill(self, selector: str, value: str) -> None:
         ok = self.evaluate(
             f"(() => {{ const el = document.querySelector({json.dumps(selector)});"

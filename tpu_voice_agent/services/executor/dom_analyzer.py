@@ -26,14 +26,18 @@ const sel = (el) => {
   return s;
 };
 const vis = (el) => { const r = el.getBoundingClientRect(); return r.width > 0 && r.height > 0; };
-const info = (el) => ({
-  selector: sel(el), type: el.type || el.tagName.toLowerCase(),
-  text: (el.innerText || el.value || '').trim().slice(0, 120),
-  placeholder: el.placeholder || '',
-  attributes: {role: el.getAttribute('role') || '', name: el.name || '',
-               'aria-label': el.getAttribute('aria-label') || ''},
-  isVisible: vis(el), isEnabled: !el.disabled,
-});
+const info = (el) => {
+  const r = el.getBoundingClientRect();
+  return {
+    selector: sel(el), type: el.type || el.tagName.toLowerCase(),
+    text: (el.innerText || el.value || '').trim().slice(0, 120),
+    placeholder: el.placeholder || '',
+    attributes: {role: el.getAttribute('role') || '', name: el.name || '',
+                 'aria-label': el.getAttribute('aria-label') || ''},
+    bbox: {x: r.x + window.scrollX, y: r.y + window.scrollY, w: r.width, h: r.height},
+    isVisible: vis(el), isEnabled: !el.disabled,
+  };
+};
 """
 
 

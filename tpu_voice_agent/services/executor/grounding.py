@@ -1,0 +1,109 @@
+"""Screenshot-grounding bridge: VL point -> DOM selector -> click.
+
+The reference grounds targets purely via DOM scans (apps/executor/src/
+dom-analyzer.ts:34-448). This bridge augments that path (SURVEY.md §2 #15):
+when the auto strategy finds no analyzed-element match, the interpreter can
+screenshot the page, ask a Qwen2-VL grounding engine for a page point, snap
+the point onto the analyzed DOM (smallest enclosing bbox wins), and click
+the resulting selector — falling back to a raw coordinate click when no
+element encloses the point.
+
+The grounder itself is an injected callable so tests (and the fake-page
+service mode) can ground without a TPU:  grounder(image, instruction) ->
+(x_px, y_px, label)  in page pixel space.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol
+
+import numpy as np
+
+Grounder = Callable[[np.ndarray, str], tuple[float, float, str]]
+
+
+def element_at_point(analysis: dict, x: float, y: float) -> dict | None:
+    """Smallest visible analyzed element whose bbox encloses (x, y)."""
+    best: dict | None = None
+    best_area = float("inf")
+    for bucket in ("buttons", "links", "searchElements", "navigationElements"):
+        for el in analysis.get(bucket) or []:
+            bbox = el.get("bbox") or {}
+            bw, bh = bbox.get("w", 0), bbox.get("h", 0)
+            if not el.get("isVisible") or bw <= 0 or bh <= 0:
+                continue
+            bx, by = bbox.get("x", 0), bbox.get("y", 0)
+            if bx <= x <= bx + bw and by <= y <= by + bh and bw * bh < best_area:
+                best, best_area = el, bw * bh
+    return best
+
+
+def load_screenshot(path: str) -> np.ndarray:
+    """PNG -> (H, W, 3) uint8 via PIL (present in this image's env)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+class TPUGrounder:
+    """Adapter: serve.grounding.GroundingEngine as an executor Grounder.
+
+    Lazy-constructed so the executor service stays importable (and the fake
+    page path stays TPU-free) until the first grounded click.
+    """
+
+    def __init__(self, preset: str = "qwen2vl-test", max_len: int = 256):
+        self.preset = preset
+        self.max_len = max_len
+        self._engine = None
+
+    def _get(self):
+        if self._engine is None:
+            from ...serve.grounding import GroundingEngine
+
+            self._engine = GroundingEngine(preset=self.preset, max_len=self.max_len)
+        return self._engine
+
+    def __call__(self, image: np.ndarray, instruction: str) -> tuple[float, float, str]:
+        engine = self._get()
+        res = engine.ground(image, instruction)
+        if not res.ok:
+            # truncated decode: no trustworthy point — let the interpreter
+            # fall back to its text-click path rather than click page center
+            raise RuntimeError(f"grounding decode truncated: {res.raw!r}")
+        h, w = image.shape[:2]
+        x, y = engine.to_page_px(res, w, h)
+        return x, y, res.label
+
+
+def _scroll_offset(page: Any) -> tuple[float, float]:
+    try:
+        off = page.evaluate("(() => [window.scrollX, window.scrollY])()")
+        if isinstance(off, (list, tuple)) and len(off) == 2:
+            return float(off[0]), float(off[1])
+    except Exception:
+        pass
+    return 0.0, 0.0
+
+
+def grounded_click(page: Any, analysis: dict, grounder: Grounder, instruction: str,
+                   shot_path: str, timeout_ms: int = 5000) -> dict:
+    """Screenshot -> ground -> snap to DOM -> click. Returns step data.
+
+    The screenshot (and hence the grounded point) is viewport-space; the
+    analyzed bboxes are document-space — add the scroll offset before
+    snapping, and click raw coordinates in viewport space.
+    """
+    page.screenshot(shot_path, full_page=False)
+    image = load_screenshot(shot_path)
+    vx, vy, label = grounder(image, instruction)
+    sx, sy = _scroll_offset(page)
+    x, y = vx + sx, vy + sy  # document space
+    el = element_at_point(analysis, x, y)
+    if el is not None:
+        page.click_selector(el["selector"], timeout_ms=timeout_ms)
+        return {"by": "grounded_selector", "selector": el["selector"],
+                "point": [x, y], "label": label}
+    page.click_at(vx, vy)
+    return {"by": "grounded_point", "point": [x, y], "label": label}

@@ -27,6 +27,7 @@ class PageLike(Protocol):
     def click_selector(self, selector: str, timeout_ms: int = 5000) -> None: ...
     def click_text(self, text: str, timeout_ms: int = 5000) -> None: ...
     def click_role(self, role: str, name: str | None, timeout_ms: int = 5000) -> None: ...
+    def click_at(self, x: float, y: float) -> None: ...
     def fill(self, selector: str, value: str) -> None: ...
     def press(self, selector: str, key: str) -> None: ...
     def select_option(self, selector: str, label_or_value: str) -> None: ...
@@ -52,6 +53,7 @@ class FakeElement:
     options: list[str] = field(default_factory=list)
     visible: bool = True
     attrs: dict[str, str] = field(default_factory=dict)
+    bbox: tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)  # x, y, w, h
 
 
 class FakePage:
@@ -71,6 +73,7 @@ class FakePage:
         self.history: list[str] = [url]
         self._fwd: list[str] = []
         self.closed = False
+        self.scroll: list[float] = [0.0, 0.0]  # window.scrollX / scrollY
         self.fail_next: str | None = None  # operation name to fail once (fault injection)
         self.extract_rows: list[dict] = [
             {"title": "Fake Product A", "price": "$19.99"},
@@ -138,6 +141,8 @@ class FakePage:
             return self.url
         if "document.body.innerText" in js:
             return " ".join(el.text for el in self.elements if el.text) or "fake body text"
+        if "window.scrollX" in js:
+            return list(self.scroll)
         return None
 
     def _info(self, el: FakeElement) -> dict:
@@ -147,6 +152,7 @@ class FakePage:
             "text": el.text,
             "placeholder": el.placeholder,
             "attributes": {"role": el.role, "name": el.name, **el.attrs},
+            "bbox": {"x": el.bbox[0], "y": el.bbox[1], "w": el.bbox[2], "h": el.bbox[3]},
             "isVisible": el.visible,
             "isEnabled": True,
         }
@@ -212,6 +218,15 @@ class FakePage:
                 return
         raise RuntimeError(f"no element with role={role} name={name}")
 
+    def click_at(self, x: float, y: float) -> None:
+        self._maybe_fail("click")
+        for el in self.elements:
+            bx, by, bw, bh = el.bbox
+            if el.visible and bw > 0 and bh > 0 and bx <= x <= bx + bw and by <= y <= by + bh:
+                self.actions.append(("click_at", x, y, el.selector))
+                return
+        self.actions.append(("click_at", x, y, None))
+
     def fill(self, selector: str, value: str) -> None:
         self._maybe_fail("fill")
         el = self.find(selector)
@@ -242,6 +257,8 @@ class FakePage:
         self.actions.append(("set_input_files", selector, path))
 
     def scroll_by(self, dx: int, dy: int) -> None:
+        self.scroll[0] = max(0.0, self.scroll[0] + dx)
+        self.scroll[1] = max(0.0, self.scroll[1] + dy)
         self.actions.append(("scroll_by", dx, dy))
 
     def go_back(self) -> None:
@@ -262,8 +279,8 @@ class FakePage:
             # 1x1 transparent PNG
             f.write(
                 bytes.fromhex(
-                    "89504e470d0a1a0a0000000d49484452000000010000000108060000001f15c489"
-                    "0000000d49444154789c626001000000ffff03000006000557bfabd40000000049454e44ae426082"
+                    "89504e470d0a1a0a0000000d4948445200000001000000010802000000907753de"
+                    "0000000c49444154789c63606060000000040001f61738550000000049454e44ae426082"
                 )
             )
         self.actions.append(("screenshot", path))
