@@ -1,0 +1,173 @@
+"""Multi-model colocation: Whisper STT + Llama intent decode on one mesh.
+
+SURVEY.md §7 step 6 and hard part (3): the voice pipeline needs BOTH models
+resident at once — streaming STT chunks arrive every ~250 ms while intent
+decodes run continuously — and the reference simply pays two cloud vendors
+for this (Deepgram + OpenAI; apps/voice/src/deepgram.ts, apps/brain/src/
+llm.ts). Here both engines live in the same process on the same device
+mesh, sharing HBM, and a host-side scheduler interleaves their dispatches:
+
+- every model executable is shape-bucketed (SpeechEngine frame buckets,
+  DecodeEngine prefill buckets, fixed-width decode chunks), so colocation
+  adds zero recompilation — the XLA program cache holds one program per
+  (model, bucket) pair for the process lifetime
+- STT jobs get priority: an utterance chunk is one bounded encoder+decode
+  dispatch, and intent decoding advances in chunk_steps-token chunks, so
+  the worst-case STT queueing delay is a single decode chunk — this is the
+  scheduler-tail-latency knob for the p50 < 800 ms target
+- device work stays async (JAX dispatch); the interleave loop only orders
+  dispatches and harvests finished results
+
+The engines are constructed by the caller (so tests inject tiny presets and
+services pick real ones) and must target the same devices; on a multi-chip
+mesh both param trees live in the same HBM pool, which is the point.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .engine import GenerationResult
+from .scheduler import ContinuousBatcher
+from .stt import SpeechEngine, TranscribeResult
+
+
+@dataclass
+class ColocationStats:
+    stt_jobs: int = 0
+    parse_jobs: int = 0
+    stt_busy_ms: float = 0.0
+    decode_busy_ms: float = 0.0
+    decode_chunks: int = 0
+    max_stt_queue: int = 0
+    max_parse_inflight: int = 0
+    # dispatch-order trace: "stt" / "chunk" entries, for fairness asserts
+    trace: list = field(default_factory=list)
+
+
+class ColocatedServing:
+    """Interleaves one SpeechEngine and one ContinuousBatcher.
+
+    Synchronous core (``step``) plus an optional worker thread
+    (``start``/``stop``). ``submit_stt`` / ``submit_parse`` are thread-safe
+    and return ``concurrent.futures.Future``.
+    """
+
+    def __init__(self, stt: SpeechEngine, batcher: ContinuousBatcher):
+        self.stt = stt
+        self.batcher = batcher
+        self.stats = ColocationStats()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stt_q: list[tuple[np.ndarray, Future]] = []
+        self._parse_futs: dict[int, Future] = {}
+        self._thread: threading.Thread | None = None
+        self._stop = False
+
+    # ------------------------------------------------------------ submit
+
+    def submit_stt(self, audio: np.ndarray) -> "Future[TranscribeResult]":
+        fut: Future = Future()
+        with self._work:
+            self._stt_q.append((audio, fut))
+            self.stats.max_stt_queue = max(self.stats.max_stt_queue, len(self._stt_q))
+            self._work.notify()
+        return fut
+
+    def submit_parse(self, prompt: str) -> "Future[GenerationResult]":
+        fut: Future = Future()
+        with self._work:
+            rid = self.batcher.submit(prompt)
+            self._parse_futs[rid] = fut
+            self.stats.max_parse_inflight = max(
+                self.stats.max_parse_inflight, len(self._parse_futs)
+            )
+            self._work.notify()
+        return fut
+
+    # ------------------------------------------------------------ core
+
+    def _has_decode_work(self) -> bool:
+        return bool(self.batcher.pending) or any(
+            sl.request_id >= 0 for sl in self.batcher.slots
+        )
+
+    def step(self) -> bool:
+        """One scheduling decision: drain STT queue, else one decode chunk.
+        Returns True if any device work was dispatched."""
+        with self._lock:
+            stt_jobs = list(self._stt_q)
+            self._stt_q.clear()
+        did = False
+
+        for audio, fut in stt_jobs:  # priority lane
+            t0 = time.perf_counter()
+            try:
+                fut.set_result(self.stt.transcribe(audio))
+            except Exception as e:  # per-job isolation
+                fut.set_exception(e)
+            self.stats.stt_busy_ms += (time.perf_counter() - t0) * 1e3
+            self.stats.stt_jobs += 1
+            self.stats.trace.append("stt")
+            did = True
+
+        if self._has_decode_work():
+            t0 = time.perf_counter()
+            self.batcher.step()
+            self.stats.decode_busy_ms += (time.perf_counter() - t0) * 1e3
+            self.stats.decode_chunks += 1
+            self.stats.trace.append("chunk")
+            did = True
+            self._harvest()
+        return did
+
+    def _harvest(self) -> None:
+        with self._lock:
+            done = [rid for rid in self._parse_futs if rid in self.batcher.results]
+            for rid in done:
+                fut = self._parse_futs.pop(rid)
+                res = self.batcher.results.pop(rid)
+                self.stats.parse_jobs += 1
+                fut.set_result(res)
+
+    def drain(self, timeout_s: float = 120.0) -> None:
+        """Run steps until all queued work (both lanes) has completed."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = not self._stt_q and not self._parse_futs
+            if idle:
+                return
+            self.step()
+        raise TimeoutError("colocated drain timed out")
+
+    # ------------------------------------------------------------ worker
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, name="colocate", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            did = self.step()
+            with self._work:
+                if self._stop:
+                    return
+                if not did and not self._stt_q and not self._has_decode_work():
+                    self._work.wait(timeout=0.05)

@@ -138,7 +138,9 @@ class ContinuousBatcher:
 
     def step(self) -> None:
         """Admit pending requests into free slots, then run one chunk."""
-        act = np.asarray(jax.device_get(self.active))
+        # np.array: device_get may hand back a read-only buffer view, and the
+        # admit loop marks slots taken in-place
+        act = np.array(jax.device_get(self.active))
         while self.pending:
             slot = self._free_slot(act)
             if slot is None:
