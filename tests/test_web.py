@@ -1,0 +1,51 @@
+"""Web client serving: the voice service hosts the UI on one origin.
+
+Reference parity (SURVEY.md §2 #1-#4): the shell, capture pipeline, intent
+review, and executor client all live in the served static bundle; the WS
+contract they speak is covered end-to-end by tests/test_voice.py.
+"""
+
+import asyncio
+
+import aiohttp
+
+from tpu_voice_agent.serve.stt import NullSTT
+from tpu_voice_agent.services.voice import VoiceConfig, build_app as build_voice
+from tests.http_helper import AppServer
+
+
+def _get(url: str) -> tuple[int, str]:
+    async def run():
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(url) as r:
+                return r.status, await r.text()
+
+    return asyncio.run(run())
+
+
+def test_index_and_assets_served():
+    app = build_voice(VoiceConfig(stt_factory=NullSTT))
+    with AppServer(app) as srv:
+        status, html = _get(srv.url + "/")
+        assert status == 200 and "tpu-voice-agent" in html
+        # the shell wires exactly one socket: /stream on the same origin
+        status, js = _get(srv.url + "/static/app.js")
+        assert status == 200
+        assert "ws://${location.host}/stream" in js
+        assert "7071" not in js  # the reference's phantom-port bug stays dead
+        status, css = _get(srv.url + "/static/style.css")
+        assert status == 200 and ".badge" in css
+
+
+def test_client_covers_reference_capture_contract():
+    """The capture pipeline constants match the reference behavior the
+    framework replicates (60 ms batching, 2 s keep-alive, 16 kHz)."""
+    from tpu_voice_agent.web import static_dir
+
+    js = (static_dir() / "app.js").read_text()
+    assert "BATCH_MS = 60" in js
+    assert "KEEPALIVE_MS = 2000" in js
+    assert "TARGET_RATE = 16000" in js
+    for feature in ("confirm_execute", "uploads", "fileRef", "AudioWorkletNode",
+                    "transcript_partial", "confirmation_required", "execution_result"):
+        assert feature in js, feature

@@ -222,8 +222,17 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                     break
         return ws
 
+    async def index(_req: web.Request) -> web.FileResponse:
+        from ..web import static_dir
+
+        return web.FileResponse(static_dir() / "index.html")
+
     app.router.add_get("/health", health)
     app.router.add_get("/stream", stream)
+    app.router.add_get("/", index)
+    from ..web import static_dir as _sd
+
+    app.router.add_static("/static/", _sd())
     return app
 
 

@@ -1,0 +1,15 @@
+"""Static web client (reference: apps/web — SURVEY.md §2 #1-#4).
+
+The reference ships a React/vite app with its own dev server on :5173
+(vite.config.ts:7). Here the client is dependency-free static HTML/JS served
+by the voice service itself: one origin, one WebSocket (fixing the
+reference's phantom second socket on :7071, App.tsx:160), no build step.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+def static_dir() -> Path:
+    return Path(__file__).parent / "static"

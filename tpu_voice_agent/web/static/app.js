@@ -1,0 +1,264 @@
+/* Web client: mic capture -> one WS -> voice service; intent review + confirm.
+ *
+ * Capability parity with the reference web app (cited file:line are the
+ * reference's apps/web/src):
+ * - AudioWorklet mic tap via a Blob-URL module ............ App.tsx:35-81
+ * - resample to 16 kHz (linear interp — the reference used
+ *   aliasing nearest-neighbor decimation) ................. App.tsx:18-32
+ * - float -> PCM16 ........................................ App.tsx:7-16
+ * - ~60 ms frame aggregation .............................. App.tsx:263-289
+ * - keep-alive: 100 ms of silence every 2 s ............... App.tsx:291-296
+ * - RMS level meter ....................................... App.tsx:267-271
+ * - transcript panel, partials update in place, 200 cap ... App.tsx:188-207
+ * - intent review, Confirm & Run for risky plans .......... IntentReview.tsx:53,98
+ * - upload intents missing fileRef open a file picker and
+ *   POST /uploads first ................................... IntentReview.tsx:19-37
+ * - executor client (uploads) ............................. api.ts:14-23
+ * One WS only: confirmations ride the same /stream socket (the reference's
+ * intent listener lived on a phantom second socket, App.tsx:160).
+ */
+"use strict";
+
+const qs = new URLSearchParams(location.search);
+const EXECUTOR_URL = qs.get("executor") || `http://${location.hostname}:7081`;
+const TARGET_RATE = 16000;
+const BATCH_MS = 60;
+const KEEPALIVE_MS = 2000;
+
+const $ = (id) => document.getElementById(id);
+const statusEl = $("status"), levelEl = $("level");
+const transcriptEl = $("transcript"), intentEl = $("intent"), resultsEl = $("results");
+const confirmBar = $("confirm-bar");
+
+let ws = null, audio = null, pendingRisky = null, lastSend = 0;
+
+function setStatus(kind, text) {
+  statusEl.className = `badge ${kind}`;
+  statusEl.textContent = text || kind;
+}
+
+/* ------------------------------------------------------------ transcript */
+
+let partialLi = null;
+function addLine(cls, text) {
+  const li = document.createElement("li");
+  li.className = cls;
+  li.textContent = text;
+  transcriptEl.appendChild(li);
+  while (transcriptEl.children.length > 200) transcriptEl.firstChild.remove();
+  transcriptEl.scrollTop = transcriptEl.scrollHeight;
+  return li;
+}
+function showPartial(text) {
+  if (!partialLi) partialLi = addLine("partial", text);
+  else partialLi.textContent = text;
+}
+function showFinal(text) {
+  if (partialLi) { partialLi.remove(); partialLi = null; }
+  addLine("final", text);
+}
+
+/* ------------------------------------------------------------ results */
+
+function showResults(body) {
+  resultsEl.innerHTML = "";
+  for (const r of body.results || []) {
+    const li = document.createElement("li");
+    li.className = r.ok ? "ok" : "fail";
+    const t = r.intent && r.intent.type;
+    li.textContent = r.ok ? `✓ ${t}` : `✗ ${t}: ${r.error || "failed"}`;
+    resultsEl.appendChild(li);
+  }
+}
+
+/* ------------------------------------------------------------ uploads */
+
+async function pickFile() {
+  return new Promise((resolve) => {
+    const picker = $("file-picker");
+    picker.onchange = () => resolve(picker.files[0] || null);
+    picker.click();
+  });
+}
+
+async function uploadFile(file) {
+  const form = new FormData();
+  form.append("file", file, file.name);
+  const r = await fetch(`${EXECUTOR_URL}/uploads`, { method: "POST", body: form });
+  if (!r.ok) throw new Error(`upload failed: ${r.status}`);
+  return (await r.json()).fileRef;
+}
+
+async function patchUploads(intents) {
+  for (const intent of intents) {
+    if (intent.type === "upload" && !(intent.args && intent.args.fileRef)) {
+      const file = await pickFile();
+      if (!file) throw new Error("upload cancelled");
+      intent.args = intent.args || {};
+      intent.args.fileRef = await uploadFile(file);
+    }
+  }
+  return intents;
+}
+
+/* ------------------------------------------------------------ websocket */
+
+function connect() {
+  if (ws && ws.readyState <= 1) return ws;
+  setStatus("connecting");
+  ws = new WebSocket(`ws://${location.host}/stream`);
+  ws.binaryType = "arraybuffer";
+  ws.onopen = () => setStatus("listening", audio ? "listening" : "connected");
+  ws.onclose = () => { setStatus("idle"); ws = null; };
+  ws.onerror = () => setStatus("error");
+  ws.onmessage = (ev) => {
+    let m; try { m = JSON.parse(ev.data); } catch { return; }
+    switch (m.type) {
+      case "transcript_partial": showPartial(m.text); break;
+      case "transcript_final": showFinal(m.text); break;
+      case "intent": intentEl.textContent = JSON.stringify(m.data, null, 2); break;
+      case "tts": addLine("tts", `🔊 ${m.text}`); break;
+      case "confirmation_required":
+        pendingRisky = m.intents;
+        confirmBar.hidden = false;
+        addLine("warn", `${m.intents.length} action(s) need confirmation`);
+        break;
+      case "execution_result": showResults(m.data); break;
+      case "execution_error": addLine("error", `execution: ${m.message}`); break;
+      case "info": addLine("partial", m.message); break;
+      case "warn": addLine("warn", m.message); break;
+      case "error": addLine("error", m.message); setStatus("error"); break;
+    }
+  };
+  return ws;
+}
+
+function sendJson(obj) {
+  const sock = connect();
+  const fire = () => sock.send(JSON.stringify(obj));
+  if (sock.readyState === 1) fire(); else sock.addEventListener("open", fire, { once: true });
+}
+
+/* ------------------------------------------------------------ audio */
+
+function floatTo16BitPCM(f32) {
+  const out = new Int16Array(f32.length);
+  for (let i = 0; i < f32.length; i++) {
+    const s = Math.max(-1, Math.min(1, f32[i]));
+    out[i] = s < 0 ? s * 0x8000 : s * 0x7fff;
+  }
+  return out;
+}
+
+function resampleTo16k(f32, fromRate) {
+  if (fromRate === TARGET_RATE) return f32;
+  const n = Math.floor((f32.length * TARGET_RATE) / fromRate);
+  const out = new Float32Array(n);
+  const step = fromRate / TARGET_RATE;
+  for (let i = 0; i < n; i++) {
+    const pos = i * step, j = Math.floor(pos), frac = pos - j;
+    const a = f32[j], b = f32[Math.min(j + 1, f32.length - 1)];
+    out[i] = a + (b - a) * frac;  // linear interp (vs reference's NN decimation)
+  }
+  return out;
+}
+
+const WORKLET_SRC = `
+registerProcessor("mic-tap", class extends AudioWorkletProcessor {
+  process(inputs) {
+    const ch = inputs[0] && inputs[0][0];
+    if (ch) this.port.postMessage(ch.slice(0));
+    return true;
+  }
+});`;
+
+async function startMic() {
+  const stream = await navigator.mediaDevices.getUserMedia({ audio: true });
+  const ctx = new AudioContext();
+  await ctx.resume();
+  const url = URL.createObjectURL(new Blob([WORKLET_SRC], { type: "text/javascript" }));
+  await ctx.audioWorklet.addModule(url);
+  const src = ctx.createMediaStreamSource(stream);
+  const node = new AudioWorkletNode(ctx, "mic-tap");
+  src.connect(node);
+
+  connect();
+  let buf = [], bufLen = 0;
+  const batchSamples = Math.round((ctx.sampleRate * BATCH_MS) / 1000);
+
+  node.port.onmessage = (ev) => {
+    const chunk = ev.data;
+    // RMS meter
+    let acc = 0;
+    for (let i = 0; i < chunk.length; i++) acc += chunk[i] * chunk[i];
+    const rms = Math.sqrt(acc / chunk.length);
+    levelEl.style.width = `${Math.min(100, rms * 400)}%`;
+
+    buf.push(chunk); bufLen += chunk.length;
+    if (bufLen >= batchSamples) {
+      const joined = new Float32Array(bufLen);
+      let off = 0;
+      for (const c of buf) { joined.set(c, off); off += c.length; }
+      buf = []; bufLen = 0;
+      const pcm = floatTo16BitPCM(resampleTo16k(joined, ctx.sampleRate));
+      if (ws && ws.readyState === 1) { ws.send(pcm.buffer); lastSend = Date.now(); }
+    }
+  };
+
+  // keep-alive: 100 ms of silence every 2 s of inactivity
+  const keepalive = setInterval(() => {
+    if (ws && ws.readyState === 1 && Date.now() - lastSend >= KEEPALIVE_MS) {
+      ws.send(new Int16Array(TARGET_RATE / 10).buffer);
+      lastSend = Date.now();
+    }
+  }, KEEPALIVE_MS);
+
+  audio = { stream, ctx, node, keepalive };
+  setStatus("listening");
+  $("start").disabled = true;
+  $("stop").disabled = false;
+}
+
+function stopMic() {
+  if (!audio) return;
+  clearInterval(audio.keepalive);
+  audio.node.disconnect();
+  audio.stream.getTracks().forEach((t) => t.stop());
+  audio.ctx.close();
+  audio = null;
+  levelEl.style.width = "0";
+  setStatus(ws && ws.readyState === 1 ? "listening" : "idle", "connected");
+  $("start").disabled = false;
+  $("stop").disabled = true;
+}
+
+/* ------------------------------------------------------------ wiring */
+
+$("start").onclick = () => startMic().catch((e) => {
+  addLine("error", `mic: ${e.message}`); setStatus("error");
+});
+$("stop").onclick = stopMic;
+
+$("typed").onsubmit = (ev) => {
+  ev.preventDefault();
+  const input = $("typed-text");
+  const text = input.value.trim();
+  if (!text) return;
+  input.value = "";
+  sendJson({ type: "text", text });
+};
+
+$("confirm").onclick = async () => {
+  if (!pendingRisky) return;
+  confirmBar.hidden = true;
+  try {
+    const intents = await patchUploads(pendingRisky);
+    sendJson({ type: "confirm_execute", intents });
+  } catch (e) {
+    addLine("error", e.message);
+  }
+  pendingRisky = null;
+};
+$("dismiss").onclick = () => { pendingRisky = null; confirmBar.hidden = true; };
+
+connect();
