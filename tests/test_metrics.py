@@ -1,0 +1,77 @@
+"""Metrics/observability: runtime counters, gauges, /metrics endpoints.
+
+SURVEY.md §5 rebuild notes: counters for tokens/sec and queue depth plus
+per-request trace ids — none of which the reference has (its observability
+is tagged console.log lines).
+"""
+
+import asyncio
+
+import aiohttp
+
+from tpu_voice_agent.utils import Metrics, get_metrics
+
+
+def _get_json(url: str):
+    async def run():
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(url) as r:
+                return r.status, await r.json()
+
+    return asyncio.run(run())
+
+
+def test_metrics_counters_gauges_percentiles():
+    m = Metrics()
+    m.inc("a")
+    m.inc("a", 2)
+    m.set_gauge("depth", 7)
+    for ms in (10, 20, 30, 40):
+        m.observe_ms("lat", ms)
+    snap = m.snapshot()
+    assert snap["counters"]["a"] == 3
+    assert snap["gauges"]["depth"] == 7.0
+    assert snap["latency_ms"]["lat"]["count"] == 4
+    assert 10 <= snap["latency_ms"]["lat"]["p50"] <= 40
+
+
+def test_engine_generate_records_runtime_metrics(tiny_engine):
+    before = get_metrics().snapshot()["counters"].get("engine.tokens_generated", 0)
+    res = tiny_engine.generate("<|user|>\nscroll down\n<|assistant|>\n", max_new_tokens=16)
+    after = get_metrics().snapshot()["counters"]
+    assert after["engine.tokens_generated"] >= before + res.steps
+    assert after["engine.requests"] >= 1
+
+
+def test_interpreter_records_intent_counters(tmp_path):
+    from tpu_voice_agent.schemas import Intent
+    from tpu_voice_agent.services.executor.actions import run_intents
+    from tpu_voice_agent.services.executor.page import FakePage
+
+    before = get_metrics().snapshot()["counters"]
+    run_intents(FakePage.demo(), tmp_path,
+                [Intent(type="scroll", args={"direction": "down"})],
+                screenshot_each_step=False)
+    after = get_metrics().snapshot()["counters"]
+    assert after["executor.intents_executed"] >= before.get("executor.intents_executed", 0) + 1
+    assert after.get("executor.intents.scroll", 0) >= 1
+
+
+def test_services_expose_metrics_endpoint():
+    from tpu_voice_agent.serve.stt import NullSTT
+    from tpu_voice_agent.services.brain import RuleBasedParser, build_app as build_brain
+    from tpu_voice_agent.services.executor import build_app as build_executor
+    from tpu_voice_agent.services.voice import VoiceConfig, build_app as build_voice
+    from tests.http_helper import AppServer
+
+    apps = [
+        ("brain", build_brain(RuleBasedParser())),
+        ("executor", build_executor()),
+        ("voice", build_voice(VoiceConfig(stt_factory=NullSTT))),
+    ]
+    for name, app in apps:
+        with AppServer(app) as srv:
+            status, body = _get_json(srv.url + "/metrics")
+            assert status == 200
+            assert body["service"] == name
+            assert "counters" in body["local"] and "counters" in body["runtime"]

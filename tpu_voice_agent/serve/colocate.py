@@ -32,8 +32,10 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from .engine import GenerationResult
-from .scheduler import ContinuousBatcher
+from .scheduler import ContinuousBatcher, _Slot
 from .stt import SpeechEngine, TranscribeResult
 
 
@@ -44,6 +46,7 @@ class ColocationStats:
     stt_busy_ms: float = 0.0
     decode_busy_ms: float = 0.0
     decode_chunks: int = 0
+    errors: int = 0  # decode-lane failures survived by the loop
     max_stt_queue: int = 0
     max_parse_inflight: int = 0
     # dispatch-order trace: "stt" / "chunk" entries, for fairness asserts
@@ -100,17 +103,25 @@ class ColocatedServing:
     def step(self) -> bool:
         """One scheduling decision: drain STT queue, else one decode chunk.
         Returns True if any device work was dispatched."""
+        from ..utils import get_metrics
+
         with self._lock:
             stt_jobs = list(self._stt_q)
             self._stt_q.clear()
+            # pre-drain depths: what a scrape should see as backlog
+            get_metrics().set_gauge("colocate.stt_queue", len(stt_jobs))
+            get_metrics().set_gauge("colocate.parse_inflight", len(self._parse_futs))
         did = False
 
-        for audio, fut in stt_jobs:  # priority lane
+        for i, (audio, fut) in enumerate(stt_jobs):  # priority lane
             t0 = time.perf_counter()
             try:
-                fut.set_result(self.stt.transcribe(audio))
+                result = self.stt.transcribe(audio)
             except Exception as e:  # per-job isolation
-                fut.set_exception(e)
+                result = None
+                self._set_future(fut, exc=e)
+            if result is not None:
+                self._set_future(fut, value=result)
             self.stats.stt_busy_ms += (time.perf_counter() - t0) * 1e3
             self.stats.stt_jobs += 1
             self.stats.trace.append("stt")
@@ -118,13 +129,44 @@ class ColocatedServing:
 
         if self._has_decode_work():
             t0 = time.perf_counter()
-            self.batcher.step()
+            try:
+                self.batcher.step()
+            except Exception as e:
+                # decode-lane failure detection: the batch state is suspect,
+                # so fail every inflight parse (callers never hang) and keep
+                # the serving loop alive for the STT lane and new requests
+                self.stats.errors += 1
+                self._fail_inflight(e)
+                return True
             self.stats.decode_busy_ms += (time.perf_counter() - t0) * 1e3
             self.stats.decode_chunks += 1
             self.stats.trace.append("chunk")
             did = True
             self._harvest()
         return did
+
+    @staticmethod
+    def _set_future(fut: Future, value=None, exc: Exception | None = None) -> None:
+        """Resolve a future, tolerating caller-side cancellation."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+        except Exception:
+            pass  # already cancelled/resolved by the caller
+
+    def _fail_inflight(self, exc: Exception) -> None:
+        # everything under the one lock: a concurrent submit_parse must land
+        # either wholly before the reset (and get failed) or wholly after
+        with self._lock:
+            futs = list(self._parse_futs.values())
+            self._parse_futs.clear()
+            self.batcher.pending.clear()
+            self.batcher.slots = [_Slot() for _ in range(self.batcher.B)]
+            self.batcher.active = jnp.zeros_like(self.batcher.active)
+        for fut in futs:
+            self._set_future(fut, exc=exc)
 
     def _harvest(self) -> None:
         with self._lock:
@@ -133,7 +175,7 @@ class ColocatedServing:
                 fut = self._parse_futs.pop(rid)
                 res = self.batcher.results.pop(rid)
                 self.stats.parse_jobs += 1
-                fut.set_result(res)
+                self._set_future(fut, value=res)
 
     def drain(self, timeout_s: float = 120.0) -> None:
         """Run steps until all queued work (both lanes) has completed."""
@@ -163,9 +205,24 @@ class ColocatedServing:
             self._thread.join(timeout=30)
             self._thread = None
 
+    def healthy(self) -> bool:
+        """Worker-liveness probe; a service embedding this runtime should
+        surface it from its own /health handler."""
+        return self._thread is not None and self._thread.is_alive()
+
     def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger("tpu_voice_agent.colocate")
         while True:
-            did = self.step()
+            try:
+                did = self.step()
+            except Exception:
+                # the worker must outlive any single bad step (§5: failure
+                # detection — per-job faults are already isolated upstream)
+                self.stats.errors += 1
+                log.exception("colocate step failed; worker continues")
+                did = False
             with self._work:
                 if self._stop:
                     return

@@ -330,6 +330,14 @@ class DecodeEngine:
         finished = bool(jax.device_get(eos)[0])
         decode_ms = (time.perf_counter() - t1) * 1e3
 
+        from ..utils import get_metrics
+
+        m = get_metrics()
+        m.inc("engine.requests")
+        m.inc("engine.tokens_generated", count_h)
+        m.observe_ms("engine.prefill", prefill_ms)
+        m.observe_ms("engine.decode", decode_ms)
+
         return GenerationResult(
             text=self.tokenizer.decode(out_ids),
             token_ids=out_ids,

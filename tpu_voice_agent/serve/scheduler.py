@@ -176,6 +176,14 @@ class ContinuousBatcher:
             np.asarray(x) for x in jax.device_get((out, n, self.active, eos))
         )
 
+        from ..utils import get_metrics
+
+        m = get_metrics()
+        m.inc("scheduler.tokens_generated", float(n_h.sum()))
+        m.inc("scheduler.chunks")
+        m.set_gauge("scheduler.queue_depth", len(self.pending))
+        m.set_gauge("scheduler.active_slots", float(act_h.sum()))
+
         for b in range(self.B):
             sl = self.slots[b]
             if sl.request_id < 0:
@@ -192,6 +200,9 @@ class ContinuousBatcher:
                     steps=len(sl.token_ids),
                     finished=bool(eos_h[b]),
                 )
+                m.inc("scheduler.requests_completed")
+                m.observe_ms("scheduler.request_total",
+                             (time.perf_counter() - sl.start_s) * 1e3)
                 self.slots[b] = _Slot()
 
     # ------------------------------------------------------------ drain

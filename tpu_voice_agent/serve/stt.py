@@ -219,11 +219,15 @@ class NullSTT:
     def __init__(self, scripted: list[tuple[str, str]] | None = None):
         self.scripted = list(scripted or [])
         self.fed_samples = 0
+        self.fail_next = False  # fault injection (SURVEY.md §5 rebuild note)
 
     def reset(self) -> None:
         self.fed_samples = 0
 
     def feed(self, samples: np.ndarray) -> list[tuple[str, str]]:
+        if self.fail_next:
+            self.fail_next = False
+            raise RuntimeError("injected STT fault")
         self.fed_samples += len(samples)
         if self.scripted:
             return [self.scripted.pop(0)]

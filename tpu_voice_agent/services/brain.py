@@ -208,7 +208,11 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
             resp.model_dump(), headers={"x-trace-id": trace_id}
         )
 
+
     app.router.add_get("/health", health)
+    from ..utils.tracing import make_metrics_handler
+
+    app.router.add_get("/metrics", make_metrics_handler("brain", tracer))
     app.router.add_post("/parse", parse)
     return app
 

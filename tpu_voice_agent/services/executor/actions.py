@@ -276,6 +276,16 @@ def run_intents(
             except Exception:
                 shot = None
 
+        step_ms = (time.perf_counter() - t0) * 1e3
+        from ...utils import get_metrics
+
+        m = get_metrics()
+        m.inc("executor.intents_executed")
+        m.inc(f"executor.intents.{intent.type}")
+        if not ok:
+            m.inc("executor.intents_failed")
+        m.observe_ms("executor.step", step_ms)
+
         results.append(
             StepResult(
                 intent=intent,
@@ -285,7 +295,7 @@ def run_intents(
                 screenshot=shot,
                 data_paths=data_paths,
                 page_analysis=analysis_out,
-                latency_ms=(time.perf_counter() - t0) * 1e3,
+                latency_ms=step_ms,
             )
         )
     return results

@@ -110,7 +110,11 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
         ok = await asyncio.get_running_loop().run_in_executor(None, work)
         return web.json_response({"ok": ok})
 
+
     app.router.add_get("/health", health)
+    from ...utils.tracing import make_metrics_handler
+
+    app.router.add_get("/metrics", make_metrics_handler("executor", tracer))
     app.router.add_post("/execute", execute)
     app.router.add_post("/uploads", uploads)
     app.router.add_post("/close", close)

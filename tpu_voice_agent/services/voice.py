@@ -227,7 +227,11 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
 
         return web.FileResponse(static_dir() / "index.html")
 
+
     app.router.add_get("/health", health)
+    from ..utils.tracing import make_metrics_handler
+
+    app.router.add_get("/metrics", make_metrics_handler("voice", tracer))
     app.router.add_get("/stream", stream)
     app.router.add_get("/", index)
     from ..web import static_dir as _sd

@@ -1,5 +1,5 @@
 from .envcfg import load_env_cascade, env_str, env_int, env_bool
-from .tracing import Span, Tracer, Metrics, new_trace_id
+from .tracing import Span, Tracer, Metrics, get_metrics, new_trace_id
 
 __all__ = [
     "load_env_cascade",
@@ -9,5 +9,6 @@ __all__ = [
     "Span",
     "Tracer",
     "Metrics",
+    "get_metrics",
     "new_trace_id",
 ]

@@ -42,15 +42,27 @@ class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
         self._latencies: dict[str, list[float]] = {}
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + value
 
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    # bounded reservoir per key: long-lived services must not grow (or sort)
+    # an unbounded sample list on every scrape
+    MAX_SAMPLES = 4096
+
     def observe_ms(self, name: str, ms: float) -> None:
         with self._lock:
-            self._latencies.setdefault(name, []).append(ms)
+            xs = self._latencies.setdefault(name, [])
+            xs.append(ms)
+            if len(xs) > self.MAX_SAMPLES:
+                del xs[: len(xs) // 2]  # amortized trim, keeps the recent half
 
     def percentile_ms(self, name: str, q: float) -> float | None:
         with self._lock:
@@ -62,7 +74,8 @@ class Metrics:
 
     def snapshot(self) -> dict:
         with self._lock:
-            out = {"counters": dict(self._counters), "latency_ms": {}}
+            out = {"counters": dict(self._counters), "gauges": dict(self._gauges),
+                   "latency_ms": {}}
             for k, xs in self._latencies.items():
                 s = sorted(xs)
                 out["latency_ms"][k] = {
@@ -72,6 +85,31 @@ class Metrics:
                     "max": s[-1],
                 }
         return out
+
+
+# Process-global registry: the serving runtime (engine/scheduler/interpreter)
+# records here without plumbing a Metrics through every constructor; service
+# /metrics endpoints expose it next to their tracer-local snapshot.
+_GLOBAL_METRICS = Metrics()
+
+
+def get_metrics() -> Metrics:
+    return _GLOBAL_METRICS
+
+
+def make_metrics_handler(service: str, tracer: "Tracer"):
+    """aiohttp GET /metrics handler shared by every service: the tracer's
+    service-local snapshot next to the process-global runtime registry."""
+    from aiohttp import web
+
+    async def metrics_ep(_req) -> web.Response:
+        return web.json_response({
+            "service": service,
+            "local": tracer.metrics.snapshot(),
+            "runtime": get_metrics().snapshot(),
+        })
+
+    return metrics_ep
 
 
 class Tracer:
