@@ -1,0 +1,56 @@
+"""BASELINE config 4 analog: continuous-batching throughput.
+
+N concurrent sessions submit grammar-constrained intent parses; measures
+end-to-end intents/sec and decoded tokens/sec on the chip (the reference's
+"concurrency" is a Node event loop fanning out to cloud APIs — SURVEY.md §2
+request-level concurrency row).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import emit, log, on_tpu  # noqa: E402
+
+
+def main(n_sessions: int = 32) -> None:
+    from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+
+    tpu = on_tpu()
+    preset = "tinyllama-1.1b" if tpu else "test-tiny"
+    slots = 8 if tpu else 3
+    engine = DecodeEngine(preset=preset, max_len=2048, batch_slots=slots,
+                          prefill_buckets=(128, 256))
+    batcher = ContinuousBatcher(engine, chunk_steps=16, max_new_tokens=64)
+    log(f"preset={preset} slots={slots} sessions={n_sessions}")
+
+    def prompt(i: int) -> str:
+        user = json.dumps({"text": f"search for item {i} and sort by price",
+                           "context": {}}, separators=(",", ":"))
+        return f"<|user|>\n{user}\n<|assistant|>\n"
+
+    # warmup: compile prefill + chunk loop
+    batcher.submit(prompt(0))
+    batcher.run_until_done()
+    batcher.results.clear()
+
+    t0 = time.perf_counter()
+    rids = [batcher.submit(prompt(i)) for i in range(n_sessions)]
+    batcher.run_until_done()
+    wall_s = time.perf_counter() - t0
+
+    results = [batcher.results[r] for r in rids]
+    tokens = sum(r.steps for r in results)
+    ok = sum(1 for r in results if r.error is None)
+    log(f"{ok}/{n_sessions} ok, {tokens} tokens in {wall_s:.2f}s")
+    emit("batch_intents_per_s", n_sessions / wall_s, "intents/s/chip")
+    emit("batch_tokens_per_s", tokens / wall_s, "tok/s/chip")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 32)

@@ -1,0 +1,63 @@
+"""BASELINE config 3: streaming STT, 16 kHz / 250 ms chunks.
+
+Measures per-chunk feed latency and the real-time factor of the streaming
+path (endpointer + bucketed encoder-decoder). The reference streams to
+Deepgram and has no on-device number to compare (SURVEY.md §6); the budget
+is real time: rtf < 1.0 means the chip keeps up with the mic.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import emit, log, on_tpu, percentile  # noqa: E402
+
+
+def main(seconds: float = 8.0) -> None:
+    from tpu_voice_agent.serve.stt import SpeechEngine, StreamingSTT
+
+    tpu = on_tpu()
+    preset = "whisper-large-v3" if tpu else "whisper-test"
+    # 8 s of audio tops out at the 1000-frame bucket; don't compile 3000
+    buckets = (300, 1000) if tpu else (100,)
+    engine = SpeechEngine(preset=preset, frame_buckets=buckets, max_new_tokens=32)
+    stt = StreamingSTT(engine)
+    log(f"preset={preset} buckets={buckets}")
+
+    sr, chunk_ms = 16_000, 250
+    chunk = int(sr * chunk_ms / 1000)
+    rng = np.random.default_rng(0)
+    t = np.arange(int(sr * seconds)) / sr
+    # speech-like: modulated tone bursts with silence gaps (drives endpointing)
+    audio = (0.2 * np.sin(2 * np.pi * 220 * t) * (np.sin(2 * np.pi * 1.5 * t) > 0)
+             + 0.002 * rng.standard_normal(len(t))).astype(np.float32)
+
+    # warmup: compile every bucket's encoder+decoder program before timing
+    # (steady-state is the metric; XLA compiles are once per process)
+    for b in engine.frame_buckets:
+        engine.transcribe(np.zeros(b * 160, np.float32))
+    stt.feed(audio[:chunk])
+    stt.reset()
+
+    lat_ms = []
+    t0 = time.perf_counter()
+    for i in range(0, len(audio) - chunk, chunk):
+        s = time.perf_counter()
+        stt.feed(audio[i:i + chunk])
+        lat_ms.append((time.perf_counter() - s) * 1e3)
+    wall = time.perf_counter() - t0
+
+    rtf = wall / seconds
+    p50 = percentile(lat_ms, 50)
+    log(f"chunk p50 {p50:.1f}ms p95 {percentile(lat_ms, 95):.1f}ms rtf {rtf:.3f}")
+    emit("stt_chunk_p50", p50, "ms", vs_baseline=chunk_ms / max(p50, 1e-9))
+    emit("stt_realtime_factor", rtf, "x", vs_baseline=1.0 / max(rtf, 1e-9))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Shared bench harness bits.
+
+Every bench prints one JSON row per metric:
+``{"metric", "value", "unit", "vs_baseline"}`` — the same contract as the
+root ``bench.py`` the driver runs (BASELINE.md targets; the reference
+publishes no numbers, SURVEY.md §6, so vs_baseline compares against the
+BASELINE.json north-star budgets).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+# benches run as scripts; make the repo root importable
+_ROOT = str(Path(__file__).parent.parent)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# honor JAX_PLATFORMS=cpu even though this image's axon TPU plugin
+# force-prepends itself (same workaround as tests/conftest.py)
+import os  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def on_tpu() -> bool:
+    import jax
+
+    return any("tpu" in str(d).lower() for d in jax.devices())
+
+
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float | None = None) -> None:
+    row = {"metric": metric, "value": round(value, 3), "unit": unit}
+    if vs_baseline is not None:
+        row["vs_baseline"] = round(vs_baseline, 3)
+    print(json.dumps(row), flush=True)
+
+
+def percentile(xs, q) -> float:
+    import numpy as np
+
+    return float(np.percentile(xs, q))

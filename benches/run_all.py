@@ -1,0 +1,37 @@
+"""Run the full bench table (BASELINE.md configs) and print one JSON row per
+metric. The root ``bench.py`` (the driver's single headline number) stays
+separate; this is the wide table.
+
+Usage: python benches/run_all.py [--quick]
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py"]
+
+
+def main() -> None:
+    here = Path(__file__).parent
+    root = here.parent
+    failures = 0
+    for name in BENCHES:
+        print(f"[run_all] {name}", file=sys.stderr, flush=True)
+        proc = subprocess.run(
+            [sys.executable, str(here / name)], cwd=root,
+            capture_output=True, text=True, timeout=3600,
+        )
+        sys.stderr.write(proc.stderr)
+        sys.stdout.write(proc.stdout)
+        sys.stdout.flush()
+        if proc.returncode != 0:
+            failures += 1
+            print(f"[run_all] {name} FAILED ({proc.returncode})", file=sys.stderr)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

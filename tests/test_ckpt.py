@@ -110,3 +110,160 @@ class TestHFImport:
         np.testing.assert_array_equal(
             np.asarray(params["embed"]), st["model.embed_tokens.weight"]
         )
+
+
+# ---------------------------------------------------------------- whisper/vl
+
+
+def _tree_shapes(t, prefix=""):
+    if isinstance(t, dict):
+        out = {}
+        for k, v in t.items():
+            out.update(_tree_shapes(v, f"{prefix}{k}."))
+        return out
+    return {prefix[:-1]: tuple(t.shape)}
+
+
+class TestWhisperImport:
+    def test_synthetic_roundtrip_matches_init_tree(self):
+        from tpu_voice_agent.ckpt.hf_import import whisper_from_hf_state
+        from tpu_voice_agent.models.whisper import PRESETS, init_params
+
+        cfg = PRESETS["whisper-test"]
+        rng = np.random.default_rng(0)
+        d, f = cfg.d_model, cfg.ffn_dim
+        st = {}
+
+        def lin(name, o, i, bias=True):
+            st[name + ".weight"] = rng.standard_normal((o, i)).astype(np.float32)
+            if bias:
+                st[name + ".bias"] = rng.standard_normal((o,)).astype(np.float32)
+
+        def norm(name, n):
+            st[name + ".weight"] = np.ones(n, np.float32)
+            st[name + ".bias"] = np.zeros(n, np.float32)
+
+        st["model.encoder.conv1.weight"] = rng.standard_normal((d, cfg.n_mels, 3)).astype(np.float32)
+        st["model.encoder.conv1.bias"] = np.zeros(d, np.float32)
+        st["model.encoder.conv2.weight"] = rng.standard_normal((d, d, 3)).astype(np.float32)
+        st["model.encoder.conv2.bias"] = np.zeros(d, np.float32)
+        norm("model.encoder.layer_norm", d)
+        for n in range(cfg.enc_layers):
+            p = f"model.encoder.layers.{n}"
+            norm(p + ".self_attn_layer_norm", d)
+            norm(p + ".final_layer_norm", d)
+            for proj in ("q_proj", "v_proj", "out_proj"):
+                lin(f"{p}.self_attn.{proj}", d, d)
+            lin(f"{p}.self_attn.k_proj", d, d, bias=False)
+            lin(p + ".fc1", f, d)
+            lin(p + ".fc2", d, f)
+        st["model.decoder.embed_tokens.weight"] = rng.standard_normal(
+            (cfg.vocab_size, d)).astype(np.float32)
+        st["model.decoder.embed_positions.weight"] = rng.standard_normal(
+            (cfg.max_text_len, d)).astype(np.float32)
+        norm("model.decoder.layer_norm", d)
+        for n in range(cfg.dec_layers):
+            p = f"model.decoder.layers.{n}"
+            for ln_name in (".self_attn_layer_norm", ".encoder_attn_layer_norm",
+                            ".final_layer_norm"):
+                norm(p + ln_name, d)
+            for attn in (".self_attn", ".encoder_attn"):
+                for proj in ("q_proj", "v_proj", "out_proj"):
+                    lin(f"{p}{attn}.{proj}", d, d)
+                lin(f"{p}{attn}.k_proj", d, d, bias=False)
+            lin(p + ".fc1", f, d)
+            lin(p + ".fc2", d, f)
+
+        params = whisper_from_hf_state(st, cfg, dtype=jnp.float32)
+        want = _tree_shapes(init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32))
+        got = _tree_shapes(params)
+        # imported tree must slot exactly where the random-init tree goes —
+        # same keys, same shapes (a misnamed leaf KeyErrors at serving time)
+        assert set(got) == set(want), set(got) ^ set(want)
+        for k, shape in got.items():
+            assert want[k] == shape, k
+
+        from tpu_voice_agent.models.whisper import (
+            compute_cross_kv, decoder_forward, encoder_forward, init_self_cache,
+        )
+
+        mel = jnp.asarray(rng.standard_normal((1, 100, cfg.n_mels)), jnp.float32)
+        enc = encoder_forward(params, cfg, mel)
+        assert np.isfinite(np.asarray(enc)).all()
+
+        cross = compute_cross_kv(params, cfg, enc)
+        cache = init_self_cache(cfg, 1, dtype=jnp.float32)
+        toks = jnp.asarray([[3, 4, 5]], jnp.int32)
+        pos = jnp.arange(3, dtype=jnp.int32)[None]
+        enc_mask = jnp.ones((1, enc.shape[1]), bool)
+        logits, _ = decoder_forward(params, cfg, toks, pos, cache, cross, enc_mask)
+        assert logits.shape == (1, 3, cfg.vocab_size)
+        assert np.isfinite(np.asarray(logits)).all()
+
+
+class TestQwen2VLImport:
+    def test_synthetic_roundtrip_forward(self):
+        from tpu_voice_agent.ckpt.hf_import import qwen2vl_from_hf_state
+        from tpu_voice_agent.models.qwen2vl import (
+            PRESETS, forward_embeds, init_kv_cache, text_positions3, vision_forward,
+        )
+
+        cfg = PRESETS["qwen2vl-test"]
+        v = cfg.vision
+        rng = np.random.default_rng(1)
+        st = {}
+        dv, fv = v.d_model, v.ffn_dim
+        st["visual.patch_embed.proj.weight"] = rng.standard_normal(
+            (dv, 3, 2, v.patch_size, v.patch_size)).astype(np.float32)
+        for n in range(v.n_layers):
+            p = f"visual.blocks.{n}."
+            st[p + "norm1.weight"] = np.ones(dv, np.float32)
+            st[p + "norm1.bias"] = np.zeros(dv, np.float32)
+            st[p + "norm2.weight"] = np.ones(dv, np.float32)
+            st[p + "norm2.bias"] = np.zeros(dv, np.float32)
+            st[p + "attn.qkv.weight"] = rng.standard_normal((3 * dv, dv)).astype(np.float32)
+            st[p + "attn.qkv.bias"] = np.zeros(3 * dv, np.float32)
+            st[p + "attn.proj.weight"] = rng.standard_normal((dv, dv)).astype(np.float32)
+            st[p + "attn.proj.bias"] = np.zeros(dv, np.float32)
+            st[p + "mlp.fc1.weight"] = rng.standard_normal((fv, dv)).astype(np.float32)
+            st[p + "mlp.fc1.bias"] = np.zeros(fv, np.float32)
+            st[p + "mlp.fc2.weight"] = rng.standard_normal((dv, fv)).astype(np.float32)
+            st[p + "mlp.fc2.bias"] = np.zeros(dv, np.float32)
+        mi = v.merge_size * v.merge_size * dv
+        st["visual.merger.ln_q.weight"] = np.ones(dv, np.float32)
+        st["visual.merger.ln_q.bias"] = np.zeros(dv, np.float32)
+        st["visual.merger.mlp.0.weight"] = rng.standard_normal((mi, mi)).astype(np.float32)
+        st["visual.merger.mlp.0.bias"] = np.zeros(mi, np.float32)
+        st["visual.merger.mlp.2.weight"] = rng.standard_normal((cfg.dim, mi)).astype(np.float32)
+        st["visual.merger.mlp.2.bias"] = np.zeros(cfg.dim, np.float32)
+
+        d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+        nq, nkv = cfg.n_heads, cfg.n_kv_heads
+        st["model.embed_tokens.weight"] = rng.standard_normal(
+            (cfg.vocab_size, d)).astype(np.float32)
+        st["model.norm.weight"] = np.ones(d, np.float32)
+        for n in range(cfg.n_layers):
+            p = f"model.layers.{n}."
+            st[p + "input_layernorm.weight"] = np.ones(d, np.float32)
+            st[p + "post_attention_layernorm.weight"] = np.ones(d, np.float32)
+            for proj, o in (("q_proj", nq * hd), ("k_proj", nkv * hd), ("v_proj", nkv * hd)):
+                st[p + f"self_attn.{proj}.weight"] = rng.standard_normal((o, d)).astype(np.float32)
+                st[p + f"self_attn.{proj}.bias"] = np.zeros(o, np.float32)
+            st[p + "self_attn.o_proj.weight"] = rng.standard_normal((d, nq * hd)).astype(np.float32)
+            st[p + "mlp.gate_proj.weight"] = rng.standard_normal((f, d)).astype(np.float32)
+            st[p + "mlp.up_proj.weight"] = rng.standard_normal((f, d)).astype(np.float32)
+            st[p + "mlp.down_proj.weight"] = rng.standard_normal((d, f)).astype(np.float32)
+        # no lm_head -> tied embeddings path
+
+        params = qwen2vl_from_hf_state(st, cfg, dtype=jnp.float32)
+        img = jnp.asarray(rng.random((1, v.img_size, v.img_size, 3)), jnp.float32)
+        vis = vision_forward(params["vision"], v, img)
+        assert vis.shape == (1, v.n_tokens, cfg.dim)
+
+        T = 4
+        emb = params["embed"][jnp.asarray(rng.integers(3, cfg.vocab_size, (1, T)), jnp.int32)]
+        cache = init_kv_cache(cfg, 1, 16, dtype=jnp.float32)
+        logits, _ = forward_embeds(params, cfg, emb, jnp.arange(T, dtype=jnp.int32)[None],
+                                   text_positions3(0, T), cache)
+        assert logits.shape == (1, T, cfg.vocab_size)
+        assert np.isfinite(np.asarray(logits)).all()

@@ -9,6 +9,18 @@ models/llama.py uses.
 """
 
 from .orbax_io import restore_params, save_params
-from .hf_import import llama_from_hf_state, llama_hf_key_map
+from .hf_import import (
+    llama_from_hf_state,
+    llama_hf_key_map,
+    qwen2vl_from_hf_state,
+    whisper_from_hf_state,
+)
 
-__all__ = ["save_params", "restore_params", "llama_from_hf_state", "llama_hf_key_map"]
+__all__ = [
+    "save_params",
+    "restore_params",
+    "llama_from_hf_state",
+    "llama_hf_key_map",
+    "whisper_from_hf_state",
+    "qwen2vl_from_hf_state",
+]

@@ -104,3 +104,222 @@ def llama_from_hf_state(
         "final_norm": get("model.norm.weight", (d,), transpose=False),
         "lm_head": lm_head,
     }
+
+
+def _stack_layers(items: list[dict], dtype=None) -> dict:
+    """Stack per-layer leaf dicts (possibly nested) on a leading layer axis."""
+    out: dict = {}
+    for k in items[0]:
+        if isinstance(items[0][k], dict):
+            out[k] = _stack_layers([it[k] for it in items], dtype)
+        else:
+            arrs = [jnp.asarray(it[k], dtype=dtype) if dtype is not None else it[k]
+                    for it in items]
+            out[k] = jnp.stack(arrs)
+    return out
+
+
+# ---------------------------------------------------------------- whisper
+
+
+def whisper_from_hf_state(
+    state: dict[str, np.ndarray] | str,
+    cfg,  # models.whisper.WhisperConfig
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Convert an HF Whisper state dict (WhisperForConditionalGeneration
+    naming, ``model.encoder/decoder.*``) into the models/whisper.py tree.
+
+    Layout notes: HF linear weights are (out, in) -> transposed to our
+    (in, out) einsum layout; conv1d kernels are (out, in, k) -> our (k, in,
+    out); k_proj carries no bias in Whisper (our blocks model exactly bq/bv/
+    bo). Encoder positions are sinusoidal (computed, not imported); decoder
+    positions are learned and imported.
+    """
+    if isinstance(state, str):
+        state = _load_state_dir(state)
+
+    def get(name: str, want: tuple[int, ...], t: str = "") -> jnp.ndarray:
+        if name not in state:
+            raise KeyError(f"HF checkpoint missing tensor {name}")
+        a = np.asarray(state[name])
+        if t == "lin" and a.ndim == 2:
+            a = a.T
+        elif t == "conv":  # (out, in, k) -> (k, in, out)
+            a = a.transpose(2, 1, 0)
+        if tuple(a.shape) != want:
+            raise ValueError(f"{name}: shape {a.shape}, config wants {want}")
+        return jnp.asarray(a, dtype=dtype)
+
+    d, f = cfg.d_model, cfg.ffn_dim
+
+    def attn(prefix: str) -> dict:
+        p = prefix + "."
+        return {
+            "wq": get(p + "q_proj.weight", (d, d), "lin"),
+            "bq": get(p + "q_proj.bias", (d,)),
+            "wk": get(p + "k_proj.weight", (d, d), "lin"),
+            "wv": get(p + "v_proj.weight", (d, d), "lin"),
+            "bv": get(p + "v_proj.bias", (d,)),
+            "wo": get(p + "out_proj.weight", (d, d), "lin"),
+            "bo": get(p + "out_proj.bias", (d,)),
+        }
+
+    def ln(name: str) -> dict:
+        return {"g": get(name + ".weight", (d,)), "b": get(name + ".bias", (d,))}
+
+    enc_layers = []
+    for n in range(cfg.enc_layers):
+        p = f"model.encoder.layers.{n}"
+        enc_layers.append({
+            "ln1": ln(p + ".self_attn_layer_norm"),
+            "attn": attn(p + ".self_attn"),
+            "ln2": ln(p + ".final_layer_norm"),
+            "w1": get(p + ".fc1.weight", (d, f), "lin"),
+            "b1": get(p + ".fc1.bias", (f,)),
+            "w2": get(p + ".fc2.weight", (f, d), "lin"),
+            "b2": get(p + ".fc2.bias", (d,)),
+        })
+
+    dec_layers = []
+    for n in range(cfg.dec_layers):
+        p = f"model.decoder.layers.{n}"
+        dec_layers.append({
+            "ln1": ln(p + ".self_attn_layer_norm"),
+            "self_attn": attn(p + ".self_attn"),
+            "ln2": ln(p + ".encoder_attn_layer_norm"),
+            "cross_attn": attn(p + ".encoder_attn"),
+            "ln3": ln(p + ".final_layer_norm"),
+            "w1": get(p + ".fc1.weight", (d, f), "lin"),
+            "b1": get(p + ".fc1.bias", (f,)),
+            "w2": get(p + ".fc2.weight", (f, d), "lin"),
+            "b2": get(p + ".fc2.bias", (d,)),
+        })
+
+    return {
+        "encoder": {
+            "conv1": {"w": get("model.encoder.conv1.weight", (3, cfg.n_mels, d), "conv"),
+                      "b": get("model.encoder.conv1.bias", (d,))},
+            "conv2": {"w": get("model.encoder.conv2.weight", (3, d, d), "conv"),
+                      "b": get("model.encoder.conv2.bias", (d,))},
+            "layers": _stack_layers(enc_layers),
+            "ln_post": {"g": get("model.encoder.layer_norm.weight", (d,)),
+                        "b": get("model.encoder.layer_norm.bias", (d,))},
+        },
+        "decoder": {
+            "tok_emb": get("model.decoder.embed_tokens.weight", (cfg.vocab_size, d)),
+            "pos_emb": get("model.decoder.embed_positions.weight", (cfg.max_text_len, d)),
+            "layers": _stack_layers(dec_layers),
+            "ln_final": {"g": get("model.decoder.layer_norm.weight", (d,)),
+                         "b": get("model.decoder.layer_norm.bias", (d,))},
+        },
+    }
+
+
+# ---------------------------------------------------------------- qwen2-vl
+
+
+def qwen2vl_from_hf_state(
+    state: dict[str, np.ndarray] | str,
+    cfg,  # models.qwen2vl.Qwen2VLConfig
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Convert an HF Qwen2-VL state dict (Qwen2VLForConditionalGeneration
+    naming: ``visual.*`` + ``model.*``) into the models/qwen2vl.py tree.
+
+    Vision notes: the HF patch embed is a conv3d over 2 temporal frames —
+    for still images both frames carry the same patch, so the two temporal
+    taps sum into one (p*p*3, d) matmul kernel, permuted channel-last to
+    match patchify(); the fused qkv projection splits three ways.
+    """
+    if isinstance(state, str):
+        state = _load_state_dir(state)
+
+    def get(name: str, want: tuple[int, ...] | None = None, lin: bool = False):
+        if name not in state:
+            raise KeyError(f"HF checkpoint missing tensor {name}")
+        a = np.asarray(state[name])
+        if lin and a.ndim == 2:
+            a = a.T
+        if want is not None and tuple(a.shape) != want:
+            raise ValueError(f"{name}: shape {a.shape}, config wants {want}")
+        return a
+
+    v = cfg.vision
+    dv, fv, Lv = v.d_model, v.ffn_dim, v.n_layers
+    p_sz = v.patch_size
+
+    # patch embed: (dv, 3, T, p, p) [or (dv, 3, p, p)] -> (p*p*3, dv)
+    pe = get("visual.patch_embed.proj.weight")
+    if pe.ndim == 5:
+        pe = pe.sum(axis=2)
+    if pe.shape != (dv, 3, p_sz, p_sz):
+        raise ValueError(f"patch_embed: shape {pe.shape}")
+    patch_embed = pe.transpose(2, 3, 1, 0).reshape(p_sz * p_sz * 3, dv)
+
+    vis_layers = []
+    for n in range(Lv):
+        p = f"visual.blocks.{n}."
+        qkv_w = get(p + "attn.qkv.weight", (3 * dv, dv))  # (3d, d)
+        qkv_b = get(p + "attn.qkv.bias", (3 * dv,))
+        wq, wk, wv_ = (qkv_w[i * dv:(i + 1) * dv].T for i in range(3))
+        bq, bk, bv = (qkv_b[i * dv:(i + 1) * dv] for i in range(3))
+        vis_layers.append({
+            "ln1": {"g": get(p + "norm1.weight", (dv,)), "b": get(p + "norm1.bias", (dv,))},
+            "wq": wq, "bq": bq, "wk": wk, "bk": bk, "wv": wv_, "bv": bv,
+            "wo": get(p + "attn.proj.weight", (dv, dv)).T,
+            "bo": get(p + "attn.proj.bias", (dv,)),
+            "ln2": {"g": get(p + "norm2.weight", (dv,)), "b": get(p + "norm2.bias", (dv,))},
+            "w_up": get(p + "mlp.fc1.weight", (fv, dv)).T,
+            "b_up": get(p + "mlp.fc1.bias", (fv,)),
+            "w_down": get(p + "mlp.fc2.weight", (dv, fv)).T,
+            "b_down": get(p + "mlp.fc2.bias", (dv,)),
+        })
+
+    merged_in = v.merge_size * v.merge_size * dv
+    vision = {
+        "patch_embed": jnp.asarray(patch_embed, dtype=dtype),
+        "layers": _stack_layers(vis_layers, dtype),
+        "merger": {
+            "ln": {"g": jnp.asarray(get("visual.merger.ln_q.weight", (dv,)), dtype=dtype),
+                   "b": jnp.asarray(get("visual.merger.ln_q.bias", (dv,)), dtype=dtype)},
+            "w1": jnp.asarray(get("visual.merger.mlp.0.weight", (merged_in, merged_in)).T, dtype=dtype),
+            "b1": jnp.asarray(get("visual.merger.mlp.0.bias", (merged_in,)), dtype=dtype),
+            "w2": jnp.asarray(get("visual.merger.mlp.2.weight", (cfg.dim, merged_in)).T, dtype=dtype),
+            "b2": jnp.asarray(get("visual.merger.mlp.2.bias", (cfg.dim,)), dtype=dtype),
+        },
+    }
+
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    nq, nkv = cfg.n_heads, cfg.n_kv_heads
+    txt: dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "bq", "wk", "bk", "wv", "bv", "wo",
+        "mlp_norm", "w_gate", "w_up", "w_down")}
+    for n in range(cfg.n_layers):
+        p = f"model.layers.{n}."
+        txt["attn_norm"].append(get(p + "input_layernorm.weight", (d,)))
+        txt["wq"].append(get(p + "self_attn.q_proj.weight", (nq * hd, d)).T)
+        txt["bq"].append(get(p + "self_attn.q_proj.bias", (nq * hd,)))
+        txt["wk"].append(get(p + "self_attn.k_proj.weight", (nkv * hd, d)).T)
+        txt["bk"].append(get(p + "self_attn.k_proj.bias", (nkv * hd,)))
+        txt["wv"].append(get(p + "self_attn.v_proj.weight", (nkv * hd, d)).T)
+        txt["bv"].append(get(p + "self_attn.v_proj.bias", (nkv * hd,)))
+        txt["wo"].append(get(p + "self_attn.o_proj.weight", (d, nq * hd)).T)
+        txt["mlp_norm"].append(get(p + "post_attention_layernorm.weight", (d,)))
+        txt["w_gate"].append(get(p + "mlp.gate_proj.weight", (f, d)).T)
+        txt["w_up"].append(get(p + "mlp.up_proj.weight", (f, d)).T)
+        txt["w_down"].append(get(p + "mlp.down_proj.weight", (d, f)).T)
+
+    embed = jnp.asarray(get("model.embed_tokens.weight", (cfg.vocab_size, d)), dtype=dtype)
+    if "lm_head.weight" in state:
+        lm_head = jnp.asarray(get("lm_head.weight", (cfg.vocab_size, d)).T, dtype=dtype)
+    else:  # tied (Qwen2-VL-2B)
+        lm_head = embed.T
+    return {
+        "vision": vision,
+        "embed": embed,
+        "layers": {k: jnp.stack([jnp.asarray(a, dtype=dtype) for a in vlist])
+                   for k, vlist in txt.items()},
+        "final_norm": jnp.asarray(get("model.norm.weight", (d,)), dtype=dtype),
+        "lm_head": lm_head,
+    }

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .llama import rms_norm
+from .whisper import layer_norm
 
 # ---------------------------------------------------------------- config
 
@@ -143,10 +144,13 @@ def init_vision_params(cfg: VisionConfig, out_dim: int, key: jax.Array, dtype=jn
 
     ones = lambda *s: jnp.ones(s, dtype=dtype)
     zeros = lambda *s: jnp.zeros(s, dtype=dtype)
+    ln = lambda *s: {"g": ones(*s), "b": zeros(*s)}
+    # vision blocks use LayerNorm with bias and a biased output projection —
+    # the HF Qwen2-VL vision-tower layout, so real checkpoints import exactly
     return {
         "patch_embed": w(ks[0], patch_in, d),
         "layers": {
-            "ln1": ones(L, d),
+            "ln1": ln(L, d),
             "wq": w(ks[1], L, d, d),
             "bq": zeros(L, d),
             "wk": w(ks[2], L, d, d),
@@ -154,14 +158,15 @@ def init_vision_params(cfg: VisionConfig, out_dim: int, key: jax.Array, dtype=jn
             "wv": w(ks[3], L, d, d),
             "bv": zeros(L, d),
             "wo": w(ks[4], L, d, d),
-            "ln2": ones(L, d),
+            "bo": zeros(L, d),
+            "ln2": ln(L, d),
             "w_up": w(ks[5], L, d, cfg.ffn_dim),
             "b_up": zeros(L, cfg.ffn_dim),
             "w_down": w(ks[6], L, cfg.ffn_dim, d),
             "b_down": zeros(L, d),
         },
         "merger": {
-            "ln": ones(d),
+            "ln": ln(d),
             "w1": w(ks[7], merged_in, merged_in),
             "b1": zeros(merged_in),
             "w2": w(ks[8], merged_in, out_dim),
@@ -264,7 +269,7 @@ def vision_forward(params: dict, cfg: VisionConfig, images: jax.Array, rules=Non
     cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
 
     def layer(x, p):
-        h = rms_norm(x, p["ln1"], cfg.norm_eps)
+        h = layer_norm(x, p["ln1"], cfg.norm_eps)
         q = (jnp.einsum("bnd,dh->bnh", h, p["wq"], preferred_element_type=jnp.float32)
              + p["bq"].astype(jnp.float32)).astype(x.dtype).reshape(B, N, nh, hd)
         k = (jnp.einsum("bnd,dh->bnh", h, p["wk"], preferred_element_type=jnp.float32)
@@ -278,10 +283,11 @@ def vision_forward(params: dict, cfg: VisionConfig, images: jax.Array, rules=Non
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
                           preferred_element_type=jnp.float32)
         attn = attn.reshape(B, N, d).astype(x.dtype)
-        attn = jnp.einsum("bnh,hd->bnd", attn, p["wo"],
-                          preferred_element_type=jnp.float32).astype(x.dtype)
+        attn = (jnp.einsum("bnh,hd->bnd", attn, p["wo"],
+                           preferred_element_type=jnp.float32)
+                + p["bo"].astype(jnp.float32)).astype(x.dtype)
         x = x + attn
-        h = rms_norm(x, p["ln2"], cfg.norm_eps)
+        h = layer_norm(x, p["ln2"], cfg.norm_eps)
         u = (jnp.einsum("bnd,df->bnf", h, p["w_up"], preferred_element_type=jnp.float32)
              + p["b_up"].astype(jnp.float32))
         u = jax.nn.gelu(u).astype(x.dtype)
@@ -294,7 +300,7 @@ def vision_forward(params: dict, cfg: VisionConfig, images: jax.Array, rules=Non
     # 2x2 merge: (B, gh, gw, d) -> (B, gh/2, 2, gw/2, 2, d) -> (B, Nm, 4d)
     g, m = cfg.grid, cfg.merge_size
     gm = cfg.merged_grid
-    x = rms_norm(x, params["merger"]["ln"], cfg.norm_eps)
+    x = layer_norm(x, params["merger"]["ln"], cfg.norm_eps)
     x = x.reshape(B, gm, m, gm, m, d).transpose(0, 1, 3, 2, 4, 5).reshape(B, gm * gm, m * m * d)
     h = (jnp.einsum("bnm,mo->bno", x, params["merger"]["w1"],
                     preferred_element_type=jnp.float32) + params["merger"]["b1"].astype(jnp.float32))

@@ -32,10 +32,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-import jax.numpy as jnp
-
 from .engine import GenerationResult
-from .scheduler import ContinuousBatcher, _Slot
+from .scheduler import ContinuousBatcher
 from .stt import SpeechEngine, TranscribeResult
 
 
@@ -113,7 +111,7 @@ class ColocatedServing:
             get_metrics().set_gauge("colocate.parse_inflight", len(self._parse_futs))
         did = False
 
-        for i, (audio, fut) in enumerate(stt_jobs):  # priority lane
+        for audio, fut in stt_jobs:  # priority lane
             t0 = time.perf_counter()
             try:
                 result = self.stt.transcribe(audio)
@@ -162,9 +160,7 @@ class ColocatedServing:
         with self._lock:
             futs = list(self._parse_futs.values())
             self._parse_futs.clear()
-            self.batcher.pending.clear()
-            self.batcher.slots = [_Slot() for _ in range(self.batcher.B)]
-            self.batcher.active = jnp.zeros_like(self.batcher.active)
+            self.batcher.reset()
         for fut in futs:
             self._set_future(fut, exc=exc)
 

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..grammar.fsm import TokenFSM
 from ..grammar.regexlang import compile_regex
-from ..grammar.tokenizer import BOS_ID, EOS_ID, Tokenizer
+from ..grammar.tokenizer import BOS_ID, EOS_ID, PAD_ID, Tokenizer
 from ..models.qwen2vl import (
     PRESETS,
     Qwen2VLConfig,
@@ -98,19 +98,33 @@ def letterbox(image: np.ndarray, size: int) -> tuple[np.ndarray, float, int, int
     return out, scale, pad_x, pad_y
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _ground_decode_step(params, cfg: Qwen2VLConfig, cache, token, slot, pos_start,
-                        fsm_state, mask_table, next_table):
-    """One fused constrained decode step (greedy)."""
-    emb = embed_tokens(params, token[:, None])  # (B, 1, D)
-    slots = slot[:, None]
-    pos3 = jnp.broadcast_to((pos_start + slot)[None, :, None], (3, slot.shape[0], 1))
-    logits, cache = forward_embeds(params, cfg, emb, slots, pos3, cache)
-    logits = logits[:, -1]
-    masked = jnp.where(mask_table[fsm_state], logits, -jnp.inf)
-    tok = jnp.argmax(masked, axis=-1).astype(jnp.int32)
-    fsm_state = next_table[fsm_state, tok]
-    return tok, fsm_state, cache
+@partial(jax.jit, static_argnames=("cfg", "max_new"))
+def _ground_decode_loop(params, cfg: Qwen2VLConfig, cache, token0, slot0, pos_start,
+                        state0, mask_table, next_table, max_new: int):
+    """Whole constrained greedy decode in ONE device dispatch (the chip may
+    sit behind a high-latency tunnel — per-token host round-trips would
+    dominate grounding latency, as serve/engine.py's chunk loop notes)."""
+
+    def cond(c):
+        _, _, _, _, _, n, done = c
+        return jnp.logical_and(~done, n < max_new)
+
+    def body(c):
+        cache, cur, slot, state, out, n, done = c
+        out = out.at[n].set(cur[0])
+        emb = embed_tokens(params, cur[:, None])  # (1, 1, D)
+        pos3 = jnp.broadcast_to((pos_start + slot)[None, :, None], (3, 1, 1))
+        logits, cache = forward_embeds(params, cfg, emb, slot[:, None], pos3, cache)
+        masked = jnp.where(mask_table[state], logits[:, -1], -jnp.inf)
+        nxt = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+        state = next_table[state, nxt]
+        return (cache, nxt, slot + 1, state, out, n + 1, nxt[0] == EOS_ID)
+
+    out0 = jnp.zeros((max_new,), jnp.int32)
+    carry = (cache, token0, slot0, state0, out0, jnp.zeros((), jnp.int32),
+             token0[0] == EOS_ID)
+    _, _, _, _, out, n, done = jax.lax.while_loop(cond, body, carry)
+    return out, n, done
 
 
 class GroundingEngine:
@@ -154,20 +168,26 @@ class GroundingEngine:
         if total + max_new_tokens > self.max_len:
             raise ValueError(f"prompt too long: {total}+{max_new_tokens} > {self.max_len}")
 
-        txt = embed_tokens(self.params, jnp.asarray(ids, jnp.int32)[None])
-        embeds = jnp.concatenate([vis, txt], axis=1)  # (1, total, D)
-        slots = jnp.arange(total, dtype=jnp.int32)[None]
+        # pad the text segment up to a 64-wide bucket: one compiled prefill
+        # program per bucket, not per prompt length (padded slots are only
+        # ever re-attended after the decode loop overwrites them — same
+        # trick as serve.engine's bucketed prefill)
+        bucket = min(-(-total // 64) * 64, self.max_len)
+        ids_padded = ids + [PAD_ID] * (bucket - total)
+        txt = embed_tokens(self.params, jnp.asarray(ids_padded, jnp.int32)[None])
+        embeds = jnp.concatenate([vis, txt], axis=1)  # (1, bucket, D)
+        slots = jnp.arange(bucket, dtype=jnp.int32)[None]
         # M-RoPE: vision tokens carry grid coords; text continues after the
         # largest vision position (merged grid side), sequentially.
         gm = cfg.vision.merged_grid
         vp = jnp.asarray(self._vis_pos)[:, None, :]  # (3, 1, nv)
-        tp = text_positions3(gm, len(ids), batch=1)
+        tp = text_positions3(gm, bucket - nv, batch=1)
         pos3 = jnp.concatenate([vp, tp], axis=2)
 
         cache = init_kv_cache(cfg, 1, self.max_len)
         logits, cache = forward_embeds(self.params, cfg, embeds, slots, pos3, cache)
         state = jnp.asarray([self.fsm.start], jnp.int32)
-        first_logits = logits[:, -1]
+        first_logits = logits[:, total - 1]  # last REAL prompt position
         masked = jnp.where(self.mask_table[state], first_logits, -jnp.inf)
         token = jnp.argmax(masked, axis=-1).astype(jnp.int32)
         state = self.next_table[state, token]
@@ -176,19 +196,14 @@ class GroundingEngine:
 
         # text M-RoPE positions continue from gm + len(ids); slot from total
         pos_start = jnp.asarray([gm + len(ids) - total], jnp.int32)  # pos = start + slot
-        out_ids: list[int] = [int(token[0])]
         slot = jnp.asarray([total], jnp.int32)
-        steps = 1
-        for _ in range(max_new_tokens - 1):
-            token, state, cache = _ground_decode_step(
-                self.params, cfg, cache, token, slot, pos_start,
-                state, self.mask_table, self.next_table)
-            tid = int(token[0])
-            steps += 1
-            if tid == EOS_ID:
-                break
-            out_ids.append(tid)
-            slot = slot + 1
+        out, n, done = _ground_decode_loop(
+            self.params, cfg, cache, token, slot, pos_start,
+            state, self.mask_table, self.next_table, max_new_tokens)
+        n_h = int(jax.device_get(n))
+        out_ids = [int(t) for t in np.asarray(jax.device_get(out))[:n_h]]
+        finished = bool(jax.device_get(done))
+        steps = n_h + (1 if finished else 0)  # EOS consumed a step
         t3 = time.perf_counter()
 
         raw = self.tok.decode(out_ids)

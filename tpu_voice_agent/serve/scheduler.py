@@ -83,6 +83,15 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------ submit
 
+    def reset(self) -> None:
+        """Abandon all queued and in-flight work (decode-fault recovery —
+        the cache contents are garbage until fresh admissions overwrite
+        them, which _admit and chunk_decode_loop handle per slot)."""
+        self.pending.clear()
+        self.results.clear()
+        self.slots = [_Slot() for _ in range(self.B)]
+        self.active = jnp.zeros_like(self.active)
+
     def submit(self, prompt: str) -> int:
         rid = self._next_id
         self._next_id += 1

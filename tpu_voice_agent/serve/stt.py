@@ -109,6 +109,12 @@ class SpeechEngine:
         self.tokenizer = default_tokenizer()
         base = cfg or PRESETS[preset]
         self.cfg = replace(base, vocab_size=self.tokenizer.vocab_size)
+        if mel_cfg.n_mels != self.cfg.n_mels:
+            # the mel frontend must feed what the encoder expects (large-v3
+            # uses 128 bins, the rest of the family 80)
+            from dataclasses import replace as _replace
+
+            mel_cfg = _replace(mel_cfg, n_mels=self.cfg.n_mels)
         self.mel_cfg = mel_cfg
         self.frame_buckets = tuple(b for b in frame_buckets if b <= self.cfg.max_audio_frames)
         self.max_new_tokens = max_new_tokens
