@@ -30,7 +30,10 @@ def main() -> None:
     from tpu_voice_agent.services.prompts import render_prompt
 
     preset = "tinyllama-1.1b" if on_tpu else "test-tiny"
-    engine = DecodeEngine(preset=preset, max_len=2048, prefill_buckets=(1024,))
+    # int8 weight-only quantization on the chip: decode is HBM-bound on
+    # weights, and weight-only int8 is a standard serving configuration
+    engine = DecodeEngine(preset=preset, max_len=2048, prefill_buckets=(1024,),
+                          quant="int8" if on_tpu else None)
 
     utterances = [
         "search for wireless headphones",

@@ -73,3 +73,53 @@ def test_generation_result_stats(engine):
     res = engine.generate("measure me", max_new_tokens=300)
     assert res.prefill_ms > 0 and res.steps > 0
     assert res.tokens_per_s > 0
+
+
+class TestQuantizedEngine:
+    def test_int8_structure_and_range(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_voice_agent.models.llama import (
+            LlamaConfig, init_params, quantize_params,
+        )
+
+        cfg = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                          n_kv_heads=2, ffn_dim=64, max_seq_len=32)
+        q = quantize_params(init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32))
+        assert q["layers"]["wq"]["q"].dtype == jnp.int8
+        assert q["layers"]["attn_norm"].dtype != jnp.int8  # norms stay raw
+        assert q["embed"].ndim == 2  # embedding gather stays raw
+        import numpy as np
+
+        assert np.abs(np.asarray(q["lm_head"]["q"])).max() <= 127
+
+    def test_int8_dequant_is_close(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_voice_agent.models.llama import _w, LlamaConfig, init_params, quantize_params
+
+        cfg = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                          n_kv_heads=2, ffn_dim=64, max_seq_len=32)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        q = quantize_params(params)
+        w = np.asarray(params["layers"]["w_gate"], np.float32)
+        wq = np.asarray(_w(q["layers"]["w_gate"]), np.float32)
+        # per-channel symmetric int8 (error <= scale/2) + bf16 dequant
+        # rounding (relative ~2^-8)
+        scale = np.abs(w).max(axis=-2, keepdims=True) / 127.0
+        assert np.all(np.abs(w - wq) <= scale * 0.75 + np.abs(w) * 2.0**-7 + 1e-6)
+
+    def test_int8_engine_generates_grammar_valid(self):
+        import json
+
+        from tpu_voice_agent.serve import DecodeEngine
+
+        eng = DecodeEngine(preset="test-tiny", max_len=512, prefill_buckets=(64,),
+                           quant="int8")
+        res = eng.generate('<|user|>\ngo back\n<|assistant|>\n', max_new_tokens=192)
+        assert res.error is None
+        if res.finished:
+            json.loads(res.text)  # constrained decode survives quantization

@@ -99,6 +99,42 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16
     return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
 
 
+# ---------------------------------------------------------------- quantization
+
+
+def _w(leaf):
+    """Resolve a weight leaf: raw array, or int8 {"q", "s"} dequantized on
+    the fly (XLA fuses the convert+scale into the matmul's operand read, so
+    HBM traffic stays int8 — the point of weight-only quantization on a
+    memory-bound decode)."""
+    if isinstance(leaf, dict) and "q" in leaf:
+        return leaf["q"].astype(jnp.bfloat16) * leaf["s"].astype(jnp.bfloat16)
+    return leaf
+
+
+def quantize_params(params: dict) -> dict:
+    """Weight-only symmetric int8, per-output-channel scales. Norms and the
+    embedding table (a gather, already cheap) stay in their original dtype;
+    every matmul weight becomes {"q": int8, "s": f32} resolved by _w()."""
+
+    def quant(w):
+        wf = w.astype(jnp.float32)
+        s = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / 127.0
+        s = jnp.where(s == 0.0, 1.0, s)
+        q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+        return {"q": q, "s": s}
+
+    L = params["layers"]
+    return {
+        "embed": params["embed"],
+        "layers": {
+            k: (quant(v) if k.startswith("w") else v) for k, v in L.items()
+        },
+        "final_norm": params["final_norm"],
+        "lm_head": quant(_w(params["lm_head"])),
+    }
+
+
 # ---------------------------------------------------------------- ops
 
 
@@ -151,7 +187,7 @@ def _attend(q, k_cache, v_cache, q_positions, kv_len_mask):
 # ---------------------------------------------------------------- forward
 
 
-@partial(jax.jit, static_argnames=("cfg", "rules", "remat", "attn_impl", "fresh_block"))
+@partial(jax.jit, static_argnames=("cfg", "rules", "remat", "attn_impl", "fresh_block", "unroll"))
 def forward(
     params: dict,
     cfg: LlamaConfig,
@@ -162,6 +198,7 @@ def forward(
     remat: bool = False,  # rematerialize layer activations (training)
     attn_impl: str = "xla",  # "xla" | "pallas" (ops.flash_attention / decode_attention)
     fresh_block: bool = False,  # caller asserts this T>1 block starts a sequence at pos 0
+    unroll: int = 1,  # scan unroll factor (decode: trades compile time for loop overhead)
 ) -> tuple[jax.Array, dict]:
     """Unified prefill/decode forward.
 
@@ -200,9 +237,9 @@ def forward(
         p, k_cache, v_cache = layer_in
         h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
         h = cs(h, "act")
-        q = jnp.einsum("btd,dh->bth", h, p["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.einsum("btd,dh->bth", h, p["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.einsum("btd,dh->bth", h, p["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = jnp.einsum("btd,dh->bth", h, _w(p["wq"]), preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.einsum("btd,dh->bth", h, _w(p["wk"]), preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.einsum("btd,dh->bth", h, _w(p["wv"]), preferred_element_type=jnp.float32).astype(x.dtype)
         q = cs(q.reshape(B, T, cfg.n_heads, cfg.head_dim), "heads")
         k = cs(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
         v = cs(v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
@@ -226,15 +263,15 @@ def forward(
             attn = flash_attention(q, k, v, causal=True).reshape(B, T, -1)
         else:
             attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
-        attn = jnp.einsum("bth,hd->btd", attn, p["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+        attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + cs(attn, "act")
 
         h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-        gate = jnp.einsum("btd,df->btf", h, p["w_gate"], preferred_element_type=jnp.float32)
-        up = jnp.einsum("btd,df->btf", h, p["w_up"], preferred_element_type=jnp.float32)
+        gate = jnp.einsum("btd,df->btf", h, _w(p["w_gate"]), preferred_element_type=jnp.float32)
+        up = jnp.einsum("btd,df->btf", h, _w(p["w_up"]), preferred_element_type=jnp.float32)
         act = (jax.nn.silu(gate) * up).astype(x.dtype)
         act = cs(act, "ffn")
-        down = jnp.einsum("btf,fd->btd", act, p["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+        down = jnp.einsum("btf,fd->btd", act, _w(p["w_down"]), preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + cs(down, "act")
         return x, (k_cache, v_cache)
 
@@ -243,10 +280,11 @@ def forward(
         lambda carry, inp: layer_fn(carry, inp),
         x,
         (params["layers"], kv_cache["k"], kv_cache["v"]),
+        unroll=unroll,
     )
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"], preferred_element_type=jnp.float32)
+    logits = jnp.einsum("btd,dv->btv", x, _w(params["lm_head"]), preferred_element_type=jnp.float32)
     logits = cs(logits, "logits")
     return logits, {"k": new_k, "v": new_v}
 

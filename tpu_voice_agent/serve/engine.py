@@ -204,6 +204,7 @@ class DecodeEngine:
         batch_slots: int = 1,
         prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048),
         kernels: str = "auto",  # "auto" | "xla" | "pallas"
+        quant: str | None = None,  # None | "int8" — weight-only quantization
     ):
         if kernels == "auto":
             # pallas kernels are single-device pallas_calls (no shard_map
@@ -243,6 +244,19 @@ class DecodeEngine:
             self.rules = None
             self.params = jax.jit(partial(init_params, self.cfg))(key)
             self.cache = init_kv_cache(self.cfg, batch_slots, max_len)
+
+        if quant == "int8":
+            # weight-only int8: decode is HBM-bound on weights, so halving
+            # their bytes halves the per-token floor (mesh path keeps bf16 —
+            # the sharding pytrees describe raw weights)
+            if mesh is not None:
+                raise ValueError("quant='int8' is single-device for now")
+            from ..models.llama import quantize_params
+
+            self.params = jax.jit(quantize_params)(self.params)
+        elif quant is not None:
+            raise ValueError(f"unknown quant {quant!r}")
+        self.quant = quant
 
         self.mask_table = jnp.asarray(self.fsm.mask)
         self.next_table = jnp.asarray(self.fsm.next_state)
