@@ -100,6 +100,6 @@ class TestMaskedArgmax:
         V = eng.tokenizer.vocab_size
         logits = jax.random.normal(jax.random.PRNGKey(7), (2, V))
         state = jnp.asarray([eng.fsm.start, eng.fsm.start], jnp.int32)
-        out = masked_argmax(logits, state, eng.mask_table)
-        ref = masked_argmax_reference(logits, state, eng.mask_table)
+        out = masked_argmax(logits, state, eng.tables.dense_mask)
+        ref = masked_argmax_reference(logits, state, eng.tables.dense_mask)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -10,7 +10,11 @@ models/llama.py uses.
 
 from .orbax_io import restore_params, save_params
 from .hf_import import (
+    llama_config_from_hf,
     llama_from_hf_state,
+    llama_hf_check,
+    safetensors_shapes,
+    whisper_config_from_hf,
     llama_hf_key_map,
     qwen2vl_from_hf_state,
     whisper_from_hf_state,
@@ -19,7 +23,11 @@ from .hf_import import (
 __all__ = [
     "save_params",
     "restore_params",
+    "llama_config_from_hf",
     "llama_from_hf_state",
+    "llama_hf_check",
+    "safetensors_shapes",
+    "whisper_config_from_hf",
     "llama_hf_key_map",
     "whisper_from_hf_state",
     "qwen2vl_from_hf_state",

@@ -13,12 +13,120 @@ Works from either a loaded state dict (numpy arrays) or a directory of
 from __future__ import annotations
 
 import glob
+import json
 import os
+import struct
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import LlamaConfig
+
+
+# ---------------------------------------------------------------- config.json
+
+
+def llama_config_from_hf(path: str) -> LlamaConfig:
+    """Build a LlamaConfig from an HF config.json (file or directory).
+
+    This plus load_hf_tokenizer plus llama_from_hf_state is the complete
+    real-checkpoint path: nothing about the architecture is hard-coded to a
+    preset (reference capability replaced: apps/brain/src/llm.ts:7-9's
+    LLM_MODEL env selecting an arbitrary cloud model)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "config.json")
+    with open(path) as f:
+        cfg = json.load(f)
+    return LlamaConfig(
+        vocab_size=cfg["vocab_size"],
+        dim=cfg["hidden_size"],
+        n_layers=cfg["num_hidden_layers"],
+        n_heads=cfg["num_attention_heads"],
+        n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+        ffn_dim=cfg["intermediate_size"],
+        max_seq_len=cfg.get("max_position_embeddings", 2048),
+        rope_theta=float(cfg.get("rope_theta", 10_000.0)),
+        norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+    )
+
+
+def whisper_config_from_hf(path: str):
+    """WhisperConfig from an HF config.json (file or directory)."""
+    from ..models.whisper import WhisperConfig
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "config.json")
+    with open(path) as f:
+        cfg = json.load(f)
+    return WhisperConfig(
+        vocab_size=cfg["vocab_size"],
+        n_mels=cfg.get("num_mel_bins", 80),
+        d_model=cfg["d_model"],
+        n_heads=cfg["encoder_attention_heads"],
+        enc_layers=cfg["encoder_layers"],
+        dec_layers=cfg["decoder_layers"],
+        max_audio_frames=2 * cfg.get("max_source_positions", 1500),
+        max_text_len=cfg.get("max_target_positions", 448),
+    )
+
+
+def safetensors_shapes(path: str) -> dict[str, tuple[int, ...]]:
+    """Tensor name -> shape from safetensors headers only (no data read).
+
+    The header is a little-endian u64 length + JSON dict; parsing it keeps
+    shape validation of multi-GB checkpoints at zero memory cost."""
+    shapes: dict[str, tuple[int, ...]] = {}
+    files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    for f in files:
+        with open(f, "rb") as fh:
+            (n,) = struct.unpack("<Q", fh.read(8))
+            header = json.loads(fh.read(n))
+        for name, meta in header.items():
+            if name != "__metadata__":
+                shapes[name] = tuple(meta["shape"])
+    return shapes
+
+
+def llama_hf_check(shapes: dict[str, tuple[int, ...]], cfg: LlamaConfig) -> None:
+    """Validate an HF Llama checkpoint's tensor names+shapes against ``cfg``
+    without loading any data (pairs with safetensors_shapes). Raises with
+    the full list of mismatches."""
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    nq, nkv = cfg.n_heads, cfg.n_kv_heads
+    # HF (out, in) layout — the un-transposed twin of llama_from_hf_state's
+    want: dict[str, tuple[int, ...]] = {
+        "model.embed_tokens.weight": (cfg.vocab_size, d),
+        "model.norm.weight": (d,),
+    }
+    per_layer = {
+        "input_layernorm.weight": (d,),
+        "self_attn.q_proj.weight": (nq * hd, d),
+        "self_attn.k_proj.weight": (nkv * hd, d),
+        "self_attn.v_proj.weight": (nkv * hd, d),
+        "self_attn.o_proj.weight": (d, nq * hd),
+        "post_attention_layernorm.weight": (d,),
+        "mlp.gate_proj.weight": (f, d),
+        "mlp.up_proj.weight": (f, d),
+        "mlp.down_proj.weight": (d, f),
+    }
+    for layer in range(cfg.n_layers):
+        for suffix, shape in per_layer.items():
+            want[f"model.layers.{layer}.{suffix}"] = shape
+    problems = []
+    for name, shape in want.items():
+        if name not in shapes:
+            problems.append(f"missing {name}")
+        elif tuple(shapes[name]) != shape:
+            problems.append(f"{name}: shape {shapes[name]}, config wants {shape}")
+    if "lm_head.weight" in shapes and tuple(shapes["lm_head.weight"]) != (cfg.vocab_size, d):
+        problems.append(
+            f"lm_head.weight: shape {shapes['lm_head.weight']}, "
+            f"config wants {(cfg.vocab_size, d)}"
+        )
+    if problems:
+        raise ValueError("HF checkpoint mismatch:\n" + "\n".join(problems[:20]))
 
 
 def llama_hf_key_map(layer: int) -> dict[str, str]:

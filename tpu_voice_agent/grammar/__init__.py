@@ -1,8 +1,9 @@
 from .regexlang import compile_regex, DFA
 from .jsonschema import schema_to_regex
 from .tokenizer import Tokenizer, train_bpe
-from .fsm import TokenFSM
-from .intent_grammar import build_intent_fsm, intent_regex, default_tokenizer
+from .fsm import TokenFSM, DeviceFSM, fsm_advance, fsm_row
+from .intent_grammar import build_fsm_for, build_intent_fsm, intent_regex, default_tokenizer
+from .hf_tokenizer import HFTokenizer, load_hf_tokenizer
 
 __all__ = [
     "compile_regex",
@@ -11,6 +12,12 @@ __all__ = [
     "Tokenizer",
     "train_bpe",
     "TokenFSM",
+    "DeviceFSM",
+    "fsm_advance",
+    "fsm_row",
+    "build_fsm_for",
+    "HFTokenizer",
+    "load_hf_tokenizer",
     "build_intent_fsm",
     "intent_regex",
     "default_tokenizer",

@@ -98,7 +98,51 @@ def intent_regex() -> str:
 
 @lru_cache(maxsize=1)
 def intent_dfa() -> DFA:
-    return compile_regex(intent_regex())
+    """Compile (or load) the intent DFA.
+
+    With DFA-bounded strings the automaton is ~35k states and ~20 s of
+    pure-python subset construction — too slow to pay per process, so the
+    compiled tables are cached on disk keyed by the regex hash (the regex is
+    derived from the pydantic schema, so schema edits invalidate cleanly).
+    """
+    import hashlib
+    import os
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    rx = intent_regex()
+    key = hashlib.sha256(rx.encode()).hexdigest()[:16]
+    cache_dir = Path(
+        os.environ.get("TPU_VOICE_CACHE_DIR")
+        or Path.home() / ".cache" / "tpu_voice_agent"
+    )
+    path = cache_dir / f"intent_dfa_{key}.npz"
+    if path.exists():
+        try:
+            z = np.load(path)
+            return DFA(z["trans"], z["accepting"], z["class_of"], int(z["start"]))
+        except Exception:
+            # truncated/corrupt cache (crash mid-write, format drift):
+            # fall through and recompile — the cache is best-effort
+            try:
+                path.unlink()
+            except OSError:
+                pass
+    dfa = compile_regex(rx)
+    try:
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".npz")
+        os.close(fd)
+        np.savez_compressed(
+            tmp, trans=dfa.trans, accepting=dfa.accepting,
+            class_of=dfa.class_of, start=np.int64(dfa.start),
+        )
+        os.replace(tmp, path)  # atomic: concurrent processes race safely
+    except OSError:
+        pass  # cache is best-effort
+    return dfa
 
 
 @lru_cache(maxsize=1)
@@ -117,3 +161,21 @@ def build_intent_fsm() -> tuple[Tokenizer, TokenFSM]:
     tok = default_tokenizer()
     fsm = TokenFSM(intent_dfa(), tok)
     return tok, fsm
+
+
+def build_fsm_for(tokenizer, vocab_size: int | None = None) -> TokenFSM:
+    """Intent-grammar FSM over an arbitrary tokenizer (HFTokenizer for real
+    checkpoints). ``vocab_size`` may exceed the tokenizer's to match a
+    checkpoint's padded embedding table.
+
+    The multi-second FSM build is cached ON the tokenizer object (keyed by
+    vocab width), so the cache lives and dies with the tokenizer — an id()-
+    keyed global here would both leak and risk aliasing a recycled address
+    to the wrong tokenizer's tables."""
+    cache = tokenizer.__dict__.setdefault("_intent_fsm_cache", {})
+    key = int(vocab_size or tokenizer.vocab_size)
+    fsm = cache.get(key)
+    if fsm is None:
+        fsm = TokenFSM(intent_dfa(), tokenizer, vocab_size=vocab_size)
+        cache[key] = fsm
+    return fsm

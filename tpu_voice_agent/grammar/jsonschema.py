@@ -6,8 +6,11 @@ schema's language chosen for small DFAs and unambiguous decoding:
 
 - objects emit ALL properties, in declaration order, compact (no whitespace);
   optional/nullable fields are emitted as ``null`` rather than omitted
-- strings are unbounded printable-ASCII with JSON escapes (length is bounded
-  operationally by the sampler's token budget, not the DFA)
+- strings are printable-ASCII with JSON escapes, DFA-bounded at
+  ``min(maxLength, 160)`` chars: every grammar path therefore terminates
+  within a bounded byte count, so even a worst-case (random-weight) model
+  under greedy decoding reaches EOS instead of cycling inside a free string
+  until the byte budget truncates
 - integers bounded by digit count chosen to stay <= the schema's maximum
 - free-form objects (additionalProperties) allow up to 4 key/value pairs
 
@@ -22,9 +25,19 @@ from typing import Any
 
 # JSON string contents: printable ASCII minus `"` and `\`, or a JSON escape.
 STR_CHAR = r'(\\["\\/bfnrt]|[ !#-\[\]-~])'
-STRING = '"' + STR_CHAR + '*"'
+# DFA-level string length cap (see module docstring). Each bounded string
+# costs ~cap DFA states per occurrence; 160 covers every realistic utterance
+# fragment, URL, and tts summary while keeping the DFA in the low tens of
+# thousands of states.
+DEFAULT_MAX_STRING = 160
+STRING = '"' + STR_CHAR + "{0,%d}" % DEFAULT_MAX_STRING + '"'
 # Non-empty variant (for keys etc.)
-STRING_NONEMPTY = '"' + STR_CHAR + '+"'
+STRING_NONEMPTY = '"' + STR_CHAR + "{1,%d}" % DEFAULT_MAX_STRING + '"'
+
+
+def _string_regex(max_length: int | None) -> str:
+    n = DEFAULT_MAX_STRING if max_length is None else min(int(max_length), DEFAULT_MAX_STRING)
+    return '"' + STR_CHAR + "{0,%d}" % n + '"'
 KEY = r'"[a-zA-Z_][a-zA-Z0-9_\-]{0,30}"'
 BOOL = "(true|false)"
 NULL = "null"
@@ -196,7 +209,7 @@ def schema_to_regex(
                 hi = node["exclusiveMaximum"] - 1e-6
             return _num_regex(lo, hi)
         if t == "string":
-            return STRING
+            return _string_regex(node.get("maxLength"))
         if t == "array":
             item = compile_node(node.get("items", {"type": "string"}))
             max_items = int(node.get("maxItems", 8))

@@ -265,20 +265,25 @@ class _NFA:
                 self.states[loop_in].eps.append(ks)
                 self.states[ke].eps.append(loop_in)
                 return s, loop_in
-            # bounded: child^lo then (child?)^(hi-lo)
+            # bounded: child^lo then up to (hi-lo) optional copies. Each
+            # optional copy eps-exits DIRECTLY to one shared exit state —
+            # a skip-CHAIN here makes every epsilon closure drag in all
+            # downstream skips, turning subset construction quadratic in
+            # the repetition count (fatal for {0,160} string bounds).
             s = e = self.new()
             for _ in range(lo):
                 cs, ce = self.compile(node.child)
                 self.states[e].eps.append(cs)
                 e = ce
+            exit_ = self.new()
+            self.states[e].eps.append(exit_)
+            cur = e
             for _ in range(hi - lo):
                 cs, ce = self.compile(node.child)
-                skip = self.new()
-                self.states[e].eps.append(cs)
-                self.states[e].eps.append(skip)
-                self.states[ce].eps.append(skip)
-                e = skip
-            return s, e
+                self.states[cur].eps.append(cs)
+                self.states[ce].eps.append(exit_)
+                cur = ce
+            return s, exit_
         raise TypeError(node)
 
 

@@ -81,12 +81,22 @@ def train_bpe(corpus: list[str], num_merges: int) -> list[bytes]:
 
 
 class Tokenizer:
-    """Greedy longest-match tokenizer over a byte-complete vocab."""
+    """Greedy longest-match tokenizer over a byte-complete vocab.
+
+    Exposes the interface every tokenizer in the framework satisfies
+    (grammar.hf_tokenizer.HFTokenizer is the real-checkpoint twin):
+    ``encode/decode/token_bytes/byte_pieces``, ``vocab_size`` and the
+    instance special ids ``pad_id/bos_id/eos_id`` (engines must use these,
+    never the module constants — real checkpoints place them elsewhere).
+    """
 
     def __init__(self, pieces: list[bytes]):
         # pieces[i] is the byte string for id i + len(SPECIALS)
         self.pieces = pieces
         self.vocab_size = len(SPECIALS) + len(pieces)
+        self.pad_id = PAD_ID
+        self.bos_id = BOS_ID
+        self.eos_id = EOS_ID
         self.piece_bytes: list[bytes] = [s.encode() for s in SPECIALS] + pieces
         self._trie: dict = {}
         for idx, piece in enumerate(pieces):
@@ -154,6 +164,11 @@ class Tokenizer:
             return b""
         return self.piece_bytes[token_id]
 
+    def byte_pieces(self) -> list:
+        """Per-id byte content; None/b'' for non-emitting specials (the
+        TokenFSM builds its vocab trie from this)."""
+        return [None] * len(SPECIALS) + self.pieces
+
     # -------------------------------------------------- persistence
 
     def save(self, path: str | Path) -> None:
@@ -167,16 +182,10 @@ class Tokenizer:
         return cls([bytes.fromhex(h) for h in obj["pieces"]])
 
     @classmethod
-    def from_hf_tokenizer_json(cls, path: str | Path) -> "Tokenizer":
-        """Import an HF tokenizer.json vocab (for real checkpoints; offline)."""
-        obj = json.loads(Path(path).read_text())
-        vocab = obj.get("model", {}).get("vocab", {})
-        # HF BPE vocabs use byte-level unicode mapping; approximate by utf-8
-        pieces = [bytes([b]) for b in range(256)]
-        seen = set(pieces)
-        for tok in sorted(vocab, key=vocab.get):
-            raw = tok.replace("Ġ", " ").replace("Ċ", "\n").encode()
-            if raw not in seen:
-                pieces.append(raw)
-                seen.add(raw)
-        return cls(pieces)
+    def from_hf_tokenizer_json(cls, path: str | Path):
+        """Real-checkpoint import moved to grammar.hf_tokenizer (true BPE
+        merges, byte-level + sentencepiece families, checkpoint special ids).
+        Kept as a forwarding shim for round-1 callers."""
+        from .hf_tokenizer import load_hf_tokenizer
+
+        return load_hf_tokenizer(path)

@@ -239,7 +239,7 @@ def compute_cross_kv(params: dict, cfg: WhisperConfig, enc_out: jax.Array, rules
     return {"k": ks, "v": vs}  # (L, B, T_enc, nh, hd)
 
 
-@partial(jax.jit, static_argnames=("cfg", "rules"))
+@partial(jax.jit, static_argnames=("cfg", "rules", "attn_impl"))
 def decoder_forward(
     params: dict,
     cfg: WhisperConfig,
@@ -247,8 +247,9 @@ def decoder_forward(
     positions: jax.Array,  # (B, T)
     self_cache: dict,
     cross_kv: dict,
-    enc_mask: jax.Array,  # (B, T_enc) bool — valid encoder frames
+    enc_mask: jax.Array,  # (B, T_enc) bool — valid encoder frames (prefix)
     rules=None,
+    attn_impl: str = "xla",  # "pallas": T==1 steps use ops.decode_attention
 ) -> tuple[jax.Array, dict]:
     p = params["decoder"]
     cs = lambda x, name: rules.constrain(x, name) if rules is not None else x
@@ -265,7 +266,11 @@ def decoder_forward(
     causal = slot_pos <= positions[:, :, None]  # (B, T, S)
     self_mask = causal & kv_valid[:, None, :]
     cross_mask = jnp.broadcast_to(enc_mask[:, None, :], (B, T, enc_mask.shape[1]))
+    # enc_mask is prefix-shaped (valid frames 0..n-1), so the pallas decode
+    # kernel can treat cross attention as cache attention with kv_len = n
+    enc_len = jnp.sum(enc_mask.astype(jnp.int32), axis=-1)
     batch_idx = jnp.arange(B)[:, None]
+    use_pallas_step = attn_impl == "pallas" and T == 1
 
     def layer(x, inp):
         lp, k_cache, v_cache, ck, cv = inp
@@ -277,26 +282,38 @@ def decoder_forward(
         v = _proj(h, a["wv"], a["bv"]).reshape(B, T, nh, hd)
         k_cache = k_cache.at[batch_idx, positions].set(k)
         v_cache = v_cache.at[batch_idx, positions].set(v)
-        scores = jnp.einsum("btnh,bsnh->bnts", q, k_cache, preferred_element_type=jnp.float32)
-        scores = scores * (hd**-0.5)
-        scores = jnp.where(self_mask[:, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bnts,bsnh->btnh", probs.astype(x.dtype), v_cache,
-                          preferred_element_type=jnp.float32)
-        attn = attn.reshape(B, T, nh * hd).astype(x.dtype)
+        if use_pallas_step:
+            from ..ops import decode_attention
+
+            attn = decode_attention(q[:, 0], k_cache, v_cache, frontier + 1)
+            attn = attn.reshape(B, T, nh * hd).astype(x.dtype)
+        else:
+            scores = jnp.einsum("btnh,bsnh->bnts", q, k_cache, preferred_element_type=jnp.float32)
+            scores = scores * (hd**-0.5)
+            scores = jnp.where(self_mask[:, None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bnts,bsnh->btnh", probs.astype(x.dtype), v_cache,
+                              preferred_element_type=jnp.float32)
+            attn = attn.reshape(B, T, nh * hd).astype(x.dtype)
         x = x + cs(_proj(attn, a["wo"], a["bo"]), "act")
 
         # cross attention over precomputed encoder K/V
         h = layer_norm(x, lp["ln2"], cfg.norm_eps)
         ca = lp["cross_attn"]
         qc = _proj(h, ca["wq"], ca["bq"]).reshape(B, T, nh, hd)
-        scores = jnp.einsum("btnh,bsnh->bnts", qc, ck, preferred_element_type=jnp.float32)
-        scores = scores * (hd**-0.5)
-        scores = jnp.where(cross_mask[:, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bnts,bsnh->btnh", probs.astype(x.dtype), cv,
-                          preferred_element_type=jnp.float32)
-        attn = attn.reshape(B, T, nh * hd).astype(x.dtype)
+        if use_pallas_step:
+            from ..ops import decode_attention
+
+            attn = decode_attention(qc[:, 0], ck, cv, enc_len)
+            attn = attn.reshape(B, T, nh * hd).astype(x.dtype)
+        else:
+            scores = jnp.einsum("btnh,bsnh->bnts", qc, ck, preferred_element_type=jnp.float32)
+            scores = scores * (hd**-0.5)
+            scores = jnp.where(cross_mask[:, None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bnts,bsnh->btnh", probs.astype(x.dtype), cv,
+                              preferred_element_type=jnp.float32)
+            attn = attn.reshape(B, T, nh * hd).astype(x.dtype)
         x = x + cs(_proj(attn, ca["wo"], ca["bo"]), "act")
 
         h = layer_norm(x, lp["ln3"], cfg.norm_eps)

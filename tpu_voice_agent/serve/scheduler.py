@@ -28,7 +28,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..grammar.tokenizer import PAD_ID
 from ..models.llama import forward
 from .engine import DecodeEngine, GenerationResult, _mask_sample_advance, chunk_decode_loop
 
@@ -68,7 +67,7 @@ class ContinuousBatcher:
 
         S = engine.max_len
         # device-resident per-slot state
-        self.cur = jnp.full((self.B,), PAD_ID, dtype=jnp.int32)
+        self.cur = jnp.full((self.B,), engine.pad_id, dtype=jnp.int32)
         self.pos = jnp.full((self.B,), S - 1, dtype=jnp.int32)
         self.fsm = jnp.zeros((self.B,), dtype=jnp.int32)
         self.active = jnp.zeros((self.B,), dtype=bool)
@@ -111,7 +110,7 @@ class ContinuousBatcher:
         n = len(ids)
         bucket = eng._bucket(n)
         S = eng.max_len
-        tokens = np.full((self.B, bucket), PAD_ID, dtype=np.int32)
+        tokens = np.full((self.B, bucket), eng.pad_id, dtype=np.int32)
         positions = np.full((self.B, bucket), S - 1, dtype=np.int32)  # trash for others
         tokens[slot, :n] = ids
         positions[slot] = np.arange(bucket)
@@ -124,7 +123,7 @@ class ContinuousBatcher:
         self._rng, k = jax.random.split(self._rng)
         start_state = jnp.full((self.B,), self.engine.fsm.start, dtype=jnp.int32)
         tok0, fsm0 = _mask_sample_advance(
-            last_logits, start_state, eng.mask_table, eng.next_table, k,
+            last_logits, start_state, eng.tables, k,
             jnp.float32(self.temperature), self.greedy, True, eng.kernels,
         )
         onehot = jnp.arange(self.B) == slot
@@ -175,10 +174,11 @@ class ContinuousBatcher:
          self.nbytes, self.tokens_left) = chunk_decode_loop(
             eng.params, eng.cfg, eng.cache,
             self.cur, self.pos, self.fsm, self.active, self.nbytes, self.tokens_left,
-            eng.mask_table, eng.next_table, eng.byte_len_table,
+            eng.tables, eng.byte_len_table,
             k, jnp.float32(self.temperature), jnp.int32(self.byte_budget),
             rules=eng.rules, chunk_steps=self.chunk_steps,
             greedy=self.greedy, constrained=True, kernels=eng.kernels,
+            eos_id=eng.eos_id, pad_id=eng.pad_id,
         )
         # one transfer for everything the host needs this chunk
         out_h, n_h, act_h, eos_h = (

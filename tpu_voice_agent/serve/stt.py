@@ -26,7 +26,6 @@ import numpy as np
 from ..audio.endpoint import EnergyEndpointer
 from ..audio.mel import MelConfig, log_mel_spectrogram
 from ..grammar.intent_grammar import default_tokenizer
-from ..grammar.tokenizer import BOS_ID, EOS_ID, PAD_ID
 from ..models.whisper import (
     PRESETS,
     WhisperConfig,
@@ -38,26 +37,42 @@ from ..models.whisper import (
 )
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new"), donate_argnames=("self_cache",))
+@partial(jax.jit, static_argnames=("cfg", "max_new", "eos_id", "pad_id", "attn_impl"),
+         donate_argnames=("self_cache",))
 def _stt_decode_loop(
     params,
     cfg: WhisperConfig,
     self_cache,
     cross_kv,
     enc_mask,
+    bos,  # (B, P) int32 decoder prompt (sot sequence; checkpoint-specific)
+    suppress,  # (V,) bool — tokens never sampled (specials/timestamps), or None
     max_new: int = 64,
+    eos_id: int = 2,
+    pad_id: int = 0,
+    attn_impl: str = "xla",
 ):
-    """Greedy decode until EOS, fully on device."""
-    B = enc_mask.shape[0]
-    bos = jnp.full((B, 1), BOS_ID, dtype=jnp.int32)
-    logits, self_cache = decoder_forward(
-        params, cfg, bos, jnp.zeros((B, 1), jnp.int32), self_cache, cross_kv, enc_mask
-    )
-    tok0 = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+    """Greedy decode until EOS, fully on device.
 
-    out = jnp.full((B, max_new), PAD_ID, dtype=jnp.int32)
-    carry0 = (self_cache, tok0, jnp.ones((B,), jnp.int32), out,
-              jnp.zeros((B,), jnp.int32), tok0 == EOS_ID, jnp.zeros((), jnp.int32))
+    The decoder prompt is a (B, P) token block (the in-tree toy tokenizer
+    uses a single BOS; real Whisper checkpoints need the
+    <|startoftranscript|><|lang|><|task|><|notimestamps|> sequence)."""
+    B, P = bos.shape
+
+    def pick(logits):
+        if suppress is not None:
+            logits = jnp.where(suppress[None, :], -jnp.inf, logits)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    pos0 = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (B, P))
+    logits, self_cache = decoder_forward(
+        params, cfg, bos, pos0, self_cache, cross_kv, enc_mask, attn_impl=attn_impl
+    )
+    tok0 = pick(logits[:, P - 1, :])
+
+    out = jnp.full((B, max_new), pad_id, dtype=jnp.int32)
+    carry0 = (self_cache, tok0, jnp.full((B,), P, jnp.int32), out,
+              jnp.zeros((B,), jnp.int32), tok0 == eos_id, jnp.zeros((), jnp.int32))
 
     def cond(c):
         done, step = c[5], c[6]
@@ -71,11 +86,12 @@ def _stt_decode_loop(
         )
         n = n + live.astype(jnp.int32)
         logits, cache = decoder_forward(
-            params, cfg, cur[:, None], pos[:, None], cache, cross_kv, enc_mask
+            params, cfg, cur[:, None], pos[:, None], cache, cross_kv, enc_mask,
+            attn_impl=attn_impl
         )
-        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        nxt = pick(logits[:, 0, :])
         pos = jnp.where(live, pos + 1, pos)
-        done = done | (nxt == EOS_ID) | (pos >= cfg.max_text_len - 1)
+        done = done | (nxt == eos_id) | (pos >= cfg.max_text_len - 1)
         return (cache, jnp.where(live, nxt, cur), pos, out, n, done, step + 1)
 
     self_cache, _, _, out, n, _, _ = jax.lax.while_loop(cond, body, carry0)
@@ -101,14 +117,39 @@ class SpeechEngine:
         frame_buckets: tuple[int, ...] = (100, 300, 1000, 3000),
         max_new_tokens: int = 64,
         mel_cfg: MelConfig = MelConfig(),
-        kernels: str = "auto",  # "auto" | "xla" | "pallas" (encoder flash attention)
+        kernels: str = "auto",  # "auto" | "xla" | "pallas" (flash/decode attention)
+        tokenizer=None,  # checkpoint tokenizer; None = in-tree toy vocab
+        bos_ids: tuple[int, ...] | None = None,  # decoder prompt (sot sequence)
+        init_weights: bool = True,
     ):
         if kernels == "auto":
             kernels = "pallas" if jax.default_backend() == "tpu" else "xla"
         self.kernels = kernels
-        self.tokenizer = default_tokenizer()
         base = cfg or PRESETS[preset]
-        self.cfg = replace(base, vocab_size=self.tokenizer.vocab_size)
+        if tokenizer is None:
+            self.tokenizer = default_tokenizer()
+            vocab = self.tokenizer.vocab_size
+        else:
+            self.tokenizer = tokenizer
+            vocab = base.vocab_size if cfg is not None else tokenizer.vocab_size
+            if vocab < tokenizer.vocab_size:
+                raise ValueError(
+                    f"model vocab {vocab} < tokenizer vocab {tokenizer.vocab_size}"
+                )
+        self.cfg = replace(base, vocab_size=vocab)
+        self.eos_id = int(self.tokenizer.eos_id)
+        self.pad_id = int(self.tokenizer.pad_id)
+        self.bos_ids = tuple(bos_ids) if bos_ids else (int(self.tokenizer.bos_id),)
+        # greedy decode must never emit specials (real Whisper vocabularies
+        # carry hundreds of <|...|> control tokens); EOS stays samplable
+        special = getattr(self.tokenizer, "special_ids", None)
+        if special:
+            sup = np.zeros(vocab, dtype=bool)
+            sup[list(special)] = True
+            sup[self.eos_id] = False
+            self.suppress = jnp.asarray(sup)
+        else:
+            self.suppress = None
         if mel_cfg.n_mels != self.cfg.n_mels:
             # the mel frontend must feed what the encoder expects (large-v3
             # uses 128 bins, the rest of the family 80)
@@ -118,10 +159,37 @@ class SpeechEngine:
         self.mel_cfg = mel_cfg
         self.frame_buckets = tuple(b for b in frame_buckets if b <= self.cfg.max_audio_frames)
         self.max_new_tokens = max_new_tokens
-        self.params = jax.jit(partial(init_params, self.cfg))(jax.random.PRNGKey(seed))
+        self.params = (
+            jax.jit(partial(init_params, self.cfg))(jax.random.PRNGKey(seed))
+            if init_weights else None
+        )
 
     def load_params(self, params) -> None:
         self.params = params
+
+    @classmethod
+    def from_hf(cls, model_dir: str, language: str = "en", dtype=jnp.bfloat16, **kw) -> "SpeechEngine":
+        """Serve a real HF Whisper checkpoint directory (config.json +
+        tokenizer.json + *.safetensors). The decoder prompt becomes the
+        checkpoint's <|startoftranscript|><|lang|><|transcribe|>
+        <|notimestamps|> sequence and all control tokens are suppressed
+        during greedy decode. Replaces apps/voice/src/deepgram.ts:33-45
+        with on-device weights."""
+        from ..ckpt.hf_import import whisper_config_from_hf, whisper_from_hf_state
+        from ..grammar.hf_tokenizer import load_hf_tokenizer
+
+        cfg = whisper_config_from_hf(model_dir)
+        tok = load_hf_tokenizer(model_dir)
+        bos: list[int] = []
+        for name in ("<|startoftranscript|>", f"<|{language}|>", "<|transcribe|>",
+                     "<|notimestamps|>"):
+            tid = tok.id_of(name)
+            if tid is not None:
+                bos.append(tid)
+        eng = cls(cfg=cfg, tokenizer=tok, bos_ids=tuple(bos) or None,
+                  init_weights=False, **kw)
+        eng.load_params(whisper_from_hf_state(model_dir, cfg, dtype=dtype))
+        return eng
 
     def _bucket(self, n_frames: int) -> int:
         for b in self.frame_buckets:
@@ -152,8 +220,11 @@ class SpeechEngine:
 
         t1 = time.perf_counter()
         cache = init_self_cache(self.cfg, 1)
+        bos = jnp.asarray(list(self.bos_ids), dtype=jnp.int32)[None, :]
         out, n, _ = _stt_decode_loop(
-            self.params, self.cfg, cache, cross_kv, valid, max_new=self.max_new_tokens
+            self.params, self.cfg, cache, cross_kv, valid, bos, self.suppress,
+            max_new=self.max_new_tokens, eos_id=self.eos_id, pad_id=self.pad_id,
+            attn_impl=self.kernels,
         )
         n_h = int(jax.device_get(n)[0])
         ids = [int(t) for t in np.asarray(jax.device_get(out))[0, :n_h]]

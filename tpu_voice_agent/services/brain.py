@@ -218,6 +218,17 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
 
 
 def make_parser_from_env() -> IntentParser:
+    """BRAIN_BACKEND=rule (default) | engine[:preset] (random init).
+    BRAIN_MODEL=<HF checkpoint dir> overrides both: the engine serves the
+    checkpoint's weights with its own tokenizer (the real replacement for
+    the reference's LLM_BASE_URL/LLM_MODEL env, apps/brain/src/llm.ts:7-9).
+    BRAIN_QUANT=int8 enables weight-only quantization for the loaded model."""
+    model_dir = os.environ.get("BRAIN_MODEL")
+    if model_dir:
+        from ..serve import DecodeEngine
+
+        quant = os.environ.get("BRAIN_QUANT") or None
+        return EngineParser(DecodeEngine.from_hf(model_dir, quant=quant))
     backend = os.environ.get("BRAIN_BACKEND", "rule")
     if backend == "rule":
         return RuleBasedParser()

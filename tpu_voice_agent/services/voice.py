@@ -45,7 +45,8 @@ class VoiceConfig:
 
 
 def stt_factory_from_env():
-    """VOICE_STT=null (default, no model) or whisper:<preset>."""
+    """VOICE_STT=null (default, no model), whisper:<preset> (random init),
+    or whisper-hf:<checkpoint dir> (real weights + real tokenizer)."""
     spec = os.environ.get("VOICE_STT", "null")
     if spec == "null":
         from ..serve.stt import NullSTT
@@ -54,8 +55,11 @@ def stt_factory_from_env():
     if spec.startswith("whisper"):
         from ..serve.stt import SpeechEngine, StreamingSTT
 
-        preset = spec.split(":", 1)[1] if ":" in spec else "whisper-tiny"
-        engine = SpeechEngine(preset=preset)
+        if spec.startswith("whisper-hf:"):
+            engine = SpeechEngine.from_hf(spec.split(":", 1)[1])
+        else:
+            preset = spec.split(":", 1)[1] if ":" in spec else "whisper-tiny"
+            engine = SpeechEngine(preset=preset)
         lock = threading.Lock()
 
         class LockedStreaming(StreamingSTT):
