@@ -290,7 +290,9 @@ def vision_forward(params: dict, cfg: VisionConfig, images: jax.Array, rules=Non
         h = layer_norm(x, p["ln2"], cfg.norm_eps)
         u = (jnp.einsum("bnd,df->bnf", h, p["w_up"], preferred_element_type=jnp.float32)
              + p["b_up"].astype(jnp.float32))
-        u = jax.nn.gelu(u).astype(x.dtype)
+        # HF Qwen2-VL vision blocks use QuickGELU, not tanh-approx GELU;
+        # matching it keeps imported-checkpoint tower outputs bit-comparable.
+        u = (u * jax.nn.sigmoid(1.702 * u)).astype(x.dtype)
         dn = (jnp.einsum("bnf,fd->bnd", u, p["w_down"], preferred_element_type=jnp.float32)
               + p["b_down"].astype(jnp.float32)).astype(x.dtype)
         return x + dn, None
@@ -304,7 +306,7 @@ def vision_forward(params: dict, cfg: VisionConfig, images: jax.Array, rules=Non
     x = x.reshape(B, gm, m, gm, m, d).transpose(0, 1, 3, 2, 4, 5).reshape(B, gm * gm, m * m * d)
     h = (jnp.einsum("bnm,mo->bno", x, params["merger"]["w1"],
                     preferred_element_type=jnp.float32) + params["merger"]["b1"].astype(jnp.float32))
-    h = jax.nn.gelu(h).astype(jnp.bfloat16)
+    h = jax.nn.gelu(h, approximate=False).astype(jnp.bfloat16)  # HF merger: exact erf GELU
     out = (jnp.einsum("bno,od->bnd", h, params["merger"]["w2"],
                       preferred_element_type=jnp.float32) + params["merger"]["b2"].astype(jnp.float32))
     return cs(out.astype(jnp.bfloat16), "act")

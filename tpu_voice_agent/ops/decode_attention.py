@@ -110,11 +110,17 @@ def decode_attention(
     # traffic must stay proportional to the attended keys, not capacity).
     # All kv heads ride in each block (TPU tiling wants the second-minor
     # block dim equal to the array dim) and the small GQA head loop unrolls
-    # in-kernel. block_k must divide S: take the largest divisor <= block_k.
-    bk = min(block_k, S)
-    while S % bk:
-        bk -= 1
-    block_k = bk
+    # in-kernel. block_k must divide S. Bucketed caches (multiples of 64/128)
+    # hit the no-copy path; an odd S (e.g. prime) pads up to the next block
+    # boundary rather than degenerating to block_k=1 — the pad region sits
+    # beyond every row's kv_len, so the tile gate skips it entirely.
+    block_k = min(block_k, S)
+    if S % block_k:
+        S_pad = -(-S // block_k) * block_k
+        pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
+        k_cache = jnp.pad(k_cache, pad)
+        v_cache = jnp.pad(v_cache, pad)
+        S = S_pad
     qg = q.reshape(B, nkv, group, hd)  # reshape only — no copy
 
     grid = (B, S // block_k)

@@ -120,9 +120,10 @@ class ColocatedServing:
                 self._set_future(fut, exc=e)
             if result is not None:
                 self._set_future(fut, value=result)
-            self.stats.stt_busy_ms += (time.perf_counter() - t0) * 1e3
-            self.stats.stt_jobs += 1
-            self.stats.trace.append("stt")
+            with self._lock:
+                self.stats.stt_busy_ms += (time.perf_counter() - t0) * 1e3
+                self.stats.stt_jobs += 1
+                self.stats.trace.append("stt")
             did = True
 
         if self._has_decode_work():
@@ -136,9 +137,10 @@ class ColocatedServing:
                 self.stats.errors += 1
                 self._fail_inflight(e)
                 return True
-            self.stats.decode_busy_ms += (time.perf_counter() - t0) * 1e3
-            self.stats.decode_chunks += 1
-            self.stats.trace.append("chunk")
+            with self._lock:
+                self.stats.decode_busy_ms += (time.perf_counter() - t0) * 1e3
+                self.stats.decode_chunks += 1
+                self.stats.trace.append("chunk")
             did = True
             self._harvest()
         return did
@@ -174,14 +176,23 @@ class ColocatedServing:
                 self._set_future(fut, value=res)
 
     def drain(self, timeout_s: float = 120.0) -> None:
-        """Run steps until all queued work (both lanes) has completed."""
+        """Block until all queued work (both lanes) has completed.
+
+        Only steps inline when no worker thread is running — two threads
+        executing ``batcher.step()`` concurrently would corrupt slot/cache
+        state, so with a live worker this just waits for it to finish.
+        """
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._lock:
                 idle = not self._stt_q and not self._parse_futs
+                worker_alive = self._thread is not None and self._thread.is_alive()
             if idle:
                 return
-            self.step()
+            if worker_alive:
+                time.sleep(0.005)
+            else:
+                self.step()
         raise TimeoutError("colocated drain timed out")
 
     # ------------------------------------------------------------ worker

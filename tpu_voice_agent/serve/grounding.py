@@ -142,6 +142,18 @@ class GroundingEngine:
 
         self.cfg = replace(base, vocab_size=self.tok.vocab_size, max_seq_len=max_len)
         self.max_len = max_len
+        if params is not None:
+            # The FSM/mask tables are built over self.tok's vocab, so external
+            # params MUST share that vocab: a real-HF Qwen2-VL checkpoint
+            # (~152k vocab, its own tokenizer) cannot drop in here — its
+            # logits would broadcast against a 512-wide mask and its ids
+            # would index a foreign embedding table. Fail loudly instead.
+            embed = params["embed"]
+            if embed.shape[0] != self.tok.vocab_size:
+                raise ValueError(
+                    f"params embed vocab {embed.shape[0]} != grounding tokenizer "
+                    f"vocab {self.tok.vocab_size}; external checkpoints must be "
+                    "re-headed onto the grounding tokenizer (see ckpt.hf_import)")
         self.params = params if params is not None else init_params(
             self.cfg, jax.random.PRNGKey(seed))
         self.mask_table = jnp.asarray(self.fsm.mask)

@@ -139,16 +139,25 @@ def _do_click(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
     grounder = getattr(cache, "grounder", None)
     if grounder is not None:
         # no DOM match: ask the VL grounding head (SURVEY.md §2 #15 augment)
+        import os
         import tempfile
 
         from .grounding import grounded_click
 
-        shot = str(Path(tempfile.gettempdir()) / "ground_shot.png")
+        # unique per call: concurrent sessions must not clobber each other's
+        # screenshot, and a fixed name in a shared tmpdir is a symlink target
+        fd, shot = tempfile.mkstemp(prefix="ground_shot_", suffix=".png")
+        os.close(fd)
         try:
             return grounded_click(page, analysis, grounder, str(text), shot,
                                   timeout_ms=intent.timeout_ms)
         except Exception:
             pass  # fall through to the plain text click
+        finally:
+            try:
+                os.unlink(shot)
+            except OSError:
+                pass
     page.click_text(str(text), timeout_ms=intent.timeout_ms)
     return {"by": "text", "text": text}
 
