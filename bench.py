@@ -34,6 +34,12 @@ def main() -> None:
     # weights, and weight-only int8 is a standard serving configuration
     engine = DecodeEngine(preset=preset, max_len=2048, prefill_buckets=(1024,),
                           quant="int8" if on_tpu else None)
+    # shared-prefix cache: the system prompt + few-shots prefill once, so a
+    # request pays only for its user suffix (the serving path does the same)
+    from tpu_voice_agent.services.brain import install_prompt_prefix
+
+    prefix_len = install_prompt_prefix(engine)
+    print(f"[bench] prompt prefix cached: {prefix_len} tokens", file=sys.stderr)
 
     utterances = [
         "search for wireless headphones",

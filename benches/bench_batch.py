@@ -4,11 +4,14 @@ N concurrent sessions submit grammar-constrained intent parses; measures
 end-to-end intents/sec and decoded tokens/sec on the chip (the reference's
 "concurrency" is a Node event loop fanning out to cloud APIs — SURVEY.md §2
 request-level concurrency row).
+
+Round 2: admissions prefill ONE row (engine.prefill_row) and reuse the
+shared-prefix KV for the system-prompt+few-shot head, so the measured path
+is the same one services/brain.py serves with BRAIN_BATCH>1.
 """
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 from pathlib import Path
@@ -20,21 +23,23 @@ from common import emit, log, on_tpu  # noqa: E402
 def main(n_sessions: int = 32) -> None:
     from tpu_voice_agent.serve import DecodeEngine
     from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+    from tpu_voice_agent.services.brain import install_prompt_prefix
+    from tpu_voice_agent.services.prompts import render_prompt
 
     tpu = on_tpu()
     preset = "tinyllama-1.1b" if tpu else "test-tiny"
-    slots = 8 if tpu else 3
+    slots = 32 if tpu else 3
     engine = DecodeEngine(preset=preset, max_len=2048, batch_slots=slots,
-                          prefill_buckets=(128, 256))
+                          prefill_buckets=(1024,),
+                          quant="int8" if tpu else None)
+    P = install_prompt_prefix(engine)
     batcher = ContinuousBatcher(engine, chunk_steps=16, max_new_tokens=64)
-    log(f"preset={preset} slots={slots} sessions={n_sessions}")
+    log(f"preset={preset} slots={slots} sessions={n_sessions} prefix={P}tok")
 
     def prompt(i: int) -> str:
-        user = json.dumps({"text": f"search for item {i} and sort by price",
-                           "context": {}}, separators=(",", ":"))
-        return f"<|user|>\n{user}\n<|assistant|>\n"
+        return render_prompt(f"search for item {i} and sort by price", {})
 
-    # warmup: compile prefill + chunk loop
+    # warmup: compile suffix prefill + chunk loop
     batcher.submit(prompt(0))
     batcher.run_until_done()
     batcher.results.clear()

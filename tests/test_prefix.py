@@ -1,0 +1,159 @@
+"""Shared-prefix caching + single-row admission (round-2 VERDICT #2/#3).
+
+The system prompt + few-shots are identical for every /parse request, so the
+engine prefills them ONCE and each request prefills only its user suffix.
+Correctness bar: prefix-cached decode must be token-identical to full
+prefill, both single-request and through the continuous batcher, and the
+batched brain service must answer concurrent requests correctly.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_voice_agent.serve import DecodeEngine
+from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+from tpu_voice_agent.services.brain import BatchedEngineParser, install_prompt_prefix
+from tpu_voice_agent.services.prompts import render_prompt
+
+
+def _mk(slots: int = 1) -> DecodeEngine:
+    return DecodeEngine(
+        preset="test-tiny", max_len=2048, batch_slots=slots,
+        prefill_buckets=(128, 256, 512, 1024),
+    )
+
+
+@pytest.fixture(scope="module")
+def plain_engine():
+    return _mk()
+
+
+@pytest.fixture(scope="module")
+def prefix_engine():
+    eng = _mk()
+    P = install_prompt_prefix(eng)
+    assert P > 0, "shared prompt head must tokenize to a non-empty common prefix"
+    return eng
+
+
+def test_prefix_covers_almost_all_of_the_prompt(prefix_engine):
+    """The point of the cache: the per-request suffix is a small fraction of
+    the full prompt (prefill cost becomes suffix-proportional)."""
+    eng = prefix_engine
+    ids = eng.tokenizer.encode(render_prompt("search for usb hubs", {}), bos=True)
+    suffix = eng._split_prefix(ids)
+    assert suffix is not None
+    assert len(suffix) < len(ids) * 0.15, (len(suffix), len(ids))
+
+
+def test_prefix_decode_token_identical(plain_engine, prefix_engine):
+    prompt = render_prompt("search for mechanical keyboards", {})
+    ra = plain_engine.generate(prompt, max_new_tokens=200)
+    rb = prefix_engine.generate(prompt, max_new_tokens=200)
+    assert ra.token_ids == rb.token_ids
+    assert ra.finished == rb.finished
+
+
+def test_prefix_decode_with_context_payload(plain_engine, prefix_engine):
+    prompt = render_prompt("open the second result", {"last_query": "gpus"})
+    ra = plain_engine.generate(prompt, max_new_tokens=200)
+    rb = prefix_engine.generate(prompt, max_new_tokens=200)
+    assert ra.token_ids == rb.token_ids
+
+
+def test_unmatched_prompt_falls_back_to_full_prefill(prefix_engine):
+    """A prompt NOT starting with the cached prefix must still decode (the
+    exact-token-match gate routes it to the plain path)."""
+    res = prefix_engine.generate("just some other prompt entirely", max_new_tokens=64)
+    assert res.steps >= 0  # no crash; grammar walk stays live
+    state = prefix_engine.fsm.walk(res.token_ids)
+    assert state >= 0
+
+
+def test_batcher_single_row_admission_matches_generate(plain_engine):
+    """Single-row admission prefill (prefill_row) must reproduce the
+    single-request path token for token at equal batch width (B=1; across
+    batch widths bf16 numerics legitimately differ)."""
+    eng = _mk(slots=1)
+    batcher = ContinuousBatcher(eng, chunk_steps=16, max_new_tokens=200)
+    prompts = [
+        render_prompt("search for laptops under 1000", {}),
+        render_prompt("take a screenshot", {}),
+    ]
+    solo = [plain_engine.generate(p, max_new_tokens=200) for p in prompts]
+    packed = batcher.generate_many(prompts)
+    for s, b in zip(solo, packed):
+        assert s.token_ids == b.token_ids
+
+
+def test_batcher_with_prefix_matches_batcher_without(plain_engine):
+    """Prefix-cached admission must be token-identical to full-prompt
+    admission through the same batcher shape."""
+    prompts = [
+        render_prompt("sort these by price from low to high", {}),
+        render_prompt("upload my resume and submit", {}),
+        render_prompt("scroll down", {"last_query": "x"}),
+    ]
+    eng_a = _mk(slots=3)
+    plain = ContinuousBatcher(eng_a, chunk_steps=16, max_new_tokens=200).generate_many(prompts)
+    eng_b = _mk(slots=3)
+    install_prompt_prefix(eng_b)
+    cached = ContinuousBatcher(eng_b, chunk_steps=16, max_new_tokens=200).generate_many(prompts)
+    for s, b in zip(plain, cached):
+        assert s.token_ids == b.token_ids
+
+
+def test_batched_parser_concurrent_http():
+    """BatchedEngineParser behind the real HTTP app: concurrent /parse
+    requests share decode chunks and each gets a self-consistent response
+    (200 grammar-valid or 422 truncation under tiny random weights)."""
+    import httpx
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import build_app
+
+    eng = _mk(slots=4)
+    install_prompt_prefix(eng)
+    parser = BatchedEngineParser(eng, chunk_steps=16, max_new_tokens=200)
+    try:
+        with AppServer(build_app(parser)) as srv:
+            from concurrent.futures import ThreadPoolExecutor
+
+            def post(q):
+                return httpx.post(
+                    srv.url + "/parse",
+                    json={"text": f"search for {q}", "context": {}},
+                    timeout=300,
+                )
+
+            with ThreadPoolExecutor(max_workers=4) as ex:
+                results = list(ex.map(post, ["ants", "bees", "cats", "dogs"]))
+            for r in results:
+                assert r.status_code in (200, 422), r.text
+                if r.status_code == 200:
+                    assert isinstance(r.json()["intents"], list)
+            # the batcher actually interleaved: multiple parse jobs completed
+            # through the shared runtime
+            assert parser.runtime.stats.parse_jobs == 4
+    finally:
+        parser.close()
+
+
+def test_admission_writes_do_not_disturb_running_slots():
+    """A request admitted mid-decode must not change an in-flight row's
+    output (row-isolated prefill writes)."""
+    eng = _mk(slots=2)
+    b1 = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=120)
+    p1 = render_prompt("search for monitors", {})
+    p2 = render_prompt("go back", {})
+    rid1 = b1.submit(p1)
+    b1.step()  # admit p1, decode a chunk
+    rid2 = b1.submit(p2)  # joins at the next chunk boundary
+    b1.run_until_done()
+    joined = b1.results[rid1]
+    assert b1.results[rid2] is not None
+
+    eng2 = _mk(slots=2)
+    b2 = ContinuousBatcher(eng2, chunk_steps=8, max_new_tokens=120)
+    alone = b2.generate_many([p1])[0]
+    assert joined.token_ids == alone.token_ids

@@ -1,4 +1,13 @@
+from .colocate import ColocatedServing
 from .engine import DecodeEngine, GenerationResult
 from .grounding import GroundingEngine, GroundingResult
+from .scheduler import ContinuousBatcher
 
-__all__ = ["DecodeEngine", "GenerationResult", "GroundingEngine", "GroundingResult"]
+__all__ = [
+    "ColocatedServing",
+    "ContinuousBatcher",
+    "DecodeEngine",
+    "GenerationResult",
+    "GroundingEngine",
+    "GroundingResult",
+]

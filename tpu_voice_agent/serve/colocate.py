@@ -59,7 +59,10 @@ class ColocatedServing:
     and return ``concurrent.futures.Future``.
     """
 
-    def __init__(self, stt: SpeechEngine, batcher: ContinuousBatcher):
+    def __init__(self, stt: SpeechEngine | None, batcher: ContinuousBatcher):
+        """``stt=None`` runs the decode lane alone — the brain service uses
+        this to put the continuous batcher behind /parse without loading a
+        speech model into its process."""
         self.stt = stt
         self.batcher = batcher
         self.stats = ColocationStats()
@@ -73,6 +76,8 @@ class ColocatedServing:
     # ------------------------------------------------------------ submit
 
     def submit_stt(self, audio: np.ndarray) -> "Future[TranscribeResult]":
+        if self.stt is None:
+            raise RuntimeError("this runtime was built without an STT engine")
         fut: Future = Future()
         with self._work:
             self._stt_q.append((audio, fut))
@@ -84,12 +89,28 @@ class ColocatedServing:
         fut: Future = Future()
         with self._work:
             rid = self.batcher.submit(prompt)
+            fut.request_id = rid  # lets abandon_parse find the request again
             self._parse_futs[rid] = fut
             self.stats.max_parse_inflight = max(
                 self.stats.max_parse_inflight, len(self._parse_futs)
             )
             self._work.notify()
         return fut
+
+    def abandon_parse(self, fut: Future) -> None:
+        """Give up on a submitted parse (caller timed out): dequeue it if
+        still pending and drop its future, so overload does not accumulate
+        work nobody will read. A request already decoding in a slot runs to
+        its (bounded) finish; its orphaned result is purged at harvest."""
+        rid = getattr(fut, "request_id", None)
+        if rid is None:
+            return
+        with self._lock:
+            self._parse_futs.pop(rid, None)
+            self.batcher.pending = [
+                (r, p) for (r, p) in self.batcher.pending if r != rid
+            ]
+        fut.cancel()
 
     # ------------------------------------------------------------ core
 
@@ -174,6 +195,10 @@ class ColocatedServing:
                 res = self.batcher.results.pop(rid)
                 self.stats.parse_jobs += 1
                 self._set_future(fut, value=res)
+            # purge results whose futures were abandoned (submit and future
+            # registration share one lock, so no still-wanted rid lacks one)
+            for rid in [r for r in self.batcher.results if r not in self._parse_futs]:
+                self.batcher.results.pop(rid)
 
     def drain(self, timeout_s: float = 120.0) -> None:
         """Block until all queued work (both lanes) has completed.

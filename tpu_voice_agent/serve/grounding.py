@@ -168,10 +168,11 @@ class GroundingEngine:
     def ground(self, image: np.ndarray, instruction: str,
                max_new_tokens: int = 48) -> GroundingResult:
         cfg = self.cfg
+        # one combined device_get at the end; intermediate stage timings are
+        # dispatch-side (a mid-flight block costs a full tunnel round trip)
         t0 = time.perf_counter()
         img, scale, pad_x, pad_y = letterbox(image, cfg.vision.img_size)
         vis = vision_forward(self.params["vision"], cfg.vision, jnp.asarray(img)[None])
-        vis.block_until_ready()
         t1 = time.perf_counter()
 
         ids = [BOS_ID] + self._prompt_ids(instruction)
@@ -203,7 +204,6 @@ class GroundingEngine:
         masked = jnp.where(self.mask_table[state], first_logits, -jnp.inf)
         token = jnp.argmax(masked, axis=-1).astype(jnp.int32)
         state = self.next_table[state, token]
-        token.block_until_ready()
         t2 = time.perf_counter()
 
         # text M-RoPE positions continue from gm + len(ids); slot from total
@@ -212,9 +212,10 @@ class GroundingEngine:
         out, n, done = _ground_decode_loop(
             self.params, cfg, cache, token, slot, pos_start,
             state, self.mask_table, self.next_table, max_new_tokens)
-        n_h = int(jax.device_get(n))
-        out_ids = [int(t) for t in np.asarray(jax.device_get(out))[:n_h]]
-        finished = bool(jax.device_get(done))
+        out_h, n_a, done_a = jax.device_get((out, n, done))
+        n_h = int(n_a)
+        out_ids = [int(t) for t in np.asarray(out_h)[:n_h]]
+        finished = bool(done_a)
         steps = n_h + (1 if finished else 0)  # EOS consumed a step
         t3 = time.perf_counter()
 

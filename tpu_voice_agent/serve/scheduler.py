@@ -5,9 +5,11 @@ debounce (SURVEY.md §2 strategy table, "request-level concurrency"). Here the
 equivalent is slot-based continuous batching on one device mesh:
 
 - the KV cache holds `batch_slots` independent sequences (cache row = slot)
-- admission: a new request prefills into a free slot while other slots keep
-  their state; rows not being written aim their cache writes at a dedicated
-  trash slot (S-1), so no masked-write path is needed in the model
+- admission: a new request prefills into a free slot's cache line ONLY
+  (engine.prefill_row slices that row out, runs a (1, bucket) forward, and
+  writes it back in place) — admission cost is independent of batch width,
+  and other slots' cache lines are never touched; the shared prompt prefix
+  is copied from the engine's prefix KV instead of recomputed
 - decode advances ALL active slots together in chunked on-device loops
   (`chunk_steps` per dispatch): one host round-trip per chunk, not per token
   — critical over a tunneled chip — while keeping admission latency bounded
@@ -28,8 +30,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.llama import forward
-from .engine import DecodeEngine, GenerationResult, _mask_sample_advance, chunk_decode_loop
+from .engine import (
+    DecodeEngine,
+    GenerationResult,
+    _first_token,
+    chunk_decode_loop,
+    prefill_row,
+    prefill_row_with_prefix,
+)
 
 
 
@@ -79,6 +87,10 @@ class ContinuousBatcher:
         self.results: dict[int, GenerationResult] = {}
         self._next_id = 0
         self._rng = jax.random.PRNGKey(1234)
+        # host mirror of `active`: admission decisions must not pay a device
+        # readback (each one is a full tunnel round trip); the mirror is
+        # refreshed from the chunk's single combined device_get
+        self._active_h = np.zeros((self.B,), dtype=bool)
 
     # ------------------------------------------------------------ submit
 
@@ -90,6 +102,7 @@ class ContinuousBatcher:
         self.results.clear()
         self.slots = [_Slot() for _ in range(self.B)]
         self.active = jnp.zeros_like(self.active)
+        self._active_h = np.zeros((self.B,), dtype=bool)
 
     def submit(self, prompt: str) -> int:
         rid = self._next_id
@@ -104,35 +117,55 @@ class ContinuousBatcher:
         return None
 
     def _admit(self, slot: int, rid: int, prompt: str) -> None:
+        """Prefill ONE slot's cache line (cost independent of batch width —
+        round 1 prefilled the full (B, bucket) batch per admission, 32×
+        wasted FLOPs at 32 slots) and reuse the engine's shared-prefix KV
+        when the prompt starts with it."""
         eng = self.engine
         t0 = time.perf_counter()
         ids = eng.tokenizer.encode(prompt, bos=True)
         n = len(ids)
-        bucket = eng._bucket(n)
-        S = eng.max_len
-        tokens = np.full((self.B, bucket), eng.pad_id, dtype=np.int32)
-        positions = np.full((self.B, bucket), S - 1, dtype=np.int32)  # trash for others
-        tokens[slot, :n] = ids
-        positions[slot] = np.arange(bucket)
-
-        logits, eng.cache = forward(
-            eng.params, eng.cfg, jnp.asarray(tokens), jnp.asarray(positions), eng.cache,
-            eng.rules, attn_impl=eng.kernels, fresh_block=True,
-        )
-        last_logits = logits[:, n - 1, :]  # only row `slot` meaningful
+        suffix = eng._split_prefix(ids)
+        if suffix is not None:
+            bucket = eng._suffix_bucket(len(suffix), eng.max_len - len(eng.prefix_ids))
+            if bucket is None:
+                suffix = None  # no suffix bucket fits; full prefill below
+        if suffix is not None:
+            P, m = len(eng.prefix_ids), len(suffix)
+            tokens = np.full((1, bucket), eng.pad_id, dtype=np.int32)
+            tokens[0, :m] = suffix
+            positions = (P + np.arange(bucket, dtype=np.int32))[None, :]
+            logits, eng.cache = prefill_row_with_prefix(
+                eng.params, eng.cfg, eng.cache,
+                eng.prefix_kv["k"], eng.prefix_kv["v"],
+                jnp.asarray(tokens), jnp.asarray(positions), jnp.int32(slot),
+                rules=eng.rules, kernels=eng.kernels,
+            )
+            last_logits = logits[:, m - 1, :]
+        else:
+            bucket = eng._bucket(n)
+            tokens = np.full((1, bucket), eng.pad_id, dtype=np.int32)
+            tokens[0, :n] = ids
+            positions = np.arange(bucket, dtype=np.int32)[None, :]
+            logits, eng.cache = prefill_row(
+                eng.params, eng.cfg, eng.cache,
+                jnp.asarray(tokens), jnp.asarray(positions), jnp.int32(slot),
+                rules=eng.rules, kernels=eng.kernels, fresh=True,
+            )
+            last_logits = logits[:, n - 1, :]
         self._rng, k = jax.random.split(self._rng)
-        start_state = jnp.full((self.B,), self.engine.fsm.start, dtype=jnp.int32)
-        tok0, fsm0 = _mask_sample_advance(
+        start_state = jnp.full((1,), self.engine.fsm.start, dtype=jnp.int32)
+        tok0, fsm0 = _first_token(
             last_logits, start_state, eng.tables, k,
-            jnp.float32(self.temperature), self.greedy, True, eng.kernels,
+            jnp.float32(self.temperature), greedy=self.greedy, constrained=True,
+            kernels=eng.kernels,
         )
-        onehot = jnp.arange(self.B) == slot
-        self.cur = jnp.where(onehot, tok0, self.cur)
-        self.fsm = jnp.where(onehot, fsm0, self.fsm)
-        self.pos = jnp.where(onehot, n, self.pos)
-        self.nbytes = jnp.where(onehot, 0, self.nbytes)
-        self.tokens_left = jnp.where(onehot, self.max_new_tokens, self.tokens_left)
-        self.active = self.active | onehot
+        self.cur = self.cur.at[slot].set(tok0[0])
+        self.fsm = self.fsm.at[slot].set(fsm0[0])
+        self.pos = self.pos.at[slot].set(n)
+        self.nbytes = self.nbytes.at[slot].set(0)
+        self.tokens_left = self.tokens_left.at[slot].set(self.max_new_tokens)
+        self.active = self.active.at[slot].set(True)
 
         sl = self.slots[slot]
         sl.request_id = rid
@@ -146,9 +179,7 @@ class ContinuousBatcher:
 
     def step(self) -> None:
         """Admit pending requests into free slots, then run one chunk."""
-        # np.array: device_get may hand back a read-only buffer view, and the
-        # admit loop marks slots taken in-place
-        act = np.array(jax.device_get(self.active))
+        act = self._active_h  # host mirror — no device readback for admission
         while self.pending:
             slot = self._free_slot(act)
             if slot is None:
@@ -180,10 +211,12 @@ class ContinuousBatcher:
             greedy=self.greedy, constrained=True, kernels=eng.kernels,
             eos_id=eng.eos_id, pad_id=eng.pad_id,
         )
-        # one transfer for everything the host needs this chunk
+        # one transfer for everything the host needs this chunk (a combined
+        # device_get is ONE tunnel round trip; separate gets pay one each)
         out_h, n_h, act_h, eos_h = (
             np.asarray(x) for x in jax.device_get((out, n, self.active, eos))
         )
+        self._active_h = np.array(act_h)
 
         from ..utils import get_metrics
 

@@ -46,8 +46,37 @@ class ParserError(Exception):
 # ---------------------------------------------------------------- backends
 
 
+def _result_to_response(res) -> ParseResponse:
+    """GenerationResult -> ParseResponse with the reference error mapping."""
+    if res.error:
+        raise ParserError("llm_error", res.error)
+    if not res.finished:
+        raise ParserError(
+            "schema_validation_failed",
+            f"decode truncated after {res.steps} tokens (no EOS)",
+        )
+    model, err = parse_response_from_json(res.text)
+    if model is None:
+        # unreachable under the grammar; kept as a hard backstop
+        raise ParserError("schema_validation_failed", err or "invalid")
+    return model
+
+
+def install_prompt_prefix(engine) -> int:
+    """Prefill the request-invariant prompt head (system + few-shots) into
+    the engine's shared-prefix cache so per-request prefill covers only the
+    user payload. Token-exact: two differing sample payloads locate the
+    common token prefix."""
+    from .prompts import render_prompt as rp
+
+    return engine.set_prompt_prefix(
+        rp("sample utterance alpha", {}),
+        rp("a rather different beta payload", {"last_query": "gamma"}),
+    )
+
+
 class EngineParser:
-    """Grammar-constrained decode on the in-tree engine."""
+    """Grammar-constrained decode on the in-tree engine (serialized)."""
 
     def __init__(self, engine, max_new_tokens: int = 512):
         self.engine = engine
@@ -61,16 +90,55 @@ class EngineParser:
             )
         except ValueError as e:  # prompt too long etc.
             raise ParserError("llm_error", str(e)) from e
-        if not res.finished:
-            raise ParserError(
-                "schema_validation_failed",
-                f"decode truncated after {res.steps} tokens (no EOS)",
-            )
-        model, err = parse_response_from_json(res.text)
-        if model is None:
-            # unreachable under the grammar; kept as a hard backstop
-            raise ParserError("schema_validation_failed", err or "invalid")
-        return model
+        return _result_to_response(res)
+
+
+class BatchedEngineParser:
+    """Continuous-batched grammar-constrained decode behind /parse.
+
+    N concurrent requests share chunked decode dispatches on ONE engine
+    (slot-based continuous batching, serve.scheduler) — the TPU replacement
+    for the reference voice/brain stack's Node event-loop concurrency
+    (apps/voice/src/server.ts:97). Each request's future resolves when its
+    slot finishes; admission happens at chunk boundaries.
+    """
+
+    concurrent_safe = True  # build_app skips the serialization lock
+
+    def __init__(self, engine, chunk_steps: int = 16, max_new_tokens: int = 512,
+                 timeout_s: float = 120.0):
+        from ..serve import ColocatedServing, ContinuousBatcher
+
+        self.engine = engine
+        self.batcher = ContinuousBatcher(
+            engine, chunk_steps=chunk_steps, max_new_tokens=max_new_tokens
+        )
+        self.runtime = ColocatedServing(None, self.batcher)
+        self.timeout_s = timeout_s
+        self.runtime.start()
+
+    def parse(self, text: str, context: dict) -> ParseResponse:
+        prompt = render_prompt(text, context)
+        fut = self.runtime.submit_parse(prompt)
+        try:
+            res = fut.result(timeout=self.timeout_s)
+        except ParserError:
+            raise
+        except TimeoutError as e:
+            # dequeue the abandoned request so overload can't pile up work
+            # nobody will read (pending entries are dropped immediately; a
+            # slot already decoding finishes its bounded budget)
+            self.runtime.abandon_parse(fut)
+            raise ParserError("llm_error", "batched decode timed out") from e
+        except Exception as e:
+            raise ParserError("llm_error", str(e)) from e
+        return _result_to_response(res)
+
+    def healthy(self) -> bool:
+        return self.runtime.healthy()
+
+    def close(self) -> None:
+        self.runtime.stop()
 
 
 class RuleBasedParser:
@@ -158,17 +226,36 @@ class RuleBasedParser:
 def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Application:
     tracer = tracer or Tracer("brain", emit=False)
     app = web.Application()
-    # The engine owns one KV cache and RNG; concurrent parses on a shared
-    # backend must serialize (batched concurrency belongs to the scheduler,
-    # not to racing threads over one cache).
-    parse_lock = threading.Lock()
+    # A single-slot engine owns one KV cache and RNG, so concurrent parses
+    # must serialize. A concurrent-safe parser (BatchedEngineParser) does
+    # its own admission control — requests run truly concurrently, sharing
+    # decode chunks on device.
+    if getattr(parser, "concurrent_safe", False):
+        locked_parse = parser.parse
+        # aiohttp's default executor caps at min(32, cpus+4) threads; each
+        # parse blocks a thread in fut.result(), so the pool must cover the
+        # engine's batch width or the batcher never fills its slots
+        slots = getattr(getattr(parser, "engine", None), "batch_slots", 8)
+        from concurrent.futures import ThreadPoolExecutor
 
-    def locked_parse(text: str, context: dict) -> ParseResponse:
-        with parse_lock:
-            return parser.parse(text, context)
+        parse_pool = ThreadPoolExecutor(
+            max_workers=max(8, slots + 4), thread_name_prefix="parse"
+        )
+    else:
+        parse_pool = None
+        parse_lock = threading.Lock()
+
+        def locked_parse(text: str, context: dict) -> ParseResponse:
+            with parse_lock:
+                return parser.parse(text, context)
 
     async def health(_req: web.Request) -> web.Response:
-        return web.json_response({"ok": True, "service": "brain"})
+        body = {"ok": True, "service": "brain"}
+        probe = getattr(parser, "healthy", None)
+        if probe is not None:
+            body["worker_alive"] = bool(probe())
+            body["ok"] = body["worker_alive"]
+        return web.json_response(body, status=200 if body["ok"] else 503)
 
     async def parse(req: web.Request) -> web.Response:
         trace_id = req.headers.get("x-trace-id", new_trace_id())
@@ -191,7 +278,7 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
         try:
             with tracer.span("parse", trace_id=trace_id, chars=len(preq.text)):
                 resp = await loop.run_in_executor(
-                    None, locked_parse, preq.text, preq.context
+                    parse_pool, locked_parse, preq.text, preq.context
                 )
         except ParserError as e:
             status = 422 if e.kind == "schema_validation_failed" else 500
@@ -217,18 +304,33 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
     return app
 
 
+def _wrap_engine(engine) -> IntentParser:
+    """Prefix-cache the shared prompt head, then pick the serving shape:
+    BRAIN_BATCH>1 puts the continuous batcher behind /parse (concurrent
+    requests share decode chunks); otherwise the serialized single-slot
+    parser. BRAIN_PREFIX=0 disables the prefix cache (debugging)."""
+    if os.environ.get("BRAIN_PREFIX", "1") != "0":
+        install_prompt_prefix(engine)
+    if engine.batch_slots > 1:
+        chunk = int(os.environ.get("BRAIN_CHUNK", "16"))
+        return BatchedEngineParser(engine, chunk_steps=chunk)
+    return EngineParser(engine)
+
+
 def make_parser_from_env() -> IntentParser:
     """BRAIN_BACKEND=rule (default) | engine[:preset] (random init).
     BRAIN_MODEL=<HF checkpoint dir> overrides both: the engine serves the
     checkpoint's weights with its own tokenizer (the real replacement for
     the reference's LLM_BASE_URL/LLM_MODEL env, apps/brain/src/llm.ts:7-9).
-    BRAIN_QUANT=int8 enables weight-only quantization for the loaded model."""
+    BRAIN_QUANT=int8 enables weight-only quantization for the loaded model.
+    BRAIN_BATCH=N (default 1) serves N continuous-batching slots."""
+    slots = int(os.environ.get("BRAIN_BATCH", "1"))
     model_dir = os.environ.get("BRAIN_MODEL")
     if model_dir:
         from ..serve import DecodeEngine
 
         quant = os.environ.get("BRAIN_QUANT") or None
-        return EngineParser(DecodeEngine.from_hf(model_dir, quant=quant))
+        return _wrap_engine(DecodeEngine.from_hf(model_dir, quant=quant, batch_slots=slots))
     backend = os.environ.get("BRAIN_BACKEND", "rule")
     if backend == "rule":
         return RuleBasedParser()
@@ -236,7 +338,7 @@ def make_parser_from_env() -> IntentParser:
         from ..serve import DecodeEngine
 
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
-        return EngineParser(DecodeEngine(preset=preset))
+        return _wrap_engine(DecodeEngine(preset=preset, batch_slots=slots))
     raise ValueError(f"unknown BRAIN_BACKEND {backend!r}")
 
 

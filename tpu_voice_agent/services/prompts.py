@@ -141,16 +141,20 @@ def fewshot_messages() -> list[dict]:
     return msgs
 
 
+def prompt_prefix() -> str:
+    """The request-invariant prompt head (system + few-shots + user tag).
+    Identical for every /parse call, which makes it the shared-prefix cache
+    unit: the engine prefills it once and per-request prefill touches only
+    the suffix returned by ``render_prompt`` minus this string."""
+    parts = [f"<|{m['role']}|>\n{m['content']}" for m in fewshot_messages()]
+    return "\n".join(parts) + "\n<|user|>\n"
+
+
 def render_prompt(text: str, context: dict) -> str:
     """Flatten chat messages into the plain-text prompt format used by the
     in-tree decoder (no chat template dependency)."""
-    parts = []
-    for m in fewshot_messages():
-        parts.append(f"<|{m['role']}|>\n{m['content']}")
     user = json.dumps({"text": text, "context": context}, separators=(",", ":"))
-    parts.append(f"<|user|>\n{user}")
-    parts.append("<|assistant|>\n")
-    return "\n".join(parts)
+    return prompt_prefix() + user + "\n<|assistant|>\n"
 
 
 def corpus_for_tokenizer() -> list[str]:
