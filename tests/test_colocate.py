@@ -83,3 +83,27 @@ def test_worker_thread_serves_both(stt_engine, tiny_batch_engine):
         assert parse_fut.result(timeout=300).error is None
     finally:
         co.stop()
+
+
+def test_abandon_parse_dequeues_without_racing_worker(tiny_batch_engine):
+    """A timed-out request must be dequeued (tombstone applied on the worker
+    step path) and its orphaned result purged — overload cannot accumulate
+    abandoned work. The surviving request still completes."""
+    co = ColocatedServing(None, ContinuousBatcher(tiny_batch_engine, chunk_steps=8,
+                                                  max_new_tokens=64))
+    keep = co.submit_parse(_prompt("search for keyboards"))
+    drop = co.submit_parse(_prompt("take a screenshot"))
+    co.abandon_parse(drop)
+    co.drain(timeout_s=300)
+    assert keep.result(timeout=1) is not None
+    assert drop.cancelled()
+    # nothing left behind: no pending work, no orphaned futures or results
+    assert not co.batcher.pending
+    assert not co._parse_futs
+    assert not co.batcher.results
+
+
+def test_stt_less_runtime_rejects_stt_jobs(tiny_batch_engine):
+    co = ColocatedServing(None, ContinuousBatcher(tiny_batch_engine, chunk_steps=8))
+    with pytest.raises(RuntimeError):
+        co.submit_stt(_audio())

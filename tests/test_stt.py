@@ -46,8 +46,68 @@ def test_streaming_emits_final_on_endpoint(engine):
     assert len(stt._buf) == 0
 
 
+def test_incremental_feed_accumulates_and_decodes(engine):
+    """2 s of audio -> four 0.5 s blocks -> enc buffer full (whisper-test
+    enc_positions=100 = 4 x 25); decode is deterministic over the buffer."""
+    st = engine.incremental_init()
+    buf = tone(440, 2.0)  # 200 mel frames
+    st = engine.incremental_feed(st, buf)
+    assert st.consumed_frames == 200
+    assert st.enc_len == 100
+    res = engine.incremental_decode(st)
+    st2 = engine.incremental_feed(engine.incremental_init(), buf)
+    assert engine.incremental_decode(st2).text == res.text
+
+
+def test_incremental_split_feeds_match_single_feed(engine):
+    """Feeding the stream in pieces must produce the same encoder state and
+    transcript as feeding it at once (same blocks, same positions)."""
+    buf = tone(440, 1.0)  # 100 mel frames -> 2 blocks
+    st = engine.incremental_init()
+    st = engine.incremental_feed(st, buf[:8000])
+    st = engine.incremental_feed(st, buf)
+    st_once = engine.incremental_feed(engine.incremental_init(), buf)
+    assert st.enc_len == st_once.enc_len == 50
+    assert engine.incremental_decode(st).text == engine.incremental_decode(st_once).text
+
+
+def test_streaming_partials_ride_the_incremental_path(engine):
+    stt = StreamingSTT(
+        engine,
+        partial_interval_s=0.2,
+        endpointer=EnergyEndpointer(trailing_silence_ms=200, min_speech_ms=100),
+    )
+    for i in range(4):
+        stt.feed(tone(300 + 40 * i, 0.3))
+    assert stt._inc is not None and stt._inc.enc_len > 0
+    stt.feed(np.zeros(8_000, dtype=np.float32))  # endpoint closes the utterance
+    assert len(stt._buf) == 0 and stt._inc is None
+
+
 def test_null_stt_scripted():
     stt = NullSTT(scripted=[("final", "search for shoes")])
     events = stt.feed(np.zeros(160, dtype=np.float32))
     assert events == [("final", "search for shoes")]
     assert stt.feed(np.zeros(160, dtype=np.float32)) == []
+
+
+def test_incremental_long_utterance_reanchors_instead_of_freezing(engine):
+    """An utterance longer than the cross-KV budget must keep producing
+    fresh partials: the state re-anchors on the most recent window (the
+    round-1-review failure mode was a silent freeze at the budget)."""
+    st = engine.incremental_init()
+    st = engine.incremental_feed(st, tone(440, 4.0))  # 400 mel >> 2 s budget
+    assert st.consumed_frames == 400  # consumption never stalled
+    assert 0 < st.enc_len <= engine.cfg.enc_positions
+    assert st.anchor_frames > 0
+    assert engine.incremental_decode(st).n_frames == 400
+
+
+def test_incremental_init_anchors_past_stale_silence(engine):
+    """Pre-speech buffer content beyond one window is skipped at init, so
+    buffered silence cannot spend the cross-KV budget."""
+    total = 500  # mel frames already buffered
+    st = engine.incremental_init(total)
+    assert st.anchor_frames == max(0, total - engine.cfg.enc_positions)
+    st = engine.incremental_feed(st, tone(440, 5.0))
+    assert st.enc_len > 0 and st.consumed_frames == 500

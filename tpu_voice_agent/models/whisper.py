@@ -160,14 +160,21 @@ def _proj(x, w, b=None):
 
 @partial(jax.jit, static_argnames=("cfg", "rules", "attn_impl"))
 def encoder_forward(
-    params: dict, cfg: WhisperConfig, mel: jax.Array, rules=None, attn_impl: str = "xla"
+    params: dict, cfg: WhisperConfig, mel: jax.Array, rules=None, attn_impl: str = "xla",
+    pos_offset: jax.Array | None = None,
 ) -> jax.Array:
     """mel (B, T, n_mels) -> (B, T//2, d_model). T must equal max_audio_frames
     for the bucket being compiled (pad with the mel floor).
 
     ``attn_impl="pallas"`` routes self-attention through ops.flash_attention
     (non-causal) — the encoder's (T/2)^2 attention is the dominant cost at
-    whisper-large's 1500 frames."""
+    whisper-large's 1500 frames.
+
+    ``pos_offset`` (scalar, encoder-frame units) places this block's
+    sinusoidal positions at its true offset inside the utterance — the
+    incremental streaming path (serve.stt.SpeechEngine.incremental_feed)
+    encodes ~0.5 s blocks with block-local attention instead of
+    re-encoding the whole window per partial."""
     p = params["encoder"]
     cs = lambda x, name: rules.constrain(x, name) if rules is not None else x
     dn = ("NWC", "WIO", "NWC")
@@ -180,7 +187,11 @@ def encoder_forward(
     ) + p["conv2"]["b"]
     x = jax.nn.gelu(x)  # (B, T//2, d)
     T2 = x.shape[1]
-    pos = jnp.asarray(_sinusoid_pos(cfg.enc_positions, cfg.d_model))[:T2]
+    table = jnp.asarray(_sinusoid_pos(cfg.enc_positions, cfg.d_model))
+    if pos_offset is None:
+        pos = table[:T2]
+    else:
+        pos = jax.lax.dynamic_slice_in_dim(table, pos_offset, T2, axis=0)
     x = (x + pos.astype(x.dtype)[None])
     x = cs(x, "act")
 

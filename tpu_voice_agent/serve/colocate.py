@@ -70,6 +70,7 @@ class ColocatedServing:
         self._work = threading.Condition(self._lock)
         self._stt_q: list[tuple[np.ndarray, Future]] = []
         self._parse_futs: dict[int, Future] = {}
+        self._abandoned: set[int] = set()  # tombstones applied by step()
         self._thread: threading.Thread | None = None
         self._stop = False
 
@@ -98,18 +99,19 @@ class ColocatedServing:
         return fut
 
     def abandon_parse(self, fut: Future) -> None:
-        """Give up on a submitted parse (caller timed out): dequeue it if
-        still pending and drop its future, so overload does not accumulate
-        work nobody will read. A request already decoding in a slot runs to
-        its (bounded) finish; its orphaned result is purged at harvest."""
+        """Give up on a submitted parse (caller timed out): drop its future
+        and tombstone the request id, so overload does not accumulate work
+        nobody will read. The tombstone is applied by step() on the WORKER
+        thread — the only thread that touches batcher.pending — so the
+        dequeue cannot race the worker's own pending.pop(0). A request
+        already decoding in a slot runs to its (bounded) finish; its
+        orphaned result is purged at harvest."""
         rid = getattr(fut, "request_id", None)
         if rid is None:
             return
         with self._lock:
             self._parse_futs.pop(rid, None)
-            self.batcher.pending = [
-                (r, p) for (r, p) in self.batcher.pending if r != rid
-            ]
+            self._abandoned.add(rid)
         fut.cancel()
 
     # ------------------------------------------------------------ core
@@ -127,9 +129,15 @@ class ColocatedServing:
         with self._lock:
             stt_jobs = list(self._stt_q)
             self._stt_q.clear()
+            tombs, self._abandoned = self._abandoned, set()
             # pre-drain depths: what a scrape should see as backlog
             get_metrics().set_gauge("colocate.stt_queue", len(stt_jobs))
             get_metrics().set_gauge("colocate.parse_inflight", len(self._parse_futs))
+        if tombs:
+            # worker thread owns batcher.pending; safe to rewrite here
+            self.batcher.pending = [
+                (r, p) for (r, p) in self.batcher.pending if r not in tombs
+            ]
         did = False
 
         for audio, fut in stt_jobs:  # priority lane

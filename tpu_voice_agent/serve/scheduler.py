@@ -30,14 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .engine import (
-    DecodeEngine,
-    GenerationResult,
-    _first_token,
-    chunk_decode_loop,
-    prefill_row,
-    prefill_row_with_prefix,
-)
+from .engine import DecodeEngine, GenerationResult, _first_token, chunk_decode_loop
 
 
 
@@ -125,34 +118,7 @@ class ContinuousBatcher:
         t0 = time.perf_counter()
         ids = eng.tokenizer.encode(prompt, bos=True)
         n = len(ids)
-        suffix = eng._split_prefix(ids)
-        if suffix is not None:
-            bucket = eng._suffix_bucket(len(suffix), eng.max_len - len(eng.prefix_ids))
-            if bucket is None:
-                suffix = None  # no suffix bucket fits; full prefill below
-        if suffix is not None:
-            P, m = len(eng.prefix_ids), len(suffix)
-            tokens = np.full((1, bucket), eng.pad_id, dtype=np.int32)
-            tokens[0, :m] = suffix
-            positions = (P + np.arange(bucket, dtype=np.int32))[None, :]
-            logits, eng.cache = prefill_row_with_prefix(
-                eng.params, eng.cfg, eng.cache,
-                eng.prefix_kv["k"], eng.prefix_kv["v"],
-                jnp.asarray(tokens), jnp.asarray(positions), jnp.int32(slot),
-                rules=eng.rules, kernels=eng.kernels,
-            )
-            last_logits = logits[:, m - 1, :]
-        else:
-            bucket = eng._bucket(n)
-            tokens = np.full((1, bucket), eng.pad_id, dtype=np.int32)
-            tokens[0, :n] = ids
-            positions = np.arange(bucket, dtype=np.int32)[None, :]
-            logits, eng.cache = prefill_row(
-                eng.params, eng.cfg, eng.cache,
-                jnp.asarray(tokens), jnp.asarray(positions), jnp.int32(slot),
-                rules=eng.rules, kernels=eng.kernels, fresh=True,
-            )
-            last_logits = logits[:, n - 1, :]
+        last_logits = eng.prefill_slot(ids, slot)
         self._rng, k = jax.random.split(self._rng)
         start_state = jnp.full((1,), self.engine.fsm.start, dtype=jnp.int32)
         tok0, fsm0 = _first_token(

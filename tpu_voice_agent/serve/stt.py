@@ -106,6 +106,31 @@ class TranscribeResult:
     n_frames: int
 
 
+@partial(jax.jit, donate_argnames=("buf_k", "buf_v"))
+def _append_cross_kv(buf_k, buf_v, new_k, new_v, offset):
+    """Append one encoded block's cross-KV into the utterance buffer at
+    `offset` (encoder frames). Donated: the update happens in place."""
+    start = (0, 0, offset, 0, 0)
+    return (jax.lax.dynamic_update_slice(buf_k, new_k, start),
+            jax.lax.dynamic_update_slice(buf_v, new_v, start))
+
+
+@dataclass
+class IncrementalState:
+    """Streaming encoder state: the utterance's accumulated cross-attention
+    KV plus host-side frame accounting. Partial transcription cost becomes
+    O(new audio): each ~0.5 s block is encoded once (block-local attention
+    at its true positions) and only its cross-KV is appended; the decoder
+    then runs over the accumulated buffer. Finals still re-encode the whole
+    window with full bidirectional attention (exact)."""
+
+    cross_k: jax.Array  # (L, 1, enc_positions, nh, hd)
+    cross_v: jax.Array
+    enc_len: int = 0  # valid encoder frames
+    consumed_frames: int = 0  # mel frames consumed from the utterance buffer
+    anchor_frames: int = 0  # buffer frame treated as utterance position 0
+
+
 class SpeechEngine:
     """Whisper encoder-decoder with audio-length buckets."""
 
@@ -197,6 +222,87 @@ class SpeechEngine:
                 return b
         return self.frame_buckets[-1]
 
+    # ------------------------------------------------------ incremental
+
+    # mel frames per incremental encode block (0.5 s) and the re-encoded
+    # left context carried for conv/attention continuity at block joins
+    INC_STEP = 50
+    INC_LOOKBACK = 20
+
+    def incremental_init(self, total_frames: int = 0) -> IncrementalState:
+        """Fresh streaming state. ``total_frames`` = mel frames already in
+        the utterance buffer: consumption anchors at most one window
+        (enc_positions mel frames) back, so retained pre-speech silence
+        cannot spend the cross-KV budget before speech is reached."""
+        L, nh, hd = self.cfg.dec_layers, self.cfg.n_heads, self.cfg.head_dim
+        z = jnp.zeros((L, 1, self.cfg.enc_positions, nh, hd), jnp.bfloat16)
+        anchor = max(0, total_frames - self.cfg.enc_positions) & ~1  # even
+        return IncrementalState(cross_k=z, cross_v=jnp.zeros_like(z),
+                                consumed_frames=anchor, anchor_frames=anchor)
+
+    def incremental_feed(self, state: IncrementalState, buf: np.ndarray) -> IncrementalState:
+        """Encode any complete new INC_STEP blocks of `buf` (the utterance
+        audio so far) into the state's cross-KV. Each block re-encodes
+        INC_LOOKBACK frames of left context (dropped from the output) so
+        the conv frontend and block attention see real history; positions
+        are the block's offset from the state's anchor. O(new audio) per
+        call; when an utterance outgrows the cross-KV budget the state
+        re-anchors on the most recent window (one bounded re-encode burst)
+        instead of silently freezing."""
+        hop = self.mel_cfg.hop
+        step, lb = self.INC_STEP, self.INC_LOOKBACK
+        total = len(buf) // hop
+        while total - state.consumed_frames >= step:
+            if state.enc_len + step // 2 > self.cfg.enc_positions:
+                state = self.incremental_init(total)
+                continue
+            c = state.consumed_frames
+            start = max(state.anchor_frames, c - lb)
+            n_window = c + step - start  # 50 (anchor block) or 70: two compiles
+            audio = buf[start * hop:(c + step) * hop].astype(np.float32)
+            mel = log_mel_spectrogram(jnp.asarray(audio), self.mel_cfg)[None, :n_window]
+            enc = encoder_forward(self.params, self.cfg, mel,
+                                  attn_impl=self.kernels,
+                                  pos_offset=jnp.int32((start - state.anchor_frames) // 2))
+            kv = compute_cross_kv(self.params, self.cfg, enc)
+            drop = (c - start) // 2  # lookback outputs: context only
+            keep = step // 2
+            new_k = jax.lax.dynamic_slice_in_dim(kv["k"], drop, keep, axis=2)
+            new_v = jax.lax.dynamic_slice_in_dim(kv["v"], drop, keep, axis=2)
+            ck, cv = _append_cross_kv(state.cross_k, state.cross_v, new_k, new_v,
+                                      jnp.int32(state.enc_len))
+            state = IncrementalState(
+                cross_k=ck, cross_v=cv,
+                enc_len=state.enc_len + keep,
+                consumed_frames=c + step,
+                anchor_frames=state.anchor_frames,
+            )
+        return state
+
+    def incremental_decode(self, state: IncrementalState) -> TranscribeResult:
+        """Greedy decode over the accumulated cross-KV (one dispatch chain,
+        one combined device_get — same tunnel discipline as transcribe)."""
+        t0 = time.perf_counter()
+        valid = jnp.arange(self.cfg.enc_positions)[None, :] < state.enc_len
+        cache = init_self_cache(self.cfg, 1)
+        bos = jnp.asarray(list(self.bos_ids), dtype=jnp.int32)[None, :]
+        out, n, _ = _stt_decode_loop(
+            self.params, self.cfg, cache,
+            {"k": state.cross_k, "v": state.cross_v}, valid, bos, self.suppress,
+            max_new=self.max_new_tokens, eos_id=self.eos_id, pad_id=self.pad_id,
+            attn_impl=self.kernels,
+        )
+        out_h, n_a = jax.device_get((out, n))
+        n_h = int(n_a[0])
+        ids = [int(t) for t in np.asarray(out_h)[0, :n_h]]
+        decode_ms = (time.perf_counter() - t0) * 1e3
+        return TranscribeResult(
+            text=self.tokenizer.decode(ids).strip(),
+            encode_ms=0.0,  # encode cost was paid incrementally in feed()
+            decode_ms=decode_ms,
+            n_frames=state.consumed_frames,
+        )
+
     def transcribe(self, audio: np.ndarray) -> TranscribeResult:
         """audio: float32 mono 16 kHz. Longer than the top bucket -> keep the
         most recent window (streaming semantics)."""
@@ -252,16 +358,23 @@ class StreamingSTT:
         engine: SpeechEngine,
         partial_interval_s: float = 0.5,
         endpointer: EnergyEndpointer | None = None,
+        incremental: bool = True,
     ):
         self.engine = engine
         self.partial_interval_s = partial_interval_s
         self.endpointer = endpointer or EnergyEndpointer(sample_rate=engine.mel_cfg.sample_rate)
+        # incremental=True: partials ride the streaming encoder (O(new
+        # audio) per partial instead of re-encoding the whole window —
+        # SURVEY.md §7 hard part 2); finals always re-encode exactly
+        self.incremental = incremental
+        self._inc: IncrementalState | None = None
         self._buf = np.zeros(0, dtype=np.float32)
         self._since_partial = 0.0
 
     def reset(self) -> None:
         self._buf = np.zeros(0, dtype=np.float32)
         self._since_partial = 0.0
+        self._inc = None
         self.endpointer.reset()
 
     def feed(self, samples: np.ndarray) -> list[tuple[str, str]]:
@@ -273,22 +386,37 @@ class StreamingSTT:
 
         # bound the buffer: outside speech only the top transcription window
         # matters, so an open mic on silence cannot grow memory (and each
-        # append stays O(window), not O(session))
+        # append stays O(window), not O(session)). The trim invalidates
+        # incremental frame accounting, so that state resets with it
+        # (outside speech it holds nothing worth keeping).
         max_samples = self.engine.frame_buckets[-1] * self.engine.mel_cfg.hop
         if not self.endpointer.in_speech and len(self._buf) > max_samples:
             self._buf = self._buf[-max_samples:]
+            self._inc = None
 
         if ended:
+            # final: exact full-window transcription (bidirectional encoder)
             res = self.engine.transcribe(self._buf)
             if res.text:
                 events.append(("final", res.text))
             self._buf = np.zeros(0, dtype=np.float32)
             self._since_partial = 0.0
+            self._inc = None
         elif self.endpointer.in_speech and self._since_partial >= self.partial_interval_s:
             self._since_partial = 0.0
-            res = self.engine.transcribe(self._buf)
-            if res.text:
-                events.append(("partial", res.text))
+            if self.incremental:
+                if self._inc is None:
+                    self._inc = self.engine.incremental_init(
+                        len(self._buf) // self.engine.mel_cfg.hop)
+                self._inc = self.engine.incremental_feed(self._inc, self._buf)
+                if self._inc.enc_len > 0:
+                    res = self.engine.incremental_decode(self._inc)
+                    if res.text:
+                        events.append(("partial", res.text))
+            else:
+                res = self.engine.transcribe(self._buf)
+                if res.text:
+                    events.append(("partial", res.text))
         return events
 
 

@@ -122,8 +122,6 @@ class BatchedEngineParser:
         fut = self.runtime.submit_parse(prompt)
         try:
             res = fut.result(timeout=self.timeout_s)
-        except ParserError:
-            raise
         except TimeoutError as e:
             # dequeue the abandoned request so overload can't pile up work
             # nobody will read (pending entries are dropped immediately; a
