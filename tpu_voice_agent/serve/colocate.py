@@ -129,15 +129,17 @@ class ColocatedServing:
         with self._lock:
             stt_jobs = list(self._stt_q)
             self._stt_q.clear()
-            tombs, self._abandoned = self._abandoned, set()
+            if self._abandoned:
+                # filter under the lock: submit_parse appends to pending from
+                # caller threads (same lock), and this runs on the worker
+                # thread so it cannot race the worker's own pending.pop(0)
+                tombs, self._abandoned = self._abandoned, set()
+                self.batcher.pending = [
+                    (r, p) for (r, p) in self.batcher.pending if r not in tombs
+                ]
             # pre-drain depths: what a scrape should see as backlog
             get_metrics().set_gauge("colocate.stt_queue", len(stt_jobs))
             get_metrics().set_gauge("colocate.parse_inflight", len(self._parse_futs))
-        if tombs:
-            # worker thread owns batcher.pending; safe to rewrite here
-            self.batcher.pending = [
-                (r, p) for (r, p) in self.batcher.pending if r not in tombs
-            ]
         did = False
 
         for audio, fut in stt_jobs:  # priority lane

@@ -15,6 +15,7 @@ Design:
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from dataclasses import dataclass, replace
 from functools import partial
@@ -235,7 +236,10 @@ class SpeechEngine:
         (enc_positions mel frames) back, so retained pre-speech silence
         cannot spend the cross-KV budget before speech is reached."""
         L, nh, hd = self.cfg.dec_layers, self.cfg.n_heads, self.cfg.head_dim
-        z = jnp.zeros((L, 1, self.cfg.enc_positions, nh, hd), jnp.bfloat16)
+        # dynamic_update_slice needs exact dtype agreement with the blocks
+        # compute_cross_kv emits (enc_out dtype = params dtype)
+        dtype = self.params["decoder"]["tok_emb"].dtype if self.params else jnp.bfloat16
+        z = jnp.zeros((L, 1, self.cfg.enc_positions, nh, hd), dtype)
         anchor = max(0, total_frames - self.cfg.enc_positions) & ~1  # even
         return IncrementalState(cross_k=z, cross_v=jnp.zeros_like(z),
                                 consumed_frames=anchor, anchor_frames=anchor)
@@ -281,14 +285,21 @@ class SpeechEngine:
 
     def incremental_decode(self, state: IncrementalState) -> TranscribeResult:
         """Greedy decode over the accumulated cross-KV (one dispatch chain,
-        one combined device_get — same tunnel discipline as transcribe)."""
-        t0 = time.perf_counter()
+        one combined device_get — same tunnel discipline as transcribe).
+        encode_ms is 0: the encode cost was paid incrementally in feed()."""
         valid = jnp.arange(self.cfg.enc_positions)[None, :] < state.enc_len
+        return self._decode({"k": state.cross_k, "v": state.cross_v}, valid,
+                            state.consumed_frames)
+
+    def _decode(self, cross_kv: dict, enc_mask, n_frames: int) -> TranscribeResult:
+        """Shared decode tail: greedy loop over cross-KV -> transcript.
+        One combined device_get; used by transcribe() and the streaming
+        partial path so the two can never diverge."""
+        t0 = time.perf_counter()
         cache = init_self_cache(self.cfg, 1)
         bos = jnp.asarray(list(self.bos_ids), dtype=jnp.int32)[None, :]
         out, n, _ = _stt_decode_loop(
-            self.params, self.cfg, cache,
-            {"k": state.cross_k, "v": state.cross_v}, valid, bos, self.suppress,
+            self.params, self.cfg, cache, cross_kv, enc_mask, bos, self.suppress,
             max_new=self.max_new_tokens, eos_id=self.eos_id, pad_id=self.pad_id,
             attn_impl=self.kernels,
         )
@@ -298,9 +309,9 @@ class SpeechEngine:
         decode_ms = (time.perf_counter() - t0) * 1e3
         return TranscribeResult(
             text=self.tokenizer.decode(ids).strip(),
-            encode_ms=0.0,  # encode cost was paid incrementally in feed()
+            encode_ms=0.0,
             decode_ms=decode_ms,
-            n_frames=state.consumed_frames,
+            n_frames=n_frames,
         )
 
     def transcribe(self, audio: np.ndarray) -> TranscribeResult:
@@ -317,8 +328,9 @@ class SpeechEngine:
         padded[: len(audio)] = audio
 
         # encode + decode stay in ONE async dispatch chain with a single
-        # combined device_get at the end: a mid-flight block costs a full
-        # tunnel round trip (~70 ms on axon). encode_ms is dispatch-side.
+        # combined device_get at the end (inside _decode): a mid-flight
+        # block costs a full tunnel round trip (~70 ms on axon), so
+        # encode_ms is dispatch-side.
         t0 = time.perf_counter()
         mel = log_mel_spectrogram(jnp.asarray(padded), self.mel_cfg)[None, :bucket]
         enc_out = encoder_forward(self.params, self.cfg, mel, attn_impl=self.kernels)
@@ -326,24 +338,8 @@ class SpeechEngine:
         valid = jnp.arange(enc_out.shape[1])[None, :] < max(1, n_frames // 2)
         encode_ms = (time.perf_counter() - t0) * 1e3
 
-        t1 = time.perf_counter()
-        cache = init_self_cache(self.cfg, 1)
-        bos = jnp.asarray(list(self.bos_ids), dtype=jnp.int32)[None, :]
-        out, n, _ = _stt_decode_loop(
-            self.params, self.cfg, cache, cross_kv, valid, bos, self.suppress,
-            max_new=self.max_new_tokens, eos_id=self.eos_id, pad_id=self.pad_id,
-            attn_impl=self.kernels,
-        )
-        out_h, n_a = jax.device_get((out, n))
-        n_h = int(n_a[0])
-        ids = [int(t) for t in np.asarray(out_h)[0, :n_h]]
-        decode_ms = (time.perf_counter() - t1) * 1e3
-        return TranscribeResult(
-            text=self.tokenizer.decode(ids).strip(),
-            encode_ms=encode_ms,
-            decode_ms=decode_ms,
-            n_frames=n_frames,
-        )
+        res = self._decode(cross_kv, valid, n_frames)
+        return dataclasses.replace(res, encode_ms=encode_ms)
 
 
 class StreamingSTT:

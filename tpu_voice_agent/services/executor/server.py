@@ -20,7 +20,27 @@ from .actions import run_intents
 from .session import SessionManager
 
 
-def build_app(manager: SessionManager | None = None, tracer: Tracer | None = None) -> web.Application:
+def make_grounder_from_env():
+    """EXECUTOR_GROUNDING env -> Grounder | None.
+
+    ``qwen2vl[:preset]`` builds the lazy TPU-backed screenshot grounder
+    (serve.grounding.GroundingEngine); unset/empty disables grounding, in
+    which case unmatched click targets fall through to the plain text-click
+    path exactly as the reference's DOM-only analyzer would
+    (apps/executor/src/dom-analyzer.ts:34-448)."""
+    spec = os.environ.get("EXECUTOR_GROUNDING", "").strip()
+    if not spec:
+        return None
+    name, _, preset = spec.partition(":")
+    if name == "qwen2vl":
+        from .grounding import TPUGrounder
+
+        return TPUGrounder(preset=preset or "qwen2vl-7b")
+    raise ValueError(f"unknown EXECUTOR_GROUNDING {spec!r}")
+
+
+def build_app(manager: SessionManager | None = None, tracer: Tracer | None = None,
+              grounder=None) -> web.Application:
     manager = manager or SessionManager()
     tracer = tracer or Tracer("executor", emit=False)
     app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -59,6 +79,7 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
                         session.artifacts_dir,
                         ereq.intents,
                         uploads_dir=manager.uploads_dir,
+                        grounder=grounder,
                     )
                 return session, results
 
@@ -124,7 +145,7 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
 def main() -> None:
     load_env_cascade()
     port = int(os.environ.get("EXECUTOR_PORT", "7081"))
-    app = build_app(tracer=Tracer("executor"))
+    app = build_app(tracer=Tracer("executor"), grounder=make_grounder_from_env())
     web.run_app(app, port=port)
 
 
