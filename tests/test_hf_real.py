@@ -460,3 +460,39 @@ class TestFromHF:
         shapes = safetensors_shapes(str(hf_checkpoint_dir))
         cfg = llama_config_from_hf(str(hf_checkpoint_dir))
         llama_hf_check(shapes, cfg)
+
+
+def test_from_hf_on_mesh_pads_vocab_to_tp_multiple(hf_checkpoint_dir):
+    """from_hf on a dp×tp mesh whose tp does NOT divide the checkpoint
+    vocab: the engine pads the model vocab (and the checkpoint's embed and
+    lm_head) to a tp multiple, and constrained decode still emits
+    schema-valid JSON with the pallas kernels shard_map'd over the mesh."""
+    import jax
+
+    from tpu_voice_agent.parallel.mesh import make_mesh
+    from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+
+    ckpt_vocab = json.loads((hf_checkpoint_dir / "config.json").read_text())["vocab_size"]
+    # tp must divide heads/ffn of the tiny checkpoint (4 heads, 128 ffn) but
+    # NOT the vocab, so the padding branch actually triggers
+    tp = next((t for t in (4, 2) if ckpt_vocab % t), None)
+    if tp is None:
+        pytest.skip(f"checkpoint vocab {ckpt_vocab} divisible by 2 and 4")
+    mesh = make_mesh(dp=2, tp=tp, devices=jax.devices()[: 2 * tp])
+    eng = DecodeEngine.from_hf(
+        str(hf_checkpoint_dir), mesh=mesh, batch_slots=2, max_len=4096,
+        prefill_buckets=(1024, 2048, 4096), kernels="pallas",
+    )
+    assert eng.cfg.vocab_size % tp == 0
+    assert eng.cfg.vocab_size > ckpt_vocab  # padding actually triggered
+    assert eng.params["embed"].shape[0] == eng.cfg.vocab_size
+    assert eng.params["lm_head"].shape[1] == eng.cfg.vocab_size
+
+    b = ContinuousBatcher(eng, chunk_steps=16, max_new_tokens=1200)
+    res = b.generate_many([render_prompt("go back", {})])[0]
+    assert res.error is None, res.error
+    assert eng.fsm.walk(res.token_ids) >= 0, "mesh decode left the grammar"
+    if res.finished:
+        model, err = parse_response_from_json(res.text)
+        assert model is not None, err

@@ -1,0 +1,95 @@
+"""shard_map'd Pallas kernels on a dp×tp mesh (VERDICT round-1 next #4).
+
+Round 1's kernels were bare pallas_calls: on a mesh GSPMD replicated their
+operands, so the v5e-8 target couldn't use them. These tests hold the
+sharded wrappers to bit-level agreement with the single-device kernels on
+8 virtual CPU devices (kernels run under interpret=True on CPU).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.ops import (
+    decode_attention,
+    flash_attention,
+    masked_argmax,
+    sharded_decode_attention,
+    sharded_flash_attention,
+    sharded_masked_argmax,
+)
+from tpu_voice_agent.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return make_mesh(dp=2, tp=2)
+
+
+def test_sharded_decode_attention_matches_single_device(mesh):
+    B, S, nq, nkv, hd = 4, 64, 8, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, nq, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, nkv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, nkv, hd), jnp.float32)
+    kv_len = jnp.asarray([5, 17, 33, 64], jnp.int32)
+    ref = decode_attention(q, k, v, kv_len)
+    out = sharded_decode_attention(mesh, q, k, v, kv_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_decode_attention_tp_indivisible_heads_replicates(mesh):
+    # nkv=3 not divisible by tp=2: heads fall back to replicated (dp only)
+    B, S, nq, nkv, hd = 2, 32, 6, 3, 16
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, nq, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, nkv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, nkv, hd), jnp.float32)
+    kv_len = jnp.asarray([10, 32], jnp.int32)
+    ref = decode_attention(q, k, v, kv_len)
+    out = sharded_decode_attention(mesh, q, k, v, kv_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_flash_attention_matches_single_device(mesh):
+    B, T, nq, nkv, hd = 2, 32, 8, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, T, nq, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, nkv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, nkv, hd), jnp.float32)
+    ref = flash_attention(q, k, v, causal=True)
+    out = sharded_flash_attention(mesh, q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_masked_argmax_matches_single_device(mesh):
+    B, V, S = 4, 512, 7
+    key = jax.random.PRNGKey(3)
+    logits = jax.random.normal(key, (B, V), jnp.float32)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(4), 0.3, (S, V))
+    mask = mask.at[:, 0].set(True)  # every state keeps >= 1 legal token
+    state = jnp.asarray([0, 2, 5, 6], jnp.int32)
+    ref = masked_argmax(logits, state, mask)
+    out = sharded_masked_argmax(mesh, logits, state, mask)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_mesh_engine_accepts_pallas_kernels(mesh):
+    """kernels='pallas' on a dp×tp mesh compiles and produces grammar-valid
+    output (round 1 raised ValueError here)."""
+    from tpu_voice_agent.serve import DecodeEngine
+
+    eng = DecodeEngine(preset="test-tiny", mesh=mesh, batch_slots=2, max_len=1024,
+                       prefill_buckets=(512, 1024), kernels="pallas")
+    res = eng_generate_one(eng)
+    state = eng.fsm.walk(res.token_ids)
+    assert state >= 0, "mesh+pallas decode left the grammar"
+
+
+def eng_generate_one(eng):
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=48)
+    return b.generate_many(["<|user|>\nsearch for mice\n<|assistant|>\n"])[0]

@@ -155,12 +155,14 @@ class Tokenizer:
         return ids
 
     def decode(self, ids: list[int]) -> str:
-        out = b"".join(self.piece_bytes[i] for i in ids if i >= len(SPECIALS))
+        out = b"".join(self.token_bytes(i) for i in ids)
         return out.decode(errors="replace")
 
     def token_bytes(self, token_id: int) -> bytes:
-        """Bytes a token contributes to the stream ('' for specials)."""
-        if token_id < len(SPECIALS):
+        """Bytes a token contributes to the stream ('' for specials or
+        padded-vocab ids past the table — mesh engines pad the model vocab
+        to a tp multiple)."""
+        if token_id < len(SPECIALS) or token_id >= len(self.piece_bytes):
             return b""
         return self.piece_bytes[token_id]
 

@@ -250,17 +250,23 @@ def forward(
         v_cache = v_cache.at[batch_idx, positions].set(v)
 
         if attn_impl == "pallas" and T == 1:
-            from ..ops import decode_attention
+            from ..ops import sharded_decode_attention
 
             # per-row frontiers; idle rows park writes at slot 0 so this
-            # stays proportional to real context (see chunk_decode_loop)
-            attn = decode_attention(q[:, 0], k_cache, v_cache, frontier + 1).reshape(B, T, -1)
+            # stays proportional to real context (see chunk_decode_loop).
+            # On a mesh the kernel runs per-shard under shard_map (batch
+            # over dp, heads over tp) — attention needs no collectives.
+            mesh = rules.mesh if rules is not None else None
+            attn = sharded_decode_attention(
+                mesh, q[:, 0], k_cache, v_cache, frontier + 1
+            ).reshape(B, T, -1)
         elif attn_impl == "pallas" and fresh_block:
-            from ..ops import flash_attention
+            from ..ops import sharded_flash_attention
 
             # fresh sequence starting at position 0: attention over the
             # block's own k/v is exactly attention over the cache
-            attn = flash_attention(q, k, v, causal=True).reshape(B, T, -1)
+            mesh = rules.mesh if rules is not None else None
+            attn = sharded_flash_attention(mesh, q, k, v, causal=True).reshape(B, T, -1)
         else:
             attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
         attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)

@@ -204,11 +204,12 @@ def encoder_forward(
         k = _proj(h, a["wk"])
         v = _proj(h, a["wv"], a["bv"])
         if attn_impl == "pallas":
-            from ..ops import flash_attention
+            from ..ops import sharded_flash_attention
 
             B, T2l, _ = q.shape
-            attn = flash_attention(
-                q.reshape(B, T2l, nh, hd), k.reshape(B, T2l, nh, hd),
+            mesh = rules.mesh if rules is not None else None
+            attn = sharded_flash_attention(
+                mesh, q.reshape(B, T2l, nh, hd), k.reshape(B, T2l, nh, hd),
                 v.reshape(B, T2l, nh, hd), causal=False,
             ).reshape(B, T2l, nh * hd)
         else:
@@ -294,9 +295,10 @@ def decoder_forward(
         k_cache = k_cache.at[batch_idx, positions].set(k)
         v_cache = v_cache.at[batch_idx, positions].set(v)
         if use_pallas_step:
-            from ..ops import decode_attention
+            from ..ops import sharded_decode_attention
 
-            attn = decode_attention(q[:, 0], k_cache, v_cache, frontier + 1)
+            mesh = rules.mesh if rules is not None else None
+            attn = sharded_decode_attention(mesh, q[:, 0], k_cache, v_cache, frontier + 1)
             attn = attn.reshape(B, T, nh * hd).astype(x.dtype)
         else:
             scores = jnp.einsum("btnh,bsnh->bnts", q, k_cache, preferred_element_type=jnp.float32)
@@ -313,9 +315,10 @@ def decoder_forward(
         ca = lp["cross_attn"]
         qc = _proj(h, ca["wq"], ca["bq"]).reshape(B, T, nh, hd)
         if use_pallas_step:
-            from ..ops import decode_attention
+            from ..ops import sharded_decode_attention
 
-            attn = decode_attention(qc[:, 0], ck, cv, enc_len)
+            mesh = rules.mesh if rules is not None else None
+            attn = sharded_decode_attention(mesh, qc[:, 0], ck, cv, enc_len)
             attn = attn.reshape(B, T, nh * hd).astype(x.dtype)
         else:
             scores = jnp.einsum("btnh,bsnh->bnts", qc, ck, preferred_element_type=jnp.float32)

@@ -18,15 +18,22 @@ correctness tests and as the CPU fallback; kernels run under
 without a chip.
 """
 
-from .flash_attention import flash_attention, attention_reference
-from .decode_attention import decode_attention, decode_attention_reference
-from .grammar_mask import masked_argmax, masked_argmax_reference
+from .flash_attention import flash_attention, attention_reference, sharded_flash_attention
+from .decode_attention import (
+    decode_attention,
+    decode_attention_reference,
+    sharded_decode_attention,
+)
+from .grammar_mask import masked_argmax, masked_argmax_reference, sharded_masked_argmax
 
 __all__ = [
     "flash_attention",
     "attention_reference",
+    "sharded_flash_attention",
     "decode_attention",
     "decode_attention_reference",
+    "sharded_decode_attention",
     "masked_argmax",
     "masked_argmax_reference",
+    "sharded_masked_argmax",
 ]

@@ -148,6 +148,47 @@ def decode_attention(
     return out.reshape(B, nq, hd)
 
 
+def sharded_decode_attention(
+    mesh,
+    q: jax.Array,  # (B, nq, hd)
+    k_cache: jax.Array,  # (B, S, nkv, hd)
+    v_cache: jax.Array,
+    kv_len: jax.Array,  # (B,)
+    **kw,
+) -> jax.Array:
+    """decode_attention over a (dp, tp) mesh via shard_map (``mesh=None``
+    falls through to the plain kernel, so call sites need no branching).
+
+    Decode attention is batch-local and head-local, so each device runs the
+    kernel on its (B/dp, nq/tp) shard with zero collectives — the wrapper
+    exists only because a bare pallas_call under GSPMD would replicate its
+    operands (the round-1 blocker for kernels='pallas' on a mesh). Heads
+    stay sharded only when tp divides both nq and nkv (matching
+    parallel.mesh.default_rules' gating)."""
+    if mesh is None:
+        return decode_attention(q, k_cache, v_cache, kv_len, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    B, nq = q.shape[0], q.shape[1]
+    nkv = k_cache.shape[2]
+    tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
+    # single-row admission prefill/decode runs B=1 on a dp>1 mesh: batch
+    # stays replicated there, heads still shard
+    dp_ax = "dp" if (dp > 1 and B % dp == 0) else None
+    qs = P(dp_ax, tp_ax, None)
+    cs = P(dp_ax, None, tp_ax, None)
+    fn = jax.shard_map(
+        functools.partial(decode_attention, **kw),
+        mesh=mesh,
+        in_specs=(qs, cs, cs, P(dp_ax)),
+        out_specs=qs,
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, kv_len.astype(jnp.int32))
+
+
 def decode_attention_reference(
     q: jax.Array,
     k_cache: jax.Array,

@@ -174,6 +174,37 @@ def flash_attention(
     return jnp.moveaxis(out[:, :, :T, :], 1, 2)
 
 
+def sharded_flash_attention(
+    mesh,
+    q: jax.Array,  # (B, T, nq, hd)
+    k: jax.Array,  # (B, S, nkv, hd)
+    v: jax.Array,
+    **kw,
+) -> jax.Array:
+    """flash_attention over a (dp, tp) mesh via shard_map — batch over dp,
+    heads over tp, zero collectives (attention is head-local). Exists
+    because a bare pallas_call under GSPMD replicates its operands.
+    ``mesh=None`` falls through to the plain kernel."""
+    if mesh is None:
+        return flash_attention(q, k, v, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    B, nq, nkv = q.shape[0], q.shape[2], k.shape[2]
+    tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
+    dp_ax = "dp" if (dp > 1 and B % dp == 0) else None  # B=1 prefill: replicate batch
+    spec = P(dp_ax, None, tp_ax, None)
+    fn = jax.shard_map(
+        functools.partial(flash_attention, **kw),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
 def attention_reference(
     q: jax.Array,
     k: jax.Array,

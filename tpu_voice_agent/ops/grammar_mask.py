@@ -111,6 +111,33 @@ def masked_argmax(
     )(fsm_state.astype(jnp.int32), logits3, mask3)
 
 
+def sharded_masked_argmax(
+    mesh,
+    logits: jax.Array,  # (B, V)
+    fsm_state: jax.Array,  # (B,)
+    mask_table: jax.Array,  # (n_states, V) bool — replicated
+    **kw,
+) -> jax.Array:
+    """masked_argmax over a (dp, tp) mesh via shard_map: batch over dp, the
+    vocab and mask table replicated (default_rules constrains logits to
+    P('dp', None)), so every device argmaxes its own rows — no collectives.
+    ``mesh=None`` falls through to the plain kernel."""
+    if mesh is None:
+        return masked_argmax(logits, fsm_state, mask_table, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    dp = mesh.shape.get("dp", 1)
+    dp_ax = "dp" if (dp > 1 and logits.shape[0] % dp == 0) else None  # B=1: replicate
+    fn = jax.shard_map(
+        functools.partial(masked_argmax, **kw),
+        mesh=mesh,
+        in_specs=(P(dp_ax, None), P(dp_ax), P(None, None)),
+        out_specs=P(dp_ax),
+        check_vma=False,
+    )
+    return fn(logits, fsm_state, mask_table)
+
+
 def masked_argmax_reference(
     logits: jax.Array, fsm_state: jax.Array, mask_table: jax.Array
 ) -> jax.Array:

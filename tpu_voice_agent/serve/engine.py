@@ -43,7 +43,8 @@ class GenerationResult:
 
 
 def _mask_sample_advance(logits, fsm_state, tables: DeviceFSM, key, temperature,
-                         greedy: bool, constrained: bool, kernels: str = "xla"):
+                         greedy: bool, constrained: bool, kernels: str = "xla",
+                         rules=None):
     """The one sampling block: grammar-mask logits, pick a token, advance the
     FSM. Shared by the fused decode step, the prefill first-token pick, and
     the device generation loop (jit-inlined at every call site).
@@ -53,11 +54,13 @@ def _mask_sample_advance(logits, fsm_state, tables: DeviceFSM, key, temperature,
     the layout survives 128k-vocab checkpoints. kernels="pallas" routes the
     greedy constrained path through the fused ops.masked_argmax kernel when
     the dense (S, V) mask is small enough to exist (toy vocabs); otherwise
-    the compressed XLA path runs even under kernels="pallas"."""
+    the compressed XLA path runs even under kernels="pallas". On a mesh
+    (rules given) the kernel runs per-shard under shard_map."""
     if constrained and greedy and kernels == "pallas" and tables.dense_mask is not None:
-        from ..ops import masked_argmax
+        from ..ops import sharded_masked_argmax
 
-        tok = masked_argmax(logits, fsm_state, tables.dense_mask)
+        mesh = rules.mesh if rules is not None else None
+        tok = sharded_masked_argmax(mesh, logits, fsm_state, tables.dense_mask)
         return tok, fsm_advance(tables, fsm_state, tok)
     if constrained:
         row = fsm_row(tables, fsm_state)  # (B, V) int32 next states; -1 dead
@@ -91,17 +94,18 @@ def _decode_step(
                             attn_impl=kernels)
     nxt, fsm_state = _mask_sample_advance(
         logits[:, 0, :], fsm_state, tables, key, temperature, greedy,
-        constrained, kernels
+        constrained, kernels, rules
     )
     return nxt, cache, fsm_state
 
 
-@partial(jax.jit, static_argnames=("greedy", "constrained", "kernels"))
+@partial(jax.jit, static_argnames=("greedy", "constrained", "kernels", "rules"))
 def _first_token(last_logits, fsm_state, tables: DeviceFSM, key, temperature,
-                 greedy: bool = True, constrained: bool = True, kernels: str = "xla"):
+                 greedy: bool = True, constrained: bool = True, kernels: str = "xla",
+                 rules=None):
     return _mask_sample_advance(
         last_logits, fsm_state, tables, key, temperature, greedy,
-        constrained, kernels
+        constrained, kernels, rules
     )
 
 
@@ -248,7 +252,7 @@ def chunk_decode_loop(
         key, k = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
             logits[:, 0, :], state, tables, k, temperature, greedy,
-            constrained, kernels
+            constrained, kernels, rules
         )
         state = jnp.where(active, state_next, state)
         cur = jnp.where(active, nxt, cur)
@@ -284,12 +288,10 @@ class DecodeEngine:
         init_weights: bool = True,  # False: caller loads a checkpoint next
     ):
         if kernels == "auto":
-            # pallas kernels are single-device pallas_calls (no shard_map
-            # wrapper yet): on a mesh they would force GSPMD to replicate
-            # their operands, so auto only picks them off-mesh
-            kernels = "pallas" if (jax.default_backend() == "tpu" and mesh is None) else "xla"
-        if kernels == "pallas" and mesh is not None:
-            raise ValueError("kernels='pallas' is single-device; use kernels='xla' on a mesh")
+            # on a mesh the kernels run per-shard under shard_map (batch
+            # over dp, heads over tp; ops.sharded_*), so pallas is legal
+            # both off-mesh and on the dp×tp serving mesh
+            kernels = "pallas" if jax.default_backend() == "tpu" else "xla"
         self.kernels = kernels
         base = cfg or PRESETS[preset]
         if tokenizer is None:
@@ -309,6 +311,20 @@ class DecodeEngine:
                     f"model vocab {vocab} < tokenizer vocab {tokenizer.vocab_size}"
                 )
             self.fsm = fsm if fsm is not None else build_fsm_for(tokenizer, vocab_size=vocab)
+        if mesh is not None:
+            # lm_head shards the vocab over tp: pad the model vocab up to a
+            # tp multiple (padded ids are never grammar-legal, so the FSM
+            # mask keeps them unsampleable; standard padded-embedding trick)
+            tp = mesh.shape.get("tp", 1)
+            padded = -(-vocab // tp) * tp
+            if padded != vocab:
+                if fsm is not None:
+                    raise ValueError(
+                        f"custom fsm was built at vocab {vocab}, but mesh tp={tp} "
+                        f"pads the model vocab to {padded}; build it with "
+                        f"vocab_size={padded} (grammar.build_fsm_for)")
+                vocab = padded
+                self.fsm = build_fsm_for(self.tokenizer, vocab_size=vocab)
         self.cfg = replace(base, vocab_size=vocab, max_seq_len=max_len)
         self.eos_id = int(self.tokenizer.eos_id)
         self.pad_id = int(self.tokenizer.pad_id)
@@ -412,6 +428,14 @@ class DecodeEngine:
             tokenizer=tok, init_weights=False,
         )
         params = llama_from_hf_state(model_dir, cfg, dtype=dtype)
+        if eng.cfg.vocab_size != cfg.vocab_size:
+            # the engine padded its vocab to a tp multiple: pad the
+            # checkpoint's embed rows / lm_head columns to match (pad ids
+            # are never grammar-legal, so their zero logits are unsampleable
+            # under constrained decode)
+            pad = eng.cfg.vocab_size - cfg.vocab_size
+            params["embed"] = jnp.pad(params["embed"], ((0, pad), (0, 0)))
+            params["lm_head"] = jnp.pad(params["lm_head"], ((0, 0), (0, pad)))
         if mesh is not None:
             params = jax.device_put(params, eng._param_shardings)
         eng.load_params(params)
@@ -554,7 +578,7 @@ class DecodeEngine:
         tok0, fsm0 = _first_token(
             last_logits, fsm_state, self.tables, k0,
             jnp.float32(temperature), greedy=greedy, constrained=constrained,
-            kernels=self.kernels,
+            kernels=self.kernels, rules=self.rules,
         )
         prefill_ms = (time.perf_counter() - t0) * 1e3
 
@@ -614,7 +638,7 @@ class DecodeEngine:
         tok, fsm_state = _first_token(
             last_logits, fsm_state, self.tables, k0,
             jnp.float32(temperature), greedy=greedy, constrained=constrained,
-            kernels=self.kernels,
+            kernels=self.kernels, rules=self.rules,
         )
         tok.block_until_ready()
         prefill_ms = (time.perf_counter() - t0) * 1e3

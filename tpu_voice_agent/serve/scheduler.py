@@ -124,7 +124,7 @@ class ContinuousBatcher:
         tok0, fsm0 = _first_token(
             last_logits, start_state, eng.tables, k,
             jnp.float32(self.temperature), greedy=self.greedy, constrained=True,
-            kernels=eng.kernels,
+            kernels=eng.kernels, rules=eng.rules,
         )
         self.cur = self.cur.at[slot].set(tok0[0])
         self.fsm = self.fsm.at[slot].set(fsm0[0])
