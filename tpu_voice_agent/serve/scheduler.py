@@ -124,7 +124,7 @@ class ContinuousBatcher:
         tok0, fsm0 = _first_token(
             last_logits, start_state, eng.tables, k,
             jnp.float32(self.temperature), greedy=self.greedy, constrained=True,
-            kernels=eng.kernels, rules=eng.rules,
+            kernels=eng.kernels, rules=eng.rules, logit_mask=eng.logit_mask,
         )
         self.cur = self.cur.at[slot].set(tok0[0])
         self.fsm = self.fsm.at[slot].set(fsm0[0])
@@ -173,7 +173,8 @@ class ContinuousBatcher:
             self.cur, self.pos, self.fsm, self.active, self.nbytes, self.tokens_left,
             eng.tables, eng.byte_len_table,
             k, jnp.float32(self.temperature), jnp.int32(self.byte_budget),
-            rules=eng.rules, chunk_steps=self.chunk_steps,
+            rules=eng.rules, logit_mask=eng.logit_mask,
+            chunk_steps=self.chunk_steps,
             greedy=self.greedy, constrained=True, kernels=eng.kernels,
             eos_id=eng.eos_id, pad_id=eng.pad_id,
         )
