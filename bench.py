@@ -1,13 +1,19 @@
-"""Benchmark: voice->intent parse latency on the flagship in-tree model.
+"""Benchmark: TRUE voice->intent latency on the in-tree serving stack.
 
-Measures the BASELINE.md primary metric on real hardware: p50 latency of a
-full grammar-constrained intent parse (prompt prefill + constrained decode of
-a representative 64-token intent JSON) on a TinyLlama-1.1B-class decoder in
-bfloat16. 64 tokens is the measured length scale of real intent plans under
-the schema tokenizer (the few-shot exemplars span 29-60 tokens).
+Measures the BASELINE.md primary metric end to end on real hardware: from
+the moment the speaker stops talking (first silence sample), through energy
+endpointing (350 ms trailing window), the full-window Whisper final
+transcription, and the grammar-constrained intent parse (shared-prefix
+prefill + 64-token constrained decode) on a TinyLlama-1.1B-class int8
+decoder. Both models are resident on the one chip (the colocation the
+reference buys from two cloud vendors — apps/voice/src/deepgram.ts +
+apps/brain/src/llm.ts).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline = 800ms-north-star / measured-p50 (>1.0 beats the target).
+Round-1's metric (parse-only, named as if it were voice->intent) is kept as
+a stderr breakdown row; the ONE stdout JSON line is the honest end-to-end
+number. stderr also reports ms/token and the fraction of the weight-read
+HBM roofline the decode achieves, so perf regressions are visible
+(VERDICT round-1 next #9).
 """
 
 from __future__ import annotations
@@ -18,6 +24,30 @@ import time
 
 import numpy as np
 
+V5E_HBM_GBPS = 819.0  # v5e per-chip HBM bandwidth (roofline denominator)
+
+
+def synth_utterance(seconds: float, sr: int = 16_000) -> np.ndarray:
+    """Speech-like audio: modulated tone bursts over a noise floor."""
+    rng = np.random.default_rng(0)
+    t = np.arange(int(sr * seconds)) / sr
+    return (
+        0.2 * np.sin(2 * np.pi * 220 * t) * (np.sin(2 * np.pi * 2.5 * t) > -0.3)
+        + 0.002 * rng.standard_normal(len(t))
+    ).astype(np.float32)
+
+
+def int8_weight_bytes(cfg) -> float:
+    """HBM bytes read PER DECODE TOKEN for the int8 engine: every int8
+    matmul weight (incl. the int8 lm_head) is streamed once; the bf16
+    embedding contributes only a one-row gather (dim * 2 bytes)."""
+    from tpu_voice_agent.models.llama import param_count
+
+    total = param_count(cfg)  # parameter count; embed + lm_head both inside
+    embed = cfg.vocab_size * cfg.dim
+    matmul_int8 = (total - 2 * embed) + embed  # layers + lm_head, 1 B each
+    return float(matmul_int8 + cfg.dim * 2)
+
 
 def main() -> None:
     import jax
@@ -27,19 +57,27 @@ def main() -> None:
     print(f"[bench] devices: {devices}", file=sys.stderr)
 
     from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.serve.stt import SpeechEngine, StreamingSTT
+    from tpu_voice_agent.services.brain import install_prompt_prefix
     from tpu_voice_agent.services.prompts import render_prompt
 
+    # ---- intent engine (int8 weight-only: decode is HBM-bound on weights)
     preset = "tinyllama-1.1b" if on_tpu else "test-tiny"
-    # int8 weight-only quantization on the chip: decode is HBM-bound on
-    # weights, and weight-only int8 is a standard serving configuration
     engine = DecodeEngine(preset=preset, max_len=2048, prefill_buckets=(1024,),
                           quant="int8" if on_tpu else None)
-    # shared-prefix cache: the system prompt + few-shots prefill once, so a
-    # request pays only for its user suffix (the serving path does the same)
-    from tpu_voice_agent.services.brain import install_prompt_prefix
-
     prefix_len = install_prompt_prefix(engine)
     print(f"[bench] prompt prefix cached: {prefix_len} tokens", file=sys.stderr)
+
+    # ---- speech engine, colocated on the same chip
+    stt_preset = "whisper-large-v3" if on_tpu else "whisper-test"
+    stt_engine = SpeechEngine(preset=stt_preset, frame_buckets=(300, 1000),
+                              max_new_tokens=32)
+    stt = StreamingSTT(stt_engine)
+
+    sr, frame_ms = 16_000, 60  # the web client ships ~60 ms PCM frames
+    frame = sr * frame_ms // 1000
+    speech = synth_utterance(2.0)
+    silence = np.zeros(sr, dtype=np.float32)  # 1 s tail; endpoint fires at 350 ms
 
     utterances = [
         "search for wireless headphones",
@@ -48,33 +86,88 @@ def main() -> None:
         "filter results under one hundred dollars",
         "upload my resume and submit the form",
     ]
-    prompts = [render_prompt(u, {"last_query": None}) for u in utterances]
 
-    # warmup: compile prefill bucket + decode loop
-    for p in prompts[:2]:
-        engine.generate(p, max_new_tokens=64, greedy=True)
+    # ---- warmup: every compiled program on both engines (short AND long
+    # utterances cover both suffix prefill buckets)
+    for u in (utterances[0], utterances[2] + " and also " + utterances[3]):
+        engine.generate(render_prompt(u, {"last_query": None}), max_new_tokens=64)
+    for b in stt_engine.frame_buckets:
+        stt_engine.transcribe(np.zeros(b * 160, np.float32))
+    st = stt_engine.incremental_init()
+    st = stt_engine.incremental_feed(st, np.zeros(stt_engine.INC_STEP * 160 * 3, np.float32))
+    stt_engine.incremental_decode(st)
+    stt.feed(speech[:frame])
+    stt.reset()
 
-    lat_ms = []
-    for i in range(15):
-        p = prompts[i % len(prompts)]
-        t0 = time.perf_counter()
-        res = engine.generate(p, max_new_tokens=64, greedy=True)
-        lat_ms.append((time.perf_counter() - t0) * 1e3)
-        if i == 0:
-            print(
-                f"[bench] first: prefill {res.prefill_ms:.1f}ms decode {res.decode_ms:.1f}ms "
-                f"steps {res.steps}",
-                file=sys.stderr,
-            )
-    p50 = float(np.percentile(lat_ms, 50))
+    # frames are fed at their REAL-TIME deadlines, as the mic would deliver
+    # them — this is what lets the speculative final transcription hide
+    # inside the endpoint's wall-clock trailing-silence window
+    def feed_paced(audio: np.ndarray, deadline: float) -> tuple[str | None, float]:
+        final_text = None
+        for j in range(0, len(audio) - frame, frame):
+            deadline += frame_ms / 1e3
+            now = time.perf_counter()
+            if now < deadline:
+                time.sleep(deadline - now)
+            for kind, text in stt.feed(audio[j:j + frame]):
+                if kind == "final":
+                    final_text = text
+            # an emptied stream buffer means the utterance closed even when
+            # the transcript was empty (random weights) — the clock must
+            # stop here either way or the metric silently inflates
+            if final_text is not None or (j > 0 and len(stt._buf) == 0):
+                break
+        return final_text, deadline
+
+    e2e_ms, stt_ms, parse_ms = [], [], []
+    last_res = None
+    for i in range(9):
+        stt.reset()
+        _, t_end_speech = feed_paced(speech, time.perf_counter())
+        t0 = t_end_speech  # the real-time moment the speaker stopped
+        final_text, _ = feed_paced(silence, t_end_speech)
+        t1 = time.perf_counter()
+        # random weights transcribe garbage; parse cost is what's measured,
+        # so fall back to a fixed utterance when the final came back empty
+        text = final_text or utterances[i % len(utterances)]
+        last_res = engine.generate(render_prompt(text, {"last_query": None}),
+                                   max_new_tokens=64, greedy=True)
+        t2 = time.perf_counter()
+        stt_ms.append((t1 - t0) * 1e3)
+        parse_ms.append((t2 - t1) * 1e3)
+        e2e_ms.append((t2 - t0) * 1e3)
+
+    p50 = float(np.percentile(e2e_ms, 50))
+    p95 = float(np.percentile(e2e_ms, 95))
+    stt_p50 = float(np.percentile(stt_ms, 50))
+    parse_p50 = float(np.percentile(parse_ms, 50))
     print(
-        f"[bench] p50 {p50:.1f}ms p95 {float(np.percentile(lat_ms, 95)):.1f}ms over {len(lat_ms)} runs",
+        f"[bench] e2e p50 {p50:.1f}ms p95 {p95:.1f}ms over {len(e2e_ms)} runs "
+        f"(endpoint+final-STT {stt_p50:.1f}ms, parse {parse_p50:.1f}ms; the "
+        f"350 ms endpoint trailing-silence window is included — the reference "
+        f"burned 1000 ms on its debounce alone)",
         file=sys.stderr,
     )
+    # decode efficiency vs the weight-read HBM roofline (one decode chunk
+    # includes one ~70 ms tunnel round trip; the roofline row reports raw)
+    if last_res is not None and last_res.steps > 0:
+        ms_tok = last_res.decode_ms / last_res.steps
+        floor_ms = int8_weight_bytes(engine.cfg) / (V5E_HBM_GBPS * 1e9) * 1e3
+        frac = floor_ms / ms_tok if on_tpu else float("nan")
+        print(
+            f"[bench] decode {ms_tok:.2f} ms/token ({1e3 / ms_tok:.0f} tok/s); "
+            f"int8 weight-read floor {floor_ms:.2f} ms/token -> "
+            f"{100 * frac:.0f}% of HBM roofline" if on_tpu else
+            f"[bench] decode {ms_tok:.2f} ms/token (CPU run; roofline n/a)",
+            file=sys.stderr,
+        )
+        print(f"[bench] parse-only p50 {parse_p50:.1f}ms "
+              f"(round-1's metric, for continuity)", file=sys.stderr)
+
     print(
         json.dumps(
             {
-                "metric": "voice_to_intent_p50_64tok",
+                "metric": "voice_to_intent_p50_e2e",
                 "value": round(p50, 2),
                 "unit": "ms",
                 "vs_baseline": round(800.0 / p50, 3),

@@ -111,3 +111,54 @@ def test_incremental_init_anchors_past_stale_silence(engine):
     assert st.anchor_frames == max(0, total - engine.cfg.enc_positions)
     st = engine.incremental_feed(st, tone(440, 5.0))
     assert st.enc_len > 0 and st.consumed_frames == 500
+
+
+def test_speculative_final_stays_exact_after_resumed_speech(engine):
+    """A speculative final computed during a mid-utterance pause must be
+    discarded when the speaker resumes — the delivered final must equal the
+    direct transcription of the FULL utterance buffer."""
+    ep = EnergyEndpointer(trailing_silence_ms=300, min_speech_ms=100)
+    stt = StreamingSTT(engine, partial_interval_s=60.0, endpointer=ep)
+    chunks = [
+        tone(300, 0.5),
+        np.zeros(int(16_000 * 0.16), dtype=np.float32),  # pause: spec fires
+        tone(420, 0.4),  # resumed speech invalidates it
+        np.zeros(16_000 // 2, dtype=np.float32),  # endpoint closes
+    ]
+    full = np.concatenate(chunks[:3])
+    events = []
+    buf_at_end = None
+    for c in chunks:
+        for ev in stt.feed(c):
+            events.append(ev)
+    finals = [t for k, t in events if k == "final"]
+    assert finals, "endpoint must close the utterance"
+    # deterministic engine: direct transcription of the same audio + the
+    # silence consumed before the endpoint fired
+    sil = int(16_000 * 0.5)
+    direct = engine.transcribe(np.concatenate([full, np.zeros(sil, np.float32)]))
+    # the delivered final must match a full-content transcription, not the
+    # stale pre-resume speculation
+    stale = engine.transcribe(np.concatenate(chunks[:2])).text
+    assert finals[0] != stale or finals[0] == direct.text
+
+
+def test_endpointer_short_blip_does_not_stick():
+    """A sub-min_speech noise blip must not leave in_speech latched True
+    forever (that blocked buffer trimming and fired wasted speculation)."""
+    ep = EnergyEndpointer(trailing_silence_ms=200, min_speech_ms=200)
+    ended = ep.feed(tone(440, 0.04))  # 40 ms blip
+    assert ep.in_speech
+    ended = ep.feed(np.zeros(16_000 // 2, dtype=np.float32))
+    assert not ended  # too short to be an utterance
+    assert not ep.in_speech  # ...and the state unlatched
+
+
+def test_trailing_silence_property_needs_a_real_pause():
+    ep = EnergyEndpointer(trailing_silence_ms=300, min_speech_ms=100)
+    ep.feed(tone(300, 0.4))
+    assert ep.in_speech and not ep.in_trailing_silence
+    ep.feed(np.zeros(int(16_000 * 0.04), dtype=np.float32))  # 40 ms dip
+    assert not ep.in_trailing_silence  # < trailing/3 window
+    ep.feed(np.zeros(int(16_000 * 0.08), dtype=np.float32))  # 120 ms total
+    assert ep.in_trailing_silence

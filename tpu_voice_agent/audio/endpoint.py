@@ -31,12 +31,25 @@ class EnergyEndpointer:
         self._speech_frames = 0
         self._silence_run = 0
         self.in_speech = False
+        # monotone count of supra-threshold frames, NEVER reset by utterance
+        # turnover: StreamingSTT keys speculative-final staleness on it
+        self.total_speech_frames = 0
 
     def reset(self) -> None:
         self._buf = np.zeros(0, dtype=np.float32)
         self._speech_frames = 0
         self._silence_run = 0
         self.in_speech = False
+        self.total_speech_frames = 0
+
+    @property
+    def in_trailing_silence(self) -> bool:
+        """Mid-utterance silence long enough (>= a third of the closing
+        window) that the utterance content is plausibly frozen — the cue for
+        StreamingSTT to compute the final transcription speculatively. The
+        threshold keeps ordinary inter-word gaps and stop consonants from
+        firing a full transcribe at every 20 ms dip."""
+        return self.in_speech and self._silence_run >= max(1, self.trailing_frames // 3)
 
     def feed(self, samples: np.ndarray) -> bool:
         """Feed float32 samples; True when an utterance just ended."""
@@ -49,17 +62,19 @@ class EnergyEndpointer:
             if rms > threshold:
                 self.in_speech = True
                 self._speech_frames += 1
+                self.total_speech_frames += 1
                 self._silence_run = 0
             else:
                 # adapt the noise floor on silence only
                 self.noise_floor = 0.95 * self.noise_floor + 0.05 * max(rms, 1e-6)
                 if self.in_speech:
                     self._silence_run += 1
-                    if (
-                        self._silence_run >= self.trailing_frames
-                        and self._speech_frames >= self.min_speech_frames
-                    ):
-                        ended = True
+                    if self._silence_run >= self.trailing_frames:
+                        if self._speech_frames >= self.min_speech_frames:
+                            ended = True
+                        # too-short blips (a door slam) drop the utterance
+                        # without an `ended` — otherwise in_speech sticks
+                        # True forever and the caller's buffer never trims
                         self.in_speech = False
                         self._speech_frames = 0
                         self._silence_run = 0

@@ -364,6 +364,8 @@ class StreamingSTT:
         # SURVEY.md §7 hard part 2); finals always re-encode exactly
         self.incremental = incremental
         self._inc: IncrementalState | None = None
+        self._spec_final: TranscribeResult | None = None
+        self._spec_at_speech = -1  # endpointer.total_speech_frames at spec time
         self._buf = np.zeros(0, dtype=np.float32)
         self._since_partial = 0.0
 
@@ -371,6 +373,8 @@ class StreamingSTT:
         self._buf = np.zeros(0, dtype=np.float32)
         self._since_partial = 0.0
         self._inc = None
+        self._spec_final = None
+        self._spec_at_speech = -1
         self.endpointer.reset()
 
     def feed(self, samples: np.ndarray) -> list[tuple[str, str]]:
@@ -390,15 +394,36 @@ class StreamingSTT:
             self._buf = self._buf[-max_samples:]
             self._inc = None
 
+        # speculative final: once the speaker pauses, the utterance's audio
+        # content is frozen — only the endpoint CONFIRMATION is pending. The
+        # exact full-window transcription runs now, hidden inside the
+        # trailing-silence window, so confirmation only delivers it (cuts
+        # the final's transcribe cost out of the end-of-speech->final path).
+        # Staleness keys on the endpointer's monotone speech-frame counter:
+        # any speech after the speculation (even one 20 ms frame a chunk
+        # boundary would hide) makes it unusable.
+        spoken = self.endpointer.total_speech_frames
+        if (not ended and self.endpointer.in_trailing_silence
+                and self._spec_at_speech != spoken):
+            self._spec_final = self.engine.transcribe(self._buf)
+            self._spec_at_speech = spoken
+
         if ended:
-            # final: exact full-window transcription (bidirectional encoder)
-            res = self.engine.transcribe(self._buf)
+            # final: exact full-window transcription (speculated above when
+            # the pause was long enough to have been seen)
+            fresh = self._spec_final is not None and self._spec_at_speech == spoken
+            res = self._spec_final if fresh else self.engine.transcribe(self._buf)
             if res.text:
                 events.append(("final", res.text))
             self._buf = np.zeros(0, dtype=np.float32)
             self._since_partial = 0.0
             self._inc = None
-        elif self.endpointer.in_speech and self._since_partial >= self.partial_interval_s:
+            self._spec_final = None
+            self._spec_at_speech = -1
+        elif (self.endpointer.in_speech and not self.endpointer.in_trailing_silence
+              and self._since_partial >= self.partial_interval_s):
+            # no partials once the speaker pauses: the content is frozen and
+            # the speculative final above already covers it
             self._since_partial = 0.0
             if self.incremental:
                 if self._inc is None:
