@@ -61,9 +61,12 @@ def main() -> None:
     from tpu_voice_agent.services.brain import install_prompt_prefix
     from tpu_voice_agent.services.prompts import render_prompt
 
-    # ---- intent engine (int8 weight-only: decode is HBM-bound on weights)
+    # ---- intent engine (int8 weight-only: decode is HBM-bound on weights).
+    # max_len sized to the workload (prefix ~880 + suffix + 64 generated):
+    # the decode loop's cache carry costs HBM traffic proportional to
+    # capacity on every step, so capacity the workload can't use is pure tax
     preset = "tinyllama-1.1b" if on_tpu else "test-tiny"
-    engine = DecodeEngine(preset=preset, max_len=2048, prefill_buckets=(1024,),
+    engine = DecodeEngine(preset=preset, max_len=1024, prefill_buckets=(1024,),
                           quant="int8" if on_tpu else None)
     prefix_len = install_prompt_prefix(engine)
     print(f"[bench] prompt prefix cached: {prefix_len} tokens", file=sys.stderr)

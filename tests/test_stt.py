@@ -127,20 +127,17 @@ def test_speculative_final_stays_exact_after_resumed_speech(engine):
     ]
     full = np.concatenate(chunks[:3])
     events = []
-    buf_at_end = None
     for c in chunks:
         for ev in stt.feed(c):
             events.append(ev)
     finals = [t for k, t in events if k == "final"]
     assert finals, "endpoint must close the utterance"
-    # deterministic engine: direct transcription of the same audio + the
-    # silence consumed before the endpoint fired
+    # deterministic engine: the delivered final must EQUAL the direct
+    # transcription of the full utterance buffer (audio + the silence
+    # consumed before the endpoint fired) — not the stale speculation
     sil = int(16_000 * 0.5)
     direct = engine.transcribe(np.concatenate([full, np.zeros(sil, np.float32)]))
-    # the delivered final must match a full-content transcription, not the
-    # stale pre-resume speculation
-    stale = engine.transcribe(np.concatenate(chunks[:2])).text
-    assert finals[0] != stale or finals[0] == direct.text
+    assert finals[0] == direct.text
 
 
 def test_endpointer_short_blip_does_not_stick():
@@ -158,7 +155,7 @@ def test_trailing_silence_property_needs_a_real_pause():
     ep = EnergyEndpointer(trailing_silence_ms=300, min_speech_ms=100)
     ep.feed(tone(300, 0.4))
     assert ep.in_speech and not ep.in_trailing_silence
-    ep.feed(np.zeros(int(16_000 * 0.04), dtype=np.float32))  # 40 ms dip
-    assert not ep.in_trailing_silence  # < trailing/3 window
-    ep.feed(np.zeros(int(16_000 * 0.08), dtype=np.float32))  # 120 ms total
-    assert ep.in_trailing_silence
+    ep.feed(np.zeros(int(16_000 * 0.06), dtype=np.float32))  # 60 ms dip
+    assert not ep.in_trailing_silence  # ordinary inter-word gap
+    ep.feed(np.zeros(int(16_000 * 0.14), dtype=np.float32))  # 200 ms total
+    assert ep.in_trailing_silence  # >= half the closing window

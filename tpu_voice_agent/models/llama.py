@@ -233,8 +233,13 @@ def forward(
 
     batch_idx = jnp.arange(B)[:, None]  # (B, 1) for scatter
 
-    def layer(x, layer_in):
-        p, k_cache, v_cache = layer_in
+    # The FULL stacked cache rides the scan CARRY and each layer updates its
+    # (li,) plane in place. Passing per-layer cache planes as scan xs/ys
+    # instead (round 1) forced XLA to copy every layer's whole cache line
+    # per step — ~35% of the decode step's device time at tinyllama scale.
+    def layer(carry, layer_in):
+        x, kc, vc = carry
+        p, li = layer_in
         h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
         h = cs(h, "act")
         q = jnp.einsum("btd,dh->bth", h, _w(p["wq"]), preferred_element_type=jnp.float32).astype(x.dtype)
@@ -246,19 +251,21 @@ def forward(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        k_cache = k_cache.at[batch_idx, positions].set(k)
-        v_cache = v_cache.at[batch_idx, positions].set(v)
+        kc = kc.at[li, batch_idx, positions].set(k)
+        vc = vc.at[li, batch_idx, positions].set(v)
 
         if attn_impl == "pallas" and T == 1:
-            from ..ops import sharded_decode_attention
+            from ..ops import sharded_decode_attention_layer
 
             # per-row frontiers; idle rows park writes at slot 0 so this
             # stays proportional to real context (see chunk_decode_loop).
-            # On a mesh the kernel runs per-shard under shard_map (batch
-            # over dp, heads over tp) — attention needs no collectives.
+            # The kernel indexes the layer's plane of the STACKED cache via
+            # scalar prefetch — slicing cache[li] for a per-layer kernel
+            # operand would materialize a full-plane HBM copy per layer per
+            # token. On a mesh it runs per-shard under shard_map.
             mesh = rules.mesh if rules is not None else None
-            attn = sharded_decode_attention(
-                mesh, q[:, 0], k_cache, v_cache, frontier + 1
+            attn = sharded_decode_attention_layer(
+                mesh, q[:, 0], kc, vc, frontier + 1, li
             ).reshape(B, T, -1)
         elif attn_impl == "pallas" and fresh_block:
             from ..ops import sharded_flash_attention
@@ -268,7 +275,7 @@ def forward(
             mesh = rules.mesh if rules is not None else None
             attn = sharded_flash_attention(mesh, q, k, v, causal=True).reshape(B, T, -1)
         else:
-            attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
+            attn = _attend(q, kc[li], vc[li], positions, kv_len_mask)
         attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + cs(attn, "act")
 
@@ -279,13 +286,13 @@ def forward(
         act = cs(act, "ffn")
         down = jnp.einsum("btf,fd->btd", act, _w(p["w_down"]), preferred_element_type=jnp.float32).astype(x.dtype)
         x = x + cs(down, "act")
-        return x, (k_cache, v_cache)
+        return (x, kc, vc), None
 
     layer_fn = jax.checkpoint(layer) if remat else layer
-    x, (new_k, new_v) = jax.lax.scan(
+    (x, new_k, new_v), _ = jax.lax.scan(
         lambda carry, inp: layer_fn(carry, inp),
-        x,
-        (params["layers"], kv_cache["k"], kv_cache["v"]),
+        (x, kv_cache["k"], kv_cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers, dtype=jnp.int32)),
         unroll=unroll,
     )
 

@@ -21,8 +21,10 @@ without a chip.
 from .flash_attention import flash_attention, attention_reference, sharded_flash_attention
 from .decode_attention import (
     decode_attention,
+    decode_attention_layer,
     decode_attention_reference,
     sharded_decode_attention,
+    sharded_decode_attention_layer,
 )
 from .grammar_mask import masked_argmax, masked_argmax_reference, sharded_masked_argmax
 
@@ -31,8 +33,10 @@ __all__ = [
     "attention_reference",
     "sharded_flash_attention",
     "decode_attention",
+    "decode_attention_layer",
     "decode_attention_reference",
     "sharded_decode_attention",
+    "sharded_decode_attention_layer",
     "masked_argmax",
     "masked_argmax_reference",
     "sharded_masked_argmax",

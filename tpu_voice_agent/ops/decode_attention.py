@@ -31,8 +31,8 @@ def _on_cpu() -> bool:
 def _decode_kernel(
     kv_len_ref,  # SMEM (B,) int32 — all rows' valid key counts
     q_ref,  # (1, nkv, group, hd)
-    k_ref,  # (1, block_k, nkv, hd) — sliced straight from the (B,S,nkv,hd) cache
-    v_ref,  # (1, block_k, nkv, hd)
+    k_ref,  # (1, block_k, nkv, hd) — or (1, 1, bk, nkv, hd) stacked-cache view
+    v_ref,  # like k_ref
     o_ref,  # (1, nkv, group, hd)
     acc_ref,  # VMEM (nkv, group, hd) f32
     m_ref,  # VMEM (nkv, group, 128) f32
@@ -42,6 +42,7 @@ def _decode_kernel(
     nkv: int,
     group: int,
     block_k: int,
+    stacked: bool = False,  # kv blocks carry a leading layer dim of 1
 ):
     b = pl.program_id(0)
     j = pl.program_id(1)
@@ -60,7 +61,7 @@ def _decode_kernel(
         valid = k_pos < kv_len
         for h in range(nkv):  # static unroll; nkv is small (GQA)
             q = q_ref[0, h].astype(jnp.float32)  # (group, hd)
-            k = k_ref[0, :, h].astype(jnp.float32)  # (bk, hd)
+            k = (k_ref[0, 0, :, h] if stacked else k_ref[0, :, h]).astype(jnp.float32)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             ) * scale  # (group, bk)
@@ -72,8 +73,9 @@ def _decode_kernel(
             p = jnp.exp(s - m_new)
             alpha = jnp.exp(m_prev - m_new)
             l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            vblk = (v_ref[0, 0, :, h] if stacked else v_ref[0, :, h]).astype(jnp.float32)
             pv = jax.lax.dot_general(
-                p, v_ref[0, :, h].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                p, vblk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             acc_ref[h] = acc_ref[h] * alpha + pv
@@ -146,6 +148,109 @@ def decode_attention(
         interpret=interpret,
     )(kv_len.astype(jnp.int32), qg, k_cache, v_cache)
     return out.reshape(B, nq, hd)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_k", "interpret"))
+def decode_attention_layer(
+    q: jax.Array,  # (B, nq, hd) — one query token per row
+    k_cache: jax.Array,  # (L, B, S, nkv, hd) — the FULL stacked cache
+    v_cache: jax.Array,
+    kv_len: jax.Array,  # (B,) int32
+    layer: jax.Array,  # scalar int32 — which cache plane to attend
+    *,
+    scale: float | None = None,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """decode_attention reading one layer's plane straight out of the
+    stacked (L, B, S, nkv, hd) cache via a scalar-prefetched layer index in
+    the BlockSpec index map. The per-layer ``cache[li]`` slice a scan body
+    would otherwise materialize for the kernel is a full-plane HBM copy per
+    layer per token — this kernel makes the decode loop's cache traffic the
+    attended keys only."""
+    B, nq, hd = q.shape
+    S, nkv = k_cache.shape[2], k_cache.shape[3]
+    assert nq % nkv == 0
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+    # this kernel runs once per LAYER per step: padding the stacked cache
+    # here would copy the ENTIRE cache L times per token — the exact
+    # traffic it exists to eliminate. Take a smaller block instead; oddly
+    # sized caches must be bucketed by the caller (engines already do).
+    block_k = min(block_k, S)
+    while S % block_k and block_k >= 32:
+        block_k //= 2
+    if S % block_k:
+        raise ValueError(
+            f"stacked decode kernel needs cache length {S} divisible by a "
+            f">=32 block; size the cache to a power-of-two bucket")
+    qg = q.reshape(B, nkv, group, hd)
+
+    # scalar prefetch carries (kv_len ++ layer) so the index map can place
+    # each block at (layer, b, j) in the stacked cache — same trick as
+    # grammar_mask's state-indexed mask tiles
+    scalars = jnp.concatenate(
+        [kv_len.astype(jnp.int32), jnp.reshape(layer, (1,)).astype(jnp.int32)]
+    )
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, nkv=nkv, group=group, block_k=block_k,
+        stacked=True,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, nkv, group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, nkv, hd), lambda b, j, sc: (sc[B], b, j, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, nkv, hd), lambda b, j, sc: (sc[B], b, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, group, hd), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
+        interpret=interpret,
+    )(scalars, qg, k_cache, v_cache)
+    return out.reshape(B, nq, hd)
+
+
+def sharded_decode_attention_layer(
+    mesh,
+    q: jax.Array,  # (B, nq, hd)
+    k_cache: jax.Array,  # (L, B, S, nkv, hd)
+    v_cache: jax.Array,
+    kv_len: jax.Array,
+    layer: jax.Array,
+    **kw,
+) -> jax.Array:
+    """decode_attention_layer over a (dp, tp) mesh (mesh=None -> plain)."""
+    if mesh is None:
+        return decode_attention_layer(q, k_cache, v_cache, kv_len, layer, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    B, nq = q.shape[0], q.shape[1]
+    nkv = k_cache.shape[3]
+    tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
+    dp_ax = "dp" if (dp > 1 and B % dp == 0) else None
+    qs = P(dp_ax, tp_ax, None)
+    cs = P(None, dp_ax, None, tp_ax, None)
+    fn = jax.shard_map(
+        functools.partial(decode_attention_layer, **kw),
+        mesh=mesh,
+        in_specs=(qs, cs, cs, P(dp_ax), P()),
+        out_specs=qs,
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, kv_len.astype(jnp.int32), layer)
 
 
 def sharded_decode_attention(
