@@ -1,0 +1,144 @@
+"""Paged KV cache (SURVEY §7 step 2): kernel, allocator, engine parity.
+
+The paged engine must reproduce the dense engine's behavior through the
+continuous batcher while holding only live tokens in HBM and sharing the
+prompt-prefix blocks across slots.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.ops import paged_attention, paged_attention_reference
+from tpu_voice_agent.serve import DecodeEngine, PagedDecodeEngine
+from tpu_voice_agent.serve.paged import BlockAllocator
+from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+from tpu_voice_agent.services.brain import install_prompt_prefix
+from tpu_voice_agent.services.prompts import render_prompt
+
+
+# ---------------------------------------------------------------- kernel
+
+
+def test_paged_attention_matches_reference():
+    L, N, bs, B, nq, nkv, hd = 2, 12, 16, 3, 8, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (B, nq, hd), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (L, N, bs, nkv, hd), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (L, N, bs, nkv, hd), jnp.float32)
+    # rows own disjoint, deliberately out-of-order blocks
+    tables = jnp.asarray([[3, 7, 1, 0], [5, 2, 9, 4], [11, 6, 8, 10]], jnp.int32)
+    kv_len = jnp.asarray([5, 40, 64], jnp.int32)
+    for layer in (0, 1):
+        out = paged_attention(q, k_pool, v_pool, tables, kv_len, jnp.int32(layer))
+        ref = paged_attention_reference(q, k_pool, v_pool, tables, kv_len, layer)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------- allocator
+
+
+def test_allocator_refcounts_and_exhaustion():
+    a = BlockAllocator(6)  # block 0 reserved -> 5 usable
+    x = a.alloc(3)
+    assert 0 not in x and a.blocks_in_use == 3
+    a.ref(x[:1])  # shared
+    a.free(x)
+    assert a.blocks_in_use == 1  # the ref'd block survives
+    a.free(x[:1])
+    assert a.blocks_in_use == 0
+    a.alloc(5)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        a.alloc(1)
+
+
+# ---------------------------------------------------------------- engine
+
+
+def _dense(slots):
+    return DecodeEngine(preset="test-tiny", max_len=2048, batch_slots=slots,
+                        prefill_buckets=(128, 256, 512, 1024))
+
+
+def _paged(slots, **kw):
+    return PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=slots,
+                             prefill_buckets=(128, 256, 512, 1024), **kw)
+
+
+PROMPTS = [
+    render_prompt("search for laptops under 1000", {}),
+    render_prompt("upload my resume and submit", {}),
+    render_prompt("take a screenshot of this page", {}),
+]
+
+
+@pytest.mark.parametrize("with_prefix", [False, True])
+def test_paged_batcher_matches_dense(with_prefix):
+    dense = _dense(3)
+    paged = _paged(3)
+    if with_prefix:
+        install_prompt_prefix(dense)
+        install_prompt_prefix(paged)
+    rd = ContinuousBatcher(dense, chunk_steps=16, max_new_tokens=200).generate_many(PROMPTS)
+    rp = ContinuousBatcher(paged, chunk_steps=16, max_new_tokens=200).generate_many(PROMPTS)
+    for d, p in zip(rd, rp):
+        assert d.error is None and p.error is None
+        assert paged.fsm.walk(p.token_ids) >= 0
+        assert d.token_ids == p.token_ids, (d.text[:80], p.text[:80])
+
+
+def test_prefix_blocks_are_shared_not_copied():
+    eng = _paged(3)
+    P = install_prompt_prefix(eng)
+    bs = eng.block_size
+    full = P // bs
+    assert full >= 1
+    base = eng.allocator.blocks_in_use  # the shared prefix blocks
+    assert base == full
+    b = ContinuousBatcher(eng, chunk_steps=16, max_new_tokens=48)
+    for p in PROMPTS:
+        b.submit(p)
+    b.step()  # admits all three
+    # three slots live, but the prefix full-blocks exist ONCE in the pool
+    per_slot_owned = [len(o) for o in eng._slot_owned]
+    assert all(o >= 1 for o in per_slot_owned)
+    assert eng.allocator.blocks_in_use == base + sum(per_slot_owned)
+    for s in eng._slot_shared:
+        assert s == eng._prefix_blocks[:full]
+    b.run_until_done()
+    # completed requests returned their blocks; the shared prefix survives
+    assert eng.allocator.blocks_in_use == base
+
+
+def test_pool_memory_tracks_live_tokens_not_budgets():
+    """The point of paging: with the prefix shared, a pool far smaller than
+    slots*max_len (48 blocks vs the dense layout's equivalent of 3*16)
+    serves three concurrent requests."""
+    eng = _paged(3, pool_blocks=24)  # 23 usable blocks * 128 = 2944 positions
+    install_prompt_prefix(eng)  # ~7 blocks, stored once for all slots
+    b = ContinuousBatcher(eng, chunk_steps=16, max_new_tokens=64)
+    res = b.generate_many(PROMPTS)
+    for r in res:
+        assert r.error is None
+        assert eng.fsm.walk(r.token_ids) >= 0
+    # per-request blocks returned; only the installed prefix stays resident
+    assert eng.allocator.blocks_in_use == len(eng._prefix_blocks)
+
+
+def test_pool_exhaustion_fails_the_request_not_the_engine():
+    eng = _paged(2, pool_blocks=10)  # 9 usable: one admission fits, two don't
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=32)
+    r1, r2 = b.generate_many([PROMPTS[0], PROMPTS[1]])
+    # at least one completes; any failure is the clean pool-exhausted error
+    ok = [r for r in (r1, r2) if r.error is None]
+    bad = [r for r in (r1, r2) if r.error is not None]
+    assert ok, "pool sized for one request must serve at least one"
+    for r in bad:
+        assert "exhausted" in r.error
+
+
+def test_paged_generate_is_rejected():
+    eng = _paged(1)
+    with pytest.raises(ValueError, match="batcher"):
+        eng.generate("x")

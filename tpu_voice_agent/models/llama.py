@@ -302,6 +302,97 @@ def forward(
     return logits, {"k": new_k, "v": new_v}
 
 
+@partial(jax.jit, static_argnames=("cfg", "rules", "attn_impl"),
+         donate_argnames=("k_pool", "v_pool"))
+def forward_paged(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, T) int32
+    positions: jax.Array,  # (B, T) int32 — absolute positions of `tokens`
+    k_pool: jax.Array,  # (L, N, bs, nkv, hd) — global paged KV pool
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32 pool-block ids
+    rules=None,
+    attn_impl: str = "pallas",  # T=1 uses ops.paged_attention; T>1 gathers
+    write_mask: jax.Array | None = None,  # (B,) bool; False rows park their
+    # writes in reserved trash block 0 (idle continuous-batching rows must
+    # never scribble on another row's — or the shared prefix's — blocks)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The paged twin of ``forward`` (parity-tested): sequences own
+    non-contiguous pool blocks via per-row block tables (SURVEY.md §7
+    step 2's paged KV cache). KV writes scatter through the table into the
+    flat pool; T=1 decode attends via the ops.paged_attention kernel
+    (block-table indirection in the index map — no contiguous per-sequence
+    cache ever materializes); T>1 prefill gathers the row's blocks once per
+    layer (a per-prefill cost, not per-token). Returns
+    (logits, k_pool, v_pool). Single-device for now (no mesh rules)."""
+    B, T = tokens.shape
+    L, N, bs = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    S = block_tables.shape[1] * bs  # gathered context capacity
+    cs = lambda x, name: rules.constrain(x, name) if rules is not None else x
+
+    x = params["embed"][tokens]
+    x = cs(x, "act")
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    frontier = jnp.max(positions, axis=1)  # (B,)
+    kv_len_mask = jnp.arange(S)[None, :] <= frontier[:, None]
+    # pool slot for each written token: table[b, pos//bs] * bs + pos%bs
+    blk = jnp.take_along_axis(block_tables, positions // bs, axis=1)  # (B, T)
+    flat_idx = blk * bs + positions % bs  # (B, T) into the (N*bs,) flat pool
+    if write_mask is not None:
+        flat_idx = jnp.where(write_mask[:, None], flat_idx, 0)  # trash block
+
+    def layer(carry, layer_in):
+        x, kp, vp = carry
+        p, li = layer_in
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dh->bth", h, _w(p["wq"]), preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.einsum("btd,dh->bth", h, _w(p["wk"]), preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.einsum("btd,dh->bth", h, _w(p["wv"]), preferred_element_type=jnp.float32).astype(x.dtype)
+        q = apply_rope(q.reshape(B, T, cfg.n_heads, cfg.head_dim), cos, sin)
+        k = apply_rope(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), cos, sin)
+        v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+
+        kp_flat = kp.reshape(L, N * bs, cfg.n_kv_heads, cfg.head_dim)
+        vp_flat = vp.reshape(L, N * bs, cfg.n_kv_heads, cfg.head_dim)
+        kp = kp_flat.at[li, flat_idx].set(k).reshape(kp.shape)
+        vp = vp_flat.at[li, flat_idx].set(v).reshape(vp.shape)
+
+        if attn_impl == "pallas" and T == 1:
+            from ..ops import paged_attention
+
+            attn = paged_attention(
+                q[:, 0], kp, vp, block_tables, frontier + 1, li
+            ).reshape(B, T, -1)
+        else:
+            # prefill: gather the row's blocks to a contiguous view once
+            kl = kp[li][block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+            vl = vp[li][block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+            attn = _attend(q, kl, vl, positions, kv_len_mask)
+        attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + cs(attn, "act")
+
+        h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        gate = jnp.einsum("btd,df->btf", h, _w(p["w_gate"]), preferred_element_type=jnp.float32)
+        up = jnp.einsum("btd,df->btf", h, _w(p["w_up"]), preferred_element_type=jnp.float32)
+        act = (jax.nn.silu(gate) * up).astype(x.dtype)
+        act = cs(act, "ffn")
+        down = jnp.einsum("btf,fd->btd", act, _w(p["w_down"]), preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + cs(down, "act")
+        return (x, kp, vp), None
+
+    (x, k_pool, v_pool), _ = jax.lax.scan(
+        layer,
+        (x, k_pool, v_pool),
+        (params["layers"], jnp.arange(cfg.n_layers, dtype=jnp.int32)),
+    )
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, _w(params["lm_head"]), preferred_element_type=jnp.float32)
+    logits = cs(logits, "logits")
+    return logits, k_pool, v_pool
+
+
 def param_count(cfg: LlamaConfig) -> int:
     d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
     per_layer = d * (cfg.n_heads * hd) + 2 * d * (cfg.n_kv_heads * hd) + (cfg.n_heads * hd) * d

@@ -27,6 +27,7 @@ from .decode_attention import (
     sharded_decode_attention_layer,
 )
 from .grammar_mask import masked_argmax, masked_argmax_reference, sharded_masked_argmax
+from .paged_attention import paged_attention, paged_attention_reference
 
 __all__ = [
     "flash_attention",
@@ -40,4 +41,6 @@ __all__ = [
     "masked_argmax",
     "masked_argmax_reference",
     "sharded_masked_argmax",
+    "paged_attention",
+    "paged_attention_reference",
 ]

@@ -1,0 +1,183 @@
+"""Paged decode attention: block-table indirection into a global KV pool.
+
+SURVEY.md §7 step 2 names a paged KV cache; this is its attention kernel.
+Sequences own non-contiguous fixed-size blocks of one pool, so HBM holds
+only the context each sequence actually has (a dense per-slot cache burns
+max_len capacity per slot regardless), and the shared prompt prefix can be
+ONE set of blocks referenced by every sequence's table (serve.paged).
+
+Kernel shape: one query token per row attends over its blocks. The block
+table rides in scalar-prefetch SMEM and the *BlockSpec index map* does the
+indirection — grid cell (b, j) streams pool block table[b, j] — so the
+gather never materializes a contiguous per-sequence cache in HBM (the same
+index-map trick as grammar_mask's state-indexed tiles and
+decode_attention_layer's stacked-cache plane).
+
+The pool is layer-stacked (L, N, bs, nkv, hd) with the layer index in the
+scalars, so the decode loop's scan body never slices a per-layer pool.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _on_cpu() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _paged_kernel(
+    scalars_ref,  # SMEM: [kv_len (B,) | layer (1,) | table (B*max_blocks,)]
+    q_ref,  # (1, nkv, group, hd)
+    k_ref,  # (1, 1, bs, nkv, hd) — pool block picked by the index map
+    v_ref,  # like k_ref
+    o_ref,  # (1, nkv, group, hd)
+    acc_ref,  # VMEM (nkv, group, hd) f32
+    m_ref,  # VMEM (nkv, group, 128) f32
+    l_ref,  # VMEM (nkv, group, 128) f32
+    *,
+    scale: float,
+    nkv: int,
+    group: int,
+    bs: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    kv_len = scalars_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * bs < kv_len)
+    def _tile():
+        k_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1)
+        valid = k_pos < kv_len
+        for h in range(nkv):  # static unroll; nkv is small (GQA)
+            q = q_ref[0, h].astype(jnp.float32)  # (group, hd)
+            k = k_ref[0, 0, :, h].astype(jnp.float32)  # (bs, hd)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale
+            s = jnp.where(valid, s, _NEG_INF)
+
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, v_ref[0, 0, :, h].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        o_ref[0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention(
+    q: jax.Array,  # (B, nq, hd) — one query token per row
+    k_pool: jax.Array,  # (L, N, bs, nkv, hd) — global block pool
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32 pool-block ids
+    kv_len: jax.Array,  # (B,) int32 valid keys per row
+    layer: jax.Array,  # scalar int32 — which pool layer plane
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Returns (B, nq, hd) in q.dtype. Unused table entries must hold a
+    valid block id (0 is fine) — tiles beyond kv_len are skipped."""
+    B, nq, hd = q.shape
+    bs, nkv = k_pool.shape[2], k_pool.shape[3]
+    max_blocks = block_tables.shape[1]
+    assert nq % nkv == 0
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+    qg = q.reshape(B, nkv, group, hd)
+
+    scalars = jnp.concatenate([
+        kv_len.astype(jnp.int32),
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        block_tables.astype(jnp.int32).reshape(-1),
+    ])
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, nkv=nkv, group=group, bs=bs
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, nkv, group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, bs, nkv, hd),
+                lambda b, j, sc, M=max_blocks: (sc[B], sc[B + 1 + b * M + j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, nkv, hd),
+                lambda b, j, sc, M=max_blocks: (sc[B], sc[B + 1 + b * M + j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, group, hd), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
+        interpret=interpret,
+    )(scalars, qg, k_pool, v_pool)
+    return out.reshape(B, nq, hd)
+
+
+def paged_attention_reference(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    kv_len: jax.Array,
+    layer,
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Pure-jnp twin: gather each row's blocks into a contiguous cache and
+    run dense masked attention."""
+    B, nq, hd = q.shape
+    bs, nkv = k_pool.shape[2], k_pool.shape[3]
+    scale = scale if scale is not None else hd**-0.5
+    kl = k_pool[layer][block_tables]  # (B, max_blocks, bs, nkv, hd)
+    vl = v_pool[layer][block_tables]
+    S = kl.shape[1] * bs
+    k = kl.reshape(B, S, nkv, hd)
+    v = vl.reshape(B, S, nkv, hd)
+    group = nq // nkv
+    qg = q.reshape(B, nkv, group, hd)
+    scores = jnp.einsum("bkgh,bskh->bkgs", qg, k, preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(S)[None, :] < kv_len[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskh->bkgh", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, nq, hd).astype(q.dtype)

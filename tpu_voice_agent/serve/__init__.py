@@ -1,13 +1,16 @@
 from .colocate import ColocatedServing
 from .engine import DecodeEngine, GenerationResult
 from .grounding import GroundingEngine, GroundingResult
+from .paged import BlockAllocator, PagedDecodeEngine
 from .scheduler import ContinuousBatcher
 
 __all__ = [
+    "BlockAllocator",
     "ColocatedServing",
     "ContinuousBatcher",
     "DecodeEngine",
     "GenerationResult",
     "GroundingEngine",
     "GroundingResult",
+    "PagedDecodeEngine",
 ]

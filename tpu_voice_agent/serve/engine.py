@@ -279,6 +279,11 @@ def chunk_decode_loop(
 class DecodeEngine:
     """Single-model decode engine over an optional device mesh."""
 
+    # subclasses with their own KV layout (serve.paged) turn this off so
+    # startup never allocates the dense worst-case batch_slots x max_len
+    # cache they exist to avoid
+    _alloc_dense_cache = True
+
     def __init__(
         self,
         preset: str = "test-tiny",
@@ -361,12 +366,13 @@ class DecodeEngine:
             kv_sh = kv_cache_shardings(mesh, self.cfg.n_kv_heads)
             self.cache = jax.jit(
                 partial(init_kv_cache, self.cfg, batch_slots, max_len), out_shardings=kv_sh
-            )()
+            )() if self._alloc_dense_cache else None
         else:
             self.rules = None
             self._param_shardings = None
             self.params = jax.jit(partial(init_params, self.cfg))(key) if init_weights else None
-            self.cache = init_kv_cache(self.cfg, batch_slots, max_len)
+            self.cache = (init_kv_cache(self.cfg, batch_slots, max_len)
+                          if self._alloc_dense_cache else None)
 
         if quant == "int8":
             # weight-only int8: decode is HBM-bound on weights, so halving
@@ -558,6 +564,28 @@ class DecodeEngine:
             rules=self.rules, kernels=self.kernels, fresh=True,
         )
         return logits[:, n - 1, :]
+
+    def decode_chunk(self, cur, pos, fsm, active, nbytes, tokens_left, key,
+                     temperature: float, byte_budget: int, chunk_steps: int,
+                     greedy: bool):
+        """Advance all slots by one decode chunk (the batcher's device-work
+        entry point — the KV layout stays the engine's business, so the
+        paged engine can substitute its pool/table loop)."""
+        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left = chunk_decode_loop(
+            self.params, self.cfg, self.cache,
+            cur, pos, fsm, active, nbytes, tokens_left,
+            self.tables, self.byte_len_table,
+            key, jnp.float32(temperature), jnp.int32(byte_budget),
+            rules=self.rules, logit_mask=self.logit_mask,
+            chunk_steps=chunk_steps,
+            greedy=greedy, constrained=True, kernels=self.kernels,
+            eos_id=self.eos_id, pad_id=self.pad_id,
+        )
+        return out, n, eos, cur, pos, fsm, active, nbytes, left
+
+    def release_slot(self, slot: int) -> None:
+        """A batch slot finished: dense cache rows are simply reused in
+        place (the paged engine returns the slot's blocks to the pool)."""
 
     def _prefill(self, prompt: str):
         if self.batch_slots != 1:

@@ -1,0 +1,361 @@
+"""Paged KV cache serving: block pool + allocator + paged decode engine.
+
+SURVEY.md §7 step 2 / VERDICT round-1 missing #5. The dense engine gives
+every batch slot a max_len cache line — HBM pays worst-case context per
+slot, and the shared prompt prefix is COPIED into every admitted slot.
+Here sequences own fixed-size blocks of one global pool via per-slot block
+tables:
+
+- HBM holds only the context each request actually has (a 40-token command
+  in a 32-slot server no longer reserves 32 x max_len lines)
+- the shared system-prompt+few-shot prefix is ONE set of pool blocks,
+  refcounted and referenced by every slot's table — admission writes only
+  the sub-block remainder tail plus the user suffix
+- decode attends through ops.paged_attention (block-table indirection in
+  the kernel's index map; no contiguous per-sequence cache ever exists)
+- block tables grow at chunk boundaries as sequences decode, so capacity
+  tracks live tokens, not budgets
+
+``PagedDecodeEngine`` is a drop-in for ``DecodeEngine`` under the
+continuous batcher (serve.scheduler) via the engine's decode_chunk /
+prefill_slot / release_slot surface. Single-device v1 (no mesh), served
+through the batcher (single-request ``generate()`` stays on the dense
+engine).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import forward_paged
+from .engine import DecodeEngine, _mask_sample_advance
+
+
+class PoolExhausted(RuntimeError):
+    """The KV pool has no free blocks. A DEDICATED class so the scheduler
+    can isolate it per request without swallowing real device faults
+    (XlaRuntimeError also subclasses RuntimeError)."""
+
+
+class BlockAllocator:
+    """Host-side free-list allocator with refcounts (prefix blocks are
+    shared across slots). Block 0 is reserved as the trash block idle
+    batcher rows park their writes in — it is never handed out."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("pool needs >= 2 blocks (block 0 is reserved)")
+        self.n_blocks = n_blocks
+        self._free = list(range(n_blocks - 1, 0, -1))
+        self._refs: dict[int, int] = {}
+
+    def alloc(self, k: int) -> list[int]:
+        if len(self._free) < k:
+            raise PoolExhausted(
+                f"KV pool exhausted: need {k} blocks, {len(self._free)} free "
+                f"of {self.n_blocks} (size the pool to the live-token "
+                "working set, not per-slot budgets)")
+        out = [self._free.pop() for _ in range(k)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def ref(self, blocks: list[int]) -> None:
+        for b in blocks:
+            self._refs[b] += 1
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            r = self._refs[b] - 1
+            if r == 0:
+                del self._refs[b]
+                self._free.append(b)
+            else:
+                self._refs[b] = r
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.n_blocks - 1 - len(self._free)
+
+
+@partial(jax.jit, donate_argnames=("k_pool", "v_pool"))
+def _scatter_blocks(k_pool, v_pool, src_k, src_v, dst_idx):
+    """Write (L, n, nkv, hd) rows into the flat pool at dst_idx (n,)."""
+    L, N, bs = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    shp = k_pool.shape
+    kf = k_pool.reshape(L, N * bs, *shp[3:])
+    vf = v_pool.reshape(L, N * bs, *shp[3:])
+    kf = kf.at[:, dst_idx].set(src_k)
+    vf = vf.at[:, dst_idx].set(src_v)
+    return kf.reshape(shp), vf.reshape(shp)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "chunk_steps", "greedy", "constrained", "kernels",
+                     "eos_id", "pad_id", "max_len"),
+    donate_argnames=("k_pool", "v_pool"),
+)
+def paged_chunk_decode_loop(
+    params,
+    cfg,
+    k_pool,
+    v_pool,
+    block_tables,  # (B, max_blocks) int32
+    cur, pos, fsm_state, active, nbytes, tokens_left,  # (B,) device state
+    tables,  # grammar DeviceFSM
+    byte_len_table,
+    key,
+    temperature,
+    byte_budget,
+    logit_mask=None,
+    chunk_steps: int = 32,
+    greedy: bool = True,
+    constrained: bool = True,
+    kernels: str = "pallas",
+    eos_id: int = 2,
+    pad_id: int = 0,
+    max_len: int | None = None,
+):
+    """chunk_decode_loop's paged twin: forward_paged per step, idle rows'
+    writes parked in the reserved trash block via write_mask (they must
+    never scribble on another slot's — or the shared prefix's — blocks)."""
+    B = cur.shape[0]
+    # the engine's max_len, NOT the block-rounded table capacity — with a
+    # non-multiple max_len the dense loop stops at max_len-1 and the paged
+    # loop must match it token for token
+    max_pos = block_tables.shape[1] * k_pool.shape[2]
+    if max_len is not None:
+        max_pos = min(max_pos, max_len)
+    out = jnp.full((B, chunk_steps), pad_id, dtype=jnp.int32)
+    eos0 = (~active) & (cur == eos_id)
+
+    carry0 = (k_pool, v_pool, cur, pos, fsm_state, active, eos0, nbytes,
+              tokens_left, out, jnp.zeros((B,), jnp.int32), key,
+              jnp.zeros((), jnp.int32))
+
+    def cond(c):
+        active, step = c[5], c[12]
+        return jnp.logical_and(step < chunk_steps, jnp.any(active))
+
+    def body(c):
+        kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
+        out = out.at[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)].set(
+            jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)])
+        )
+        n = n + active.astype(jnp.int32)
+        nbytes = nbytes + jnp.where(active, byte_len_table[cur], 0)
+        left = left - active.astype(jnp.int32)
+
+        step_tok = jnp.where(active, cur, pad_id)
+        write_pos = jnp.where(active, pos, 0)
+        logits, kp, vp = forward_paged(
+            params, cfg, step_tok[:, None], write_pos[:, None], kp, vp,
+            block_tables, attn_impl=kernels, write_mask=active,
+        )
+        key, k = jax.random.split(key)
+        nxt, state_next = _mask_sample_advance(
+            logits[:, 0, :], state, tables, k, temperature, greedy,
+            constrained, kernels, None, logit_mask
+        )
+        state = jnp.where(active, state_next, state)
+        cur = jnp.where(active, nxt, cur)
+        pos = jnp.where(active, pos + 1, pos)
+
+        eos = eos | (active & (cur == eos_id))
+        stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_pos - 1) | (left <= 0)
+        active = active & ~stop
+        return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
+
+    (k_pool, v_pool, cur, pos, state, active, eos, nbytes, left, out, n, _, _) = (
+        jax.lax.while_loop(cond, body, carry0)
+    )
+    return out, n, eos, k_pool, v_pool, cur, pos, state, active, nbytes, left
+
+
+class PagedDecodeEngine(DecodeEngine):
+    """DecodeEngine with a paged KV pool instead of dense per-slot lines.
+
+    Served through the continuous batcher (serve.scheduler), which drives
+    the engine only via prefill_slot / decode_chunk / release_slot — the
+    KV layout never leaks out. ``pool_blocks`` sizes HBM to the expected
+    LIVE token count: pool bytes = pool_blocks * block_size * per-token KV,
+    vs the dense engine's batch_slots * max_len.
+    """
+
+    _alloc_dense_cache = False  # startup must never peak at the dense
+    # worst-case footprint this engine exists to avoid
+
+    def __init__(self, *args, block_size: int = 128, pool_blocks: int | None = None,
+                 **kw):
+        if kw.get("mesh") is not None:
+            raise ValueError("PagedDecodeEngine is single-device for now")
+        super().__init__(*args, **kw)
+        bs = block_size
+        self.block_size = bs
+        self.max_blocks = -(-self.max_len // bs)
+        if pool_blocks is None:
+            # default: same worst case as dense, plus the trash block
+            pool_blocks = self.batch_slots * self.max_blocks + 1
+        L, nkv, hd = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
+        self.k_pool = jnp.zeros((L, pool_blocks, bs, nkv, hd), jnp.bfloat16)
+        self.v_pool = jnp.zeros((L, pool_blocks, bs, nkv, hd), jnp.bfloat16)
+        self.allocator = BlockAllocator(pool_blocks)
+        self.block_tables = jnp.zeros((self.batch_slots, self.max_blocks), jnp.int32)
+        self._slot_shared: list[list[int]] = [[] for _ in range(self.batch_slots)]
+        self._slot_owned: list[list[int]] = [[] for _ in range(self.batch_slots)]
+        self._covered: list[int] = [0] * self.batch_slots  # positions with blocks
+        self._next_pos: list[int] = [0] * self.batch_slots  # upper bound
+        self._prefix_blocks: list[int] = []
+        self._prefix_tail: dict | None = None  # (L, R, nkv, hd) sub-block rest
+
+    # ------------------------------------------------------------ prefix
+
+    def set_prompt_prefix(self, *sample_prompts: str) -> int:
+        P = super().set_prompt_prefix(*sample_prompts)
+        if self._prefix_blocks:
+            self.allocator.free(self._prefix_blocks)
+            self._prefix_blocks = []
+        self._prefix_tail = None
+        if P == 0:
+            return 0
+        bs = self.block_size
+        full = P // bs
+        pk = self.prefix_kv["k"][:, 0]  # (L, P, nkv, hd)
+        pv = self.prefix_kv["v"][:, 0]
+        if full:
+            self._prefix_blocks = self.allocator.alloc(full)
+            blocks = np.asarray(self._prefix_blocks, np.int32)
+            dst = (blocks[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
+            self.k_pool, self.v_pool = _scatter_blocks(
+                self.k_pool, self.v_pool, pk[:, : full * bs], pv[:, : full * bs],
+                jnp.asarray(dst),
+            )
+        if P % bs:
+            self._prefix_tail = {"k": pk[:, full * bs:], "v": pv[:, full * bs:]}
+        return P
+
+    # ------------------------------------------------------------ admission
+
+    def _set_table_row(self, slot: int, blocks: list[int]) -> None:
+        row = np.zeros(self.max_blocks, np.int32)
+        row[: len(blocks)] = blocks
+        self.block_tables = self.block_tables.at[slot].set(jnp.asarray(row))
+
+    def prefill_slot(self, ids: list[int], slot: int):
+        bs = self.block_size
+        self.release_slot(slot)  # a finished request may still own blocks
+        n = len(ids)
+        suffix = self._split_prefix(ids)
+        if suffix is not None:
+            bucket = self._suffix_bucket(len(suffix), self.max_len - len(self.prefix_ids))
+            if bucket is None:
+                suffix = None
+        if suffix is not None:
+            P, m = len(self.prefix_ids), len(suffix)
+            full = P // bs
+            shared = self._prefix_blocks[:full]
+            self.allocator.ref(shared)
+            n_owned = -(-(P + bucket) // bs) - full
+            try:
+                owned = self.allocator.alloc(n_owned)
+            except PoolExhausted:
+                self.allocator.free(shared)  # don't leak the prefix refs
+                raise
+            self._slot_shared[slot], self._slot_owned[slot] = list(shared), owned
+            self._set_table_row(slot, shared + owned)
+            self._covered[slot] = (full + n_owned) * bs
+            if self._prefix_tail is not None:
+                # sub-block prefix remainder goes into the slot's first
+                # owned block (shared blocks stay read-only)
+                R = P - full * bs
+                dst = jnp.asarray(owned[0] * bs + np.arange(R, dtype=np.int32))
+                self.k_pool, self.v_pool = _scatter_blocks(
+                    self.k_pool, self.v_pool,
+                    self._prefix_tail["k"], self._prefix_tail["v"], dst,
+                )
+            tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
+            tokens[0, :m] = suffix
+            positions = (P + np.arange(bucket, dtype=np.int32))[None, :]
+            last = m - 1
+        else:
+            bucket = self._bucket(n)
+            owned = self.allocator.alloc(-(-bucket // bs))
+            self._slot_shared[slot], self._slot_owned[slot] = [], owned
+            self._set_table_row(slot, owned)
+            self._covered[slot] = len(owned) * bs
+            tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
+            tokens[0, :n] = ids
+            positions = np.arange(bucket, dtype=np.int32)[None, :]
+            last = n - 1
+        self._next_pos[slot] = n
+        logits, self.k_pool, self.v_pool = forward_paged(
+            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+            self.k_pool, self.v_pool, self.block_tables[slot][None],
+            attn_impl="xla",  # T>1 block gather path
+        )
+        return logits[:, last, :]
+
+    # ------------------------------------------------------------ decode
+
+    def _grow(self, slot: int, upto: int) -> None:
+        """Extend a slot's table so positions < upto have blocks."""
+        bs = self.block_size
+        upto = min(upto, self.max_len)
+        if upto <= self._covered[slot]:
+            return
+        extra = self.allocator.alloc(-(-(upto - self._covered[slot]) // bs))
+        self._slot_owned[slot].extend(extra)
+        self._set_table_row(slot, self._slot_shared[slot] + self._slot_owned[slot])
+        self._covered[slot] += len(extra) * bs
+
+    def decode_chunk(self, cur, pos, fsm, active, nbytes, tokens_left, key,
+                     temperature: float, byte_budget: int, chunk_steps: int,
+                     greedy: bool):
+        for b in range(self.batch_slots):
+            if self._slot_owned[b]:  # request in flight on this slot
+                try:
+                    self._grow(b, self._next_pos[b] + chunk_steps + 1)
+                except PoolExhausted:
+                    # per-request isolation at decode time too: the slot
+                    # that cannot grow truncates cleanly (finished=False)
+                    # at its already-covered positions; the batch lives on
+                    tokens_left = tokens_left.at[b].set(0)
+                    continue
+                self._next_pos[b] = min(self._next_pos[b] + chunk_steps, self.max_len)
+        out, n, eos, self.k_pool, self.v_pool, cur, pos, fsm, active, nbytes, left = (
+            paged_chunk_decode_loop(
+                self.params, self.cfg, self.k_pool, self.v_pool, self.block_tables,
+                cur, pos, fsm, active, nbytes, tokens_left,
+                self.tables, self.byte_len_table,
+                key, jnp.float32(temperature), jnp.int32(byte_budget),
+                logit_mask=self.logit_mask, chunk_steps=chunk_steps,
+                greedy=greedy, constrained=True, kernels=self.kernels,
+                eos_id=self.eos_id, pad_id=self.pad_id, max_len=self.max_len,
+            )
+        )
+        return out, n, eos, cur, pos, fsm, active, nbytes, left
+
+    def release_slot(self, slot: int) -> None:
+        if self._slot_owned[slot] or self._slot_shared[slot]:
+            self.allocator.free(self._slot_owned[slot])
+            self.allocator.free(self._slot_shared[slot])
+            self._slot_owned[slot] = []
+            self._slot_shared[slot] = []
+            self._covered[slot] = 0
+            self._next_pos[slot] = 0
+
+    # the dense single-request path doesn't exist here; the batcher is the
+    # serving surface (generate_many / services with BRAIN_BATCH)
+    def generate(self, *a, **kw):
+        raise ValueError(
+            "PagedDecodeEngine serves through the continuous batcher "
+            "(serve.scheduler.ContinuousBatcher); use the dense DecodeEngine "
+            "for single-request generate()")
+
+    def generate_stepwise(self, *a, **kw):
+        raise ValueError("see generate(): paged engines serve via the batcher")

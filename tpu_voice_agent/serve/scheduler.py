@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .engine import DecodeEngine, GenerationResult, _first_token, chunk_decode_loop
+from .engine import DecodeEngine, GenerationResult, _first_token
 
 
 
@@ -96,6 +96,8 @@ class ContinuousBatcher:
         self.slots = [_Slot() for _ in range(self.B)]
         self.active = jnp.zeros_like(self.active)
         self._active_h = np.zeros((self.B,), dtype=bool)
+        for b in range(self.B):
+            self.engine.release_slot(b)
 
     def submit(self, prompt: str) -> int:
         rid = self._next_id
@@ -154,9 +156,10 @@ class ContinuousBatcher:
             try:
                 self._admit(slot, rid, prompt)
                 act[slot] = True
-            except ValueError as e:
-                # per-request isolation: an oversized prompt fails alone,
-                # never the batch (mirrors the executor's per-step try/catch)
+            except (ValueError, RuntimeError) as e:
+                # per-request isolation: an oversized prompt or an exhausted
+                # KV pool fails alone, never the batch (mirrors the
+                # executor's per-step try/catch)
                 self.results[rid] = GenerationResult(
                     text="", token_ids=[], prefill_ms=0.0, decode_ms=0.0,
                     steps=0, finished=False, error=str(e),
@@ -167,16 +170,11 @@ class ContinuousBatcher:
 
         eng = self.engine
         self._rng, k = jax.random.split(self._rng)
-        (out, n, eos, eng.cache, self.cur, self.pos, self.fsm, self.active,
-         self.nbytes, self.tokens_left) = chunk_decode_loop(
-            eng.params, eng.cfg, eng.cache,
-            self.cur, self.pos, self.fsm, self.active, self.nbytes, self.tokens_left,
-            eng.tables, eng.byte_len_table,
-            k, jnp.float32(self.temperature), jnp.int32(self.byte_budget),
-            rules=eng.rules, logit_mask=eng.logit_mask,
-            chunk_steps=self.chunk_steps,
-            greedy=self.greedy, constrained=True, kernels=eng.kernels,
-            eos_id=eng.eos_id, pad_id=eng.pad_id,
+        (out, n, eos, self.cur, self.pos, self.fsm, self.active,
+         self.nbytes, self.tokens_left) = eng.decode_chunk(
+            self.cur, self.pos, self.fsm, self.active, self.nbytes,
+            self.tokens_left, k, self.temperature, self.byte_budget,
+            self.chunk_steps, self.greedy,
         )
         # one transfer for everything the host needs this chunk (a combined
         # device_get is ONE tunnel round trip; separate gets pay one each)
@@ -213,6 +211,7 @@ class ContinuousBatcher:
                 m.observe_ms("scheduler.request_total",
                              (time.perf_counter() - sl.start_s) * 1e3)
                 self.slots[b] = _Slot()
+                self.engine.release_slot(b)  # paged engines free the blocks
 
     # ------------------------------------------------------------ drain
 
