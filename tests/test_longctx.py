@@ -1,0 +1,130 @@
+"""Long-context SP prefill + planner serving path (parallel/longctx.py,
+serve/planner.py).
+
+The SP prefill must match the single-device dense forward exactly (it is
+the same math, resharded), and the planner must produce grammar-valid plans
+across warm extends and SP re-anchors.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.models.llama import (
+    LlamaConfig, forward, init_kv_cache, init_params,
+)
+from tpu_voice_agent.parallel.longctx import llama_sp_prefill, sp_pad_len
+from tpu_voice_agent.parallel.ring import sp_mesh
+
+CFG = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                  ffn_dim=128, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def fp32_setup():
+    params = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = sp_mesh(4)
+    return params, mesh
+
+
+def test_sp_prefill_matches_dense_forward(fp32_setup):
+    params, mesh = fp32_setup
+    B, T = 2, 64
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, CFG.vocab_size, (B, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    cache = init_kv_cache(CFG, B, T, dtype=jnp.float32)
+    ref_logits, ref_cache = forward(params, CFG, tokens, positions, cache)
+
+    last = jnp.full((B,), T - 1, jnp.int32)
+    sp_logits, sp_cache = llama_sp_prefill(params, CFG, tokens, mesh, last)
+
+    np.testing.assert_allclose(
+        np.asarray(sp_logits), np.asarray(ref_logits[:, -1, :]), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(sp_cache["k"]), np.asarray(ref_cache["k"]), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(sp_cache["v"]), np.asarray(ref_cache["v"]), rtol=2e-4, atol=2e-4)
+
+
+def test_sp_prefill_padded_rows(fp32_setup):
+    """Rows shorter than the bucket: last_index picks each row's own
+    frontier logits; the valid cache prefix matches the dense forward."""
+    params, mesh = fp32_setup
+    B, T = 2, 64
+    n = [50, 37]
+    rng = np.random.default_rng(1)
+    tok = np.zeros((B, T), dtype=np.int32)
+    for b in range(B):
+        tok[b, : n[b]] = rng.integers(1, CFG.vocab_size, n[b])
+    tokens = jnp.asarray(tok)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    cache = init_kv_cache(CFG, B, T, dtype=jnp.float32)
+    ref_logits, _ = forward(params, CFG, tokens, positions, cache)
+
+    sp_logits, _ = llama_sp_prefill(
+        params, CFG, tokens, mesh, jnp.asarray([x - 1 for x in n], jnp.int32))
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(sp_logits[b]), np.asarray(ref_logits[b, n[b] - 1]),
+            rtol=2e-4, atol=2e-4)
+
+
+def test_sp_pad_len():
+    assert sp_pad_len(1, 4) == 4
+    assert sp_pad_len(64, 4) == 64
+    assert sp_pad_len(65, 4) == 68
+    assert sp_pad_len(10, 4, multiple=8) == 32
+
+
+@pytest.fixture(scope="module")
+def planner():
+    from tpu_voice_agent.serve.planner import LongSessionPlanner
+
+    return LongSessionPlanner(
+        preset="test-tiny", mesh=sp_mesh(4), ctx_buckets=(128, 256, 512),
+        extend_buckets=(16, 32), max_new_tokens=32,
+    )
+
+
+def test_planner_cold_start_plan_is_grammar_valid(planner):
+    sess = planner.start("user: search for usb hubs\n")
+    text, ids = planner.plan(sess)
+    assert len(ids) > 0
+    assert planner.fsm.walk(ids) >= 0, f"plan left the grammar: {text[:80]}"
+    assert sess.anchors == 1
+    # the plan joined the transcript
+    assert sess.ids[-len(ids):] == ids
+
+
+def test_planner_warm_extend_then_plan(planner):
+    sess = planner.start("user: search for laptops\n")
+    pos0 = sess.pos
+    planner.plan(sess)
+    planner.extend(sess, "result: 24 items\nuser: sort by price\n")
+    assert sess.pos > pos0
+    assert sess.anchors == 1  # warm path: no re-anchor
+    text, ids = planner.plan(sess)
+    assert planner.fsm.walk(ids) >= 0
+
+
+def test_planner_reanchors_when_bucket_overflows(planner):
+    sess = planner.start("user: open example.com\n")
+    assert sess.cache["k"].shape[2] == 128
+    # grow the transcript past bucket 128 (max_new 32 forces early spill)
+    for i in range(6):
+        planner.extend(sess, f"user: now filter results under {i} dollars please\n")
+    assert sess.anchors >= 2
+    assert sess.cache["k"].shape[2] >= 256
+    text, ids = planner.plan(sess)
+    assert planner.fsm.walk(ids) >= 0
+
+
+def test_planner_plan_requires_frontier(planner):
+    sess = planner.start("user: screenshot\n")
+    planner.plan(sess)
+    with pytest.raises(ValueError, match="extend"):
+        planner.plan(sess)

@@ -184,6 +184,41 @@ def _attend(q, k_cache, v_cache, q_positions, kv_len_mask):
     return out.reshape(B, T, nq * hd).astype(q.dtype)
 
 
+def _identity_cs(x, name):
+    return x
+
+
+def _layer_qkv(p, x, cfg: LlamaConfig, cos, sin, cs=_identity_cs):
+    """Shared decoder-layer front half: attn-norm -> q/k/v projections ->
+    head reshape -> RoPE. The ONE copy of this math for forward /
+    forward_paged / pipeline / longctx (they differ only in how KV is
+    written and attended, never in the projections)."""
+    B, T = x.shape[:2]
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    h = cs(h, "act")
+    q = jnp.einsum("btd,dh->bth", h, _w(p["wq"]), preferred_element_type=jnp.float32).astype(x.dtype)
+    k = jnp.einsum("btd,dh->bth", h, _w(p["wk"]), preferred_element_type=jnp.float32).astype(x.dtype)
+    v = jnp.einsum("btd,dh->bth", h, _w(p["wv"]), preferred_element_type=jnp.float32).astype(x.dtype)
+    q = cs(q.reshape(B, T, cfg.n_heads, cfg.head_dim), "heads")
+    k = cs(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
+    v = cs(v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
+def _layer_out(p, x, attn, cfg: LlamaConfig, cs=_identity_cs):
+    """Shared decoder-layer back half: output projection + residual, then
+    the SwiGLU MLP + residual. ``attn`` is (B, T, n_heads * head_dim)."""
+    attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)
+    x = x + cs(attn, "act")
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("btd,df->btf", h, _w(p["w_gate"]), preferred_element_type=jnp.float32)
+    up = jnp.einsum("btd,df->btf", h, _w(p["w_up"]), preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    act = cs(act, "ffn")
+    down = jnp.einsum("btf,fd->btd", act, _w(p["w_down"]), preferred_element_type=jnp.float32).astype(x.dtype)
+    return x + cs(down, "act")
+
+
 # ---------------------------------------------------------------- forward
 
 
@@ -240,16 +275,7 @@ def forward(
     def layer(carry, layer_in):
         x, kc, vc = carry
         p, li = layer_in
-        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-        h = cs(h, "act")
-        q = jnp.einsum("btd,dh->bth", h, _w(p["wq"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.einsum("btd,dh->bth", h, _w(p["wk"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.einsum("btd,dh->bth", h, _w(p["wv"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        q = cs(q.reshape(B, T, cfg.n_heads, cfg.head_dim), "heads")
-        k = cs(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
-        v = cs(v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = _layer_qkv(p, x, cfg, cos, sin, cs)
 
         kc = kc.at[li, batch_idx, positions].set(k)
         vc = vc.at[li, batch_idx, positions].set(v)
@@ -276,16 +302,7 @@ def forward(
             attn = sharded_flash_attention(mesh, q, k, v, causal=True).reshape(B, T, -1)
         else:
             attn = _attend(q, kc[li], vc[li], positions, kv_len_mask)
-        attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + cs(attn, "act")
-
-        h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-        gate = jnp.einsum("btd,df->btf", h, _w(p["w_gate"]), preferred_element_type=jnp.float32)
-        up = jnp.einsum("btd,df->btf", h, _w(p["w_up"]), preferred_element_type=jnp.float32)
-        act = (jax.nn.silu(gate) * up).astype(x.dtype)
-        act = cs(act, "ffn")
-        down = jnp.einsum("btf,fd->btd", act, _w(p["w_down"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + cs(down, "act")
+        x = _layer_out(p, x, attn, cfg, cs)
         return (x, kc, vc), None
 
     layer_fn = jax.checkpoint(layer) if remat else layer
@@ -345,13 +362,7 @@ def forward_paged(
     def layer(carry, layer_in):
         x, kp, vp = carry
         p, li = layer_in
-        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("btd,dh->bth", h, _w(p["wq"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.einsum("btd,dh->bth", h, _w(p["wk"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.einsum("btd,dh->bth", h, _w(p["wv"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        q = apply_rope(q.reshape(B, T, cfg.n_heads, cfg.head_dim), cos, sin)
-        k = apply_rope(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), cos, sin)
-        v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q, k, v = _layer_qkv(p, x, cfg, cos, sin, cs)
 
         kp_flat = kp.reshape(L, N * bs, cfg.n_kv_heads, cfg.head_dim)
         vp_flat = vp.reshape(L, N * bs, cfg.n_kv_heads, cfg.head_dim)
@@ -369,16 +380,7 @@ def forward_paged(
             kl = kp[li][block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
             vl = vp[li][block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
             attn = _attend(q, kl, vl, positions, kv_len_mask)
-        attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + cs(attn, "act")
-
-        h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-        gate = jnp.einsum("btd,df->btf", h, _w(p["w_gate"]), preferred_element_type=jnp.float32)
-        up = jnp.einsum("btd,df->btf", h, _w(p["w_up"]), preferred_element_type=jnp.float32)
-        act = (jax.nn.silu(gate) * up).astype(x.dtype)
-        act = cs(act, "ffn")
-        down = jnp.einsum("btf,fd->btd", act, _w(p["w_down"]), preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + cs(down, "act")
+        x = _layer_out(p, x, attn, cfg, cs)
         return (x, kp, vp), None
 
     (x, k_pool, v_pool), _ = jax.lax.scan(

@@ -1,0 +1,58 @@
+"""Top-k MoE routing math shared by the served decoder and the EP layer.
+
+Dense-dispatch routing (no data-dependent shapes — jit/MXU friendly): the
+(token, expert, slot) one-hot dispatch/combine tensors turn expert selection
+into einsums. Used by:
+
+- ``models.llama`` when ``LlamaConfig.n_experts > 0`` (a served Mixtral-style
+  decoder: the MoE FFN replaces the dense SwiGLU inside the layer scan)
+- ``parallel.expert`` (the standalone EP shard_map layout over an ``ep``
+  mesh axis)
+
+Capacity semantics are standard Switch/GShard: each expert owns C slots;
+overflow tokens lose that expert's contribution and the combine weights
+renormalize over the survivors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def moe_capacity(n_tokens: int, n_experts: int, top_k: int,
+                 capacity_factor: float = 1.25) -> int:
+    return max(1, int(np.ceil(n_tokens * top_k / n_experts * capacity_factor)))
+
+
+def route_topk(router_w: jax.Array, x: jax.Array, n_experts: int, top_k: int,
+               capacity: int) -> tuple[jax.Array, jax.Array]:
+    """x (T, d), router_w (d, E) -> (dispatch (T, E, C) one-hot,
+    combine (T, E, C) gate-weighted). Pure function of static E/K/C."""
+    E, K, C = n_experts, top_k, capacity
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+
+    # top-k mask per token (iterative argmax — K is tiny and static)
+    gates = jnp.zeros_like(probs)
+    masked = probs
+    for _ in range(K):
+        idx = jnp.argmax(masked, axis=-1)  # (T,)
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        gates = gates + onehot * probs
+        masked = masked * (1.0 - onehot)
+
+    chosen = gates > 0.0  # (T, E) bool
+    # slot position of each token within its expert's queue, in token order
+    pos = jnp.cumsum(chosen.astype(jnp.int32), axis=0) - 1  # (T, E)
+    keep = chosen & (pos < C)
+    # renormalize gates over experts that kept the token
+    kept_gate = jnp.where(keep, gates, 0.0)
+    denom = jnp.sum(kept_gate, axis=-1, keepdims=True)
+    kept_gate = kept_gate / jnp.where(denom == 0.0, 1.0, denom)
+
+    slot_onehot = jax.nn.one_hot(jnp.where(keep, pos, C), C, dtype=probs.dtype)  # (T,E,C)
+    dispatch = slot_onehot * keep[..., None]
+    combine = dispatch * kept_gate[..., None]
+    return dispatch, combine

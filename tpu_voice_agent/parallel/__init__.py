@@ -1,4 +1,5 @@
 from .mesh import make_mesh, ShardingRules, default_rules, param_shardings, kv_cache_shardings
+from .longctx import llama_sp_prefill, sp_pad_len
 from .ring import ring_attention, sp_mesh, ulysses_attention
 from .pipeline import (
     llama_pp_forward,
@@ -17,6 +18,8 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "sp_mesh",
+    "llama_sp_prefill",
+    "sp_pad_len",
     "llama_pp_forward",
     "pipeline_apply",
     "pp_mesh",

@@ -44,8 +44,9 @@ class MoEConfig:
     capacity_factor: float = 1.25
 
     def capacity(self, n_tokens: int) -> int:
-        return max(1, int(np.ceil(n_tokens * self.top_k / self.n_experts
-                                  * self.capacity_factor)))
+        from ..models.moe import moe_capacity
+
+        return moe_capacity(n_tokens, self.n_experts, self.top_k, self.capacity_factor)
 
 
 def ep_mesh(ep: int, devices: list | None = None) -> Mesh:
@@ -86,34 +87,12 @@ def moe_param_shardings(mesh: Mesh) -> dict:
 
 
 def _route(router_w: jax.Array, x: jax.Array, cfg: MoEConfig, n_tokens: int):
-    """Shared routing math -> (dispatch (T,E,C) one-hot, combine (T,E,C))."""
-    E, K = cfg.n_experts, cfg.top_k
-    C = cfg.capacity(n_tokens)
-    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router_w.astype(jnp.float32))
-    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+    """Shared routing math -> (dispatch (T,E,C) one-hot, combine (T,E,C)).
+    Delegates to models.moe.route_topk — ONE copy of the routing math for
+    the standalone EP layer and the served MoE decoder (models.llama)."""
+    from ..models.moe import route_topk
 
-    # top-k mask per token (iterative argmax — K is tiny and static)
-    gates = jnp.zeros_like(probs)
-    masked = probs
-    for _ in range(K):
-        idx = jnp.argmax(masked, axis=-1)  # (T,)
-        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)
-        gates = gates + onehot * probs
-        masked = masked * (1.0 - onehot)
-
-    chosen = gates > 0.0  # (T, E) bool
-    # slot position of each token within its expert's queue, in token order
-    pos = jnp.cumsum(chosen.astype(jnp.int32), axis=0) - 1  # (T, E)
-    keep = chosen & (pos < C)
-    # renormalize gates over experts that kept the token
-    kept_gate = jnp.where(keep, gates, 0.0)
-    denom = jnp.sum(kept_gate, axis=-1, keepdims=True)
-    kept_gate = kept_gate / jnp.where(denom == 0.0, 1.0, denom)
-
-    slot_onehot = jax.nn.one_hot(jnp.where(keep, pos, C), C, dtype=probs.dtype)  # (T,E,C)
-    dispatch = slot_onehot * keep[..., None]
-    combine = dispatch * kept_gate[..., None]
-    return dispatch, combine
+    return route_topk(router_w, x, cfg.n_experts, cfg.top_k, cfg.capacity(n_tokens))
 
 
 def _expert_ffn(p: dict, xe: jax.Array) -> jax.Array:

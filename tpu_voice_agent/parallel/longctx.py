@@ -1,0 +1,99 @@
+"""Long-context prefill: sequence-parallel ring attention feeding cached decode.
+
+This is the long-session planner path SURVEY.md §5 calls for ("this is where
+real SP/CP enters — ring/blockwise attention Pallas kernels for the
+long-session planner config"). The reference's only notion of a long session
+is a rolling context dict in the voice service (apps/voice/src/server.ts:
+162-170); here a planner accumulates the whole session transcript and
+prefills it with the sequence dimension sharded over an ``sp`` mesh axis:
+
+- activations (B, T, D) and the produced KV cache (L, B, T, nkv, hd) shard
+  their T axis over ``sp`` — per-device HBM holds T/sp of the session, so
+  context length scales with the number of chips
+- attention inside every layer is ``parallel.ring.ring_attention``: K/V
+  shards rotate around the ring via ``ppermute`` (one ICI hop per step),
+  online-softmax merging keeps it exact
+- everything else in the layer (norms, projections, SwiGLU) is pointwise
+  over T, so the sp sharding flows straight through the einsums — XLA
+  inserts zero collectives outside the ring
+- the output is the standard dense KV layout ``models.llama.forward``
+  decodes against, so a long prefill hands off to the ordinary cached
+  decode loop (serve.planner.LongSessionPlanner drives both)
+
+``llama_sp_prefill`` matches the single-device ``models.llama.forward`` on
+a fresh cache to numerical tolerance (tests/test_longctx.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import (
+    LlamaConfig, _layer_out, _layer_qkv, _w, rms_norm, rope_tables,
+)
+from .ring import ring_attention
+
+
+def sp_pad_len(n: int, sp: int, multiple: int = 1) -> int:
+    """Smallest padded length >= n divisible by sp (and `multiple`)."""
+    q = sp * multiple
+    return -(-max(n, 1) // q) * q
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def llama_sp_prefill(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, T) int32, positions implicitly 0..T-1; T % sp == 0
+    mesh: Mesh,
+    last_index: jax.Array,  # (B,) int32 — index of each row's last real token
+) -> tuple[jax.Array, dict]:
+    """Fresh-sequence prefill with T sharded over mesh axis "sp".
+
+    Returns (last_logits (B, V) — logits at each row's ``last_index`` —
+    and the dense KV cache (L, B, T, nkv, hd), T-sharded over sp). Rows are
+    fresh sequences starting at position 0 (the planner's cold-start /
+    re-anchor path); trailing padding past ``last_index`` writes KV that
+    decode later overwrites slot-by-slot, exactly like the engine's
+    bucketed prefill.
+    """
+    B, T = tokens.shape
+    seq_sh = NamedSharding(mesh, P(None, "sp", None))
+    kv_sh = NamedSharding(mesh, P(None, "sp", None, None))
+
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    x = params["embed"][tokens]  # (B, T, D)
+    x = jax.lax.with_sharding_constraint(x, seq_sh)
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    def cs(a, name):
+        # every constraint point keeps the sequence axis on "sp" (heads and
+        # ffn stay unsharded — sp is the only axis this prefill uses)
+        sh = kv_sh if a.ndim == 4 else NamedSharding(mesh, P(None, "sp", None))
+        return jax.lax.with_sharding_constraint(a, sh)
+
+    def layer(x, p):
+        q, k, v = _layer_qkv(p, x, cfg, cos, sin, cs)
+        attn = ring_attention(q, k, v, mesh, causal=True)  # exact, sp-sharded
+        attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
+        x = _layer_out(p, x, attn, cfg, cs)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
+    # ks/vs: (L, B, T, nkv, hd), T sharded over sp — the dense decode layout
+    cache_sh = NamedSharding(mesh, P(None, None, "sp", None, None))
+    ks = jax.lax.with_sharding_constraint(ks, cache_sh)
+    vs = jax.lax.with_sharding_constraint(vs, cache_sh)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # only each row's LAST real hidden state meets the lm_head: at session
+    # lengths the (B, T, V) logits tensor is the single biggest waste a
+    # long-context prefill can produce
+    last_h = jnp.take_along_axis(x, last_index[:, None, None].astype(jnp.int32), axis=1)
+    logits = jnp.einsum("btd,dv->btv", last_h, _w(params["lm_head"]),
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0, :], {"k": ks, "v": vs}

@@ -23,7 +23,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..models.llama import LlamaConfig, _attend, apply_rope, rms_norm, rope_tables
+from ..models.llama import (
+    LlamaConfig, _attend, _layer_out, _layer_qkv, rms_norm, rope_tables,
+)
 
 
 def pp_mesh(pp: int, devices: list | None = None) -> Mesh:
@@ -107,26 +109,13 @@ def _decoder_block_cached(x, p, k_cache, v_cache, positions, kv_len_mask, cfg: L
     """One decoder block attending over (and writing into) a dense KV cache
     line — the cached twin of ``_decoder_block``, math-mirroring
     models.llama.forward's layer (parity-tested)."""
-    B, T, _ = x.shape
+    B = x.shape[0]
     batch_idx = jnp.arange(B)[:, None]
-    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("btd,dh->bth", h, p["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-    k = jnp.einsum("btd,dh->bth", h, p["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-    v = jnp.einsum("btd,dh->bth", h, p["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
-    q = apply_rope(q.reshape(B, T, cfg.n_heads, cfg.head_dim), cos, sin)
-    k = apply_rope(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), cos, sin)
-    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q, k, v = _layer_qkv(p, x, cfg, cos, sin)
     k_cache = k_cache.at[batch_idx, positions].set(k)
     v_cache = v_cache.at[batch_idx, positions].set(v)
     attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
-    attn = jnp.einsum("bth,hd->btd", attn, p["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
-    x = x + attn
-    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("btd,df->btf", h, p["w_gate"], preferred_element_type=jnp.float32)
-    up = jnp.einsum("btd,df->btf", h, p["w_up"], preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
-    down = jnp.einsum("btf,fd->btd", act, p["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
-    return x + down, k_cache, v_cache
+    return _layer_out(p, x, attn, cfg), k_cache, v_cache
 
 
 def init_pp_cache(cfg: LlamaConfig, mesh: Mesh, batch: int, max_len: int,

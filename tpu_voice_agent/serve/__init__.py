@@ -2,6 +2,7 @@ from .colocate import ColocatedServing
 from .engine import DecodeEngine, GenerationResult
 from .grounding import GroundingEngine, GroundingResult
 from .paged import BlockAllocator, PagedDecodeEngine
+from .planner import LongSessionPlanner, PlannerSession
 from .scheduler import ContinuousBatcher
 
 __all__ = [
@@ -12,5 +13,7 @@ __all__ = [
     "GenerationResult",
     "GroundingEngine",
     "GroundingResult",
+    "LongSessionPlanner",
     "PagedDecodeEngine",
+    "PlannerSession",
 ]

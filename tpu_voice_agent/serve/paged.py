@@ -192,7 +192,8 @@ class PagedDecodeEngine(DecodeEngine):
 
     def __init__(self, *args, block_size: int = 128, pool_blocks: int | None = None,
                  **kw):
-        if kw.get("mesh") is not None:
+        # mesh is DecodeEngine's 3rd positional parameter — guard both ways
+        if kw.get("mesh") is not None or (len(args) >= 3 and args[2] is not None):
             raise ValueError("PagedDecodeEngine is single-device for now")
         super().__init__(*args, **kw)
         bs = block_size
@@ -237,6 +238,11 @@ class PagedDecodeEngine(DecodeEngine):
             )
         if P % bs:
             self._prefix_tail = {"k": pk[:, full * bs:], "v": pv[:, full * bs:]}
+        # the dense (L, 1, P, nkv, hd) prefix KV now lives in the pool (full
+        # blocks) + self._prefix_tail (remainder); keeping the dense copy
+        # would hold the prefix in HBM twice for the engine's lifetime.
+        # _split_prefix only needs a non-None sentinel.
+        self.prefix_kv = {}
         return P
 
     # ------------------------------------------------------------ admission

@@ -1,0 +1,206 @@
+"""Long-session planner: SP ring-attention prefill + ordinary cached decode.
+
+The reference keeps no session history at all — its "context" is a rolling
+dict the voice service merges brain `context_updates` into
+(apps/voice/src/server.ts:162-170), so a session's past utterances are gone
+the moment they're summarized. The planner path keeps the FULL session
+transcript (every utterance, every intent result) as model context instead,
+which is exactly the long-context regime SURVEY.md §5 reserves for sequence
+parallelism:
+
+- cold start / re-anchor: the whole transcript prefills through
+  ``parallel.longctx.llama_sp_prefill`` — sequence sharded over the ``sp``
+  mesh axis, ring attention inside every layer, KV emerging in the standard
+  dense decode layout
+- warm turns: new utterances append through the ordinary cached
+  ``models.llama.forward`` (cost O(new tokens), like the engine's
+  prefix-cached suffix prefill)
+- decode: the engine's on-device ``chunk_decode_loop``, grammar-constrained
+  so plans always parse (same FSM machinery as serve.engine)
+
+When a session outgrows its decode cache the planner transparently
+re-anchors: one SP prefill over the full transcript into the next context
+bucket. That is the scale story the reference cannot have: context capacity
+grows with chips on the ``sp`` axis, not with a single host's memory.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..grammar.intent_grammar import build_intent_fsm
+from ..models.llama import LlamaConfig, PRESETS, forward, init_params
+from ..parallel.longctx import llama_sp_prefill
+from .engine import _first_token, chunk_decode_loop
+
+
+@dataclass
+class PlannerSession:
+    """One live session: transcript ids + its KV cache on the mesh."""
+
+    ids: list[int] = field(default_factory=list)  # full transcript tokens
+    cache: dict | None = None  # (L, 1, S, nkv, hd) replicated over the mesh
+    pos: int = 0  # next cache write slot (= len(ids) after anchoring)
+    last_logits: jax.Array | None = None  # (1, V) at the transcript frontier
+    anchors: int = 0  # how many SP re-anchor prefills this session has paid
+
+
+class LongSessionPlanner:
+    """Grammar-constrained planner over unbounded session transcripts.
+
+    ``ctx_buckets`` are the decode-cache capacities (one XLA program per
+    bucket); each must be divisible by the sp axis. A session lives in the
+    smallest bucket that fits its transcript + generation headroom and
+    re-anchors upward when it outgrows it.
+    """
+
+    def __init__(
+        self,
+        preset: str = "test-tiny",
+        cfg: LlamaConfig | None = None,
+        mesh: Mesh | None = None,
+        seed: int = 0,
+        ctx_buckets: tuple[int, ...] = (1024, 2048, 4096, 8192),
+        extend_buckets: tuple[int, ...] = (32, 128, 512),
+        max_new_tokens: int = 256,
+        kernels: str = "xla",
+    ):
+        if mesh is None or "sp" not in mesh.shape:
+            raise ValueError("LongSessionPlanner needs a mesh with an 'sp' axis")
+        self.mesh = mesh
+        self.sp = mesh.shape["sp"]
+        for b in ctx_buckets:
+            if b % self.sp:
+                raise ValueError(f"ctx bucket {b} not divisible by sp={self.sp}")
+        self.ctx_buckets = tuple(sorted(ctx_buckets))
+        self.extend_buckets = tuple(sorted(extend_buckets))
+        self.max_new_tokens = max_new_tokens
+        self.kernels = kernels
+
+        self.tokenizer, self.fsm = build_intent_fsm()
+        base = cfg or PRESETS[preset]
+        from dataclasses import replace
+
+        self.cfg = replace(base, vocab_size=self.tokenizer.vocab_size,
+                           max_seq_len=self.ctx_buckets[-1])
+        self.eos_id = int(self.tokenizer.eos_id)
+        self.pad_id = int(self.tokenizer.pad_id)
+        self.tables = self.fsm.device_tables()
+        self.byte_len_table = jnp.asarray(np.array(
+            [len(self.tokenizer.token_bytes(i)) for i in range(self.cfg.vocab_size)],
+            dtype=np.int32))
+        self._rep = NamedSharding(mesh, P())
+        self.params = jax.jit(
+            partial(init_params, self.cfg), out_shardings=self._rep
+        )(jax.random.PRNGKey(seed))
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+    def load_params(self, params) -> None:
+        self.params = jax.device_put(params, self._rep)
+
+    # ------------------------------------------------------------ anchoring
+
+    def _ctx_bucket(self, need: int) -> int:
+        for b in self.ctx_buckets:
+            if need <= b:
+                return b
+        raise ValueError(
+            f"session needs {need} cache slots, max ctx bucket is "
+            f"{self.ctx_buckets[-1]} — add sp devices or a larger bucket")
+
+    def _anchor(self, sess: PlannerSession) -> None:
+        """SP-prefill the full transcript into a fresh decode cache."""
+        n = len(sess.ids)
+        S = self._ctx_bucket(n + self.max_new_tokens)
+        tokens = np.full((1, S), self.pad_id, dtype=np.int32)
+        tokens[0, :n] = sess.ids
+        # the SP prefill runs over the WHOLE bucket (static shape per
+        # bucket); padding slots carry pad_id and are overwritten by decode
+        last_logits, kv = llama_sp_prefill(
+            self.params, self.cfg, jnp.asarray(tokens), self.mesh,
+            jnp.asarray([n - 1], jnp.int32),
+        )
+        # decode runs replicated (sequence-sharding has nothing to shard at
+        # T=1); one resharding collective moves the cache off the sp layout
+        sess.cache = jax.device_put(kv, self._rep)
+        sess.pos = n
+        sess.last_logits = last_logits
+        sess.anchors += 1
+
+    # ------------------------------------------------------------ session API
+
+    def start(self, transcript: str) -> PlannerSession:
+        """Open a session from its initial transcript (cold start)."""
+        sess = PlannerSession(ids=self.tokenizer.encode(transcript, bos=True))
+        self._anchor(sess)
+        return sess
+
+    def extend(self, sess: PlannerSession, text: str) -> None:
+        """Append a new utterance/result line to the session (warm path:
+        cached forward over only the new tokens — O(new), not O(session)).
+        Re-anchors via SP prefill when the bucket can't hold the growth."""
+        new_ids = self.tokenizer.encode(text, bos=False)
+        sess.ids.extend(new_ids)
+        m = len(new_ids)
+        S = sess.cache["k"].shape[2]
+        bucket = next((b for b in self.extend_buckets if m <= b), None)
+        if bucket is None or sess.pos + bucket + self.max_new_tokens > S:
+            self._anchor(sess)  # outgrew the bucket: one SP prefill
+            return
+        tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
+        tokens[0, :m] = new_ids
+        positions = (sess.pos + np.arange(bucket, dtype=np.int32))[None, :]
+        logits, sess.cache = forward(
+            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+            sess.cache, attn_impl="xla",
+        )
+        sess.last_logits = logits[:, m - 1, :]
+        sess.pos += m
+
+    def plan(self, sess: PlannerSession, max_new_tokens: int | None = None,
+             greedy: bool = True, temperature: float = 0.7,
+             byte_budget: int = 3900) -> tuple[str, list[int]]:
+        """Decode a grammar-valid intent plan at the session frontier. The
+        generated tokens join the transcript (the session's own plans are
+        part of its history, unlike the reference's forgotten summaries)."""
+        if sess.last_logits is None:
+            raise ValueError("no frontier logits: extend() the session before plan()")
+        max_new = max_new_tokens or self.max_new_tokens
+        t0 = time.perf_counter()
+        self._rng, k0 = jax.random.split(self._rng)
+        state0 = jnp.full((1,), self.fsm.start, dtype=jnp.int32)
+        tok0, fsm0 = _first_token(
+            sess.last_logits, state0, self.tables, k0, jnp.float32(temperature),
+            greedy=greedy, constrained=True, kernels=self.kernels,
+        )
+        self._rng, key = jax.random.split(self._rng)
+        buf, count, eos, sess.cache, cur, pos, _, _, _, _ = chunk_decode_loop(
+            self.params, self.cfg, sess.cache,
+            tok0, jnp.full((1,), sess.pos, jnp.int32), fsm0,
+            tok0 != self.eos_id,
+            jnp.zeros((1,), jnp.int32),
+            jnp.full((1,), max_new, jnp.int32),
+            self.tables, self.byte_len_table,
+            key, jnp.float32(temperature), jnp.int32(byte_budget),
+            chunk_steps=max_new, greedy=greedy, constrained=True,
+            kernels=self.kernels, eos_id=self.eos_id, pad_id=self.pad_id,
+        )
+        buf_h, count_h = jax.device_get((buf, count))
+        out_ids = [int(t) for t in np.asarray(buf_h)[0, : int(count_h[0])]]
+        sess.ids.extend(out_ids)
+        sess.pos = int(jax.device_get(pos)[0])
+        sess.last_logits = None  # frontier logits consumed; next turn extends
+
+        from ..utils import get_metrics
+
+        m = get_metrics()
+        m.inc("planner.plans")
+        m.observe_ms("planner.plan", (time.perf_counter() - t0) * 1e3)
+        return self.tokenizer.decode(out_ids), out_ids

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .engine import DecodeEngine, GenerationResult, _first_token
+from .paged import PoolExhausted
 
 
 
@@ -156,10 +157,12 @@ class ContinuousBatcher:
             try:
                 self._admit(slot, rid, prompt)
                 act[slot] = True
-            except (ValueError, RuntimeError) as e:
+            except (ValueError, PoolExhausted) as e:
                 # per-request isolation: an oversized prompt or an exhausted
                 # KV pool fails alone, never the batch (mirrors the
-                # executor's per-step try/catch)
+                # executor's per-step try/catch). Deliberately NOT a broad
+                # RuntimeError catch: XlaRuntimeError device faults must
+                # propagate, not dispatch more chunks on a corrupted engine.
                 self.results[rid] = GenerationResult(
                     text="", token_ids=[], prefill_ms=0.0, decode_ms=0.0,
                     steps=0, finished=False, error=str(e),
