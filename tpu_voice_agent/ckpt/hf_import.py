@@ -37,6 +37,8 @@ def llama_config_from_hf(path: str) -> LlamaConfig:
         path = os.path.join(path, "config.json")
     with open(path) as f:
         cfg = json.load(f)
+    E = cfg.get("num_local_experts", 0)
+    K = cfg.get("num_experts_per_tok", 2)
     return LlamaConfig(
         vocab_size=cfg["vocab_size"],
         dim=cfg["hidden_size"],
@@ -47,6 +49,13 @@ def llama_config_from_hf(path: str) -> LlamaConfig:
         max_seq_len=cfg.get("max_position_embeddings", 2048),
         rope_theta=float(cfg.get("rope_theta", 10_000.0)),
         norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+        # Mixtral-style MoE configs (MixtralForCausalLM) carry expert counts;
+        # capacity_factor = E/K makes routing drop-free so chunked prefill
+        # stays exactly consistent with per-token decode (see PRESETS note
+        # in models/llama.py) — the HF config has no such field to read
+        n_experts=E,
+        top_k=K,
+        capacity_factor=max(1.25, E / K) if E else 1.25,
     )
 
 
@@ -107,10 +116,19 @@ def llama_hf_check(shapes: dict[str, tuple[int, ...]], cfg: LlamaConfig) -> None
         "self_attn.v_proj.weight": (nkv * hd, d),
         "self_attn.o_proj.weight": (d, nq * hd),
         "post_attention_layernorm.weight": (d,),
-        "mlp.gate_proj.weight": (f, d),
-        "mlp.up_proj.weight": (f, d),
-        "mlp.down_proj.weight": (d, f),
     }
+    if cfg.n_experts > 0:
+        per_layer["block_sparse_moe.gate.weight"] = (cfg.n_experts, d)
+        for e in range(cfg.n_experts):
+            per_layer[f"block_sparse_moe.experts.{e}.w1.weight"] = (f, d)
+            per_layer[f"block_sparse_moe.experts.{e}.w3.weight"] = (f, d)
+            per_layer[f"block_sparse_moe.experts.{e}.w2.weight"] = (d, f)
+    else:
+        per_layer.update({
+            "mlp.gate_proj.weight": (f, d),
+            "mlp.up_proj.weight": (f, d),
+            "mlp.down_proj.weight": (d, f),
+        })
     for layer in range(cfg.n_layers):
         for suffix, shape in per_layer.items():
             want[f"model.layers.{layer}.{suffix}"] = shape
@@ -129,20 +147,27 @@ def llama_hf_check(shapes: dict[str, tuple[int, ...]], cfg: LlamaConfig) -> None
         raise ValueError("HF checkpoint mismatch:\n" + "\n".join(problems[:20]))
 
 
-def llama_hf_key_map(layer: int) -> dict[str, str]:
-    """Our per-layer leaf name -> HF tensor name, for layer ``layer``."""
+def llama_hf_key_map(layer: int, moe: bool = False) -> dict[str, str]:
+    """Our per-layer leaf name -> HF tensor name, for layer ``layer``.
+    ``moe=True`` (Mixtral naming): the dense MLP keys are absent — the
+    router and per-expert tensors are handled by llama_from_hf_state's
+    expert stacking (they map E tensors onto one stacked leaf)."""
     p = f"model.layers.{layer}."
-    return {
+    base = {
         "attn_norm": p + "input_layernorm.weight",
         "wq": p + "self_attn.q_proj.weight",
         "wk": p + "self_attn.k_proj.weight",
         "wv": p + "self_attn.v_proj.weight",
         "wo": p + "self_attn.o_proj.weight",
         "mlp_norm": p + "post_attention_layernorm.weight",
-        "w_gate": p + "mlp.gate_proj.weight",
-        "w_up": p + "mlp.up_proj.weight",
-        "w_down": p + "mlp.down_proj.weight",
     }
+    if not moe:
+        base.update({
+            "w_gate": p + "mlp.gate_proj.weight",
+            "w_up": p + "mlp.up_proj.weight",
+            "w_down": p + "mlp.down_proj.weight",
+        })
+    return base
 
 
 _TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
@@ -184,6 +209,7 @@ def llama_from_hf_state(
 
     d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
     nq, nkv = cfg.n_heads, cfg.n_kv_heads
+    moe = cfg.n_experts > 0
     want = {
         "attn_norm": (d,),
         "wq": (d, nq * hd),
@@ -191,14 +217,29 @@ def llama_from_hf_state(
         "wv": (d, nkv * hd),
         "wo": (nq * hd, d),
         "mlp_norm": (d,),
-        "w_gate": (d, f),
-        "w_up": (d, f),
-        "w_down": (f, d),
     }
+    if not moe:
+        want.update({"w_gate": (d, f), "w_up": (d, f), "w_down": (f, d)})
     stacked: dict[str, list] = {k: [] for k in want}
+    if moe:
+        stacked.update({"router": [], "moe_gate": [], "moe_up": [], "moe_down": []})
     for layer in range(cfg.n_layers):
-        for ours, hf_name in llama_hf_key_map(layer).items():
+        for ours, hf_name in llama_hf_key_map(layer, moe=moe).items():
             stacked[ours].append(get(hf_name, want[ours], ours in _TRANSPOSED))
+        if moe:
+            # Mixtral block_sparse_moe: gate (E, d) -> router (d, E);
+            # experts.{e}.w1/w3 (f, d) -> moe_gate/up (E, d, f);
+            # experts.{e}.w2 (d, f) -> moe_down (E, f, d)
+            p = f"model.layers.{layer}.block_sparse_moe."
+            stacked["router"].append(
+                get(p + "gate.weight", (d, cfg.n_experts), transpose=True))
+            for ours, hf_w, shape in (("moe_gate", "w1", (d, f)),
+                                      ("moe_up", "w3", (d, f)),
+                                      ("moe_down", "w2", (f, d))):
+                stacked[ours].append(jnp.stack([
+                    get(f"{p}experts.{e}.{hf_w}.weight", shape, transpose=True)
+                    for e in range(cfg.n_experts)
+                ]))
 
     embed = get("model.embed_tokens.weight", (cfg.vocab_size, d), transpose=False)
     head_name = "lm_head.weight"

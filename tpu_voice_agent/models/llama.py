@@ -39,6 +39,11 @@ class LlamaConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10_000.0
     norm_eps: float = 1e-5
+    # MoE (Mixtral-style): n_experts == 0 means a dense SwiGLU MLP;
+    # n_experts > 0 swaps in a top-k routed expert FFN (models.moe routing)
+    n_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -55,6 +60,20 @@ PRESETS: dict[str, LlamaConfig] = {
     ),
     "llama3-70b": LlamaConfig(
         dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672, rope_theta=500_000.0, max_seq_len=8192
+    ),
+    # capacity_factor = E / K makes routing drop-free (capacity == token
+    # count): inference quality never loses an expert contribution and
+    # chunked prefill stays exactly consistent with per-token decode. The
+    # cost is dense-dispatch FLOPs proportional to E instead of K at long
+    # prefill T — a Pallas grouped-matmul is the optimization path there.
+    "mixtral-test": LlamaConfig(
+        dim=128, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=256, max_seq_len=256,
+        n_experts=4, top_k=2, capacity_factor=2.0,
+    ),
+    "mixtral-8x7b": LlamaConfig(
+        dim=4096, n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336,
+        rope_theta=1_000_000.0, max_seq_len=8192, n_experts=8, top_k=2,
+        capacity_factor=4.0,
     ),
 }
 
@@ -76,19 +95,32 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
         return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
 
     ks = jax.random.split(k_layers, 8)
-    return {
-        "embed": w_init(k_embed, cfg.vocab_size, d, scale=d**-0.5),
-        "layers": {
-            "attn_norm": norm_init(L, d),
-            "wq": w_init(ks[0], L, d, nq * hd),
-            "wk": w_init(ks[1], L, d, nkv * hd),
-            "wv": w_init(ks[2], L, d, nkv * hd),
-            "wo": w_init(ks[3], L, nq * hd, d),
-            "mlp_norm": norm_init(L, d),
+    layers = {
+        "attn_norm": norm_init(L, d),
+        "wq": w_init(ks[0], L, d, nq * hd),
+        "wk": w_init(ks[1], L, d, nkv * hd),
+        "wv": w_init(ks[2], L, d, nkv * hd),
+        "wo": w_init(ks[3], L, nq * hd, d),
+        "mlp_norm": norm_init(L, d),
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        layers.update({
+            # router stays small + unquantized; expert weights stack on E
+            "router": w_init(ks[7], L, d, E),
+            "moe_gate": w_init(ks[4], L, E, d, f),
+            "moe_up": w_init(ks[5], L, E, d, f),
+            "moe_down": w_init(ks[6], L, E, f, d),
+        })
+    else:
+        layers.update({
             "w_gate": w_init(ks[4], L, d, f),
             "w_up": w_init(ks[5], L, d, f),
             "w_down": w_init(ks[6], L, f, d),
-        },
+        })
+    return {
+        "embed": w_init(k_embed, cfg.vocab_size, d, scale=d**-0.5),
+        "layers": layers,
         "final_norm": norm_init(d),
         "lm_head": w_init(k_head, d, cfg.vocab_size),
     }
@@ -128,7 +160,10 @@ def quantize_params(params: dict) -> dict:
     return {
         "embed": params["embed"],
         "layers": {
-            k: (quant(v) if k.startswith("w") else v) for k, v in L.items()
+            # matmul weights (dense w_* and stacked-expert moe_*) quantize;
+            # norms and the tiny router stay full precision
+            k: (quant(v) if k.startswith(("w", "moe_")) else v)
+            for k, v in L.items()
         },
         "final_norm": params["final_norm"],
         "lm_head": quant(_w(params["lm_head"])),
@@ -205,12 +240,37 @@ def _layer_qkv(p, x, cfg: LlamaConfig, cos, sin, cs=_identity_cs):
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
 
+def _moe_ffn(p, h, cfg: LlamaConfig):
+    """Top-k routed expert FFN over (B, T, d) hidden states. Dense-dispatch
+    einsums (models.moe.route_topk): expert choice becomes MXU matmuls with
+    static shapes, so the MoE decode step jits exactly like the dense one.
+    EP sharding happens declaratively: the stacked (E, ...) expert weights
+    shard E over the mesh's tp axis (parallel.mesh.param_shardings) and XLA
+    partitions the dispatch/combine einsums, inserting one psum."""
+    from .moe import moe_capacity, route_topk
+
+    B, T, d = h.shape
+    x2 = h.reshape(B * T, d)
+    C = moe_capacity(B * T, cfg.n_experts, cfg.top_k, cfg.capacity_factor)
+    dispatch, combine = route_topk(p["router"], x2, cfg.n_experts, cfg.top_k, C)
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(h.dtype), x2)  # (E, C, d)
+    gate = jnp.einsum("ecd,edf->ecf", xe, _w(p["moe_gate"]), preferred_element_type=jnp.float32)
+    up = jnp.einsum("ecd,edf->ecf", xe, _w(p["moe_up"]), preferred_element_type=jnp.float32)
+    a = (jax.nn.silu(gate) * up).astype(h.dtype)
+    down = jnp.einsum("ecf,efd->ecd", a, _w(p["moe_down"]),
+                      preferred_element_type=jnp.float32).astype(h.dtype)
+    return jnp.einsum("tec,ecd->td", combine.astype(h.dtype), down).reshape(B, T, d)
+
+
 def _layer_out(p, x, attn, cfg: LlamaConfig, cs=_identity_cs):
     """Shared decoder-layer back half: output projection + residual, then
-    the SwiGLU MLP + residual. ``attn`` is (B, T, n_heads * head_dim)."""
+    the MLP (dense SwiGLU, or routed MoE when cfg.n_experts > 0) +
+    residual. ``attn`` is (B, T, n_heads * head_dim)."""
     attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)
     x = x + cs(attn, "act")
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        return x + cs(_moe_ffn(p, h, cfg), "act")
     gate = jnp.einsum("btd,df->btf", h, _w(p["w_gate"]), preferred_element_type=jnp.float32)
     up = jnp.einsum("btd,df->btf", h, _w(p["w_up"]), preferred_element_type=jnp.float32)
     act = (jax.nn.silu(gate) * up).astype(x.dtype)
@@ -398,5 +458,8 @@ def forward_paged(
 def param_count(cfg: LlamaConfig) -> int:
     d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
     per_layer = d * (cfg.n_heads * hd) + 2 * d * (cfg.n_kv_heads * hd) + (cfg.n_heads * hd) * d
-    per_layer += 3 * d * f + 2 * d
+    if cfg.n_experts > 0:
+        per_layer += cfg.n_experts * 3 * d * f + d * cfg.n_experts + 2 * d
+    else:
+        per_layer += 3 * d * f + 2 * d
     return cfg.vocab_size * d * 2 + cfg.n_layers * per_layer + d

@@ -65,9 +65,16 @@ def default_rules(mesh: Mesh, n_kv_heads: int, n_heads: int) -> ShardingRules:
     return ShardingRules(mesh=mesh, specs=tuple(specs))
 
 
-def param_shardings(mesh: Mesh, n_kv_heads: int) -> dict:
-    """NamedSharding pytree matching models.llama.init_params structure."""
-    tp_ok_kv = n_kv_heads % mesh.shape["tp"] == 0
+def param_shardings(mesh: Mesh, n_kv_heads: int, n_experts: int = 0) -> dict:
+    """NamedSharding pytree matching models.llama.init_params structure.
+
+    Dense MLP weights are Megatron column/row-parallel over tp. For an MoE
+    config (n_experts > 0) the stacked (L, E, d, f) expert weights shard
+    their EXPERT axis over tp instead — expert parallelism on the serving
+    mesh: each tp shard holds E/tp whole experts, the dispatch/combine
+    einsums partition over E, and XLA closes the combine with one psum."""
+    tp = mesh.shape["tp"]
+    tp_ok_kv = n_kv_heads % tp == 0
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
@@ -75,19 +82,27 @@ def param_shardings(mesh: Mesh, n_kv_heads: int) -> dict:
     col = ns(None, None, "tp")  # (L, d, out) shard out
     row = ns(None, "tp", None)  # (L, in, d) shard in
     rep2 = ns(None, None)
+    layers = {
+        "attn_norm": rep2,
+        "wq": col,
+        "wk": col if tp_ok_kv else ns(None, None, None),
+        "wv": col if tp_ok_kv else ns(None, None, None),
+        "wo": row,
+        "mlp_norm": rep2,
+    }
+    if n_experts > 0:
+        ep = "tp" if n_experts % tp == 0 else None  # replicate if E doesn't divide
+        layers.update({
+            "router": ns(None, None, None),
+            "moe_gate": ns(None, ep, None, None),
+            "moe_up": ns(None, ep, None, None),
+            "moe_down": ns(None, ep, None, None),
+        })
+    else:
+        layers.update({"w_gate": col, "w_up": col, "w_down": row})
     return {
         "embed": rep2,
-        "layers": {
-            "attn_norm": rep2,
-            "wq": col,
-            "wk": col if tp_ok_kv else ns(None, None, None),
-            "wv": col if tp_ok_kv else ns(None, None, None),
-            "wo": row,
-            "mlp_norm": rep2,
-            "w_gate": col,
-            "w_up": col,
-            "w_down": row,
-        },
+        "layers": layers,
         "final_norm": ns(None),
         "lm_head": ns(None, "tp"),
     }

@@ -27,6 +27,14 @@ from ..models.llama import LlamaConfig, PRESETS, forward, init_kv_cache, init_pa
 from ..parallel.mesh import default_rules, kv_cache_shardings, param_shardings
 
 
+def byte_len_table_for(tokenizer, vocab_size: int) -> jnp.ndarray:
+    """(V,) int32 bytes each token id contributes to decoded output — the
+    device-side table the byte-budget stop condition gathers from. Shared
+    by DecodeEngine and serve.planner (one copy of the accounting)."""
+    return jnp.asarray(np.array(
+        [len(tokenizer.token_bytes(i)) for i in range(vocab_size)], dtype=np.int32))
+
+
 @dataclass
 class GenerationResult:
     text: str
@@ -359,7 +367,8 @@ class DecodeEngine:
                     "(batched decode is driven by serve.scheduler)."
                 )
             self.rules = default_rules(mesh, self.cfg.n_kv_heads, self.cfg.n_heads)
-            self._param_shardings = param_shardings(mesh, self.cfg.n_kv_heads)
+            self._param_shardings = param_shardings(
+                mesh, self.cfg.n_kv_heads, self.cfg.n_experts)
             self.params = jax.jit(
                 partial(init_params, self.cfg), out_shardings=self._param_shardings
             )(key) if init_weights else None
@@ -389,12 +398,7 @@ class DecodeEngine:
         self.quant = quant
 
         self.tables = self.fsm.device_tables()
-        self.byte_len_table = jnp.asarray(
-            np.array(
-                [len(self.tokenizer.token_bytes(i)) for i in range(self.cfg.vocab_size)],
-                dtype=np.int32,
-            )
-        )
+        self.byte_len_table = byte_len_table_for(self.tokenizer, self.cfg.vocab_size)
         self._rng = jax.random.PRNGKey(seed + 1)
         # ids past the tokenizer (mesh tp padding / checkpoint embed padding)
         # decode to nothing: unsampleable even in unconstrained decode

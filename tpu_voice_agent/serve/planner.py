@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..grammar.intent_grammar import build_intent_fsm
 from ..models.llama import LlamaConfig, PRESETS, forward, init_params
 from ..parallel.longctx import llama_sp_prefill
-from .engine import _first_token, chunk_decode_loop
+from .engine import _first_token, byte_len_table_for, chunk_decode_loop
 
 
 @dataclass
@@ -93,9 +93,9 @@ class LongSessionPlanner:
         self.eos_id = int(self.tokenizer.eos_id)
         self.pad_id = int(self.tokenizer.pad_id)
         self.tables = self.fsm.device_tables()
-        self.byte_len_table = jnp.asarray(np.array(
-            [len(self.tokenizer.token_bytes(i)) for i in range(self.cfg.vocab_size)],
-            dtype=np.int32))
+        # vocab == tokenizer vocab here (no mesh tp padding), so no
+        # logit_mask is needed in the decode loop
+        self.byte_len_table = byte_len_table_for(self.tokenizer, self.cfg.vocab_size)
         self._rep = NamedSharding(mesh, P())
         self.params = jax.jit(
             partial(init_params, self.cfg), out_shardings=self._rep
