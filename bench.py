@@ -67,7 +67,9 @@ def main() -> None:
     # capacity on every step, so capacity the workload can't use is pure tax
     preset = "tinyllama-1.1b" if on_tpu else "test-tiny"
     engine = DecodeEngine(preset=preset, max_len=1024, prefill_buckets=(1024,),
-                          quant="int8" if on_tpu else None)
+                          quant="int8" if on_tpu else None,
+                          fast_forward=8)  # forced-chain tokens ride the
+    # memory-bound step free: fewer forwards per intent JSON
     prefix_len = install_prompt_prefix(engine)
     print(f"[bench] prompt prefix cached: {prefix_len} tokens", file=sys.stderr)
 
@@ -123,7 +125,6 @@ def main() -> None:
         return final_text, deadline
 
     e2e_ms, stt_ms, parse_ms = [], [], []
-    last_res = None
     for i in range(9):
         stt.reset()
         _, t_end_speech = feed_paced(speech, time.perf_counter())
@@ -133,8 +134,8 @@ def main() -> None:
         # random weights transcribe garbage; parse cost is what's measured,
         # so fall back to a fixed utterance when the final came back empty
         text = final_text or utterances[i % len(utterances)]
-        last_res = engine.generate(render_prompt(text, {"last_query": None}),
-                                   max_new_tokens=64, greedy=True)
+        engine.generate(render_prompt(text, {"last_query": None}),
+                        max_new_tokens=64, greedy=True)
         t2 = time.perf_counter()
         stt_ms.append((t1 - t0) * 1e3)
         parse_ms.append((t2 - t1) * 1e3)
@@ -151,21 +152,36 @@ def main() -> None:
         f"burned 1000 ms on its debounce alone)",
         file=sys.stderr,
     )
-    # decode efficiency vs the weight-read HBM roofline (one decode chunk
-    # includes one ~70 ms tunnel round trip; the roofline row reports raw)
-    if last_res is not None and last_res.steps > 0:
-        ms_tok = last_res.decode_ms / last_res.steps
+    # decode efficiency vs the weight-read HBM roofline. The MARGINAL rate
+    # is what matters: every whole-generation dispatch carries one fixed
+    # ~70 ms tunnel round trip, so decode_ms/steps over a short generation
+    # wildly understates the chip (round-2 measured 14% "of roofline" that
+    # way vs 59% by slope). Two unconstrained runs at different lengths;
+    # slope over their ACTUAL step counts cancels every fixed cost.
+    pts = {}
+    for n in (64, 192):
+        best = None
+        for _ in range(3):
+            r = engine.generate(render_prompt(utterances[0], {"last_query": None}),
+                                max_new_tokens=n, constrained=False,
+                                byte_budget=1_000_000, ignore_eos=True)
+            best = r if best is None or r.decode_ms < best.decode_ms else best
+        if best.steps > 0:
+            pts[best.steps] = min(pts.get(best.steps, best.decode_ms), best.decode_ms)
+    ks = sorted(pts)
+    if len(ks) >= 2 and ks[-1] > ks[0]:
+        ms_tok = (pts[ks[-1]] - pts[ks[0]]) / (ks[-1] - ks[0])
         floor_ms = int8_weight_bytes(engine.cfg) / (V5E_HBM_GBPS * 1e9) * 1e3
         frac = floor_ms / ms_tok if on_tpu else float("nan")
         print(
-            f"[bench] decode {ms_tok:.2f} ms/token ({1e3 / ms_tok:.0f} tok/s); "
-            f"int8 weight-read floor {floor_ms:.2f} ms/token -> "
-            f"{100 * frac:.0f}% of HBM roofline" if on_tpu else
-            f"[bench] decode {ms_tok:.2f} ms/token (CPU run; roofline n/a)",
+            f"[bench] decode {ms_tok:.2f} ms/token marginal ({1e3 / ms_tok:.0f} tok/s, "
+            f"slope over steps {ks[0]}->{ks[-1]}); int8 weight-read floor "
+            f"{floor_ms:.2f} ms/token -> {100 * frac:.0f}% of HBM roofline" if on_tpu else
+            f"[bench] decode {ms_tok:.2f} ms/token marginal (CPU run; roofline n/a)",
             file=sys.stderr,
         )
-        print(f"[bench] parse-only p50 {parse_p50:.1f}ms "
-              f"(round-1's metric, for continuity)", file=sys.stderr)
+    print(f"[bench] parse-only p50 {parse_p50:.1f}ms "
+          f"(round-1's metric, for continuity)", file=sys.stderr)
 
     print(
         json.dumps(

@@ -1,0 +1,106 @@
+"""Grammar fast-forward decoding (fsm.forced_tables + the engine's ff loop).
+
+Forced runs — byte paths the grammar admits uniquely (JSON scaffolding
+between free choices) — are appended without sampling: one (1+W)-token
+forward per iteration instead of 1+W sequential steps. Memory-bound decode
+makes the chain tokens nearly free on TPU.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.grammar.fsm import TokenFSM
+from tpu_voice_agent.grammar.intent_grammar import build_intent_fsm
+from tpu_voice_agent.grammar.regexlang import compile_regex
+
+
+@pytest.fixture(scope="module")
+def intent():
+    return build_intent_fsm()
+
+
+def test_forced_tables_chains_walk_the_fsm(intent):
+    tok, fsm = intent
+    ff_tokens, ff_len = fsm.forced_tables(width=8)
+    n_chains = int((ff_len > 0).sum())
+    assert n_chains > 50, "the intent grammar has plenty of forced scaffolding"
+    rng = np.random.default_rng(0)
+    for s in rng.choice(np.nonzero(ff_len > 0)[0], size=40, replace=False):
+        st = int(s)
+        for i in range(int(ff_len[s])):
+            t = int(ff_tokens[s, i])
+            assert t >= 0
+            st = fsm.step(st, t)
+            assert st >= 0, "forced chain left the grammar"
+
+
+def test_forced_chain_bytes_match_dfa_run(intent):
+    """The chain's byte decoding must be a prefix of the state's unique
+    forced byte path (canonical tokenization changes nothing byte-wise)."""
+    tok, fsm = intent
+    ff_tokens, ff_len = fsm.forced_tables(width=8)
+    trans_b = fsm._trans_b
+    legal = trans_b >= 0
+    forced = (legal.sum(axis=1) == 1) & ~fsm.accepting
+    fbyte = np.argmax(legal, axis=1)
+    checked = 0
+    for s in np.nonzero(ff_len > 0)[0][:40]:
+        run, st = bytearray(), int(s)
+        while forced[st] and len(run) < 2048:
+            run.append(int(fbyte[st]))
+            st = int(trans_b[st, fbyte[st]])
+        chain_bytes = b"".join(
+            tok.token_bytes(int(t)) for t in ff_tokens[s, : int(ff_len[s])])
+        assert bytes(run).startswith(chain_bytes)
+        assert len(chain_bytes) > 0
+        checked += 1
+    assert checked > 0
+
+
+def test_fully_forced_grammar_decodes_exactly():
+    """A literal-string grammar is one long forced run: ANY model must emit
+    exactly that string, and the ff loop must produce it in far fewer
+    forwards than tokens."""
+    from tpu_voice_agent.serve import DecodeEngine
+
+    tok, _ = build_intent_fsm()
+    lit = '{"version":"1.0","intents":[]}'
+    fsm = TokenFSM(compile_regex(lit.replace("{", "\\{").replace("}", "\\}")
+                                 .replace("[", "\\[").replace("]", "\\]")
+                                 .replace(".", "\\.")), tok)
+    eng = DecodeEngine(preset="test-tiny", max_len=512, prefill_buckets=(64,),
+                       tokenizer=tok, fsm=fsm, fast_forward=8)
+    res = eng.generate("go", max_new_tokens=64)
+    assert res.text == lit
+    assert res.finished
+
+
+def test_ff_generate_is_grammar_valid_and_multi_emits(intent):
+    from tpu_voice_agent.serve import DecodeEngine
+
+    eng = DecodeEngine(preset="test-tiny", max_len=1024,
+                       prefill_buckets=(64, 128, 256, 512), fast_forward=8)
+    res = eng.generate("search for usb hubs", max_new_tokens=200)
+    assert res.steps > 0
+    assert eng.fsm.walk(res.token_ids) >= 0
+    if res.finished:
+        import json
+
+        json.loads(res.text)
+    # the point of ff: emitted tokens contain forced chains, so the decoded
+    # byte stream must contain the grammar's fixed scaffolding
+    assert '"version"' in res.text
+
+
+def test_ff_unconstrained_path_unchanged():
+    """ff tables must not alter unconstrained decoding (the branch is gated
+    on `constrained`)."""
+    from tpu_voice_agent.serve import DecodeEngine
+
+    a = DecodeEngine(preset="test-tiny", max_len=512, prefill_buckets=(64,),
+                     fast_forward=8)
+    b = DecodeEngine(preset="test-tiny", max_len=512, prefill_buckets=(64,))
+    ra = a.generate("same prompt", max_new_tokens=32, constrained=False)
+    rb = b.generate("same prompt", max_new_tokens=32, constrained=False)
+    assert ra.token_ids == rb.token_ids

@@ -1,0 +1,71 @@
+"""Multi-host bring-up (parallel/multihost.py) — single-process paths.
+
+Real DCN needs multiple processes; what CAN be pinned here: the no-op
+single-process contract, the ICI-first mesh layout rules, and that the
+resulting mesh drives the same sharded forward as make_mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.parallel.multihost import (
+    init_multihost, multihost_mesh, process_info,
+)
+
+
+def test_init_single_process_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert init_multihost() is False  # no coordinator -> clean no-op
+
+
+def test_mesh_layout_and_forward():
+    mesh = multihost_mesh(dp=2, tp=4)
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    # tp groups must be host-contiguous: all same process here, but the
+    # ordering contract (process_index-major) still holds
+    procs = [d.process_index for d in mesh.devices.flatten()]
+    assert procs == sorted(procs)
+
+    from tpu_voice_agent.models.llama import (
+        LlamaConfig, forward, init_kv_cache, init_params,
+    )
+    from tpu_voice_agent.parallel.mesh import (
+        default_rules, kv_cache_shardings, param_shardings,
+    )
+
+    cfg = LlamaConfig(vocab_size=64, dim=32, n_layers=1, n_heads=4, n_kv_heads=4,
+                      ffn_dim=64, max_seq_len=32)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    sh = jax.device_put(params, param_shardings(mesh, cfg.n_kv_heads))
+    cache = jax.device_put(init_kv_cache(cfg, 2, 32, dtype=jnp.float32),
+                           kv_cache_shardings(mesh, cfg.n_kv_heads))
+    toks = jnp.zeros((2, 4), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32)[None], (2, 4))
+    logits, _ = forward(sh, cfg, toks, pos, cache,
+                        default_rules(mesh, cfg.n_kv_heads, cfg.n_heads))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_mesh_too_big_raises():
+    with pytest.raises(ValueError, match="needs"):
+        multihost_mesh(dp=4, tp=4)
+
+
+def test_uneven_hosts_straddling_tp_group_refused():
+    """{6, 4} local devices, dp=2 tp=4: the second tp group would span both
+    hosts — the guard must catch it (a min-per-host check would not)."""
+    from types import SimpleNamespace
+
+    fakes = [SimpleNamespace(process_index=0, id=i) for i in range(6)] + [
+        SimpleNamespace(process_index=1, id=i) for i in range(4)
+    ]
+    with pytest.raises(ValueError, match="straddles"):
+        multihost_mesh(dp=2, tp=4, devices=fakes)
+
+
+def test_process_info_shape():
+    info = process_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] == 8

@@ -40,11 +40,20 @@ class DeviceFSM(NamedTuple):
     ``dense_mask`` is populated only for small vocabs (the Pallas
     ``masked_argmax`` kernel streams dense (S, V) mask tiles); ``None``
     switches the engine to the compressed XLA path.
+
+    ``ff_tokens``/``ff_len`` (grammar fast-forward, optional): for each
+    state, the canonical tokenization of its FORCED byte run — the unique
+    byte path the grammar admits (JSON scaffolding between free choices).
+    The decode loop appends these without sampling: in the memory-bound
+    decode regime a (1+W)-token forward costs the same HBM traffic as a
+    1-token forward, so forced tokens are nearly free.
     """
 
     table: jax.Array  # (S, C) int32; -1 = dead
     col_id: jax.Array  # (V,) int32 token -> class
     dense_mask: Optional[jax.Array]  # (S, V) bool or None
+    ff_tokens: Optional[jax.Array] = None  # (S, W) int32; -1 pad
+    ff_len: Optional[jax.Array] = None  # (S,) int32 0..W
 
 
 def fsm_row(t: DeviceFSM, state: jax.Array) -> jax.Array:
@@ -136,6 +145,9 @@ class TokenFSM:
         self.num_classes = len(columns)
         self.vocab_size = V
         self.accepting = dfa.accepting.copy()
+        # kept for forced_tables(): byte-expanded transitions + piece trie
+        self._trans_b = trans_b
+        self._trie = trie
 
     # ------------------------------------------------------------ dense views
 
@@ -165,20 +177,74 @@ class TokenFSM:
                 return s
         return s
 
+    # ------------------------------------------------------------ fast-forward
+
+    def forced_tables(self, width: int) -> tuple[np.ndarray, np.ndarray]:
+        """(ff_tokens (S, width) int32, ff_len (S,) int32): per state, the
+        canonical tokenization of its forced byte run.
+
+        A state is "forced" when the byte DFA admits exactly one byte and
+        is not accepting (accepting adds the EOS choice). The run's bytes
+        are unique — any grammar-legal continuation must emit them — so the
+        decode loop may append them without consulting the model. The run
+        is tokenized greedily (longest piece first) over the vocab trie;
+        runs longer than ``width`` tokens continue next step because the
+        state after a truncated chain is itself forced. Chains never
+        contain EOS (runs stop before accepting states).
+        """
+        S = self.num_states
+        trans_b, trie = self._trans_b, self._trie
+        legal = trans_b >= 0  # (S, 256)
+        forced = (legal.sum(axis=1) == 1) & ~self.accepting
+        fbyte = np.argmax(legal, axis=1)
+
+        ff_tokens = np.full((S, width), -1, dtype=np.int32)
+        ff_len = np.zeros((S,), dtype=np.int32)
+        for s in range(S):
+            if not forced[s]:
+                continue
+            run, st = [], s
+            while forced[st] and len(run) < 4096:
+                b = int(fbyte[st])
+                run.append(b)
+                st = int(trans_b[st, b])
+            toks, i = [], 0
+            while i < len(run) and len(toks) < width:
+                node, best, j = trie, None, i
+                while j < len(run) and run[j] in node:
+                    node = node[run[j]]
+                    j += 1
+                    if -1 in node:
+                        best = (j, node[-1][0])  # first id = canonical
+                if best is None:
+                    break  # no piece tiles here; stop fast-forwarding
+                i = best[0]
+                toks.append(best[1])
+            ff_tokens[s, : len(toks)] = toks
+            ff_len[s] = len(toks)
+        return ff_tokens, ff_len
+
     # ------------------------------------------------------------ device tables
 
-    def device_tables(self, dense_limit: int = 1 << 25) -> DeviceFSM:
+    def device_tables(self, dense_limit: int = 1 << 25, ff_width: int = 0) -> DeviceFSM:
         """Ship tables to device. The dense bool mask (Pallas masked_argmax
         fodder) is included only while S·V stays under ``dense_limit``
         entries (32M default = 32 MB of bool); past that the engine's
-        compressed XLA path is the only sane layout."""
+        compressed XLA path is the only sane layout. ``ff_width > 0``
+        attaches the grammar fast-forward chains (forced_tables)."""
         dense = None
         if self.num_states * self.vocab_size <= dense_limit:
             dense = jnp.asarray(self.mask)
+        ff_tok = ff_len = None
+        if ff_width > 0:
+            t, l = self.forced_tables(ff_width)
+            ff_tok, ff_len = jnp.asarray(t), jnp.asarray(l)
         return DeviceFSM(
             table=jnp.asarray(self.table),
             col_id=jnp.asarray(self.col_id),
             dense_mask=dense,
+            ff_tokens=ff_tok,
+            ff_len=ff_len,
         )
 
 

@@ -1,5 +1,6 @@
 from .mesh import make_mesh, ShardingRules, default_rules, param_shardings, kv_cache_shardings
 from .longctx import llama_sp_prefill, sp_pad_len
+from .multihost import init_multihost, multihost_mesh, process_info
 from .ring import ring_attention, sp_mesh, ulysses_attention
 from .pipeline import (
     llama_pp_forward,
@@ -20,6 +21,9 @@ __all__ = [
     "sp_mesh",
     "llama_sp_prefill",
     "sp_pad_len",
+    "init_multihost",
+    "multihost_mesh",
+    "process_info",
     "llama_pp_forward",
     "pipeline_apply",
     "pp_mesh",

@@ -44,6 +44,8 @@ class GenerationResult:
     steps: int
     finished: bool  # True only if EOS was reached (truncation => False)
     error: str | None = None  # per-request failure (e.g. prompt too long)
+    forwards: int = 0  # decode forward dispatches (< steps under grammar
+    # fast-forward, where forced chains emit several tokens per forward)
 
     @property
     def tokens_per_s(self) -> float:
@@ -195,7 +197,7 @@ def prefill_row_with_prefix(
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained", "kernels",
-                     "eos_id", "pad_id"),
+                     "eos_id", "pad_id", "unroll"),
     donate_argnames=("cache",),
 )
 def chunk_decode_loop(
@@ -221,6 +223,7 @@ def chunk_decode_loop(
     kernels: str = "xla",
     eos_id: int = 2,  # the serving tokenizer's ids (checkpoint-specific)
     pad_id: int = 0,
+    unroll: int = 1,  # layer-scan unroll inside each decode step
 ):
     """THE decode loop: advance every active row by up to chunk_steps tokens
     entirely on device.
@@ -232,13 +235,27 @@ def chunk_decode_loop(
     rows park their cache writes in slot 0 of their own dead cache line —
     keeping their attention frontier (and pallas decode cost) at 1 slot.
 
-    Returns (emitted (B, chunk_steps), counts, eos_flags, cache, cur, pos,
-    fsm_state, active, nbytes, tokens_left). eos is True only for rows that
-    sampled EOS (clean finish) -- budget/length truncation leaves it False.
+    Grammar fast-forward: when ``tables`` carries ff chains (DeviceFSM
+    ``ff_tokens``/``ff_len``) and decoding is constrained, each iteration
+    appends the current token PLUS its state's forced-token chain in one
+    (B, 1+W) forward — the weight read dominates a decode step's HBM
+    traffic, so the chain tokens ride along nearly free and one iteration
+    emits up to 1+W tokens. T>1 steps take the XLA cache-attention path,
+    whose extra cache read is noise next to the weights at serving batch
+    sizes this loop is used with (B=1 generate).
+
+    Returns (emitted (B, <=chunk_steps*(1+W)), counts, eos_flags, cache,
+    cur, pos, fsm_state, active, nbytes, tokens_left). eos is True only for
+    rows that sampled EOS (clean finish) -- budget/length truncation leaves
+    it False.
     """
     B = cur.shape[0]
     max_len = cache["k"].shape[2]
-    out = jnp.full((B, chunk_steps), pad_id, dtype=jnp.int32)
+    use_ff = constrained and tables.ff_tokens is not None
+    W = tables.ff_tokens.shape[1] if use_ff else 0
+    cap = chunk_steps * (1 + W)
+    # ff emission scatters through a trash column (index `cap`)
+    out = jnp.full((B, cap + 1 if use_ff else cap), pad_id, dtype=jnp.int32)
     # rows already stopped before the loop: EOS right at admission
     eos0 = (~active) & (cur == eos_id)
 
@@ -252,8 +269,8 @@ def chunk_decode_loop(
     def body(c):
         cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
         # record current token for active rows
-        out = out.at[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)].set(
-            jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)])
+        out = out.at[jnp.arange(B), jnp.minimum(n, cap - 1)].set(
+            jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, cap - 1)])
         )
         n = n + active.astype(jnp.int32)
         nbytes = nbytes + jnp.where(active, byte_len_table[cur], 0)
@@ -263,7 +280,7 @@ def chunk_decode_loop(
         write_pos = jnp.where(active, pos, 0)
         step_tok = jnp.where(active, cur, pad_id)
         logits, cache = forward(params, cfg, step_tok[:, None], write_pos[:, None], cache, rules,
-                                attn_impl=kernels)
+                                attn_impl=kernels, unroll=unroll)
         key, k = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
             logits[:, 0, :], state, tables, k, temperature, greedy,
@@ -278,10 +295,71 @@ def chunk_decode_loop(
         active = active & ~stop
         return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
 
-    (cache, cur, pos, state, active, eos, nbytes, left, out, n, _, _) = jax.lax.while_loop(
-        cond, body, carry0
+    def ff_body(c):
+        cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
+        iw = jnp.arange(1 + W)[None, :]  # (1, 1+W) block index
+        chain = tables.ff_tokens[state]  # (B, W); -1 pads
+        # chain length, capped so emission fits the token budget and the
+        # cache (writes land at pos .. pos+k <= max_len-1)
+        k = jnp.minimum(jnp.minimum(tables.ff_len[state], left - 1),
+                        max_len - 1 - pos)
+        k = jnp.where(active, jnp.maximum(k, 0), 0)
+
+        # block tokens: [cur, chain_0..chain_{k-1}], tail duplicates the
+        # last valid token at the last valid position — duplicate (token,
+        # position) scatter writes are idempotent on the cache
+        ci = jnp.clip(iw - 1, 0, jnp.maximum(k[:, None] - 1, 0))
+        chain_tok = jnp.take_along_axis(chain, ci, axis=1)
+        step_tok = jnp.where(active, cur, pad_id)
+        blk_tok = jnp.where(iw == 0, step_tok[:, None],
+                            jnp.where(k[:, None] > 0, chain_tok, step_tok[:, None]))
+        write_pos = jnp.where(active, pos, 0)
+        blk_pos = write_pos[:, None] + jnp.minimum(iw, k[:, None])
+
+        # emit cur + chain via the trash column
+        valid = (iw <= k[:, None]) & active[:, None]
+        tgt = jnp.where(valid, jnp.minimum(n[:, None] + iw, cap - 1), cap)
+        out = out.at[jnp.arange(B)[:, None], tgt].set(
+            jnp.where(valid, blk_tok, pad_id))
+        emitted = jnp.where(active, 1 + k, 0)
+        n = n + emitted
+        chain_valid = (iw >= 1) & (iw <= k[:, None]) & active[:, None]
+        nbytes = (nbytes + jnp.where(active, byte_len_table[cur], 0)
+                  + jnp.sum(jnp.where(chain_valid,
+                                      byte_len_table[jnp.maximum(chain_tok, 0)], 0),
+                            axis=1))
+        left = left - emitted
+
+        # FSM state after the taken chain tokens (walked stepwise so budget
+        # truncation of the chain keeps the state exact)
+        def cstep(s, xs):
+            t, i = xs
+            s2 = fsm_advance(tables, s, jnp.maximum(t, 0))
+            return jnp.where(i < k, s2, s), None
+
+        s_end, _ = jax.lax.scan(cstep, state, (chain.T, jnp.arange(W)))
+
+        logits, cache = forward(params, cfg, blk_tok, blk_pos, cache, rules,
+                                attn_impl=kernels, unroll=unroll)
+        logits_k = jnp.take_along_axis(logits, k[:, None, None], axis=1)[:, 0, :]
+        key, kk = jax.random.split(key)
+        nxt, state_next = _mask_sample_advance(
+            logits_k, s_end, tables, kk, temperature, greedy,
+            constrained, kernels, rules, logit_mask
+        )
+        state = jnp.where(active, state_next, state)
+        cur = jnp.where(active, nxt, cur)
+        pos = jnp.where(active, pos + 1 + k, pos)
+
+        eos = eos | (active & (cur == eos_id))
+        stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_len - 1) | (left <= 0)
+        active = active & ~stop
+        return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
+
+    (cache, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds) = (
+        jax.lax.while_loop(cond, ff_body if use_ff else body, carry0)
     )
-    return out, n, eos, cache, cur, pos, state, active, nbytes, left
+    return out[:, :cap], n, eos, cache, cur, pos, state, active, nbytes, left, fwds
 
 
 class DecodeEngine:
@@ -306,6 +384,9 @@ class DecodeEngine:
         tokenizer=None,  # external (checkpoint) tokenizer; None = in-tree toy
         fsm=None,  # prebuilt grammar.TokenFSM over `tokenizer`
         init_weights: bool = True,  # False: caller loads a checkpoint next
+        decode_unroll: int = 1,  # layer-scan unroll in the decode step
+        fast_forward: int = 0,  # grammar fast-forward chain width (0 = off);
+        # single-request generate() only — the batcher keeps T=1 steps
     ):
         if kernels == "auto":
             # on a mesh the kernels run per-shard under shard_map (batch
@@ -355,6 +436,7 @@ class DecodeEngine:
         self.mesh = mesh
         self.max_len = max_len
         self.batch_slots = batch_slots
+        self.decode_unroll = decode_unroll
         self.prefill_buckets = tuple(b for b in prefill_buckets if b <= max_len)
 
         key = jax.random.PRNGKey(seed)
@@ -398,6 +480,14 @@ class DecodeEngine:
         self.quant = quant
 
         self.tables = self.fsm.device_tables()
+        # fast-forward twin: forced-chain tables attached; used by the
+        # single-request constrained path (generate), never by the batcher
+        # (a T=1+W step at batch width would re-read the whole cache
+        # through the XLA attention fallback)
+        self.fast_forward = fast_forward
+        self.tables_ff = (
+            self.fsm.device_tables(ff_width=fast_forward) if fast_forward > 0 else None
+        )
         self.byte_len_table = byte_len_table_for(self.tokenizer, self.cfg.vocab_size)
         self._rng = jax.random.PRNGKey(seed + 1)
         # ids past the tokenizer (mesh tp padding / checkpoint embed padding)
@@ -435,6 +525,7 @@ class DecodeEngine:
         kernels: str = "auto",
         quant: str | None = None,
         dtype=jnp.bfloat16,
+        fast_forward: int = 0,
     ) -> "DecodeEngine":
         """Serve a real HF checkpoint directory: config.json decides the
         architecture, tokenizer.json supplies the real BPE vocab (the intent
@@ -452,7 +543,7 @@ class DecodeEngine:
         eng = cls(
             cfg=cfg, mesh=mesh, max_len=max_len, batch_slots=batch_slots,
             prefill_buckets=prefill_buckets, kernels=kernels, quant=quant,
-            tokenizer=tok, init_weights=False,
+            tokenizer=tok, init_weights=False, fast_forward=fast_forward,
         )
         params = llama_from_hf_state(model_dir, cfg, dtype=dtype)
         if eng.cfg.vocab_size != cfg.vocab_size:
@@ -575,7 +666,7 @@ class DecodeEngine:
         """Advance all slots by one decode chunk (the batcher's device-work
         entry point — the KV layout stays the engine's business, so the
         paged engine can substitute its pool/table loop)."""
-        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left = chunk_decode_loop(
+        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, _ = chunk_decode_loop(
             self.params, self.cfg, self.cache,
             cur, pos, fsm, active, nbytes, tokens_left,
             self.tables, self.byte_len_table,
@@ -583,7 +674,7 @@ class DecodeEngine:
             rules=self.rules, logit_mask=self.logit_mask,
             chunk_steps=chunk_steps,
             greedy=greedy, constrained=True, kernels=self.kernels,
-            eos_id=self.eos_id, pad_id=self.pad_id,
+            eos_id=self.eos_id, pad_id=self.pad_id, unroll=self.decode_unroll,
         )
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
@@ -608,6 +699,8 @@ class DecodeEngine:
         greedy: bool = True,
         temperature: float = 0.7,
         byte_budget: int = 3900,
+        ignore_eos: bool = False,  # benchmarking: never stop at EOS, so a
+        # fixed-step-count run exists even for checkpoints that answer short
     ) -> GenerationResult:
         """Generate a completion with the on-device whole-generation loop
         (single host dispatch; essential because the chip may sit behind a
@@ -633,20 +726,22 @@ class DecodeEngine:
 
         t1 = time.perf_counter()
         self._rng, key = jax.random.split(self._rng)
-        buf, count, eos, self.cache, *_ = chunk_decode_loop(
+        tables = self.tables_ff if (constrained and self.tables_ff is not None) else self.tables
+        buf, count, eos, self.cache, *rest = chunk_decode_loop(
             self.params, self.cfg, self.cache,
             tok0, jnp.full((1,), n, dtype=jnp.int32), fsm0,
-            tok0 != self.eos_id,  # active
+            tok0 != (-1 if ignore_eos else self.eos_id),  # active
             jnp.zeros((1,), jnp.int32),  # nbytes
             jnp.full((1,), max_new_tokens, dtype=jnp.int32),  # tokens_left
-            self.tables, self.byte_len_table,
+            tables, self.byte_len_table,
             key, jnp.float32(temperature), jnp.int32(byte_budget),
             rules=self.rules, logit_mask=self.logit_mask,
             chunk_steps=max_new_tokens,
             greedy=greedy, constrained=constrained, kernels=self.kernels,
-            eos_id=self.eos_id, pad_id=self.pad_id,
+            eos_id=-1 if ignore_eos else self.eos_id,
+            pad_id=self.pad_id, unroll=self.decode_unroll,
         )
-        buf_h, count_h_a, eos_h = jax.device_get((buf, count, eos))
+        buf_h, count_h_a, eos_h, fwds_h = jax.device_get((buf, count, eos, rest[-1]))
         count_h = int(count_h_a[0])
         out_ids = [int(t) for t in np.asarray(buf_h)[0, :count_h]]
         finished = bool(eos_h[0])
@@ -667,6 +762,7 @@ class DecodeEngine:
             decode_ms=decode_ms,
             steps=count_h,
             finished=finished,
+            forwards=int(fwds_h),
         )
 
     def generate_stepwise(

@@ -172,7 +172,9 @@ class LongSessionPlanner:
         part of its history, unlike the reference's forgotten summaries)."""
         if sess.last_logits is None:
             raise ValueError("no frontier logits: extend() the session before plan()")
-        max_new = max_new_tokens or self.max_new_tokens
+        # clamp to the reserved headroom — anchoring/extending budgeted
+        # exactly self.max_new_tokens slots past the transcript frontier
+        max_new = min(max_new_tokens or self.max_new_tokens, self.max_new_tokens)
         t0 = time.perf_counter()
         self._rng, k0 = jax.random.split(self._rng)
         state0 = jnp.full((1,), self.fsm.start, dtype=jnp.int32)
@@ -181,7 +183,7 @@ class LongSessionPlanner:
             greedy=greedy, constrained=True, kernels=self.kernels,
         )
         self._rng, key = jax.random.split(self._rng)
-        buf, count, eos, sess.cache, cur, pos, _, _, _, _ = chunk_decode_loop(
+        buf, count, eos, sess.cache, cur, pos, _, _, _, _, _ = chunk_decode_loop(
             self.params, self.cfg, sess.cache,
             tok0, jnp.full((1,), sess.pos, jnp.int32), fsm0,
             tok0 != self.eos_id,

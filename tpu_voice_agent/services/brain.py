@@ -139,6 +139,72 @@ class BatchedEngineParser:
         self.runtime.stop()
 
 
+class PlannerParser:
+    """Long-session planner behind /parse (``BRAIN_BACKEND=planner[:preset]``).
+
+    Unlike EngineParser — which re-renders a stateless prompt per request
+    while the voice service carries a rolling context dict — this backend
+    keeps each session's FULL transcript as model context: turn N sees
+    every prior utterance AND every prior plan. New turns append with
+    O(new-tokens) cached prefill; when a transcript outgrows its context
+    bucket the planner re-anchors via the SP ring-attention prefill
+    (parallel.longctx), so per-session context capacity scales with chips
+    on the sp mesh axis. Reference capability replaced: the rolling
+    context-dict merge at apps/voice/src/server.ts:162-170 — the part of
+    the session the reference throws away is exactly what this keeps.
+    Sessions are LRU-capped; an evicted session simply cold-starts again.
+    """
+
+    wants_session = True  # build_app passes ParseRequest.session_id through
+    max_sessions = 32
+
+    def __init__(self, planner, max_new_tokens: int | None = None):
+        from collections import OrderedDict
+
+        self.planner = planner
+        # never exceed the planner's reserved headroom: its bucket
+        # accounting guarantees max_new_tokens slots past the transcript,
+        # so a larger request here would truncate mid-JSON at the bucket
+        # wall on exactly the turns the accounting was supposed to protect
+        self.max_new_tokens = min(max_new_tokens or planner.max_new_tokens,
+                                  planner.max_new_tokens)
+        self._sessions: "OrderedDict[str, object]" = OrderedDict()
+        self._lock = threading.Lock()  # one engine state: turns serialize
+
+    def parse(self, text: str, context: dict, session_id: str | None = None) -> ParseResponse:
+        user = json.dumps({"text": text, "context": context}, separators=(",", ":"))
+        with self._lock:
+            # no session_id -> one-shot: NEVER a shared default key, which
+            # would bleed one client's transcript into another's context
+            sess = self._sessions.pop(session_id, None) if session_id else None
+            try:
+                if sess is None:
+                    sess = self.planner.start(render_prompt(text, context))
+                else:
+                    self.planner.extend(sess, f"\n<|user|>\n{user}\n<|assistant|>\n")
+                out_text, _ = self.planner.plan(sess, max_new_tokens=self.max_new_tokens)
+            except ValueError as e:
+                # the session is dropped (not re-stored): a failed extend /
+                # re-anchor leaves transcript and cache out of sync, so the
+                # next turn on this session_id cold-starts cleanly instead
+                raise ParserError("llm_error", str(e)) from e
+            model, err = parse_response_from_json(out_text)
+            if model is None:
+                # truncation (token budget before EOS): drop the session too
+                # — its transcript now ends in malformed half-JSON that
+                # would poison every later turn
+                raise ParserError("schema_validation_failed", err or "invalid")
+            if session_id:
+                self._sessions[session_id] = sess
+                while len(self._sessions) > self.max_sessions:
+                    self._sessions.popitem(last=False)  # LRU eviction
+        return model
+
+    def session_count(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+
 class RuleBasedParser:
     """Deterministic heuristic parser — offline mode + test fake.
 
@@ -243,9 +309,16 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
         parse_pool = None
         parse_lock = threading.Lock()
 
-        def locked_parse(text: str, context: dict) -> ParseResponse:
+        def locked_parse(*args) -> ParseResponse:
             with parse_lock:
-                return parser.parse(text, context)
+                return parser.parse(*args)
+
+    wants_session = getattr(parser, "wants_session", False)
+
+    def do_parse(preq: ParseRequest) -> ParseResponse:
+        if wants_session:
+            return locked_parse(preq.text, preq.context, preq.session_id)
+        return locked_parse(preq.text, preq.context)
 
     async def health(_req: web.Request) -> web.Response:
         body = {"ok": True, "service": "brain"}
@@ -275,9 +348,7 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
         loop = asyncio.get_running_loop()
         try:
             with tracer.span("parse", trace_id=trace_id, chars=len(preq.text)):
-                resp = await loop.run_in_executor(
-                    parse_pool, locked_parse, preq.text, preq.context
-                )
+                resp = await loop.run_in_executor(parse_pool, do_parse, preq)
         except ParserError as e:
             status = 422 if e.kind == "schema_validation_failed" else 500
             return web.json_response(
@@ -316,19 +387,23 @@ def _wrap_engine(engine) -> IntentParser:
 
 
 def make_parser_from_env() -> IntentParser:
-    """BRAIN_BACKEND=rule (default) | engine[:preset] (random init).
+    """BRAIN_BACKEND=rule (default) | engine[:preset] | planner[:preset].
     BRAIN_MODEL=<HF checkpoint dir> overrides both: the engine serves the
     checkpoint's weights with its own tokenizer (the real replacement for
     the reference's LLM_BASE_URL/LLM_MODEL env, apps/brain/src/llm.ts:7-9).
     BRAIN_QUANT=int8 enables weight-only quantization for the loaded model.
     BRAIN_BATCH=N (default 1) serves N continuous-batching slots."""
     slots = int(os.environ.get("BRAIN_BATCH", "1"))
+    # grammar fast-forward applies to the single-slot generate() path only
+    # (BRAIN_FF=0 disables); the batcher keeps T=1 decode steps
+    ff = int(os.environ.get("BRAIN_FF", "8")) if slots == 1 else 0
     model_dir = os.environ.get("BRAIN_MODEL")
     if model_dir:
         from ..serve import DecodeEngine
 
         quant = os.environ.get("BRAIN_QUANT") or None
-        return _wrap_engine(DecodeEngine.from_hf(model_dir, quant=quant, batch_slots=slots))
+        return _wrap_engine(DecodeEngine.from_hf(model_dir, quant=quant,
+                                                 batch_slots=slots, fast_forward=ff))
     backend = os.environ.get("BRAIN_BACKEND", "rule")
     if backend == "rule":
         return RuleBasedParser()
@@ -336,12 +411,29 @@ def make_parser_from_env() -> IntentParser:
         from ..serve import DecodeEngine
 
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
-        return _wrap_engine(DecodeEngine(preset=preset, batch_slots=slots))
+        return _wrap_engine(DecodeEngine(preset=preset, batch_slots=slots,
+                                         fast_forward=ff))
+    if backend.startswith("planner"):
+        # long-session transcripts as model context; BRAIN_SP sizes the
+        # sequence-parallel axis (default: every visible device)
+        import jax
+
+        from ..parallel.ring import sp_mesh
+        from ..serve import LongSessionPlanner
+
+        preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
+        sp = int(os.environ.get("BRAIN_SP", "0")) or len(jax.devices())
+        return PlannerParser(LongSessionPlanner(preset=preset, mesh=sp_mesh(sp)))
     raise ValueError(f"unknown BRAIN_BACKEND {backend!r}")
 
 
 def main() -> None:
     load_env_cascade()
+    # multi-host engines (70B-planner-class meshes spanning hosts): join the
+    # DCN job before any JAX call; single-host runs no-op (multihost.py)
+    from ..parallel.multihost import init_multihost
+
+    init_multihost()
     port = int(os.environ.get("BRAIN_PORT", "8090"))
     parser = make_parser_from_env()
     app = build_app(parser, Tracer("brain"))

@@ -76,6 +76,12 @@ class ClientState:
         self.stt = stt
         self.context: dict = {}
         self.session_id: str | None = None
+        # stable per-connection conversation key for /parse: the executor's
+        # session_id above only exists after the first /execute, and a
+        # session-keyed brain backend (PlannerParser) must never see turn 1
+        # under one key and turn 2 under another — or, worse, share a
+        # default key across clients
+        self.convo_id = new_trace_id()
         self.trace_id = new_trace_id()
         # serializes executor calls per client so the first execution's
         # session_id is threaded into the next (back-to-back commands must
@@ -101,7 +107,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             try:
                 r = await http.post(
                     cfg.brain_url + "/parse",
-                    json={"text": text, "session_id": state.session_id, "context": state.context},
+                    json={"text": text, "session_id": state.convo_id, "context": state.context},
                     headers={"x-trace-id": state.trace_id},
                     timeout=60.0,
                 )
@@ -246,6 +252,9 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
 
 def main() -> None:
     load_env_cascade()
+    from ..parallel.multihost import init_multihost
+
+    init_multihost()  # no-op single-host; DCN join for pod-sharded STT
     port = int(os.environ.get("VOICE_PORT", "7072"))
     app = build_app(tracer=Tracer("voice"))
     web.run_app(app, port=port)
