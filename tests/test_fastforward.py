@@ -104,3 +104,26 @@ def test_ff_unconstrained_path_unchanged():
     ra = a.generate("same prompt", max_new_tokens=32, constrained=False)
     rb = b.generate("same prompt", max_new_tokens=32, constrained=False)
     assert ra.token_ids == rb.token_ids
+
+
+def test_ff_respects_byte_budget():
+    """The forced chain must stop at the byte budget like the plain path
+    does (at most one token of overshoot) — a wide chain previously added
+    its whole width of bytes before the stop check (round-2 advisor)."""
+    from tpu_voice_agent.serve import DecodeEngine
+
+    tok, _ = build_intent_fsm()
+    lit = '{"version":"1.0","intents":[]}'
+    fsm = TokenFSM(compile_regex(lit.replace("{", "\\{").replace("}", "\\}")
+                                 .replace("[", "\\[").replace("]", "\\]")
+                                 .replace(".", "\\.")), tok)
+    eng = DecodeEngine(preset="test-tiny", max_len=512, prefill_buckets=(64,),
+                       tokenizer=tok, fsm=fsm, fast_forward=8)
+    budget = 10
+    res = eng.generate("go", max_new_tokens=64, byte_budget=budget)
+    n = len(res.text.encode())
+    assert not res.finished  # truncated by bytes, not EOS
+    # overshoot bounded by ONE token's bytes, exactly like the non-ff path
+    max_tok_bytes = max(len(tok.token_bytes(t)) for t in res.token_ids)
+    assert n < budget + max_tok_bytes
+    assert lit.startswith(res.text)

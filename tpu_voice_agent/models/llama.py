@@ -251,7 +251,25 @@ def _moe_ffn(p, h, cfg: LlamaConfig):
 
     B, T, d = h.shape
     x2 = h.reshape(B * T, d)
-    C = moe_capacity(B * T, cfg.n_experts, cfg.top_k, cfg.capacity_factor)
+    # serving is drop-free by construction: cf >= E/K makes capacity cover
+    # every routed token even under total routing skew, so bucketed-prefill
+    # pad tokens can never crowd out real ones and chunked prefill stays
+    # token-exact with per-token decode (a hand-built config with a smaller
+    # cf silently dropped expert contributions — round-2 advisor finding).
+    # The standalone EP layer (parallel.expert) keeps drop semantics; this
+    # clamp governs the served decoder only, and says so when it fires
+    # (warn runs at trace time: once per compiled shape, not per step)
+    cf = max(cfg.capacity_factor, cfg.n_experts / cfg.top_k)
+    if cf != cfg.capacity_factor:
+        import warnings
+
+        warnings.warn(
+            f"MoE serving path clamped capacity_factor {cfg.capacity_factor} -> "
+            f"{cf} (= E/K) to stay drop-free; set capacity_factor >= "
+            f"{cfg.n_experts}/{cfg.top_k} in the config to silence this",
+            stacklevel=2,
+        )
+    C = moe_capacity(B * T, cfg.n_experts, cfg.top_k, cf)
     dispatch, combine = route_topk(p["router"], x2, cfg.n_experts, cfg.top_k, C)
     xe = jnp.einsum("tec,td->ecd", dispatch.astype(h.dtype), x2)  # (E, C, d)
     gate = jnp.einsum("ecd,edf->ecf", xe, _w(p["moe_gate"]), preferred_element_type=jnp.float32)

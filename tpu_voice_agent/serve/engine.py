@@ -303,6 +303,14 @@ def chunk_decode_loop(
         # cache (writes land at pos .. pos+k <= max_len-1)
         k = jnp.minimum(jnp.minimum(tables.ff_len[state], left - 1),
                         max_len - 1 - pos)
+        # ...and the byte budget: the non-ff path overshoots by at most one
+        # token (stop is checked after the add), so the chain may only take
+        # tokens whose cumulative bytes still fit after cur's — otherwise a
+        # wide chain could blow past byte_budget by W tokens in one step
+        chain_bytes = jnp.cumsum(
+            jnp.where(chain >= 0, byte_len_table[jnp.maximum(chain, 0)], 0), axis=1)
+        rem = (byte_budget - nbytes - byte_len_table[cur])[:, None]
+        k = jnp.minimum(k, jnp.sum(chain_bytes <= rem, axis=1))
         k = jnp.where(active, jnp.maximum(k, 0), 0)
 
         # block tokens: [cur, chain_0..chain_{k-1}], tail duplicates the

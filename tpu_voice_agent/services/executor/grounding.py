@@ -54,16 +54,24 @@ class TPUGrounder:
     """
 
     def __init__(self, preset: str = "qwen2vl-test", max_len: int = 256):
+        import threading
+
         self.preset = preset
         self.max_len = max_len
         self._engine = None
+        self._build_lock = threading.Lock()  # warm thread vs request thread
 
     def _get(self):
-        if self._engine is None:
-            from ...serve.grounding import GroundingEngine
+        with self._build_lock:
+            if self._engine is None:
+                from ...serve.grounding import GroundingEngine
 
-            self._engine = GroundingEngine(preset=self.preset, max_len=self.max_len)
-        return self._engine
+                self._engine = GroundingEngine(preset=self.preset, max_len=self.max_len)
+            return self._engine
+
+    def warm(self) -> None:
+        """Build the engine off the request path (server startup thread)."""
+        self._get()
 
     def __call__(self, image: np.ndarray, instruction: str) -> tuple[float, float, str]:
         engine = self._get()

@@ -40,7 +40,7 @@ def make_grounder_from_env():
 
 
 def build_app(manager: SessionManager | None = None, tracer: Tracer | None = None,
-              grounder=None) -> web.Application:
+              grounder=None, summarizer=None) -> web.Application:
     manager = manager or SessionManager()
     tracer = tracer or Tracer("executor", emit=False)
     app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -80,6 +80,7 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
                         ereq.intents,
                         uploads_dir=manager.uploads_dir,
                         grounder=grounder,
+                        summarizer=summarizer,
                     )
                 return session, results
 
@@ -144,8 +145,20 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
 
 def main() -> None:
     load_env_cascade()
+    from .summarize import make_summarizer_from_env
+
     port = int(os.environ.get("EXECUTOR_PORT", "7081"))
-    app = build_app(tracer=Tracer("executor"), grounder=make_grounder_from_env())
+    grounder = make_grounder_from_env()
+    summarizer = make_summarizer_from_env()
+    # engine construction (checkpoint load + XLA compile) can take minutes;
+    # warm lazily-built model backends off the request path so the first
+    # grounded click / summarize doesn't stall every session behind exec_lock
+    for backend in (grounder, summarizer):
+        warm = getattr(backend, "warm", None)
+        if warm is not None:
+            threading.Thread(target=warm, daemon=True).start()
+    app = build_app(tracer=Tracer("executor"), grounder=grounder,
+                    summarizer=summarizer)
     web.run_app(app, port=port)
 
 
