@@ -22,6 +22,41 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Two test tiers (round-2 VERDICT weak #7: the full suite is too slow to be
+# a habit). Fast tier = the service/contract/unit tests plus the shared
+# session-scoped engines: `pytest -m "not slow"` (< ~3 min on CPU). Slow
+# tier = compile-heavy mesh/parity/model tests, auto-marked per module here
+# (one central list instead of 19 scattered pytestmark lines). The plain
+# `pytest tests/` still runs EVERYTHING — the driver's green bar covers
+# both tiers.
+SLOW_MODULES = {
+    "test_brain_planner",
+    "test_ckpt",
+    "test_colocate",
+    "test_expert",
+    "test_fastforward",
+    "test_hf_real",
+    "test_llama",
+    "test_longctx",
+    "test_moe_llama",
+    "test_multihost",
+    "test_ops_sharded",
+    "test_paged",
+    "test_pipeline",
+    "test_prefix",
+    "test_qwen2vl",
+    "test_races",
+    "test_ring",
+    "test_stt",
+    "test_whisper",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.fspath.purebasename in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session")
 def tiny_engine():

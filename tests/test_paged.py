@@ -105,7 +105,7 @@ def test_prefix_blocks_are_shared_not_copied():
     assert all(o >= 1 for o in per_slot_owned)
     assert eng.allocator.blocks_in_use == base + sum(per_slot_owned)
     for s in eng._slot_shared:
-        assert s == eng._prefix_blocks[:full]
+        assert s == eng._prefix_blocks[0][:full]  # group 0 (no mesh -> one group)
     b.run_until_done()
     # completed requests returned their blocks; the shared prefix survives
     assert eng.allocator.blocks_in_use == base
@@ -123,7 +123,7 @@ def test_pool_memory_tracks_live_tokens_not_budgets():
         assert r.error is None
         assert eng.fsm.walk(r.token_ids) >= 0
     # per-request blocks returned; only the installed prefix stays resident
-    assert eng.allocator.blocks_in_use == len(eng._prefix_blocks)
+    assert eng.allocator.blocks_in_use == len(eng._prefix_blocks[0])
 
 
 def test_pool_exhaustion_fails_the_request_not_the_engine():
@@ -142,3 +142,63 @@ def test_paged_generate_is_rejected():
     eng = _paged(1)
     with pytest.raises(ValueError, match="batcher"):
         eng.generate("x")
+
+
+# ---------------------------------------------------------------- mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from tpu_voice_agent.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return make_mesh(dp=2, tp=2)
+
+
+def test_sharded_paged_attention_matches_single_device(mesh):
+    """Pool blocks shard over dp, kv heads over tp; each row's table only
+    references its own dp group's block range (the allocator invariant)."""
+    from tpu_voice_agent.ops import sharded_paged_attention
+
+    L, N, bs, B, nq, nkv, hd = 2, 16, 16, 4, 8, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, nq, hd), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (L, N, bs, nkv, hd), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (L, N, bs, nkv, hd), jnp.float32)
+    # rows 0-1 (dp group 0) use blocks 1..7; rows 2-3 (group 1) blocks 9..15
+    tables = jnp.asarray(
+        [[3, 7, 1, 2], [5, 2, 6, 4], [11, 14, 8, 10], [15, 9, 13, 12]], jnp.int32)
+    kv_len = jnp.asarray([5, 40, 64, 17], jnp.int32)
+    for layer in (0, 1):
+        ref = paged_attention_reference(q, k_pool, v_pool, tables, kv_len, layer)
+        out = sharded_paged_attention(
+            mesh, q, k_pool, v_pool, tables, kv_len, jnp.int32(layer))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+MESH_PROMPTS = PROMPTS + [render_prompt("sort results by price low to high", {})]
+
+
+@pytest.mark.parametrize("kernels", ["xla", "pallas"])
+def test_paged_batcher_on_mesh_matches_dense_single_device(mesh, kernels):
+    """The meshed paged engine (pool dp-sharded, kv heads tp-sharded, int8
+    aside) must be token-identical to the single-device dense engine."""
+    dense = _dense(4)
+    paged = PagedDecodeEngine(
+        preset="test-tiny", max_len=2048, batch_slots=4,
+        prefill_buckets=(128, 256, 512, 1024), mesh=mesh, kernels=kernels)
+    install_prompt_prefix(dense)
+    install_prompt_prefix(paged)
+    rd = ContinuousBatcher(dense, chunk_steps=16, max_new_tokens=160).generate_many(MESH_PROMPTS)
+    rp = ContinuousBatcher(paged, chunk_steps=16, max_new_tokens=160).generate_many(MESH_PROMPTS)
+    for d, p in zip(rd, rp):
+        assert d.error is None and p.error is None
+        assert paged.fsm.walk(p.token_ids) >= 0
+        assert d.token_ids == p.token_ids, (d.text[:80], p.text[:80])
+    # slots landed in their own dp group's block ranges
+    bpg = paged.allocator.blocks_per_group
+    for slot in range(4):
+        g = paged._group(slot)
+        for blk in paged._slot_owned[slot] + paged._slot_shared[slot]:
+            assert g * bpg <= blk < (g + 1) * bpg

@@ -59,6 +59,47 @@ def llama_config_from_hf(path: str) -> LlamaConfig:
     )
 
 
+def qwen2vl_config_from_hf(path: str):
+    """Qwen2VLConfig from an HF config.json (file or directory) — the
+    real-checkpoint grounding path (BASELINE config 5): nothing about the
+    architecture is preset-bound."""
+    from ..models.qwen2vl import Qwen2VLConfig, VisionConfig
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "config.json")
+    with open(path) as f:
+        cfg = json.load(f)
+    v = cfg.get("vision_config", {})
+    rope = cfg.get("rope_scaling") or {}
+    sections = rope.get("mrope_section")
+    head_dim = cfg["hidden_size"] // cfg["num_attention_heads"]
+    if sections is None:
+        # Qwen2-VL's published split (t, h, w) = (hd/8, 3hd/16, 3hd/16),
+        # e.g. (16, 24, 24) at head_dim 128; sums to head_dim // 2
+        sections = (head_dim // 8, 3 * head_dim // 16, 3 * head_dim // 16)
+    vision = VisionConfig(
+        img_size=int(v.get("img_size", 448)),
+        patch_size=v.get("patch_size", 14),
+        merge_size=v.get("spatial_merge_size", 2),
+        d_model=v.get("embed_dim", v.get("hidden_size", 1280)),
+        n_heads=v.get("num_heads", 16),
+        n_layers=v.get("depth", 32),
+    )
+    return Qwen2VLConfig(
+        vocab_size=cfg["vocab_size"],
+        dim=cfg["hidden_size"],
+        n_layers=cfg["num_hidden_layers"],
+        n_heads=cfg["num_attention_heads"],
+        n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+        ffn_dim=cfg["intermediate_size"],
+        max_seq_len=min(cfg.get("max_position_embeddings", 2048), 32768),
+        rope_theta=float(cfg.get("rope_theta", 1_000_000.0)),
+        norm_eps=float(cfg.get("rms_norm_eps", 1e-6)),
+        mrope_sections=tuple(int(x) for x in sections),
+        vision=vision,
+    )
+
+
 def whisper_config_from_hf(path: str):
     """WhisperConfig from an HF config.json (file or directory)."""
     from ..models.whisper import WhisperConfig

@@ -223,20 +223,26 @@ def _identity_cs(x, name):
     return x
 
 
-def _layer_qkv(p, x, cfg: LlamaConfig, cos, sin, cs=_identity_cs):
+def _layer_qkv(p, x, cfg: LlamaConfig, cos, sin, cs=_identity_cs,
+               n_heads: int | None = None, n_kv_heads: int | None = None):
     """Shared decoder-layer front half: attn-norm -> q/k/v projections ->
     head reshape -> RoPE. The ONE copy of this math for forward /
     forward_paged / pipeline / longctx (they differ only in how KV is
-    written and attended, never in the projections)."""
+    written and attended, never in the projections). ``n_heads`` /
+    ``n_kv_heads`` override the config's counts for tensor-parallel LOCAL
+    shards inside shard_map (pipeline.pp_tp_forward_cached passes
+    cfg.n_heads // tp etc; head_dim is unchanged)."""
     B, T = x.shape[:2]
+    nq = n_heads if n_heads is not None else cfg.n_heads
+    nkv = n_kv_heads if n_kv_heads is not None else cfg.n_kv_heads
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
     h = cs(h, "act")
     q = jnp.einsum("btd,dh->bth", h, _w(p["wq"]), preferred_element_type=jnp.float32).astype(x.dtype)
     k = jnp.einsum("btd,dh->bth", h, _w(p["wk"]), preferred_element_type=jnp.float32).astype(x.dtype)
     v = jnp.einsum("btd,dh->bth", h, _w(p["wv"]), preferred_element_type=jnp.float32).astype(x.dtype)
-    q = cs(q.reshape(B, T, cfg.n_heads, cfg.head_dim), "heads")
-    k = cs(k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
-    v = cs(v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim), "kv_heads")
+    q = cs(q.reshape(B, T, nq, cfg.head_dim), "heads")
+    k = cs(k.reshape(B, T, nkv, cfg.head_dim), "kv_heads")
+    v = cs(v.reshape(B, T, nkv, cfg.head_dim), "kv_heads")
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
 
@@ -355,8 +361,8 @@ def forward(
         p, li = layer_in
         q, k, v = _layer_qkv(p, x, cfg, cos, sin, cs)
 
-        kc = kc.at[li, batch_idx, positions].set(k)
-        vc = vc.at[li, batch_idx, positions].set(v)
+        kc = kc.at[li, batch_idx, positions].set(k.astype(kc.dtype))
+        vc = vc.at[li, batch_idx, positions].set(v.astype(vc.dtype))
 
         if attn_impl == "pallas" and T == 1:
             from ..ops import sharded_decode_attention_layer
@@ -407,11 +413,15 @@ def forward_paged(
     k_pool: jax.Array,  # (L, N, bs, nkv, hd) — global paged KV pool
     v_pool: jax.Array,
     block_tables: jax.Array,  # (B, max_blocks) int32 pool-block ids
-    rules=None,
+    rules=None,  # parallel.ShardingRules | None — pool blocks shard over
+    # dp, kv heads over tp (parallel.mesh.paged_pool_shardings)
     attn_impl: str = "pallas",  # T=1 uses ops.paged_attention; T>1 gathers
     write_mask: jax.Array | None = None,  # (B,) bool; False rows park their
-    # writes in reserved trash block 0 (idle continuous-batching rows must
+    # writes in their trash block (idle continuous-batching rows must
     # never scribble on another row's — or the shared prefix's — blocks)
+    trash_idx: jax.Array | None = None,  # (B,) int32 flat pool index for
+    # parked writes; default 0 (block 0). On a dp mesh each dp group
+    # reserves its own trash block so parked writes stay shard-local.
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """The paged twin of ``forward`` (parity-tested): sequences own
     non-contiguous pool blocks via per-row block tables (SURVEY.md §7
@@ -420,7 +430,7 @@ def forward_paged(
     (block-table indirection in the index map — no contiguous per-sequence
     cache ever materializes); T>1 prefill gathers the row's blocks once per
     layer (a per-prefill cost, not per-token). Returns
-    (logits, k_pool, v_pool). Single-device for now (no mesh rules)."""
+    (logits, k_pool, v_pool)."""
     B, T = tokens.shape
     L, N, bs = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
     S = block_tables.shape[1] * bs  # gathered context capacity
@@ -435,7 +445,9 @@ def forward_paged(
     blk = jnp.take_along_axis(block_tables, positions // bs, axis=1)  # (B, T)
     flat_idx = blk * bs + positions % bs  # (B, T) into the (N*bs,) flat pool
     if write_mask is not None:
-        flat_idx = jnp.where(write_mask[:, None], flat_idx, 0)  # trash block
+        park = (jnp.zeros((B,), jnp.int32) if trash_idx is None
+                else trash_idx.astype(jnp.int32))
+        flat_idx = jnp.where(write_mask[:, None], flat_idx, park[:, None])
 
     def layer(carry, layer_in):
         x, kp, vp = carry
@@ -444,14 +456,15 @@ def forward_paged(
 
         kp_flat = kp.reshape(L, N * bs, cfg.n_kv_heads, cfg.head_dim)
         vp_flat = vp.reshape(L, N * bs, cfg.n_kv_heads, cfg.head_dim)
-        kp = kp_flat.at[li, flat_idx].set(k).reshape(kp.shape)
-        vp = vp_flat.at[li, flat_idx].set(v).reshape(vp.shape)
+        kp = kp_flat.at[li, flat_idx].set(k.astype(kp.dtype)).reshape(kp.shape)
+        vp = vp_flat.at[li, flat_idx].set(v.astype(vp.dtype)).reshape(vp.shape)
 
         if attn_impl == "pallas" and T == 1:
-            from ..ops import paged_attention
+            from ..ops import sharded_paged_attention
 
-            attn = paged_attention(
-                q[:, 0], kp, vp, block_tables, frontier + 1, li
+            mesh = rules.mesh if rules is not None else None
+            attn = sharded_paged_attention(
+                mesh, q[:, 0], kp, vp, block_tables, frontier + 1, li
             ).reshape(B, T, -1)
         else:
             # prefill: gather the row's blocks to a contiguous view once

@@ -27,7 +27,11 @@ from .decode_attention import (
     sharded_decode_attention_layer,
 )
 from .grammar_mask import masked_argmax, masked_argmax_reference, sharded_masked_argmax
-from .paged_attention import paged_attention, paged_attention_reference
+from .paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+    sharded_paged_attention,
+)
 
 __all__ = [
     "flash_attention",
@@ -43,4 +47,5 @@ __all__ = [
     "sharded_masked_argmax",
     "paged_attention",
     "paged_attention_reference",
+    "sharded_paged_attention",
 ]

@@ -152,6 +152,55 @@ def paged_attention(
     return out.reshape(B, nq, hd)
 
 
+def sharded_paged_attention(
+    mesh,
+    q: jax.Array,  # (B, nq, hd)
+    k_pool: jax.Array,  # (L, N, bs, nkv, hd)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32 GLOBAL block ids
+    kv_len: jax.Array,  # (B,)
+    layer: jax.Array,
+    **kw,
+) -> jax.Array:
+    """paged_attention over a (dp, tp) mesh (mesh=None -> plain kernel).
+
+    Layout mirrors parallel.mesh.paged_pool_shardings: pool blocks shard
+    over dp, kv heads over tp, batch rows over dp. The allocator only hands
+    a slot blocks from its own dp group's range, so each dp shard's rows
+    attend entirely within the local pool shard — zero collectives, like
+    the dense sharded_decode_attention. Block-table ids are global; the
+    local body subtracts the shard's block offset before the kernel's
+    index-map indirection."""
+    if mesh is None:
+        return paged_attention(q, k_pool, v_pool, block_tables, kv_len, layer, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    B, nq = q.shape[0], q.shape[1]
+    N, nkv = k_pool.shape[1], k_pool.shape[3]
+    tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
+    dp_ax = "dp" if (dp > 1 and B % dp == 0 and N % dp == 0) else None
+    local_blocks = N // dp if dp_ax else N
+
+    def local(q, kp, vp, bt, kl, layer):
+        if dp_ax is not None:
+            bt = bt - jax.lax.axis_index("dp") * local_blocks
+        return paged_attention(q, kp, vp, bt, kl, layer, **kw)
+
+    qs = P(dp_ax, tp_ax, None)
+    ps = P(None, dp_ax, None, tp_ax, None)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(qs, ps, ps, P(dp_ax, None), P(dp_ax), P()),
+        out_specs=qs,
+        check_vma=False,
+    )
+    return fn(q, k_pool, v_pool, block_tables.astype(jnp.int32),
+              kv_len.astype(jnp.int32), layer)
+
+
 def paged_attention_reference(
     q: jax.Array,
     k_pool: jax.Array,

@@ -113,3 +113,44 @@ def kv_cache_shardings(mesh: Mesh, n_kv_heads: int) -> dict:
     spec = P(None, "dp", None, "tp", None) if tp_ok else P(None, "dp", None, None, None)
     ns = NamedSharding(mesh, spec)
     return {"k": ns, "v": ns}
+
+
+def paged_pool_shardings(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
+    """Sharding for the paged KV pool (L, N, bs, nkv, hd): pool blocks over
+    dp (each dp group owns its own block range — serve.paged's allocator
+    hands a slot only blocks from its group), kv heads over tp (matching the
+    dense cache layout, so the paged attention kernel shards identically)."""
+    tp_ok = n_kv_heads % mesh.shape["tp"] == 0
+    return NamedSharding(
+        mesh, P(None, "dp", None, "tp" if tp_ok else None, None))
+
+
+def quantized_param_shardings(mesh: Mesh, n_kv_heads: int, n_experts: int = 0) -> dict:
+    """param_shardings for an int8-quantized tree (models.llama.
+    quantize_params): every quantized matmul weight becomes {"q", "s"} where
+    q keeps the raw weight's spec and s — the per-output-channel scale with
+    a size-1 reduced axis at -2 — keeps the spec minus that axis (a size-1
+    dim can't shard). This is what lifts the engine's old 'int8 is
+    single-device' restriction: the quantized tree gets real shardings, and
+    XLA still reads int8 bytes from HBM per shard."""
+    raw = param_shardings(mesh, n_kv_heads, n_experts)
+
+    def scale_spec(ns: NamedSharding) -> NamedSharding:
+        spec = list(ns.spec)
+        if len(spec) >= 2:
+            spec[-2] = None
+        return NamedSharding(mesh, P(*spec))
+
+    def quantize_leaf(ns: NamedSharding) -> dict:
+        return {"q": ns, "s": scale_spec(ns)}
+
+    layers = {
+        k: (quantize_leaf(v) if k.startswith(("w", "moe_")) else v)
+        for k, v in raw["layers"].items()
+    }
+    return {
+        "embed": raw["embed"],
+        "layers": layers,
+        "final_norm": raw["final_norm"],
+        "lm_head": quantize_leaf(raw["lm_head"]),
+    }

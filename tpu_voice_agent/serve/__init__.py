@@ -3,6 +3,7 @@ from .engine import DecodeEngine, GenerationResult
 from .grounding import GroundingEngine, GroundingResult
 from .paged import BlockAllocator, PagedDecodeEngine
 from .planner import LongSessionPlanner, PlannerSession
+from .pp_engine import PPDecodeEngine
 from .scheduler import ContinuousBatcher
 
 __all__ = [
@@ -15,5 +16,6 @@ __all__ = [
     "GroundingResult",
     "LongSessionPlanner",
     "PagedDecodeEngine",
+    "PPDecodeEngine",
     "PlannerSession",
 ]

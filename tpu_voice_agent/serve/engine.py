@@ -197,7 +197,7 @@ def prefill_row_with_prefix(
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained", "kernels",
-                     "eos_id", "pad_id", "unroll"),
+                     "eos_id", "pad_id", "unroll", "fwd", "max_len"),
     donate_argnames=("cache",),
 )
 def chunk_decode_loop(
@@ -224,6 +224,12 @@ def chunk_decode_loop(
     eos_id: int = 2,  # the serving tokenizer's ids (checkpoint-specific)
     pad_id: int = 0,
     unroll: int = 1,  # layer-scan unroll inside each decode step
+    fwd=None,  # optional forward override: (params, cache, tokens,
+    # positions) -> (logits, cache). The pp×tp engine injects its staged
+    # pipeline forward here; None = models.llama.forward (dense cache).
+    max_len: int | None = None,  # cache capacity; None = dense layout's
+    # cache["k"].shape[2] (a non-dense layout MUST pass it — the staged pp
+    # cache has batch at axis 2)
 ):
     """THE decode loop: advance every active row by up to chunk_steps tokens
     entirely on device.
@@ -250,7 +256,8 @@ def chunk_decode_loop(
     it False.
     """
     B = cur.shape[0]
-    max_len = cache["k"].shape[2]
+    if max_len is None:
+        max_len = cache["k"].shape[2]
     use_ff = constrained and tables.ff_tokens is not None
     W = tables.ff_tokens.shape[1] if use_ff else 0
     cap = chunk_steps * (1 + W)
@@ -279,8 +286,11 @@ def chunk_decode_loop(
         # idle rows park their writes at slot 0 of their own (dead) line
         write_pos = jnp.where(active, pos, 0)
         step_tok = jnp.where(active, cur, pad_id)
-        logits, cache = forward(params, cfg, step_tok[:, None], write_pos[:, None], cache, rules,
-                                attn_impl=kernels, unroll=unroll)
+        if fwd is not None:
+            logits, cache = fwd(params, cache, step_tok[:, None], write_pos[:, None])
+        else:
+            logits, cache = forward(params, cfg, step_tok[:, None], write_pos[:, None],
+                                    cache, rules, attn_impl=kernels, unroll=unroll)
         key, k = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
             logits[:, 0, :], state, tables, k, temperature, greedy,
@@ -347,8 +357,11 @@ def chunk_decode_loop(
 
         s_end, _ = jax.lax.scan(cstep, state, (chain.T, jnp.arange(W)))
 
-        logits, cache = forward(params, cfg, blk_tok, blk_pos, cache, rules,
-                                attn_impl=kernels, unroll=unroll)
+        if fwd is not None:
+            logits, cache = fwd(params, cache, blk_tok, blk_pos)
+        else:
+            logits, cache = forward(params, cfg, blk_tok, blk_pos, cache, rules,
+                                    attn_impl=kernels, unroll=unroll)
         logits_k = jnp.take_along_axis(logits, k[:, None, None], axis=1)[:, 0, :]
         key, kk = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
@@ -475,14 +488,23 @@ class DecodeEngine:
 
         if quant == "int8":
             # weight-only int8: decode is HBM-bound on weights, so halving
-            # their bytes halves the per-token floor (mesh path keeps bf16 —
-            # the sharding pytrees describe raw weights)
+            # their bytes halves the per-token floor. On a mesh the
+            # quantized {"q","s"} leaves get their own shardings (q keeps
+            # the raw spec, per-channel scales drop the reduced axis) so
+            # each tp shard reads its own int8 bytes
             if mesh is not None:
-                raise ValueError("quant='int8' is single-device for now")
+                from ..parallel.mesh import quantized_param_shardings
+
+                self._quant_shardings = quantized_param_shardings(
+                    mesh, self.cfg.n_kv_heads, self.cfg.n_experts)
+            else:
+                self._quant_shardings = None
             if self.params is not None:
                 from ..models.llama import quantize_params
 
-                self.params = jax.jit(quantize_params)(self.params)
+                self.params = jax.jit(
+                    quantize_params, out_shardings=self._quant_shardings
+                )(self.params)
         elif quant is not None:
             raise ValueError(f"unknown quant {quant!r}")
         self.quant = quant
@@ -519,7 +541,10 @@ class DecodeEngine:
         ):
             from ..models.llama import quantize_params
 
-            params = jax.jit(quantize_params)(params)
+            params = jax.jit(
+                quantize_params,
+                out_shardings=getattr(self, "_quant_shardings", None),
+            )(params)
         self.params = params
 
     @classmethod

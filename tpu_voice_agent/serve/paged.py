@@ -8,9 +8,9 @@ tables:
 
 - HBM holds only the context each request actually has (a 40-token command
   in a 32-slot server no longer reserves 32 x max_len lines)
-- the shared system-prompt+few-shot prefix is ONE set of pool blocks,
-  refcounted and referenced by every slot's table — admission writes only
-  the sub-block remainder tail plus the user suffix
+- the shared system-prompt+few-shot prefix is ONE set of pool blocks per
+  dp group, refcounted and referenced by every slot's table — admission
+  writes only the sub-block remainder tail plus the user suffix
 - decode attends through ops.paged_attention (block-table indirection in
   the kernel's index map; no contiguous per-sequence cache ever exists)
 - block tables grow at chunk boundaries as sequences decode, so capacity
@@ -18,9 +18,12 @@ tables:
 
 ``PagedDecodeEngine`` is a drop-in for ``DecodeEngine`` under the
 continuous batcher (serve.scheduler) via the engine's decode_chunk /
-prefill_slot / release_slot surface. Single-device v1 (no mesh), served
-through the batcher (single-request ``generate()`` stays on the dense
-engine).
+prefill_slot / release_slot surface. On a (dp, tp) mesh the pool shards
+its block axis over dp and kv heads over tp
+(parallel.mesh.paged_pool_shardings): the allocator hands each slot only
+blocks from its dp group's range, so paged decode attention stays
+shard-local (ops.sharded_paged_attention) exactly like the dense path.
+Single-request ``generate()`` stays on the dense engine.
 """
 
 from __future__ import annotations
@@ -43,23 +46,33 @@ class PoolExhausted(RuntimeError):
 
 class BlockAllocator:
     """Host-side free-list allocator with refcounts (prefix blocks are
-    shared across slots). Block 0 is reserved as the trash block idle
-    batcher rows park their writes in — it is never handed out."""
+    shared across slots). ``n_groups`` partitions the pool into equal
+    contiguous ranges (one per mesh dp group); the first block of each
+    group is reserved as that group's trash block — idle batcher rows park
+    their writes there — and is never handed out. Block ids are GLOBAL."""
 
-    def __init__(self, n_blocks: int):
-        if n_blocks < 2:
-            raise ValueError("pool needs >= 2 blocks (block 0 is reserved)")
+    def __init__(self, n_blocks: int, n_groups: int = 1):
+        if n_blocks % n_groups:
+            raise ValueError(f"pool size {n_blocks} must divide into {n_groups} groups")
+        bpg = n_blocks // n_groups
+        if bpg < 2:
+            raise ValueError("each group needs >= 2 blocks (block 0 is reserved)")
         self.n_blocks = n_blocks
-        self._free = list(range(n_blocks - 1, 0, -1))
+        self.n_groups = n_groups
+        self.blocks_per_group = bpg
+        self._free = [
+            list(range((g + 1) * bpg - 1, g * bpg, -1)) for g in range(n_groups)
+        ]
         self._refs: dict[int, int] = {}
 
-    def alloc(self, k: int) -> list[int]:
-        if len(self._free) < k:
+    def alloc(self, k: int, group: int = 0) -> list[int]:
+        free = self._free[group]
+        if len(free) < k:
             raise PoolExhausted(
-                f"KV pool exhausted: need {k} blocks, {len(self._free)} free "
-                f"of {self.n_blocks} (size the pool to the live-token "
-                "working set, not per-slot budgets)")
-        out = [self._free.pop() for _ in range(k)]
+                f"KV pool exhausted: need {k} blocks, {len(free)} free of "
+                f"{self.blocks_per_group} in group {group} (size the pool to "
+                "the live-token working set, not per-slot budgets)")
+        out = [free.pop() for _ in range(k)]
         for b in out:
             self._refs[b] = 1
         return out
@@ -73,13 +86,13 @@ class BlockAllocator:
             r = self._refs[b] - 1
             if r == 0:
                 del self._refs[b]
-                self._free.append(b)
+                self._free[b // self.blocks_per_group].append(b)
             else:
                 self._refs[b] = r
 
     @property
     def blocks_in_use(self) -> int:
-        return self.n_blocks - 1 - len(self._free)
+        return self.n_blocks - self.n_groups - sum(len(f) for f in self._free)
 
 
 @partial(jax.jit, donate_argnames=("k_pool", "v_pool"))
@@ -96,8 +109,8 @@ def _scatter_blocks(k_pool, v_pool, src_k, src_v, dst_idx):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "chunk_steps", "greedy", "constrained", "kernels",
-                     "eos_id", "pad_id", "max_len"),
+    static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained",
+                     "kernels", "eos_id", "pad_id", "max_len"),
     donate_argnames=("k_pool", "v_pool"),
 )
 def paged_chunk_decode_loop(
@@ -112,6 +125,8 @@ def paged_chunk_decode_loop(
     key,
     temperature,
     byte_budget,
+    trash_idx=None,  # (B,) int32 per-row parked-write index (dp-local trash)
+    rules=None,
     logit_mask=None,
     chunk_steps: int = 32,
     greedy: bool = True,
@@ -122,8 +137,9 @@ def paged_chunk_decode_loop(
     max_len: int | None = None,
 ):
     """chunk_decode_loop's paged twin: forward_paged per step, idle rows'
-    writes parked in the reserved trash block via write_mask (they must
-    never scribble on another slot's — or the shared prefix's — blocks)."""
+    writes parked in their group's reserved trash block via write_mask (they
+    must never scribble on another slot's — or the shared prefix's —
+    blocks)."""
     B = cur.shape[0]
     # the engine's max_len, NOT the block-rounded table capacity — with a
     # non-multiple max_len the dense loop stops at max_len-1 and the paged
@@ -155,12 +171,13 @@ def paged_chunk_decode_loop(
         write_pos = jnp.where(active, pos, 0)
         logits, kp, vp = forward_paged(
             params, cfg, step_tok[:, None], write_pos[:, None], kp, vp,
-            block_tables, attn_impl=kernels, write_mask=active,
+            block_tables, rules=rules, attn_impl=kernels, write_mask=active,
+            trash_idx=trash_idx,
         )
         key, k = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
             logits[:, 0, :], state, tables, k, temperature, greedy,
-            constrained, kernels, None, logit_mask
+            constrained, kernels, rules, logit_mask
         )
         state = jnp.where(active, state_next, state)
         cur = jnp.where(active, nxt, cur)
@@ -185,6 +202,11 @@ class PagedDecodeEngine(DecodeEngine):
     KV layout never leaks out. ``pool_blocks`` sizes HBM to the expected
     LIVE token count: pool bytes = pool_blocks * block_size * per-token KV,
     vs the dense engine's batch_slots * max_len.
+
+    On a mesh: pool blocks shard over dp (each dp group allocates from its
+    own contiguous range, so a slot's whole context is local to its dp
+    shard), kv heads over tp. batch_slots must divide by dp (the parent
+    engine enforces this) and so must pool_blocks.
     """
 
     _alloc_dense_cache = False  # startup must never peak at the dense
@@ -192,35 +214,58 @@ class PagedDecodeEngine(DecodeEngine):
 
     def __init__(self, *args, block_size: int = 128, pool_blocks: int | None = None,
                  **kw):
-        # mesh is DecodeEngine's 3rd positional parameter — guard both ways
-        if kw.get("mesh") is not None or (len(args) >= 3 and args[2] is not None):
-            raise ValueError("PagedDecodeEngine is single-device for now")
         super().__init__(*args, **kw)
         bs = block_size
         self.block_size = bs
         self.max_blocks = -(-self.max_len // bs)
+        self.dp = self.mesh.shape.get("dp", 1) if self.mesh is not None else 1
         if pool_blocks is None:
-            # default: same worst case as dense, plus the trash block
-            pool_blocks = self.batch_slots * self.max_blocks + 1
+            # default: same worst case as dense, plus each group's trash block
+            pool_blocks = self.batch_slots * self.max_blocks + self.dp
+        if pool_blocks % self.dp:
+            raise ValueError(
+                f"pool_blocks ({pool_blocks}) must divide into the mesh dp "
+                f"axis ({self.dp}): each dp group owns its own block range")
         L, nkv, hd = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
-        self.k_pool = jnp.zeros((L, pool_blocks, bs, nkv, hd), jnp.bfloat16)
-        self.v_pool = jnp.zeros((L, pool_blocks, bs, nkv, hd), jnp.bfloat16)
-        self.allocator = BlockAllocator(pool_blocks)
+        shape = (L, pool_blocks, bs, nkv, hd)
+        if self.mesh is not None:
+            from ..parallel.mesh import paged_pool_shardings
+
+            sh = paged_pool_shardings(self.mesh, nkv)
+            z = jax.jit(partial(jnp.zeros, shape, jnp.bfloat16), out_shardings=sh)
+            self.k_pool, self.v_pool = z(), z()
+        else:
+            self.k_pool = jnp.zeros(shape, jnp.bfloat16)
+            self.v_pool = jnp.zeros(shape, jnp.bfloat16)
+        self.allocator = BlockAllocator(pool_blocks, n_groups=self.dp)
         self.block_tables = jnp.zeros((self.batch_slots, self.max_blocks), jnp.int32)
         self._slot_shared: list[list[int]] = [[] for _ in range(self.batch_slots)]
         self._slot_owned: list[list[int]] = [[] for _ in range(self.batch_slots)]
         self._covered: list[int] = [0] * self.batch_slots  # positions with blocks
         self._next_pos: list[int] = [0] * self.batch_slots  # upper bound
-        self._prefix_blocks: list[int] = []
+        # parked writes go to the slot's OWN group's trash block so they
+        # never cross dp shards (flat index = first block of the group)
+        self._trash_idx = jnp.asarray(
+            [self._group(b) * self.allocator.blocks_per_group * bs
+             for b in range(self.batch_slots)], jnp.int32)
+        # per-group shared-prefix blocks (the prefix KV must live inside
+        # every dp shard that has slots attending to it)
+        self._prefix_blocks: list[list[int]] = [[] for _ in range(self.dp)]
         self._prefix_tail: dict | None = None  # (L, R, nkv, hd) sub-block rest
+
+    def _group(self, slot: int) -> int:
+        """dp group of a batch slot (slots shard over dp like the dense
+        cache's batch axis: contiguous runs of batch_slots/dp)."""
+        return slot // (self.batch_slots // self.dp)
 
     # ------------------------------------------------------------ prefix
 
     def set_prompt_prefix(self, *sample_prompts: str) -> int:
         P = super().set_prompt_prefix(*sample_prompts)
-        if self._prefix_blocks:
-            self.allocator.free(self._prefix_blocks)
-            self._prefix_blocks = []
+        for g in range(self.dp):
+            if self._prefix_blocks[g]:
+                self.allocator.free(self._prefix_blocks[g])
+                self._prefix_blocks[g] = []
         self._prefix_tail = None
         if P == 0:
             return 0
@@ -229,19 +274,20 @@ class PagedDecodeEngine(DecodeEngine):
         pk = self.prefix_kv["k"][:, 0]  # (L, P, nkv, hd)
         pv = self.prefix_kv["v"][:, 0]
         if full:
-            self._prefix_blocks = self.allocator.alloc(full)
-            blocks = np.asarray(self._prefix_blocks, np.int32)
-            dst = (blocks[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
-            self.k_pool, self.v_pool = _scatter_blocks(
-                self.k_pool, self.v_pool, pk[:, : full * bs], pv[:, : full * bs],
-                jnp.asarray(dst),
-            )
+            for g in range(self.dp):
+                self._prefix_blocks[g] = self.allocator.alloc(full, group=g)
+                blocks = np.asarray(self._prefix_blocks[g], np.int32)
+                dst = (blocks[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
+                self.k_pool, self.v_pool = _scatter_blocks(
+                    self.k_pool, self.v_pool, pk[:, : full * bs], pv[:, : full * bs],
+                    jnp.asarray(dst),
+                )
         if P % bs:
             self._prefix_tail = {"k": pk[:, full * bs:], "v": pv[:, full * bs:]}
         # the dense (L, 1, P, nkv, hd) prefix KV now lives in the pool (full
-        # blocks) + self._prefix_tail (remainder); keeping the dense copy
-        # would hold the prefix in HBM twice for the engine's lifetime.
-        # _split_prefix only needs a non-None sentinel.
+        # blocks per dp group) + self._prefix_tail (remainder); keeping the
+        # dense copy would hold the prefix in HBM twice for the engine's
+        # lifetime. _split_prefix only needs a non-None sentinel.
         self.prefix_kv = {}
         return P
 
@@ -250,10 +296,14 @@ class PagedDecodeEngine(DecodeEngine):
     def _set_table_row(self, slot: int, blocks: list[int]) -> None:
         row = np.zeros(self.max_blocks, np.int32)
         row[: len(blocks)] = blocks
+        # empty table rows must still point INSIDE the slot's dp shard
+        # (the sharded kernel localizes ids by subtracting the group base)
+        row[len(blocks):] = self._group(slot) * self.allocator.blocks_per_group
         self.block_tables = self.block_tables.at[slot].set(jnp.asarray(row))
 
     def prefill_slot(self, ids: list[int], slot: int):
         bs = self.block_size
+        g = self._group(slot)
         self.release_slot(slot)  # a finished request may still own blocks
         n = len(ids)
         suffix = self._split_prefix(ids)
@@ -264,11 +314,11 @@ class PagedDecodeEngine(DecodeEngine):
         if suffix is not None:
             P, m = len(self.prefix_ids), len(suffix)
             full = P // bs
-            shared = self._prefix_blocks[:full]
+            shared = self._prefix_blocks[g][:full]
             self.allocator.ref(shared)
             n_owned = -(-(P + bucket) // bs) - full
             try:
-                owned = self.allocator.alloc(n_owned)
+                owned = self.allocator.alloc(n_owned, group=g)
             except PoolExhausted:
                 self.allocator.free(shared)  # don't leak the prefix refs
                 raise
@@ -290,7 +340,7 @@ class PagedDecodeEngine(DecodeEngine):
             last = m - 1
         else:
             bucket = self._bucket(n)
-            owned = self.allocator.alloc(-(-bucket // bs))
+            owned = self.allocator.alloc(-(-bucket // bs), group=g)
             self._slot_shared[slot], self._slot_owned[slot] = [], owned
             self._set_table_row(slot, owned)
             self._covered[slot] = len(owned) * bs
@@ -302,6 +352,7 @@ class PagedDecodeEngine(DecodeEngine):
         logits, self.k_pool, self.v_pool = forward_paged(
             self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
             self.k_pool, self.v_pool, self.block_tables[slot][None],
+            rules=self.rules,
             attn_impl="xla",  # T>1 block gather path
         )
         return logits[:, last, :]
@@ -314,7 +365,8 @@ class PagedDecodeEngine(DecodeEngine):
         upto = min(upto, self.max_len)
         if upto <= self._covered[slot]:
             return
-        extra = self.allocator.alloc(-(-(upto - self._covered[slot]) // bs))
+        extra = self.allocator.alloc(
+            -(-(upto - self._covered[slot]) // bs), group=self._group(slot))
         self._slot_owned[slot].extend(extra)
         self._set_table_row(slot, self._slot_shared[slot] + self._slot_owned[slot])
         self._covered[slot] += len(extra) * bs
@@ -339,6 +391,7 @@ class PagedDecodeEngine(DecodeEngine):
                 cur, pos, fsm, active, nbytes, tokens_left,
                 self.tables, self.byte_len_table,
                 key, jnp.float32(temperature), jnp.int32(byte_budget),
+                trash_idx=self._trash_idx, rules=self.rules,
                 logit_mask=self.logit_mask, chunk_steps=chunk_steps,
                 greedy=greedy, constrained=True, kernels=self.kernels,
                 eos_id=self.eos_id, pad_id=self.pad_id, max_len=self.max_len,

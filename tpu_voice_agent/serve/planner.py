@@ -164,45 +164,96 @@ class LongSessionPlanner:
         sess.last_logits = logits[:, m - 1, :]
         sess.pos += m
 
+    def session_bytes(self, sess: PlannerSession) -> int:
+        """Device bytes this session's KV cache pins in HBM (k + v)."""
+        if sess.cache is None:
+            return 0
+        k = sess.cache["k"]
+        return 2 * int(np.prod(k.shape)) * k.dtype.itemsize
+
     def plan(self, sess: PlannerSession, max_new_tokens: int | None = None,
              greedy: bool = True, temperature: float = 0.7,
              byte_budget: int = 3900) -> tuple[str, list[int]]:
         """Decode a grammar-valid intent plan at the session frontier. The
         generated tokens join the transcript (the session's own plans are
         part of its history, unlike the reference's forgotten summaries)."""
-        if sess.last_logits is None:
-            raise ValueError("no frontier logits: extend() the session before plan()")
-        # clamp to the reserved headroom — anchoring/extending budgeted
-        # exactly self.max_new_tokens slots past the transcript frontier
+        return self.plan_many([sess], max_new_tokens=max_new_tokens,
+                              greedy=greedy, temperature=temperature,
+                              byte_budget=byte_budget)[0]
+
+    def plan_many(self, sessions: list[PlannerSession],
+                  max_new_tokens: int | None = None, greedy: bool = True,
+                  temperature: float = 0.7,
+                  byte_budget: int = 3900) -> list[tuple[str, list[int]]]:
+        """Batched plan decode across sessions (round-2 VERDICT weak #2:
+        'PlannerParser serializes every session').
+
+        Sessions in the same context bucket stack their (L, 1, S, nkv, hd)
+        caches into one (L, B, S, nkv, hd) batch and share every decode
+        step's weight read — the HBM traffic that dominates decode — so B
+        concurrent sessions cost barely more wall-clock than one. The
+        stack/split copies are O(cache bytes) once per plan call, noise
+        next to a couple hundred decode steps. Sessions in different
+        buckets decode group by group (one compiled program per bucket)."""
+        from collections import defaultdict
+
+        for sess in sessions:
+            if sess.last_logits is None:
+                raise ValueError("no frontier logits: extend() the session before plan()")
         max_new = min(max_new_tokens or self.max_new_tokens, self.max_new_tokens)
         t0 = time.perf_counter()
-        self._rng, k0 = jax.random.split(self._rng)
-        state0 = jnp.full((1,), self.fsm.start, dtype=jnp.int32)
-        tok0, fsm0 = _first_token(
-            sess.last_logits, state0, self.tables, k0, jnp.float32(temperature),
-            greedy=greedy, constrained=True, kernels=self.kernels,
-        )
-        self._rng, key = jax.random.split(self._rng)
-        buf, count, eos, sess.cache, cur, pos, _, _, _, _, _ = chunk_decode_loop(
-            self.params, self.cfg, sess.cache,
-            tok0, jnp.full((1,), sess.pos, jnp.int32), fsm0,
-            tok0 != self.eos_id,
-            jnp.zeros((1,), jnp.int32),
-            jnp.full((1,), max_new, jnp.int32),
-            self.tables, self.byte_len_table,
-            key, jnp.float32(temperature), jnp.int32(byte_budget),
-            chunk_steps=max_new, greedy=greedy, constrained=True,
-            kernels=self.kernels, eos_id=self.eos_id, pad_id=self.pad_id,
-        )
-        buf_h, count_h = jax.device_get((buf, count))
-        out_ids = [int(t) for t in np.asarray(buf_h)[0, : int(count_h[0])]]
-        sess.ids.extend(out_ids)
-        sess.pos = int(jax.device_get(pos)[0])
-        sess.last_logits = None  # frontier logits consumed; next turn extends
+        results: dict[int, tuple[str, list[int]]] = {}
+        groups: dict[int, list[int]] = defaultdict(list)
+        for i, sess in enumerate(sessions):
+            groups[sess.cache["k"].shape[2]].append(i)
+
+        for S, idxs in groups.items():
+            B = len(idxs)
+            # pad the batch to a power of two: one compiled decode program
+            # per (bucket, Bp), not per arrival pattern. Pad rows replicate
+            # session 0's cache line (their active flag starts False, so
+            # they only ever park writes at their own row's slot 0)
+            Bp = 1 << (B - 1).bit_length()
+            rows = idxs + [idxs[0]] * (Bp - B)
+            cache = {
+                "k": jnp.concatenate([sessions[i].cache["k"] for i in rows], axis=1),
+                "v": jnp.concatenate([sessions[i].cache["v"] for i in rows], axis=1),
+            }
+            last = jnp.concatenate([sessions[i].last_logits for i in rows], axis=0)
+            pos0 = jnp.asarray([sessions[i].pos for i in rows], jnp.int32)
+            self._rng, k0, key = jax.random.split(self._rng, 3)
+            state0 = jnp.full((Bp,), self.fsm.start, dtype=jnp.int32)
+            tok0, fsm0 = _first_token(
+                last, state0, self.tables, k0, jnp.float32(temperature),
+                greedy=greedy, constrained=True, kernels=self.kernels,
+            )
+            live = jnp.arange(Bp) < B
+            buf, count, eos, cache, cur, pos, _, _, _, _, _ = chunk_decode_loop(
+                self.params, self.cfg, cache,
+                tok0, pos0, fsm0,
+                live & (tok0 != self.eos_id),
+                jnp.zeros((Bp,), jnp.int32),
+                jnp.full((Bp,), max_new, jnp.int32),
+                self.tables, self.byte_len_table,
+                key, jnp.float32(temperature), jnp.int32(byte_budget),
+                chunk_steps=max_new, greedy=greedy, constrained=True,
+                kernels=self.kernels, eos_id=self.eos_id, pad_id=self.pad_id,
+            )
+            buf_h, count_h, pos_h = jax.device_get((buf, count, pos))
+            for j, i in enumerate(idxs):
+                sess = sessions[i]
+                out_ids = [int(t) for t in np.asarray(buf_h)[j, : int(count_h[j])]]
+                sess.cache = {"k": cache["k"][:, j: j + 1], "v": cache["v"][:, j: j + 1]}
+                sess.ids.extend(out_ids)
+                sess.pos = int(pos_h[j])
+                sess.last_logits = None  # frontier consumed; next turn extends
+                results[i] = (self.tokenizer.decode(out_ids), out_ids)
 
         from ..utils import get_metrics
 
         m = get_metrics()
-        m.inc("planner.plans")
+        m.inc("planner.plans", float(len(sessions)))
+        if len(sessions) > 1:
+            m.inc("planner.batched_plans")
         m.observe_ms("planner.plan", (time.perf_counter() - t0) * 1e3)
-        return self.tokenizer.decode(out_ids), out_ids
+        return [results[i] for i in range(len(sessions))]

@@ -53,11 +53,13 @@ class TPUGrounder:
     page path stays TPU-free) until the first grounded click.
     """
 
-    def __init__(self, preset: str = "qwen2vl-test", max_len: int = 256):
+    def __init__(self, preset: str = "qwen2vl-test", max_len: int = 256,
+                 model_dir: str | None = None):
         import threading
 
         self.preset = preset
         self.max_len = max_len
+        self.model_dir = model_dir  # real HF checkpoint dir (qwen2vl-hf:<dir>)
         self._engine = None
         self._build_lock = threading.Lock()  # warm thread vs request thread
 
@@ -66,7 +68,12 @@ class TPUGrounder:
             if self._engine is None:
                 from ...serve.grounding import GroundingEngine
 
-                self._engine = GroundingEngine(preset=self.preset, max_len=self.max_len)
+                if self.model_dir:
+                    self._engine = GroundingEngine.from_hf(
+                        self.model_dir, max_len=max(self.max_len, 512))
+                else:
+                    self._engine = GroundingEngine(preset=self.preset,
+                                                   max_len=self.max_len)
             return self._engine
 
     def warm(self) -> None:

@@ -31,11 +31,19 @@ def make_grounder_from_env():
     spec = os.environ.get("EXECUTOR_GROUNDING", "").strip()
     if not spec:
         return None
-    name, _, preset = spec.partition(":")
+    name, _, arg = spec.partition(":")
     if name == "qwen2vl":
         from .grounding import TPUGrounder
 
-        return TPUGrounder(preset=preset or "qwen2vl-7b")
+        return TPUGrounder(preset=arg or "qwen2vl-7b")
+    if name == "qwen2vl-hf":
+        # real HF checkpoint directory (config.json + tokenizer.json +
+        # safetensors) — BASELINE config 5 with real weights
+        if not arg:
+            raise ValueError("EXECUTOR_GROUNDING=qwen2vl-hf:<checkpoint dir>")
+        from .grounding import TPUGrounder
+
+        return TPUGrounder(model_dir=arg)
     raise ValueError(f"unknown EXECUTOR_GROUNDING {spec!r}")
 
 
