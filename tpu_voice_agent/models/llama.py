@@ -44,6 +44,11 @@ class LlamaConfig:
     n_experts: int = 0
     top_k: int = 2
     capacity_factor: float = 1.25
+    # "dense": one-hot dispatch/combine einsums (jit-simple; FLOPs ∝ E at
+    # drop-free capacity; the mesh/EP path). "grouped": expert-sorted rows
+    # through the ops.grouped_matmul Pallas kernel — FLOPs ∝ K + one row
+    # tile of padding per expert; single-device prefill optimization.
+    moe_impl: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -246,13 +251,74 @@ def _layer_qkv(p, x, cfg: LlamaConfig, cos, sin, cs=_identity_cs,
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
 
+def _moe_ffn_grouped(p, h, cfg: LlamaConfig):
+    """Grouped-matmul MoE FFN (round-2 VERDICT weak #5): tokens sort by
+    expert, each expert's run pads to a row-tile multiple, and the Pallas
+    grouped matmul streams one weight plane per tile — FFN FLOPs ∝ T·K
+    (plus one tile of padding per expert) instead of the dense dispatch's
+    T·E. Single-device path (a bare pallas_call under GSPMD would
+    replicate its operands); the mesh/EP layout keeps dense dispatch."""
+    from ..ops.grouped_matmul import grouped_matmul
+    from .moe import route_topk_flat
+
+    B, T, d = h.shape
+    E, K, f = cfg.n_experts, cfg.top_k, cfg.ffn_dim
+    Tt = B * T
+    x2 = h.reshape(Tt, d)
+    eids, gates = route_topk_flat(p["router"], x2, E, K)  # (Tt, K)
+
+    flat_e = eids.reshape(-1)  # assignment j = t*K + k
+    flat_t = jnp.arange(Tt * K, dtype=jnp.int32) // K
+    flat_g = gates.reshape(-1)
+    order = jnp.argsort(flat_e, stable=True)  # expert-major, token-stable
+    sorted_e = flat_e[order]
+
+    # fixed power-of-two row tile >= 8: tm need NOT divide Tt*K (rows are
+    # zero-padded to a tile multiple below), and Mosaic's f32 sublane
+    # tiling rejects blocks narrower than 8 rows on real TPU — a divisor-
+    # derived tm of 1-2 (odd batch x top_k) would fail to compile there
+    # while CPU interpret mode hid it (round-3 reviewer finding)
+    tm = min(128, max(8, 1 << (max(Tt * K, 1) - 1).bit_length()))
+    counts = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
+    padded = ((counts + tm - 1) // tm) * tm
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(padded)[:-1]])
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    # destination row for sorted assignment i: its expert's padded offset
+    # plus its rank within the expert's run
+    rank = jnp.arange(Tt * K, dtype=jnp.int32) - starts[sorted_e]
+    pos = offsets[sorted_e] + rank
+
+    # static bound >= sum(padded), rounded to a tile multiple (Tt*K itself
+    # need not divide tm); tail tiles are garbage and never gathered back
+    M_pad = -(-(Tt * K) // tm) * tm + E * tm
+    xs = jnp.zeros((M_pad, d), h.dtype).at[pos].set(x2[flat_t[order]])
+    ends = jnp.cumsum(padded)
+    tile_expert = jnp.clip(
+        jnp.searchsorted(ends, jnp.arange(M_pad // tm, dtype=jnp.int32) * tm,
+                         side="right"),
+        0, E - 1).astype(jnp.int32)
+
+    gate_s = grouped_matmul(xs, _w(p["moe_gate"]), tile_expert, tm=tm)
+    up_s = grouped_matmul(xs, _w(p["moe_up"]), tile_expert, tm=tm)
+    act = (jax.nn.silu(gate_s.astype(jnp.float32)) * up_s.astype(jnp.float32)).astype(h.dtype)
+    down = grouped_matmul(act, _w(p["moe_down"]), tile_expert, tm=tm)  # (M_pad, d)
+
+    out = jnp.zeros((Tt, d), jnp.float32).at[flat_t[order]].add(
+        flat_g[order][:, None] * down[pos].astype(jnp.float32))
+    return out.astype(h.dtype).reshape(B, T, d)
+
+
 def _moe_ffn(p, h, cfg: LlamaConfig):
     """Top-k routed expert FFN over (B, T, d) hidden states. Dense-dispatch
     einsums (models.moe.route_topk): expert choice becomes MXU matmuls with
     static shapes, so the MoE decode step jits exactly like the dense one.
     EP sharding happens declaratively: the stacked (E, ...) expert weights
     shard E over the mesh's tp axis (parallel.mesh.param_shardings) and XLA
-    partitions the dispatch/combine einsums, inserting one psum."""
+    partitions the dispatch/combine einsums, inserting one psum.
+    ``cfg.moe_impl="grouped"`` swaps in the Pallas grouped-matmul dispatch
+    (FLOPs ∝ K, not E)."""
+    if cfg.moe_impl == "grouped":
+        return _moe_ffn_grouped(p, h, cfg)
     from .moe import moe_capacity, route_topk
 
     B, T, d = h.shape

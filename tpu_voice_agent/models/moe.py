@@ -26,22 +26,47 @@ def moe_capacity(n_tokens: int, n_experts: int, top_k: int,
     return max(1, int(np.ceil(n_tokens * top_k / n_experts * capacity_factor)))
 
 
+def _select_topk(router_w: jax.Array, x: jax.Array, n_experts: int,
+                 top_k: int) -> tuple[jax.Array, jax.Array]:
+    """THE expert-selection rule, in one place: x (T, d), router_w (d, E)
+    -> (probs (T, E) f32 softmax, eids (T, K) int32 iterative-argmax picks).
+    Both dispatch layouts (dense one-hot and flat/grouped) derive from
+    this, so expert choice and tie behavior can never drift apart."""
+    E, K = n_experts, top_k
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+    ids = []
+    masked = probs
+    for _ in range(K):
+        idx = jnp.argmax(masked, axis=-1)  # (T,)
+        ids.append(idx.astype(jnp.int32))
+        masked = masked * (1.0 - jax.nn.one_hot(idx, E, dtype=probs.dtype))
+    return probs, jnp.stack(ids, axis=1)
+
+
+def route_topk_flat(router_w: jax.Array, x: jax.Array, n_experts: int,
+                    top_k: int) -> tuple[jax.Array, jax.Array]:
+    """x (T, d), router_w (d, E) -> (eids (T, K) int32, gates (T, K) f32
+    renormalized over the K chosen experts). The flat (assignment-list)
+    layout for the grouped-matmul dispatch path; selection comes from
+    ``_select_topk`` so it is identical to the dense path by construction."""
+    probs, eids = _select_topk(router_w, x, n_experts, top_k)
+    gates = jnp.take_along_axis(probs, eids, axis=-1)  # (T, K)
+    denom = jnp.sum(gates, axis=1, keepdims=True)
+    return eids, gates / jnp.where(denom == 0.0, 1.0, denom)
+
+
 def route_topk(router_w: jax.Array, x: jax.Array, n_experts: int, top_k: int,
                capacity: int) -> tuple[jax.Array, jax.Array]:
     """x (T, d), router_w (d, E) -> (dispatch (T, E, C) one-hot,
     combine (T, E, C) gate-weighted). Pure function of static E/K/C."""
     E, K, C = n_experts, top_k, capacity
-    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router_w.astype(jnp.float32))
-    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
-
-    # top-k mask per token (iterative argmax — K is tiny and static)
-    gates = jnp.zeros_like(probs)
-    masked = probs
-    for _ in range(K):
-        idx = jnp.argmax(masked, axis=-1)  # (T,)
-        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)
-        gates = gates + onehot * probs
-        masked = masked * (1.0 - onehot)
+    probs, eids = _select_topk(router_w, x, E, K)
+    # (T, E) gate matrix from the selected ids
+    gates = jnp.sum(
+        jax.nn.one_hot(eids, E, dtype=probs.dtype, axis=-1) * probs[:, None, :],
+        axis=1,
+    )
 
     chosen = gates > 0.0  # (T, E) bool
     # slot position of each token within its expert's queue, in token order

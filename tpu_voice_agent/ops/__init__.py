@@ -27,6 +27,7 @@ from .decode_attention import (
     sharded_decode_attention_layer,
 )
 from .grammar_mask import masked_argmax, masked_argmax_reference, sharded_masked_argmax
+from .grouped_matmul import grouped_matmul, grouped_matmul_reference
 from .paged_attention import (
     paged_attention,
     paged_attention_reference,
@@ -42,6 +43,8 @@ __all__ = [
     "decode_attention_reference",
     "sharded_decode_attention",
     "sharded_decode_attention_layer",
+    "grouped_matmul",
+    "grouped_matmul_reference",
     "masked_argmax",
     "masked_argmax_reference",
     "sharded_masked_argmax",

@@ -1,0 +1,109 @@
+"""Grouped matmul: expert-sorted rows × per-group weight, Pallas TPU.
+
+The MoE dispatch optimization (round-2 VERDICT weak #5): drop-free
+dense-dispatch routing turns expert choice into (T, E, C) one-hot einsums —
+jit-friendly, but the expert FFN then burns FLOPs ∝ E (every expert's
+matmul runs over the full capacity C == T). Here tokens are SORTED by
+expert on the host side of the op (jnp argsort; static shapes), each
+expert's run padded to a row-tile multiple, and one kernel walks the row
+tiles with the expert id in scalar prefetch — the BlockSpec index map picks
+the expert's weight plane per tile (the same indirection trick as
+paged_attention's block tables). FLOPs become ∝ T·K plus one tile of
+padding per expert.
+
+Standard (m, n, k) matmul tiling: f32 accumulation scratch across the k
+grid axis, output written on the last k step. Like every kernel in ops/,
+a pure-jnp reference twin and interpret=True on CPU keep it testable
+without a chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _on_cpu() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _pick_tile(n: int, cap: int) -> int:
+    """Largest power-of-two divisor of n, at most cap."""
+    t = 1
+    while t * 2 <= cap and n % (t * 2) == 0:
+        t *= 2
+    return t
+
+
+def _gmm_kernel(gid_ref, x_ref, w_ref, o_ref, acc_ref):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...].astype(jnp.float32), w_ref[0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("tm", "tn", "tk", "interpret"))
+def grouped_matmul(
+    x: jax.Array,  # (M, d) rows, expert-sorted and tile-padded
+    w: jax.Array,  # (E, d, f) stacked expert weights
+    tile_expert: jax.Array,  # (M // tm,) int32 expert id per row tile
+    *,
+    tm: int | None = None,
+    tn: int | None = None,
+    tk: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """out[i] = x[i] @ w[tile_expert[i // tm]]  — (M, f).
+
+    Every row tile belongs to exactly ONE expert (the caller pads each
+    expert's run to a tile multiple); the weight plane streams from HBM
+    once per (row-tile, n-tile) pair regardless of E.
+    """
+    M, d = x.shape
+    E, d2, f = w.shape
+    assert d == d2, (d, d2)
+    tm = tm or _pick_tile(M, 128)
+    tn = tn or _pick_tile(f, 128)
+    tk = tk or _pick_tile(d, 512)
+    assert M % tm == 0 and f % tn == 0 and d % tk == 0, (M, f, d, tm, tn, tk)
+    assert tile_expert.shape == (M // tm,)
+    interpret = interpret if interpret is not None else _on_cpu()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(M // tm, f // tn, d // tk),
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda m, n, k, sc: (m, k)),
+            pl.BlockSpec((1, tk, tn), lambda m, n, k, sc: (sc[m], k, n)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda m, n, k, sc: (m, n)),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        _gmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((M, f), x.dtype),
+        interpret=interpret,
+    )(tile_expert.astype(jnp.int32), x, w)
+
+
+def grouped_matmul_reference(x, w, tile_expert, tm: int) -> jax.Array:
+    """Pure-jnp twin: per-row expert gather + batched matmul."""
+    row_expert = jnp.repeat(tile_expert, tm)  # (M,)
+    return jnp.einsum(
+        "md,mdf->mf", x.astype(jnp.float32), w[row_expert].astype(jnp.float32)
+    ).astype(x.dtype)

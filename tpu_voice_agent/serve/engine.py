@@ -434,6 +434,14 @@ class DecodeEngine:
                     f"model vocab {vocab} < tokenizer vocab {tokenizer.vocab_size}"
                 )
         if mesh is not None:
+            if getattr(base, "moe_impl", "dense") == "grouped":
+                # the grouped-matmul dispatch is a bare pallas_call: under
+                # GSPMD it would replicate the (E, d, f) expert weights on
+                # every device, silently defeating EP — enforce the
+                # documented single-device restriction at construction
+                raise ValueError(
+                    "moe_impl='grouped' is single-device; meshed MoE engines "
+                    "use dense dispatch (EP shards experts over tp)")
             # lm_head shards the vocab over tp: pad the model vocab up to a
             # tp multiple BEFORE any FSM build (the build is multi-second —
             # it must happen once, at the final width). Padded ids are never
@@ -559,6 +567,8 @@ class DecodeEngine:
         quant: str | None = None,
         dtype=jnp.bfloat16,
         fast_forward: int = 0,
+        moe_impl: str | None = None,  # override cfg.moe_impl ("grouped" for
+        # the single-device Pallas dispatch on MoE checkpoints)
     ) -> "DecodeEngine":
         """Serve a real HF checkpoint directory: config.json decides the
         architecture, tokenizer.json supplies the real BPE vocab (the intent
@@ -572,6 +582,8 @@ class DecodeEngine:
 
         cfg = llama_config_from_hf(os.path.join(model_dir, "config.json"))
         cfg = replace(cfg, max_seq_len=max_len)
+        if moe_impl is not None:
+            cfg = replace(cfg, moe_impl=moe_impl)
         tok = load_hf_tokenizer(model_dir)
         eng = cls(
             cfg=cfg, mesh=mesh, max_len=max_len, batch_slots=batch_slots,

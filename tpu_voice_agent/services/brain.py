@@ -539,8 +539,10 @@ def make_parser_from_env() -> IntentParser:
         from ..serve import DecodeEngine
 
         quant = os.environ.get("BRAIN_QUANT") or None
+        moe = "grouped" if os.environ.get("BRAIN_MOE") == "grouped" else None
         return _wrap_engine(DecodeEngine.from_hf(model_dir, quant=quant,
-                                                 batch_slots=slots, fast_forward=ff))
+                                                 batch_slots=slots, fast_forward=ff,
+                                                 moe_impl=moe))
     backend = os.environ.get("BRAIN_BACKEND", "rule")
     if backend == "rule":
         return RuleBasedParser()
@@ -548,7 +550,16 @@ def make_parser_from_env() -> IntentParser:
         from ..serve import DecodeEngine
 
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
-        return _wrap_engine(DecodeEngine(preset=preset, batch_slots=slots,
+        cfg = None
+        if os.environ.get("BRAIN_MOE") == "grouped":
+            # Pallas grouped-matmul MoE dispatch (FLOPs ∝ K not E) for
+            # single-device MoE serving; no-op for dense models
+            from dataclasses import replace as _replace
+
+            from ..models.llama import PRESETS as _PRESETS
+
+            cfg = _replace(_PRESETS[preset], moe_impl="grouped")
+        return _wrap_engine(DecodeEngine(preset=preset, cfg=cfg, batch_slots=slots,
                                          fast_forward=ff))
     if backend.startswith("pp"):
         # TP×PP pipelined engine (the 70B planner serving layout): layers
