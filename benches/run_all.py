@@ -11,19 +11,36 @@ import subprocess
 import sys
 from pathlib import Path
 
-BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py"]
+BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
+           "bench_quality.py"]
+# --quick: the fast subset (quality rows always run — they skip cleanly
+# when no checkpoint is configured; the heavy latency benches are dropped)
+QUICK_BENCHES = ["bench_quality.py"]
 
 
 def main() -> None:
     here = Path(__file__).parent
     root = here.parent
+    quick = "--quick" in sys.argv[1:]
     failures = 0
-    for name in BENCHES:
+    for name in (QUICK_BENCHES if quick else BENCHES):
         print(f"[run_all] {name}", file=sys.stderr, flush=True)
-        proc = subprocess.run(
-            [sys.executable, str(here / name)], cwd=root,
-            capture_output=True, text=True, timeout=3600,
-        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(here / name)], cwd=root,
+                capture_output=True, text=True, timeout=3600,
+            )
+        except subprocess.TimeoutExpired as e:
+            # count the timeout as this bench's failure and keep going —
+            # one slow checkpoint eval must not eat the rest of the table
+            failures += 1
+            for stream, buf in (("stderr", e.stderr), ("stdout", e.stdout)):
+                if buf:
+                    out = buf.decode() if isinstance(buf, bytes) else buf
+                    (sys.stderr if stream == "stderr" else sys.stdout).write(out)
+            print(f"[run_all] {name} TIMED OUT after {e.timeout:.0f}s",
+                  file=sys.stderr, flush=True)
+            continue
         sys.stderr.write(proc.stderr)
         sys.stdout.write(proc.stdout)
         sys.stdout.flush()

@@ -1,0 +1,4 @@
+from .golden import GOLDEN_INTENT_CASES, score_case, score_parser
+from .wer import wer
+
+__all__ = ["GOLDEN_INTENT_CASES", "score_case", "score_parser", "wer"]

@@ -1,0 +1,177 @@
+"""Golden-file intent-parse eval (SURVEY.md §4's missing piece).
+
+The reference had no model-quality measurement at all — its quality rested
+on gpt-4o-mini behind the API (apps/brain/src/llm.ts:7-9). This is the
+held-out eval set for the in-tree parser: utterances drawn from the same
+command families as the prompt few-shots (services/prompts.py — search,
+context-dependent follow-ups, sort/filter, risky uploads, multi-intent
+chains) but NEVER shown to the model, each with the expected intent-type
+sequence and the argument facts that matter.
+
+Scoring is two-tier:
+- ``type_match``  — predicted intent TYPE sequence equals the expectation
+  (order included; the executor runs intents sequentially)
+- ``args_score``  — fraction of expected (intent index, arg path, value)
+  facts present in the prediction (substring match for strings, exact for
+  the rest); confirmation flags count as facts
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class GoldenCase:
+    text: str
+    expected_types: tuple[str, ...]
+    context: dict = field(default_factory=dict)
+    # facts: (intent_index, dotted path under that intent, expected value)
+    facts: tuple[tuple[int, str, Any], ...] = ()
+
+
+GOLDEN_INTENT_CASES: list[GoldenCase] = [
+    GoldenCase(
+        "search for mechanical keyboards",
+        ("search",),
+        facts=((0, "args.query", "mechanical keyboard"),),
+    ),
+    GoldenCase(
+        "find waterproof hiking boots",
+        ("search",),
+        facts=((0, "args.query", "hiking boots"),),
+    ),
+    GoldenCase(
+        "open the third result",
+        ("click",),
+        context={"last_query": "mechanical keyboards"},
+        facts=((0, "args.index", 3),),
+    ),
+    GoldenCase(
+        "sort these by price from high to low",
+        ("sort",),
+        context={"last_query": "laptops"},
+        facts=((0, "args.field", "price"), (0, "args.direction", "desc")),
+    ),
+    GoldenCase(
+        "upload my cover letter and submit it",
+        ("upload", "click"),
+        facts=(
+            (0, "requires_confirmation", True),
+            (1, "requires_confirmation", True),
+        ),
+    ),
+    GoldenCase(
+        "go back",
+        ("back",),
+    ),
+    GoldenCase(
+        "scroll down",
+        ("scroll",),
+        facts=((0, "args.direction", "down"),),
+    ),
+    GoldenCase(
+        "take a screenshot of this page",
+        ("screenshot",),
+    ),
+    GoldenCase(
+        "extract the table as csv",
+        ("extract_table",),
+        facts=((0, "args.format", "csv"),),
+    ),
+    GoldenCase(
+        "summarize this page",
+        ("summarize",),
+    ),
+    GoldenCase(
+        "cancel that",
+        ("cancel",),
+    ),
+    GoldenCase(
+        "click the checkout button",
+        ("click",),
+        facts=((0, "target.value", "checkout"),),
+    ),
+    GoldenCase(
+        "search for usb c chargers and sort by price low to high",
+        ("search", "sort"),
+        facts=(
+            (0, "args.query", "usb c charger"),
+            (1, "args.direction", "asc"),
+        ),
+    ),
+    GoldenCase(
+        "open the first link",
+        ("click",),
+        context={"last_query": "usb c chargers"},
+        facts=((0, "args.index", 1),),
+    ),
+    GoldenCase(
+        "navigate to example.com",
+        ("navigate",),
+        facts=((0, "args.url", "example.com"),),
+    ),
+]
+
+
+def _dig(obj: Any, path: str) -> Any:
+    cur = obj
+    for part in path.split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            cur = getattr(cur, part, None)
+        if cur is None:
+            return None
+    return cur
+
+
+def _fact_holds(intent: Any, path: str, want: Any) -> bool:
+    got = _dig(intent, path)
+    if isinstance(want, str):
+        return isinstance(got, str) and want.lower() in got.lower()
+    if isinstance(want, bool):
+        return got is want
+    return got == want
+
+
+def score_case(case: GoldenCase, resp: Any) -> tuple[bool, float]:
+    """resp: ParseResponse (or anything with .intents of objects/dicts).
+    Returns (type_match, args_score in [0, 1])."""
+    intents = getattr(resp, "intents", None) or []
+    types = tuple(getattr(i, "type", None) or i.get("type") for i in intents)
+    type_match = types == case.expected_types
+    if not case.facts:
+        return type_match, 1.0 if type_match else 0.0
+    held = 0
+    for idx, path, want in case.facts:
+        if idx < len(intents) and _fact_holds(intents[idx], path, want):
+            held += 1
+    return type_match, held / len(case.facts)
+
+
+def score_parser(parser, cases: list[GoldenCase] | None = None) -> dict:
+    """Run every golden case through ``parser.parse(text, context)`` and
+    aggregate. Parser errors count as total misses for that case (the
+    eval measures the served surface, not just the happy path)."""
+    cases = cases if cases is not None else GOLDEN_INTENT_CASES
+    type_hits = 0
+    args_total = 0.0
+    errors = 0
+    for case in cases:
+        try:
+            resp = parser.parse(case.text, dict(case.context))
+        except Exception:
+            errors += 1
+            continue
+        tm, ascore = score_case(case, resp)
+        type_hits += int(tm)
+        args_total += ascore
+    n = len(cases)
+    return {
+        "cases": n,
+        "errors": errors,
+        "type_accuracy": type_hits / n,
+        "args_score": args_total / n,
+    }

@@ -1,0 +1,65 @@
+"""Word error rate for the in-tree STT stack (SURVEY.md §4 eval gap).
+
+The reference's speech quality rested entirely on Deepgram nova-3
+(apps/voice/src/deepgram.ts:36-45); nothing in-tree could say how close the
+Whisper replacement gets. ``wer`` is the standard Levenshtein word distance
+over a normalized transcript; ``wer_over_dir`` walks a directory of
+(audio, transcript) pairs — the offline-friendly shape: point
+``WHISPER_EVAL_DIR`` at wavs with sibling .txt files and the bench reports
+a number whenever real audio is present (this image has zero egress, so no
+corpus ships in-tree).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+_NORM = re.compile(r"[^a-z0-9' ]+")
+
+
+def normalize_words(text: str) -> list[str]:
+    return _NORM.sub(" ", text.lower()).split()
+
+
+def wer(reference: str, hypothesis: str) -> float:
+    """Word error rate: (S + D + I) / len(ref words). 0.0 = perfect.
+    An empty reference scores 0.0 against empty, else 1.0."""
+    ref = normalize_words(reference)
+    hyp = normalize_words(hypothesis)
+    if not ref:
+        return 0.0 if not hyp else 1.0
+    # single-row Levenshtein over words
+    prev = list(range(len(hyp) + 1))
+    for i, r in enumerate(ref, 1):
+        cur = [i] + [0] * len(hyp)
+        for j, h in enumerate(hyp, 1):
+            cur[j] = min(
+                prev[j] + 1,  # deletion
+                cur[j - 1] + 1,  # insertion
+                prev[j - 1] + (r != h),  # substitution
+            )
+        prev = cur
+    return prev[-1] / len(ref)
+
+
+def wer_over_dir(transcribe, audio_dir: str | Path) -> dict:
+    """``transcribe(path) -> str`` over every ``*.wav`` with a sibling
+    ``.txt`` reference. Returns {pairs, wer} (corpus-level: total errors /
+    total reference words, the standard aggregation)."""
+    audio_dir = Path(audio_dir)
+    total_errs = 0.0
+    total_words = 0
+    pairs = 0
+    for wav in sorted(audio_dir.glob("*.wav")):
+        ref_path = wav.with_suffix(".txt")
+        if not ref_path.exists():
+            continue
+        ref = ref_path.read_text().strip()
+        hyp = transcribe(str(wav))
+        n = len(normalize_words(ref))
+        total_errs += wer(ref, hyp) * max(n, 1)
+        total_words += max(n, 1)
+        pairs += 1
+    return {"pairs": pairs,
+            "wer": (total_errs / total_words) if total_words else None}
