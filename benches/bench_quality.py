@@ -72,7 +72,6 @@ def wer_rows() -> None:
         return
     import numpy as np
 
-    from tpu_voice_agent.evals import wer  # noqa: F401 (re-exported)
     from tpu_voice_agent.evals.wer import wer_over_dir
     from tpu_voice_agent.serve.stt import SpeechEngine
 
@@ -83,7 +82,13 @@ def wer_rows() -> None:
 
         with wave.open(path, "rb") as w:
             rate = w.getframerate()
+            if w.getsampwidth() != 2:
+                raise ValueError(
+                    f"{path}: {8 * w.getsampwidth()}-bit wav; the WER harness "
+                    "reads 16-bit PCM (convert the corpus first)")
             pcm = np.frombuffer(w.readframes(w.getnframes()), dtype=np.int16)
+            if w.getnchannels() > 1:  # downmix interleaved channels
+                pcm = pcm.reshape(-1, w.getnchannels()).mean(axis=1).astype(np.int16)
         audio = pcm.astype(np.float32) / 32768.0
         if rate != 16000:  # nearest-neighbor to 16 kHz (eval-side convenience)
             idx = (np.arange(int(len(audio) * 16000 / rate)) * rate / 16000).astype(np.int64)

@@ -183,11 +183,28 @@ MESH_PROMPTS = PROMPTS + [render_prompt("sort results by price low to high", {})
 @pytest.mark.parametrize("kernels", ["xla", "pallas"])
 def test_paged_batcher_on_mesh_matches_dense_single_device(mesh, kernels):
     """The meshed paged engine (pool dp-sharded, kv heads tp-sharded, int8
-    aside) must be token-identical to the single-device dense engine."""
-    dense = _dense(4)
+    aside) must be token-identical to the single-device dense engine.
+
+    Identical float32 weights go into both: the mesh engine pads its vocab
+    to a tp multiple (changing any random init), and GSPMD's tp-split
+    contractions reorder f32 partial sums enough to flip greedy argmax on
+    random bf16 weights."""
+    from tpu_voice_agent.models.llama import init_params
+
+    dense = DecodeEngine(preset="test-tiny", max_len=2048, batch_slots=4,
+                         prefill_buckets=(128, 256, 512, 1024),
+                         init_weights=False)
     paged = PagedDecodeEngine(
         preset="test-tiny", max_len=2048, batch_slots=4,
-        prefill_buckets=(128, 256, 512, 1024), mesh=mesh, kernels=kernels)
+        prefill_buckets=(128, 256, 512, 1024), mesh=mesh, kernels=kernels,
+        init_weights=False)
+    raw = init_params(dense.cfg, jax.random.PRNGKey(21), dtype=jnp.float32)
+    dense.load_params(raw)
+    pad = paged.cfg.vocab_size - dense.cfg.vocab_size
+    padded = dict(raw)
+    padded["embed"] = jnp.pad(raw["embed"], ((0, pad), (0, 0)))
+    padded["lm_head"] = jnp.pad(raw["lm_head"], ((0, 0), (0, pad)))
+    paged.load_params(padded)
     install_prompt_prefix(dense)
     install_prompt_prefix(paged)
     rd = ContinuousBatcher(dense, chunk_steps=16, max_new_tokens=160).generate_many(MESH_PROMPTS)

@@ -338,6 +338,15 @@ class PagedDecodeEngine(DecodeEngine):
             tokens[0, :m] = suffix
             positions = (P + np.arange(bucket, dtype=np.int32))[None, :]
             last = m - 1
+            fresh = False
+            # gather only the COVERED blocks, bucketed to a power of two so
+            # compile count stays log-bounded (the old path gathered the
+            # whole table width — max_len of context — per layer)
+            need = -(-(P + bucket) // bs)
+            gb = 1
+            while gb < need:
+                gb *= 2
+            gb = min(gb, self.max_blocks)
         else:
             bucket = self._bucket(n)
             owned = self.allocator.alloc(-(-bucket // bs), group=g)
@@ -348,12 +357,15 @@ class PagedDecodeEngine(DecodeEngine):
             tokens[0, :n] = ids
             positions = np.arange(bucket, dtype=np.int32)[None, :]
             last = n - 1
+            fresh = True  # position 0 start: block-local attention, no gather
+            gb = None
         self._next_pos[slot] = n
         logits, self.k_pool, self.v_pool = forward_paged(
             self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
             self.k_pool, self.v_pool, self.block_tables[slot][None],
             rules=self.rules,
-            attn_impl="xla",  # T>1 block gather path
+            attn_impl=self.kernels if fresh else "xla",
+            fresh_block=fresh, gather_blocks=gb,
         )
         return logits[:, last, :]
 

@@ -510,16 +510,24 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
     return app
 
 
+def _wrap_batched(engine) -> "BatchedEngineParser":
+    """ONE place reading the batched-serving env contract (BRAIN_PREFIX /
+    BRAIN_CHUNK) for every engine flavor put behind the batcher."""
+    if os.environ.get("BRAIN_PREFIX", "1") != "0":
+        install_prompt_prefix(engine)
+    return BatchedEngineParser(engine,
+                               chunk_steps=int(os.environ.get("BRAIN_CHUNK", "16")))
+
+
 def _wrap_engine(engine) -> IntentParser:
     """Prefix-cache the shared prompt head, then pick the serving shape:
     BRAIN_BATCH>1 puts the continuous batcher behind /parse (concurrent
     requests share decode chunks); otherwise the serialized single-slot
     parser. BRAIN_PREFIX=0 disables the prefix cache (debugging)."""
+    if engine.batch_slots > 1:
+        return _wrap_batched(engine)
     if os.environ.get("BRAIN_PREFIX", "1") != "0":
         install_prompt_prefix(engine)
-    if engine.batch_slots > 1:
-        chunk = int(os.environ.get("BRAIN_CHUNK", "16"))
-        return BatchedEngineParser(engine, chunk_steps=chunk)
     return EngineParser(engine)
 
 
@@ -547,7 +555,7 @@ def make_parser_from_env() -> IntentParser:
     if backend == "rule":
         return RuleBasedParser()
     if backend.startswith("engine"):
-        from ..serve import DecodeEngine
+        from ..serve import DecodeEngine, PagedDecodeEngine
 
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
         cfg = None
@@ -559,6 +567,14 @@ def make_parser_from_env() -> IntentParser:
             from ..models.llama import PRESETS as _PRESETS
 
             cfg = _replace(_PRESETS[preset], moe_impl="grouped")
+        if os.environ.get("BRAIN_PAGED") == "1":
+            # paged KV pool behind the batcher: HBM tracks live tokens, the
+            # shared prompt prefix is stored once, BRAIN_POOL_BLOCKS sizes
+            # the pool (default: dense worst case)
+            pool = int(os.environ.get("BRAIN_POOL_BLOCKS", "0")) or None
+            return _wrap_batched(PagedDecodeEngine(
+                preset=preset, cfg=cfg, batch_slots=max(slots, 1),
+                pool_blocks=pool))
         return _wrap_engine(DecodeEngine(preset=preset, cfg=cfg, batch_slots=slots,
                                          fast_forward=ff))
     if backend.startswith("pp"):
@@ -574,9 +590,8 @@ def make_parser_from_env() -> IntentParser:
         ndev = len(jax.devices())
         pp = int(os.environ.get("BRAIN_PP", "0")) or min(2, ndev)
         tp = int(os.environ.get("BRAIN_TP", "0")) or max(1, ndev // pp)
-        eng = PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(pp, tp),
-                             batch_slots=slots)
-        return BatchedEngineParser(eng, chunk_steps=int(os.environ.get("BRAIN_CHUNK", "16")))
+        return _wrap_batched(PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(pp, tp),
+                                            batch_slots=slots))
     if backend.startswith("planner"):
         # long-session transcripts as model context; BRAIN_SP sizes the
         # sequence-parallel axis (default: every visible device)
