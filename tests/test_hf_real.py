@@ -606,3 +606,20 @@ class TestGroundingFromHF:
                            f"qwen2vl-hf:{qwen2vl_hf_checkpoint_dir}")
         g = make_grounder_from_env()
         assert g is not None and g.model_dir == str(qwen2vl_hf_checkpoint_dir)
+
+
+def test_paged_engine_serves_real_checkpoint(hf_checkpoint_dir):
+    """Classmethod polymorphism: the paged engine loads HF checkpoints
+    through the same from_hf loader (BRAIN_MODEL + BRAIN_PAGED=1 path),
+    with subclass knobs (pool_blocks) passing through."""
+    from tpu_voice_agent.serve import PagedDecodeEngine
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+    from tpu_voice_agent.services.prompts import render_prompt
+
+    eng = PagedDecodeEngine.from_hf(str(hf_checkpoint_dir), max_len=2048,
+                                    batch_slots=2, pool_blocks=40)
+    assert eng.allocator.n_blocks == 40
+    res = ContinuousBatcher(eng, chunk_steps=16, max_new_tokens=96).generate_many(
+        [render_prompt("scroll down", {})])
+    assert res[0].error is None
+    assert eng.fsm.walk(res[0].token_ids) >= 0

@@ -142,3 +142,35 @@ class TestPPDecodeEngine:
 
         with _pytest.raises(ValueError, match="batcher"):
             eng.generate("x")
+
+    def test_pp_prefix_cache_matches_dense(self):
+        """The pp engine's staged-layout prefix cache (admission = copy
+        prefix KV + suffix-only forward) stays token-identical to the dense
+        engine with ITS prefix cache installed."""
+        from tpu_voice_agent.models.llama import init_params
+        from tpu_voice_agent.parallel.pipeline import pp_tp_mesh
+        from tpu_voice_agent.serve import DecodeEngine, PPDecodeEngine
+        from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+        from tpu_voice_agent.services.brain import install_prompt_prefix
+        from tpu_voice_agent.services.prompts import render_prompt
+
+        dense = DecodeEngine(preset="test-tiny", max_len=2048, batch_slots=2,
+                             prefill_buckets=(512, 1024), init_weights=False)
+        pp = PPDecodeEngine(preset="test-tiny", mesh=pp_tp_mesh(2, 2),
+                            max_len=2048, batch_slots=2,
+                            prefill_buckets=(512, 1024), init_weights=False)
+        raw = init_params(dense.cfg, jax.random.PRNGKey(13), dtype=jnp.float32)
+        dense.load_params(raw)
+        pp.load_params(raw)
+        pd = install_prompt_prefix(dense)
+        ppfx = install_prompt_prefix(pp)
+        assert ppfx == pd > 0  # the pp engine really caches the prefix now
+        prompts = [
+            render_prompt("filter under two hundred dollars", {}),
+            render_prompt("take a screenshot", {"last_query": "filters"}),
+        ]
+        rd = ContinuousBatcher(dense, chunk_steps=16, max_new_tokens=120).generate_many(prompts)
+        rp = ContinuousBatcher(pp, chunk_steps=16, max_new_tokens=120).generate_many(prompts)
+        for d, p in zip(rd, rp):
+            assert d.error is None and p.error is None
+            assert d.token_ids == p.token_ids, (d.text[:80], p.text[:80])

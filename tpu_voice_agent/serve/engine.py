@@ -569,6 +569,8 @@ class DecodeEngine:
         fast_forward: int = 0,
         moe_impl: str | None = None,  # override cfg.moe_impl ("grouped" for
         # the single-device Pallas dispatch on MoE checkpoints)
+        **engine_kw,  # subclass knobs (classmethod polymorphism: e.g.
+        # PagedDecodeEngine.from_hf takes pool_blocks / block_size)
     ) -> "DecodeEngine":
         """Serve a real HF checkpoint directory: config.json decides the
         architecture, tokenizer.json supplies the real BPE vocab (the intent
@@ -589,6 +591,7 @@ class DecodeEngine:
             cfg=cfg, mesh=mesh, max_len=max_len, batch_slots=batch_slots,
             prefill_buckets=prefill_buckets, kernels=kernels, quant=quant,
             tokenizer=tok, init_weights=False, fast_forward=fast_forward,
+            **engine_kw,
         )
         params = llama_from_hf_state(model_dir, cfg, dtype=dtype)
         if eng.cfg.vocab_size != cfg.vocab_size:
@@ -631,7 +634,9 @@ class DecodeEngine:
         behavior at the prefix/suffix boundary (an exact-match check at
         prefill time guarantees correctness either way). Returns the cached
         prefix length in tokens. Call once at service start with two
-        rendered prompts that differ only in their user payload."""
+        rendered prompts that differ only in their user payload. The ONE
+        copy of the matching logic; subclasses with their own cache layout
+        override only ``_compute_prefix_kv``."""
         if len(sample_prompts) < 2:
             raise ValueError("need >= 2 sample prompts to locate the shared prefix")
         encs = [self.tokenizer.encode(p, bos=True) for p in sample_prompts]
@@ -647,14 +652,20 @@ class DecodeEngine:
         tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
         tokens[0, :P] = ids
         positions = np.arange(bucket, dtype=np.int32)[None, :]
-        scratch = init_kv_cache(self.cfg, 1, bucket)
-        _, kv = forward(
-            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-            scratch, self.rules, attn_impl=self.kernels, fresh_block=True,
-        )
-        self.prefix_kv = {"k": kv["k"][:, :, :P], "v": kv["v"][:, :, :P]}
+        self.prefix_kv = self._compute_prefix_kv(
+            jnp.asarray(tokens), jnp.asarray(positions), P, bucket)
         self.prefix_ids = ids
         return P
+
+    def _compute_prefix_kv(self, tokens, positions, P: int, bucket: int) -> dict:
+        """Prefill the prefix into a scratch cache and return its KV in
+        this engine's layout (dense: (L, 1, P, nkv, hd))."""
+        scratch = init_kv_cache(self.cfg, 1, bucket)
+        _, kv = forward(
+            self.params, self.cfg, tokens, positions,
+            scratch, self.rules, attn_impl=self.kernels, fresh_block=True,
+        )
+        return {"k": kv["k"][:, :, :P], "v": kv["v"][:, :, :P]}
 
     def _split_prefix(self, ids: list[int]) -> list[int] | None:
         """Return the suffix ids when the cached prefix applies, else None.
@@ -673,9 +684,12 @@ class DecodeEngine:
         """Prefill token ids into one batch slot's cache line, reusing the
         shared-prefix KV when `ids` starts with it (exact token match;
         anything else takes the full-prompt path). Returns the last real
-        token's logits (1, V). The single decision tree shared by
-        single-request generate() and the continuous batcher's admission —
-        the two paths the equivalence tests hold token-identical."""
+        token's logits (1, V). THE single decision tree shared by
+        single-request generate(), the continuous batcher's admission, and
+        every engine layout (dense / paged / pp override only the
+        ``_prefill_suffix`` / ``_prefill_full`` kernels) — the paths the
+        equivalence tests hold token-identical."""
+        self.release_slot(slot)  # a finished request may still own resources
         n = len(ids)
         suffix = self._split_prefix(ids)
         if suffix is not None:
@@ -687,23 +701,36 @@ class DecodeEngine:
             tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
             tokens[0, :m] = suffix
             positions = (P + np.arange(bucket, dtype=np.int32))[None, :]
-            logits, self.cache = prefill_row_with_prefix(
-                self.params, self.cfg, self.cache,
-                self.prefix_kv["k"], self.prefix_kv["v"],
-                jnp.asarray(tokens), jnp.asarray(positions), jnp.int32(slot),
-                rules=self.rules, kernels=self.kernels,
-            )
+            logits = self._prefill_suffix(
+                jnp.asarray(tokens), jnp.asarray(positions), slot, P, bucket, n)
             return logits[:, m - 1, :]
         bucket = self._bucket(n)
         tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
         tokens[0, :n] = ids
         positions = np.arange(bucket, dtype=np.int32)[None, :]
+        logits = self._prefill_full(
+            jnp.asarray(tokens), jnp.asarray(positions), slot, bucket, n)
+        return logits[:, n - 1, :]
+
+    def _prefill_suffix(self, tokens, positions, slot: int, P: int, bucket: int,
+                        n: int):
+        """Layout kernel: admit a prefix-cached suffix into ``slot``."""
+        logits, self.cache = prefill_row_with_prefix(
+            self.params, self.cfg, self.cache,
+            self.prefix_kv["k"], self.prefix_kv["v"],
+            tokens, positions, jnp.int32(slot),
+            rules=self.rules, kernels=self.kernels,
+        )
+        return logits
+
+    def _prefill_full(self, tokens, positions, slot: int, bucket: int, n: int):
+        """Layout kernel: admit a fresh full prompt into ``slot``."""
         logits, self.cache = prefill_row(
             self.params, self.cfg, self.cache,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.int32(slot),
+            tokens, positions, jnp.int32(slot),
             rules=self.rules, kernels=self.kernels, fresh=True,
         )
-        return logits[:, n - 1, :]
+        return logits
 
     def decode_chunk(self, cur, pos, fsm, active, nbytes, tokens_left, key,
                      temperature: float, byte_budget: int, chunk_steps: int,

@@ -62,6 +62,27 @@ def pp_prefill_row(params, cache, cfg: LlamaConfig, tokens, positions, slot, mes
     }
 
 
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("cache",))
+def pp_prefill_row_with_prefix(params, cache, cfg: LlamaConfig, prefix_k,
+                               prefix_v, tokens, positions, slot, mesh):
+    """Admission prefill reusing precomputed shared-prefix KV (staged
+    (S, L/S, 1, P, nkv, hd)): copy it into the slot's cache row, run the
+    forward over ONLY the user suffix — per-request prefill cost becomes
+    proportional to what differs between requests, exactly like the dense
+    engine's prefill_row_with_prefix (the 70B path's prompt head is the
+    same ~900 tokens every call)."""
+    k = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=2)
+    v = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=2)
+    k = jax.lax.dynamic_update_slice(k, prefix_k, (0, 0, 0, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(v, prefix_v, (0, 0, 0, 0, 0, 0))
+    logits, row = pp_tp_forward_cached(params, {"k": k, "v": v}, cfg, tokens,
+                                       positions, mesh)
+    return logits, {
+        "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], row["k"], slot, axis=2),
+        "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], row["v"], slot, axis=2),
+    }
+
+
 class PPDecodeEngine(DecodeEngine):
     """Grammar-constrained decode over a (pp, tp) mesh (70B planner layout).
 
@@ -161,29 +182,33 @@ class PPDecodeEngine(DecodeEngine):
 
     # ------------------------------------------------------------ prefix
 
-    def set_prompt_prefix(self, *sample_prompts: str) -> int:
-        """Prefix KV caching is not wired for the staged cache layout yet:
-        report no shared prefix, so every prompt takes the full prefill
-        path (callers already handle P == 0)."""
-        self.prefix_ids, self.prefix_kv = [], None
-        return 0
+    def _compute_prefix_kv(self, tokens, positions, P: int, bucket: int) -> dict:
+        """Prefix KV in the STAGED layout (S, L/S, 1, P, nkv, hd): one
+        pipeline prefill into a scratch one-row staged cache. The matching
+        logic stays in DecodeEngine.set_prompt_prefix."""
+        scratch = init_pp_tp_cache(self.cfg, self.pmesh, 1, bucket)
+        _, kv = pp_tp_forward_cached(
+            self.params, scratch, self.cfg, tokens, positions, self.pmesh,
+        )
+        return {"k": kv["k"][:, :, :, :P], "v": kv["v"][:, :, :, :P]}
 
     # ------------------------------------------------------------ engine surface
 
-    def prefill_slot(self, ids: list[int], slot: int):
-        import numpy as np
+    def _prefill_suffix(self, tokens, positions, slot: int, P: int, bucket: int,
+                        n: int):
+        logits, self.cache = pp_prefill_row_with_prefix(
+            self.params, self.cache, self.cfg,
+            self.prefix_kv["k"], self.prefix_kv["v"],
+            tokens, positions, jnp.int32(slot), self.pmesh,
+        )
+        return logits
 
-        n = len(ids)
-        bucket = self._bucket(n)
-        tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
-        tokens[0, :n] = ids
-        positions = np.arange(bucket, dtype=np.int32)[None, :]
+    def _prefill_full(self, tokens, positions, slot: int, bucket: int, n: int):
         logits, self.cache = pp_prefill_row(
             self.params, self.cache, self.cfg,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.int32(slot),
-            self.pmesh,
+            tokens, positions, jnp.int32(slot), self.pmesh,
         )
-        return logits[:, n - 1, :]
+        return logits
 
     def decode_chunk(self, cur, pos, fsm, active, nbytes, tokens_left, key,
                      temperature: float, byte_budget: int, chunk_steps: int,

@@ -538,28 +538,48 @@ def make_parser_from_env() -> IntentParser:
     the reference's LLM_BASE_URL/LLM_MODEL env, apps/brain/src/llm.ts:7-9).
     BRAIN_QUANT=int8 enables weight-only quantization for the loaded model.
     BRAIN_BATCH=N (default 1) serves N continuous-batching slots."""
+    import logging
+
+    log = logging.getLogger("tpu_voice_agent.brain")
     slots = int(os.environ.get("BRAIN_BATCH", "1"))
     # grammar fast-forward applies to the single-slot generate() path only
     # (BRAIN_FF=0 disables); the batcher keeps T=1 decode steps
     ff = int(os.environ.get("BRAIN_FF", "8")) if slots == 1 else 0
+    paged = os.environ.get("BRAIN_PAGED") == "1"
+    quant = os.environ.get("BRAIN_QUANT") or None
+    moe = "grouped" if os.environ.get("BRAIN_MOE") == "grouped" else None
+
+    def warn_unused(backend_name: str, **knobs) -> None:
+        for name, val in knobs.items():
+            if val:
+                log.warning("%s is not supported by the %s backend; ignoring",
+                            name, backend_name)
+
     model_dir = os.environ.get("BRAIN_MODEL")
     if model_dir:
-        from ..serve import DecodeEngine
+        from ..serve import DecodeEngine, PagedDecodeEngine
 
-        quant = os.environ.get("BRAIN_QUANT") or None
-        moe = "grouped" if os.environ.get("BRAIN_MOE") == "grouped" else None
+        if paged:
+            # classmethod polymorphism: from_hf builds cls(...), so the
+            # paged engine loads checkpoints through the same loader
+            pool = int(os.environ.get("BRAIN_POOL_BLOCKS", "0")) or None
+            eng = PagedDecodeEngine.from_hf(
+                model_dir, quant=quant, batch_slots=max(slots, 1),
+                moe_impl=moe, pool_blocks=pool)
+            return _wrap_batched(eng)
         return _wrap_engine(DecodeEngine.from_hf(model_dir, quant=quant,
                                                  batch_slots=slots, fast_forward=ff,
                                                  moe_impl=moe))
     backend = os.environ.get("BRAIN_BACKEND", "rule")
     if backend == "rule":
+        warn_unused("rule", BRAIN_PAGED=paged, BRAIN_QUANT=quant, BRAIN_MOE=moe)
         return RuleBasedParser()
     if backend.startswith("engine"):
         from ..serve import DecodeEngine, PagedDecodeEngine
 
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
         cfg = None
-        if os.environ.get("BRAIN_MOE") == "grouped":
+        if moe:
             # Pallas grouped-matmul MoE dispatch (FLOPs ∝ K not E) for
             # single-device MoE serving; no-op for dense models
             from dataclasses import replace as _replace
@@ -567,16 +587,16 @@ def make_parser_from_env() -> IntentParser:
             from ..models.llama import PRESETS as _PRESETS
 
             cfg = _replace(_PRESETS[preset], moe_impl="grouped")
-        if os.environ.get("BRAIN_PAGED") == "1":
+        if paged:
             # paged KV pool behind the batcher: HBM tracks live tokens, the
             # shared prompt prefix is stored once, BRAIN_POOL_BLOCKS sizes
             # the pool (default: dense worst case)
             pool = int(os.environ.get("BRAIN_POOL_BLOCKS", "0")) or None
             return _wrap_batched(PagedDecodeEngine(
                 preset=preset, cfg=cfg, batch_slots=max(slots, 1),
-                pool_blocks=pool))
+                pool_blocks=pool, quant=quant))
         return _wrap_engine(DecodeEngine(preset=preset, cfg=cfg, batch_slots=slots,
-                                         fast_forward=ff))
+                                         fast_forward=ff, quant=quant))
     if backend.startswith("pp"):
         # TP×PP pipelined engine (the 70B planner serving layout): layers
         # pipeline over pp, each stage tensor-parallel over tp.
@@ -586,6 +606,7 @@ def make_parser_from_env() -> IntentParser:
         from ..parallel.pipeline import pp_tp_mesh
         from ..serve import PPDecodeEngine
 
+        warn_unused("pp", BRAIN_PAGED=paged, BRAIN_QUANT=quant, BRAIN_MOE=moe)
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
         ndev = len(jax.devices())
         pp = int(os.environ.get("BRAIN_PP", "0")) or min(2, ndev)
@@ -600,6 +621,7 @@ def make_parser_from_env() -> IntentParser:
         from ..parallel.ring import sp_mesh
         from ..serve import LongSessionPlanner
 
+        warn_unused("planner", BRAIN_PAGED=paged, BRAIN_QUANT=quant, BRAIN_MOE=moe)
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
         sp = int(os.environ.get("BRAIN_SP", "0")) or len(jax.devices())
         return PlannerParser(LongSessionPlanner(preset=preset, mesh=sp_mesh(sp)))
