@@ -85,7 +85,16 @@ class _StubPlanner:
         return [self.plan(s, max_new_tokens) for s in sessions]
 
     def session_bytes(self, sess):
-        return self.bytes_per_session
+        return 0 if getattr(sess, "parked", False) else self.bytes_per_session
+
+    def park(self, sess):
+        sess.parked = True
+
+    def unpark(self, sess):
+        sess.parked = False
+
+    def parked_bytes(self, sess):
+        return self.bytes_per_session if getattr(sess, "parked", False) else 0
 
 
 def test_planner_sessions_isolated_and_evicted():
@@ -198,3 +207,68 @@ def test_plan_many_matches_sequential_plan():
     for (st, si), (bt, bi) in zip(seq, batched):
         assert si == bi
         assert st == bt
+
+
+def test_evicted_session_parks_to_host_and_resumes():
+    """Eviction parks the session's cache to host RAM instead of dropping
+    it (round-2 advisor offload option): a later turn on the evicted id
+    RESUMES the transcript (extend path), never cold-starts."""
+    parser = PlannerParser(_StubPlanner(bytes_per_session=1 << 20))
+    parser.max_sessions = 2
+
+    parser.parse("scroll down", {}, session_id="a")
+    sess_a = parser._sessions["a"]
+    n_before = len(sess_a.ids)
+    parser.parse("scroll down", {}, session_id="b")
+    parser.parse("scroll down", {}, session_id="c")  # evicts "a" -> parked
+    assert "a" not in parser._sessions and "a" in parser._parked
+    assert getattr(sess_a, "parked", False) is True
+    parser.parse("go back", {}, session_id="a")  # resumes the SAME session
+    assert parser._sessions["a"] is sess_a
+    assert sess_a.parked is False  # unparked on checkout
+    assert len(sess_a.ids) > n_before  # extended, not restarted
+
+
+def test_park_budget_zero_disables_offload():
+    parser = PlannerParser(_StubPlanner(bytes_per_session=1 << 20))
+    parser.max_sessions = 1
+    parser.park_budget_bytes = 0
+    parser.parse("scroll down", {}, session_id="a")
+    parser.parse("scroll down", {}, session_id="b")  # evicts "a" for real
+    assert "a" not in parser._sessions and not parser._parked
+
+
+def test_parked_overflow_drops_oldest():
+    parser = PlannerParser(_StubPlanner(bytes_per_session=1 << 20))
+    parser.max_sessions = 1
+    parser.park_budget_bytes = 2 << 20  # room for two parked sessions
+    for sid in ("a", "b", "c", "d"):
+        parser.parse("scroll down", {}, session_id=sid)
+    # d live; c, b parked; a dropped (oldest parked beyond budget)
+    assert list(parser._sessions) == ["d"]
+    assert list(parser._parked) == ["b", "c"]
+
+
+def test_real_planner_park_roundtrip_preserves_decode():
+    """park/unpark on the real planner: cache round-trips through host
+    numpy and the next plan is token-identical to a never-parked twin."""
+    import numpy as np
+
+    mk = lambda: LongSessionPlanner(
+        preset="test-tiny", mesh=sp_mesh(4), ctx_buckets=(1024,),
+        extend_buckets=(32,), max_new_tokens=100,
+    )
+    p1, p2 = mk(), mk()
+    s1 = p1.start("search for red shoes")
+    s2 = p2.start("search for red shoes")
+    p1.plan(s1)
+    p2.plan(s2)
+    p2.park(s2)
+    assert isinstance(s2.cache["k"], np.ndarray)
+    assert p2.session_bytes(s2) == 0 and p2.parked_bytes(s2) > 0
+    p2.unpark(s2)
+    p1.extend(s1, "\n<|user|>\nsort by price\n<|assistant|>\n")
+    p2.extend(s2, "\n<|user|>\nsort by price\n<|assistant|>\n")
+    (t1, ids1) = p1.plan(s1)
+    (t2, ids2) = p2.plan(s2)
+    assert ids1 == ids2 and t1 == t2

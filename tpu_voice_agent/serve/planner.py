@@ -165,8 +165,36 @@ class LongSessionPlanner:
         sess.pos += m
 
     def session_bytes(self, sess: PlannerSession) -> int:
-        """Device bytes this session's KV cache pins in HBM (k + v)."""
-        if sess.cache is None:
+        """Device bytes this session's KV cache pins in HBM (k + v);
+        0 when parked to host."""
+        if sess.cache is None or isinstance(sess.cache["k"], np.ndarray):
+            return 0
+        k = sess.cache["k"]
+        return 2 * int(np.prod(k.shape)) * k.dtype.itemsize
+
+    def park(self, sess: PlannerSession) -> None:
+        """Move the session's KV cache to HOST memory (one device_get):
+        its HBM footprint drops to zero but the transcript's compute is
+        preserved — resuming costs one upload, not an O(transcript)
+        re-anchor prefill. The round-2 advisor's offload option."""
+        if sess.cache is not None and not isinstance(sess.cache["k"], np.ndarray):
+            sess.cache = jax.device_get(sess.cache)
+        if sess.last_logits is not None and not isinstance(sess.last_logits, np.ndarray):
+            sess.last_logits = jax.device_get(sess.last_logits)
+
+    def unpark(self, sess: PlannerSession) -> None:
+        """Re-upload a parked session's cache to the mesh (replicated, the
+        decode layout)."""
+        if sess.cache is not None and isinstance(sess.cache["k"], np.ndarray):
+            sess.cache = jax.device_put(
+                {"k": jnp.asarray(sess.cache["k"]),
+                 "v": jnp.asarray(sess.cache["v"])}, self._rep)
+        if sess.last_logits is not None and isinstance(sess.last_logits, np.ndarray):
+            sess.last_logits = jax.device_put(jnp.asarray(sess.last_logits), self._rep)
+
+    def parked_bytes(self, sess: PlannerSession) -> int:
+        """Host bytes a parked session's cache occupies."""
+        if sess.cache is None or not isinstance(sess.cache["k"], np.ndarray):
             return 0
         k = sess.cache["k"]
         return 2 * int(np.prod(k.shape)) * k.dtype.itemsize

@@ -239,10 +239,16 @@ class PlannerParser:
             hbm_budget_bytes = int(os.environ.get(
                 "BRAIN_PLANNER_HBM_MB", "2048")) * (1 << 20)
         self.hbm_budget_bytes = hbm_budget_bytes
+        # evicted sessions PARK to host RAM (one device_get) instead of
+        # being dropped — resuming costs one upload, not an O(transcript)
+        # re-anchor. BRAIN_PLANNER_PARK_MB caps host bytes (0 = drop only).
+        self.park_budget_bytes = int(os.environ.get(
+            "BRAIN_PLANNER_PARK_MB", "4096")) * (1 << 20)
         self._sessions: "OrderedDict[str, object]" = OrderedDict()
+        self._parked: "OrderedDict[str, object]" = OrderedDict()  # host RAM
         self._busy: set[str] = set()  # sessions mid-turn: never evicted
         self._session_locks: dict[str, threading.Lock] = {}
-        self._registry = threading.Lock()  # guards the three maps above
+        self._registry = threading.Lock()  # guards the maps above
         self._gather = _PlanGather(planner)
 
     def _checkout(self, session_id: str | None):
@@ -265,9 +271,26 @@ class PlannerParser:
                 # concurrently. Retry on the current object instead.
                 if self._session_locks.get(session_id) is lock:
                     sess = self._sessions.pop(session_id, None)
+                    if sess is None:
+                        sess = self._parked.pop(session_id, None)
                     self._busy.add(session_id)
-                    return sess, lock
+                    break
             lock.release()
+        if sess is not None:
+            # no-op for live sessions; parked ones re-upload their cache.
+            # A failed upload (e.g. HBM RESOURCE_EXHAUSTED — the scarcity
+            # that caused parking) must NOT leak the held lock: fall back
+            # to a cold start and let the turn proceed.
+            try:
+                self.planner.unpark(sess)
+            except Exception:
+                import logging
+
+                logging.getLogger("tpu_voice_agent.planner").warning(
+                    "unpark failed for session %s; cold-starting", session_id,
+                    exc_info=True)
+                sess = None
+        return sess, lock
 
     def _checkin(self, session_id: str | None, lock, sess) -> None:
         if lock is None:
@@ -276,32 +299,71 @@ class PlannerParser:
             self._busy.discard(session_id)
             if sess is not None:
                 self._sessions[session_id] = sess
-            self._evict_locked()
+            victims = self._evict_locked()
+        # park OUTSIDE the registry lock: jax.device_get of a large session
+        # cache is a blocking D2H copy, and holding _registry for it would
+        # stall every other session's checkout/checkin (and /health)
+        parked_now = []
+        for vid, vsess in victims:
+            self.planner.park(vsess)
+            parked_now.append((vid, vsess))
+        if parked_now:
+            with self._registry:
+                for vid, vsess in parked_now:
+                    # a checkout raced us and cold-started this id while we
+                    # were parking: the parked copy is stale — drop it
+                    if vid not in self._busy and vid not in self._sessions:
+                        self._parked[vid] = vsess
+                self._drop_parked_overflow_locked()
         lock.release()
 
-    def _evict_locked(self) -> None:
+    def _evict_locked(self) -> list[tuple[str, object]]:
         """LRU eviction by count AND by total KV-cache bytes (sessions
-        mid-turn are skipped — their caches are in use on device)."""
+        mid-turn are skipped — their caches are in use on device). Returns
+        the victims to PARK to host RAM; the caller runs the blocking D2H
+        copies OUTSIDE the registry lock. A victim bigger than the whole
+        park budget is dropped directly — paying the transfer only to
+        immediately flush it (or everything else) would waste the copy."""
+        from ..utils import get_metrics
+
         def total_bytes():
             return sum(self.planner.session_bytes(s) for s in self._sessions.values())
 
+        victims: list[tuple[str, object]] = []
         while len(self._sessions) > self.max_sessions or (
             total_bytes() > self.hbm_budget_bytes and len(self._sessions) > 1
         ):
             victim = next((k for k in self._sessions if k not in self._busy), None)
             if victim is None:
                 break  # everything live is mid-turn; nothing evictable
-            self._sessions.pop(victim)
-            from ..utils import get_metrics
-
+            sess = self._sessions.pop(victim)
             get_metrics().inc("planner.sessions_evicted")
+            if 0 < self.planner.session_bytes(sess) <= self.park_budget_bytes or (
+                self.park_budget_bytes > 0 and self.planner.session_bytes(sess) == 0
+            ):
+                victims.append((victim, sess))
+                get_metrics().inc("planner.sessions_parked")
         # prune lock entries for dead sessions (never pop a HELD lock's
         # entry: a waiter still blocks on it and must reuse the same object
         # when it wakes, or two turns of one session could run concurrently)
+        pending = {vid for vid, _ in victims}
         for k in list(self._session_locks):
-            if (k not in self._sessions and k not in self._busy
+            if (k not in self._sessions and k not in self._parked
+                    and k not in self._busy and k not in pending
                     and not self._session_locks[k].locked()):
                 del self._session_locks[k]
+        return victims
+
+    def _drop_parked_overflow_locked(self) -> None:
+        """Oldest parked sessions drop entirely past the host budget."""
+        from ..utils import get_metrics
+
+        def parked_bytes():
+            return sum(self.planner.parked_bytes(s) for s in self._parked.values())
+
+        while self._parked and parked_bytes() > self.park_budget_bytes:
+            self._parked.popitem(last=False)
+            get_metrics().inc("planner.sessions_dropped")
 
     def parse(self, text: str, context: dict, session_id: str | None = None) -> ParseResponse:
         user = json.dumps({"text": text, "context": context}, separators=(",", ":"))
