@@ -19,12 +19,33 @@ HBM roofline the decode achieves, so perf regressions are visible
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 V5E_HBM_GBPS = 819.0  # v5e per-chip HBM bandwidth (roofline denominator)
+
+
+def _reexec_on_cpu(reason: str) -> None:
+    """Replace this process with itself pinned to CPU, so a clearly-labeled
+    fallback row still lands when the TPU backend is unusable.
+
+    JAX_PLATFORMS cannot signal operator intent here: this image's shell
+    profile exports JAX_PLATFORMS=axon ambiently (so every run looks
+    'pinned'). Operators who prefer a visible failure over a CPU row set
+    BENCH_NO_CPU_FALLBACK=1 instead."""
+    if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+        print(f"[bench] {reason}; BENCH_NO_CPU_FALLBACK=1 — failing instead "
+              "of substituting CPU", file=sys.stderr, flush=True)
+        os._exit(7)
+    print(f"[bench] {reason}; re-exec pinned to CPU", file=sys.stderr, flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    except OSError:
+        os._exit(7)
 
 
 def synth_utterance(seconds: float, sr: int = 16_000) -> np.ndarray:
@@ -49,12 +70,52 @@ def int8_weight_bytes(cfg) -> float:
     return float(matmul_int8 + cfg.dim * 2)
 
 
+def _devices_with_watchdog(timeout_s: float = 240.0):
+    """jax.devices() with two escape hatches (the round-2 capture recorded
+    NO number because the axon tunnel made this call die — both ways):
+
+    - the call HANGS indefinitely (flapping tunnel): it blocks in C, so no
+      in-thread recovery exists — a watchdog thread re-execs the whole
+      bench pinned to CPU
+    - the call RAISES (backend init fails fast): re-exec likewise, with a
+      clean process image instead of a half-initialized backend
+    """
+    import threading
+
+    import jax
+
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(timeout_s):
+            _reexec_on_cpu(f"device init hung > {timeout_s:.0f}s")
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        devices = jax.devices()
+    except RuntimeError as e:
+        done.set()
+        _reexec_on_cpu(f"backend init failed ({str(e)[:120]})")
+        raise  # unreachable (explicit-pin path already exited)
+    done.set()
+    return devices
+
+
 def main() -> None:
     import jax
 
-    devices = jax.devices()
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # this image's axon plugin force-prepends itself regardless of the
+        # env var; pin the config too (same workaround as tests/conftest)
+        jax.config.update("jax_platforms", "cpu")
+    devices = _devices_with_watchdog()
     on_tpu = any("tpu" in str(d).lower() for d in devices)
     print(f"[bench] devices: {devices}", file=sys.stderr)
+    if not on_tpu:
+        print("[bench] NOTE: CPU run — the voice_to_intent number is NOT "
+              "the v5e headline (README records the round-2 on-chip "
+              "measurement: p50 648 ms, decode ~59% of int8 roofline)",
+              file=sys.stderr)
 
     from tpu_voice_agent.serve import DecodeEngine
     from tpu_voice_agent.serve.stt import SpeechEngine, StreamingSTT
@@ -75,7 +136,9 @@ def main() -> None:
 
     # ---- speech engine, colocated on the same chip
     stt_preset = "whisper-large-v3" if on_tpu else "whisper-test"
-    stt_engine = SpeechEngine(preset=stt_preset, frame_buckets=(300, 1000),
+    # whisper-test (CPU fallback) caps at 200 audio frames; buckets must fit
+    stt_buckets = (300, 1000) if on_tpu else (100, 200)
+    stt_engine = SpeechEngine(preset=stt_preset, frame_buckets=stt_buckets,
                               max_new_tokens=32)
     stt = StreamingSTT(stt_engine)
 
@@ -190,6 +253,9 @@ def main() -> None:
                 "value": round(p50, 2),
                 "unit": "ms",
                 "vs_baseline": round(800.0 / p50, 3),
+                # a CPU fallback row must be distinguishable from the v5e
+                # headline in the JSON itself, not only on stderr
+                "backend": "tpu" if on_tpu else "cpu",
             }
         )
     )

@@ -184,6 +184,11 @@ class SpeechEngine:
             mel_cfg = _replace(mel_cfg, n_mels=self.cfg.n_mels)
         self.mel_cfg = mel_cfg
         self.frame_buckets = tuple(b for b in frame_buckets if b <= self.cfg.max_audio_frames)
+        if not self.frame_buckets:
+            # fail at construction, not as an IndexError mid-stream
+            raise ValueError(
+                f"no frame bucket in {frame_buckets} fits this config's "
+                f"max_audio_frames ({self.cfg.max_audio_frames})")
         self.max_new_tokens = max_new_tokens
         self.params = (
             jax.jit(partial(init_params, self.cfg))(jax.random.PRNGKey(seed))
