@@ -29,32 +29,54 @@ def main(n_sessions: int = 32) -> None:
     tpu = on_tpu()
     preset = "tinyllama-1.1b" if tpu else "test-tiny"
     slots = 32 if tpu else 3
-    engine = DecodeEngine(preset=preset, max_len=2048, batch_slots=slots,
-                          prefill_buckets=(1024,),
-                          quant="int8" if tpu else None)
-    P = install_prompt_prefix(engine)
-    batcher = ContinuousBatcher(engine, chunk_steps=16, max_new_tokens=64)
-    log(f"preset={preset} slots={slots} sessions={n_sessions} prefix={P}tok")
 
     def prompt(i: int) -> str:
         return render_prompt(f"search for item {i} and sort by price", {})
 
-    # warmup: compile suffix prefill + chunk loop
-    batcher.submit(prompt(0))
-    batcher.run_until_done()
-    batcher.results.clear()
+    def run_one(engine, suffix: str) -> None:
+        """ONE benchmark protocol for every engine flavor: warmup, timed
+        submit+drain (stepping manually so the paged pool's REAL peak
+        occupancy gets sampled at chunk boundaries), aggregate, emit."""
+        P = install_prompt_prefix(engine)
+        batcher = ContinuousBatcher(engine, chunk_steps=16, max_new_tokens=64)
+        label = suffix.lstrip("_") or "dense"
+        log(f"[{label}] preset={preset} slots={slots} sessions={n_sessions} "
+            f"prefix={P}tok")
+        batcher.submit(prompt(0))  # warmup: compile suffix prefill + chunk loop
+        batcher.run_until_done()
+        batcher.results.clear()
 
-    t0 = time.perf_counter()
-    rids = [batcher.submit(prompt(i)) for i in range(n_sessions)]
-    batcher.run_until_done()
-    wall_s = time.perf_counter() - t0
+        alloc = getattr(engine, "allocator", None)
+        peak_blocks = 0
+        t0 = time.perf_counter()
+        rids = [batcher.submit(prompt(i)) for i in range(n_sessions)]
+        while batcher.pending or any(s.request_id >= 0 for s in batcher.slots):
+            batcher.step()
+            if alloc is not None:
+                peak_blocks = max(peak_blocks, alloc.blocks_in_use)
+        wall_s = time.perf_counter() - t0
 
-    results = [batcher.results[r] for r in rids]
-    tokens = sum(r.steps for r in results)
-    ok = sum(1 for r in results if r.error is None)
-    log(f"{ok}/{n_sessions} ok, {tokens} tokens in {wall_s:.2f}s")
-    emit("batch_intents_per_s", n_sessions / wall_s, "intents/s/chip")
-    emit("batch_tokens_per_s", tokens / wall_s, "tok/s/chip")
+        results = [batcher.results[r] for r in rids]
+        tokens = sum(r.steps for r in results)
+        ok = sum(1 for r in results if r.error is None)
+        extra = (f", peak pool blocks {peak_blocks}/{alloc.n_blocks}"
+                 if alloc is not None else "")
+        log(f"[{label}] {ok}/{n_sessions} ok, {tokens} tokens in "
+            f"{wall_s:.2f}s{extra}")
+        emit(f"batch_intents_per_s{suffix}", n_sessions / wall_s, "intents/s/chip")
+        emit(f"batch_tokens_per_s{suffix}", tokens / wall_s, "tok/s/chip")
+
+    run_one(DecodeEngine(preset=preset, max_len=2048, batch_slots=slots,
+                         prefill_buckets=(1024,),
+                         quant="int8" if tpu else None), "")
+
+    # paged twin: same workload through the paged KV pool (the BRAIN_PAGED
+    # serving shape — shared-prefix blocks stored once, HBM ∝ live tokens)
+    from tpu_voice_agent.serve import PagedDecodeEngine
+
+    run_one(PagedDecodeEngine(preset=preset, max_len=2048, batch_slots=slots,
+                              prefill_buckets=(1024,),
+                              quant="int8" if tpu else None), "_paged")
 
 
 if __name__ == "__main__":
