@@ -623,3 +623,23 @@ def test_paged_engine_serves_real_checkpoint(hf_checkpoint_dir):
         [render_prompt("scroll down", {})])
     assert res[0].error is None
     assert eng.fsm.walk(res[0].token_ids) >= 0
+
+
+def test_make_parser_env_routes_paged_checkpoint(hf_checkpoint_dir, monkeypatch):
+    """BRAIN_MODEL + BRAIN_PAGED=1 must actually serve the checkpoint
+    through the paged engine (the env contract README documents)."""
+    from tpu_voice_agent.serve import PagedDecodeEngine
+    from tpu_voice_agent.services.brain import make_parser_from_env
+
+    monkeypatch.setenv("BRAIN_MODEL", str(hf_checkpoint_dir))
+    monkeypatch.setenv("BRAIN_PAGED", "1")
+    monkeypatch.setenv("BRAIN_BATCH", "2")
+    monkeypatch.setenv("BRAIN_POOL_BLOCKS", "40")
+    parser = make_parser_from_env()
+    try:
+        assert isinstance(parser.engine, PagedDecodeEngine)
+        assert parser.engine.allocator.n_blocks == 40
+        resp = parser.parse("scroll down", {})
+        assert resp.version == "1.0"
+    finally:
+        parser.close()
