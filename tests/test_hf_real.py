@@ -635,6 +635,10 @@ def test_make_parser_env_routes_paged_checkpoint(hf_checkpoint_dir, monkeypatch)
     monkeypatch.setenv("BRAIN_PAGED", "1")
     monkeypatch.setenv("BRAIN_BATCH", "2")
     monkeypatch.setenv("BRAIN_POOL_BLOCKS", "40")
+    # ambient BRAIN_* knobs must not leak into the configuration under test
+    for knob in ("BRAIN_QUANT", "BRAIN_MOE", "BRAIN_PREFIX", "BRAIN_CHUNK",
+                 "BRAIN_FF", "BRAIN_BACKEND"):
+        monkeypatch.delenv(knob, raising=False)
     parser = make_parser_from_env()
     try:
         assert isinstance(parser.engine, PagedDecodeEngine)
