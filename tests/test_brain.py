@@ -134,3 +134,34 @@ def test_engine_parser_end_to_end_http(tiny_engine):
             assert "intents" in body and isinstance(body["intents"], list)
         else:
             assert r.json()["error"] == "schema_validation_failed"
+
+
+@pytest.mark.slow  # compiles the pp×tp pipeline on the 8-device mesh
+def test_make_parser_env_routes_pp_backend(monkeypatch):
+    """BRAIN_BACKEND=pp[:preset] serves through the TP×PP engine with the
+    BRAIN_PP/BRAIN_TP mesh axes (the 70B serving layout's env contract)."""
+    from tpu_voice_agent.serve import PPDecodeEngine
+    from tpu_voice_agent.services.brain import make_parser_from_env
+
+    monkeypatch.setenv("BRAIN_BACKEND", "pp:test-tiny")
+    monkeypatch.setenv("BRAIN_PP", "2")
+    monkeypatch.setenv("BRAIN_TP", "2")
+    monkeypatch.setenv("BRAIN_BATCH", "2")
+    for knob in ("BRAIN_MODEL", "BRAIN_QUANT", "BRAIN_MOE", "BRAIN_PAGED",
+                 "BRAIN_PREFIX", "BRAIN_CHUNK", "BRAIN_FF"):
+        monkeypatch.delenv(knob, raising=False)
+    from tpu_voice_agent.services.brain import ParserError
+
+    parser = make_parser_from_env()
+    try:
+        assert isinstance(parser.engine, PPDecodeEngine)
+        assert parser.engine.pp == 2 and parser.engine.tp == 2
+        try:
+            resp = parser.parse("go back", {})
+            assert resp.version == "1.0"
+        except ParserError as e:
+            # random weights may ramble to the token budget without EOS —
+            # the 422-class truncation envelope is the one legal failure
+            assert e.kind == "schema_validation_failed"
+    finally:
+        parser.close()
