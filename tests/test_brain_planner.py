@@ -272,3 +272,22 @@ def test_real_planner_park_roundtrip_preserves_decode():
     (t1, ids1) = p1.plan(s1)
     (t2, ids2) = p2.plan(s2)
     assert ids1 == ids2 and t1 == t2
+
+
+def test_planner_fast_forward_stays_in_grammar():
+    """fast_forward>0 (opt-in) routes single-session plans through the
+    forced-chain decode; the emitted token stream must still walk the
+    intent grammar and carry its forced scaffolding. (Byte-identity with
+    ff=0 is NOT a contract: retokenized chains change the model-visible
+    history, so later free choices may legitimately diverge — which is why
+    ff defaults OFF in the planner.)"""
+    p8 = LongSessionPlanner(
+        preset="test-tiny", mesh=sp_mesh(4), ctx_buckets=(1024,),
+        extend_buckets=(32,), max_new_tokens=120, fast_forward=8,
+    )
+    t8, ids8 = p8.plan(p8.start("search for red shoes"))
+    assert p8.fsm.walk(ids8) >= 0, "ff plan left the grammar"
+    assert t8.startswith('{"version":"1.0","intents":[')
+    # the ff twin shares the base tables' device arrays (no re-upload)
+    assert p8.tables_ff.table is p8.tables.table
+    assert p8.tables_ff.col_id is p8.tables.col_id

@@ -521,11 +521,16 @@ class DecodeEngine:
         # fast-forward twin: forced-chain tables attached; used by the
         # single-request constrained path (generate), never by the batcher
         # (a T=1+W step at batch width would re-read the whole cache
-        # through the XLA attention fallback)
+        # through the XLA attention fallback). _replace shares the
+        # already-uploaded table/col_id/dense_mask device arrays instead of
+        # re-uploading them (the dense mask alone can be tens of MB)
         self.fast_forward = fast_forward
-        self.tables_ff = (
-            self.fsm.device_tables(ff_width=fast_forward) if fast_forward > 0 else None
-        )
+        if fast_forward > 0:
+            fft, ffl = self.fsm.forced_tables(fast_forward)
+            self.tables_ff = self.tables._replace(
+                ff_tokens=jnp.asarray(fft), ff_len=jnp.asarray(ffl))
+        else:
+            self.tables_ff = None
         self.byte_len_table = byte_len_table_for(self.tokenizer, self.cfg.vocab_size)
         self._rng = jax.random.PRNGKey(seed + 1)
         # ids past the tokenizer (mesh tp padding / checkpoint embed padding)

@@ -71,6 +71,12 @@ class LongSessionPlanner:
         extend_buckets: tuple[int, ...] = (32, 128, 512),
         max_new_tokens: int = 256,
         kernels: str = "xla",
+        fast_forward: int = 0,  # grammar forced-chain width for B=1 plans.
+        # OFF by default: ff emits the canonical tokenization of forced
+        # byte runs, which changes the model-visible token history and can
+        # legitimately diverge from the T=1 path at later free choices —
+        # enabling it trades the plan()/plan_many token-identity property
+        # for single-session latency (batched groups always keep T=1)
     ):
         if mesh is None or "sp" not in mesh.shape:
             raise ValueError("LongSessionPlanner needs a mesh with an 'sp' axis")
@@ -93,6 +99,17 @@ class LongSessionPlanner:
         self.eos_id = int(self.tokenizer.eos_id)
         self.pad_id = int(self.tokenizer.pad_id)
         self.tables = self.fsm.device_tables()
+        # forced-chain twin for single-session plans: a plan's JSON is
+        # mostly grammar-forced scaffolding, and in the memory-bound decode
+        # regime the chain tokens ride a (1, 1+W) forward nearly free.
+        # _replace shares the already-uploaded table/col_id/dense_mask
+        # device arrays; only the small ff tables are new
+        if fast_forward > 0:
+            fft, ffl = self.fsm.forced_tables(fast_forward)
+            self.tables_ff = self.tables._replace(
+                ff_tokens=jnp.asarray(fft), ff_len=jnp.asarray(ffl))
+        else:
+            self.tables_ff = None
         # vocab == tokenizer vocab here (no mesh tp padding), so no
         # logit_mask is needed in the decode loop
         self.byte_len_table = byte_len_table_for(self.tokenizer, self.cfg.vocab_size)
@@ -256,13 +273,18 @@ class LongSessionPlanner:
                 greedy=greedy, constrained=True, kernels=self.kernels,
             )
             live = jnp.arange(Bp) < B
+            # fast-forward only at Bp == 1: a (1+W)-token step at batch
+            # width would re-read every row's cache through the XLA
+            # attention fallback (same policy as the engine batcher)
+            tables = (self.tables_ff
+                      if Bp == 1 and self.tables_ff is not None else self.tables)
             buf, count, eos, cache, cur, pos, _, _, _, _, _ = chunk_decode_loop(
                 self.params, self.cfg, cache,
                 tok0, pos0, fsm0,
                 live & (tok0 != self.eos_id),
                 jnp.zeros((Bp,), jnp.int32),
                 jnp.full((Bp,), max_new, jnp.int32),
-                self.tables, self.byte_len_table,
+                tables, self.byte_len_table,
                 key, jnp.float32(temperature), jnp.int32(byte_budget),
                 chunk_steps=max_new, greedy=greedy, constrained=True,
                 kernels=self.kernels, eos_id=self.eos_id, pad_id=self.pad_id,
