@@ -219,3 +219,21 @@ def test_paged_batcher_on_mesh_matches_dense_single_device(mesh, kernels):
         g = paged._group(slot)
         for blk in paged._slot_owned[slot] + paged._slot_shared[slot]:
             assert g * bpg <= blk < (g + 1) * bpg
+
+
+def test_sharded_paged_attention_rejects_dp_indivisible(mesh):
+    """Round-3 advisor: with the pool physically sharded over dp, a silent
+    fallback to replicated in_specs would make GSPMD all-gather the whole
+    KV pool per layer. The public op must raise, not degrade."""
+    from tpu_voice_agent.ops import sharded_paged_attention
+
+    L, N, bs, B, nq, nkv, hd = 1, 16, 16, 3, 8, 4, 32  # B=3 % dp=2 != 0
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (B, nq, hd), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (L, N, bs, nkv, hd), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (L, N, bs, nkv, hd), jnp.float32)
+    tables = jnp.zeros((B, 4), jnp.int32)
+    kv_len = jnp.asarray([5, 6, 7], jnp.int32)
+    with pytest.raises(ValueError, match="divisible by dp"):
+        sharded_paged_attention(mesh, q, k_pool, v_pool, tables, kv_len,
+                                jnp.int32(0))

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import glob
 import json
+import logging
 import os
 import struct
 
@@ -77,6 +78,18 @@ def qwen2vl_config_from_hf(path: str):
         # Qwen2-VL's published split (t, h, w) = (hd/8, 3hd/16, 3hd/16),
         # e.g. (16, 24, 24) at head_dim 128; sums to head_dim // 2
         sections = (head_dim // 8, 3 * head_dim // 16, 3 * head_dim // 16)
+    if "img_size" not in v:
+        # real HF Qwen2-VL configs carry no img_size — upstream is
+        # dynamic-resolution. This port letterboxes to a fixed square
+        # (models/qwen2vl.py preprocessing), a deliberate static-shape
+        # adaptation for XLA; surface it so operators evaluating a real
+        # checkpoint know the vision path diverges from upstream.
+        logging.getLogger("tpu_voice_agent.ckpt").warning(
+            "HF vision_config has no img_size: adapting dynamic-resolution "
+            "Qwen2-VL to the fixed 448x448 letterbox pipeline (grounding "
+            "boxes are mapped back through the letterbox transform, but "
+            "very wide/tall screenshots lose detail vs upstream's native "
+            "resolution)")
     vision = VisionConfig(
         img_size=int(v.get("img_size", 448)),
         patch_size=v.get("patch_size", 14),

@@ -180,7 +180,15 @@ def sharded_paged_attention(
     B, nq = q.shape[0], q.shape[1]
     N, nkv = k_pool.shape[1], k_pool.shape[3]
     tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
-    dp_ax = "dp" if (dp > 1 and B % dp == 0 and N % dp == 0) else None
+    if dp > 1 and (B % dp != 0 or N % dp != 0):
+        # never degrade to replicated in_specs here: with the pool
+        # physically sharded over dp, GSPMD would all-gather the whole KV
+        # pool per layer — a severe layout bug this public op must surface,
+        # not hide (PagedDecodeEngine already enforces the invariants).
+        raise ValueError(
+            f"sharded_paged_attention: batch B={B} and pool blocks N={N} "
+            f"must both be divisible by dp={dp}")
+    dp_ax = "dp" if dp > 1 else None
     local_blocks = N // dp if dp_ax else N
 
     def local(q, kp, vp, bt, kl, layer):

@@ -255,17 +255,30 @@ class LongSessionPlanner:
         for S, idxs in groups.items():
             B = len(idxs)
             # pad the batch to a power of two: one compiled decode program
-            # per (bucket, Bp), not per arrival pattern. Pad rows replicate
-            # session 0's cache line (their active flag starts False, so
-            # they only ever park writes at their own row's slot 0)
+            # per (bucket, Bp), not per arrival pattern. Pad rows get ZERO
+            # cache lines, not a copy of a real session's cache (round-3
+            # advisor: duplicating session 0 made a 5-session group
+            # transiently hold 8 widths of REAL cache on top of the
+            # originals, outside the BRAIN_PLANNER_HBM_MB accounting).
+            # Their active flag starts False, so they only ever park writes
+            # at their own row's slot 0; pos=1 keeps the attention window
+            # non-empty (softmax over one zero key, never 0/0 NaN).
             Bp = 1 << (B - 1).bit_length()
-            rows = idxs + [idxs[0]] * (Bp - B)
+            pad = Bp - B
+            k_parts = [sessions[i].cache["k"] for i in idxs]
+            v_parts = [sessions[i].cache["v"] for i in idxs]
+            last_parts = [sessions[i].last_logits for i in idxs]
+            if pad:
+                k_parts += [jnp.zeros_like(k_parts[0])] * pad
+                v_parts += [jnp.zeros_like(v_parts[0])] * pad
+                last_parts += [jnp.zeros_like(last_parts[0])] * pad
             cache = {
-                "k": jnp.concatenate([sessions[i].cache["k"] for i in rows], axis=1),
-                "v": jnp.concatenate([sessions[i].cache["v"] for i in rows], axis=1),
+                "k": jnp.concatenate(k_parts, axis=1),
+                "v": jnp.concatenate(v_parts, axis=1),
             }
-            last = jnp.concatenate([sessions[i].last_logits for i in rows], axis=0)
-            pos0 = jnp.asarray([sessions[i].pos for i in rows], jnp.int32)
+            last = jnp.concatenate(last_parts, axis=0)
+            pos0 = jnp.asarray([sessions[i].pos for i in idxs] + [1] * pad,
+                               jnp.int32)
             self._rng, k0, key = jax.random.split(self._rng, 3)
             state0 = jnp.full((Bp,), self.fsm.start, dtype=jnp.int32)
             tok0, fsm0 = _first_token(
@@ -273,6 +286,15 @@ class LongSessionPlanner:
                 greedy=greedy, constrained=True, kernels=self.kernels,
             )
             live = jnp.arange(Bp) < B
+            # chunk_decode_loop parks idle rows' writes at slot 0 of their
+            # own cache line — harmless for the engines' throwaway
+            # per-request caches, but THIS cache is the session's persistent
+            # transcript KV: a row that hits EOS before its batchmates would
+            # get its first transcript token's K/V silently clobbered with
+            # pad-token garbage, poisoning every later turn. Save slot 0
+            # (tiny: (L, Bp, nkv, hd)) and restore it after the loop.
+            slot0_k = cache["k"][:, :, 0]
+            slot0_v = cache["v"][:, :, 0]
             # fast-forward only at Bp == 1: a (1+W)-token step at batch
             # width would re-read every row's cache through the XLA
             # attention fallback (same policy as the engine batcher)
@@ -289,6 +311,8 @@ class LongSessionPlanner:
                 chunk_steps=max_new, greedy=greedy, constrained=True,
                 kernels=self.kernels, eos_id=self.eos_id, pad_id=self.pad_id,
             )
+            cache = {"k": cache["k"].at[:, :, 0].set(slot0_k),
+                     "v": cache["v"].at[:, :, 0].set(slot0_v)}
             buf_h, count_h, pos_h = jax.device_get((buf, count, pos))
             for j, i in enumerate(idxs):
                 sess = sessions[i]

@@ -181,17 +181,24 @@ class _PlanGather:
                     batch.append(self._q.get_nowait())
                 except queue.Empty:
                     break
-            sessions = [b[0] for b in batch]
-            max_new = min(b[1] for b in batch)
-            try:
-                outs = self.planner.plan_many(sessions, max_new_tokens=max_new)
-            except Exception as e:
-                log.exception("batched plan decode failed")
-                for _, _, fut in batch:
-                    fut.set_exception(e)
-                continue
-            for (_, _, fut), out in zip(batch, outs):
-                fut.set_result(out)
+            # group by token budget: co-batching requests with different
+            # max_new_tokens under min() would silently truncate the larger
+            # ask (PlannerParser happens to pass a constant today, but this
+            # gatherer is public surface)
+            groups: dict[int, list] = {}
+            for b in batch:
+                groups.setdefault(b[1], []).append(b)
+            for max_new, group in groups.items():
+                sessions = [b[0] for b in group]
+                try:
+                    outs = self.planner.plan_many(sessions, max_new_tokens=max_new)
+                except Exception as e:
+                    log.exception("batched plan decode failed")
+                    for _, _, fut in group:
+                        fut.set_exception(e)
+                    continue
+                for (_, _, fut), out in zip(group, outs):
+                    fut.set_result(out)
 
 
 class PlannerParser:
@@ -295,27 +302,51 @@ class PlannerParser:
     def _checkin(self, session_id: str | None, lock, sess) -> None:
         if lock is None:
             return
-        with self._registry:
-            self._busy.discard(session_id)
-            if sess is not None:
-                self._sessions[session_id] = sess
-            victims = self._evict_locked()
-        # park OUTSIDE the registry lock: jax.device_get of a large session
-        # cache is a blocking D2H copy, and holding _registry for it would
-        # stall every other session's checkout/checkin (and /health)
-        parked_now = []
-        for vid, vsess in victims:
-            self.planner.park(vsess)
-            parked_now.append((vid, vsess))
-        if parked_now:
+        # everything below runs with the per-session lock held; park() is a
+        # blocking jax.device_get that can raise (e.g. TPU backend failure),
+        # and _busy is already cleared by then — leaking the lock would
+        # deadlock every future turn for this session_id, so release in a
+        # finally (mirroring the unpark-failure care in _checkout).
+        try:
             with self._registry:
-                for vid, vsess in parked_now:
-                    # a checkout raced us and cold-started this id while we
-                    # were parking: the parked copy is stale — drop it
-                    if vid not in self._busy and vid not in self._sessions:
-                        self._parked[vid] = vsess
-                self._drop_parked_overflow_locked()
-        lock.release()
+                self._busy.discard(session_id)
+                if sess is not None:
+                    self._sessions[session_id] = sess
+                victims = self._evict_locked()
+            # park OUTSIDE the registry lock: jax.device_get of a large
+            # session cache is a blocking D2H copy, and holding _registry
+            # for it would stall every other session's checkout/checkin
+            # (and /health)
+            from ..utils import get_metrics
+
+            parked_now = []
+            for vid, vsess in victims:
+                # park is best-effort offload of an ALREADY-evicted session:
+                # a failure just means the victim cold-starts next turn, it
+                # must not fail this request (whose plan already succeeded)
+                try:
+                    self.planner.park(vsess)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("tpu_voice_agent.planner").warning(
+                        "park failed for evicted session %s; dropping "
+                        "(will cold-start on its next turn)", vid,
+                        exc_info=True)
+                    get_metrics().inc("planner.sessions_park_failed")
+                    continue
+                get_metrics().inc("planner.sessions_parked")
+                parked_now.append((vid, vsess))
+            if parked_now:
+                with self._registry:
+                    for vid, vsess in parked_now:
+                        # a checkout raced us and cold-started this id while
+                        # we were parking: the parked copy is stale — drop it
+                        if vid not in self._busy and vid not in self._sessions:
+                            self._parked[vid] = vsess
+                    self._drop_parked_overflow_locked()
+        finally:
+            lock.release()
 
     def _evict_locked(self) -> list[tuple[str, object]]:
         """LRU eviction by count AND by total KV-cache bytes (sessions
@@ -342,7 +373,9 @@ class PlannerParser:
                 self.park_budget_bytes > 0 and self.planner.session_bytes(sess) == 0
             ):
                 victims.append((victim, sess))
-                get_metrics().inc("planner.sessions_parked")
+                # sessions_parked is counted in _checkin AFTER park()
+                # succeeds — counting here would claim a park that a D2H
+                # failure then silently turns into a drop
         # prune lock entries for dead sessions (never pop a HELD lock's
         # entry: a waiter still blocks on it and must reuse the same object
         # when it wakes, or two turns of one session could run concurrently)
