@@ -168,8 +168,26 @@ def main() -> None:
     stt.reset()
 
     # frames are fed at their REAL-TIME deadlines, as the mic would deliver
-    # them — this is what lets the speculative final transcription hide
-    # inside the endpoint's wall-clock trailing-silence window
+    # them — this is what lets the speculative final transcription AND the
+    # speculative parse hide inside the endpoint's wall-clock
+    # trailing-silence window (VERDICT round-3 next #3: the voice service
+    # starts /parse on the spec_final event; this harness mirrors that)
+    from concurrent.futures import ThreadPoolExecutor
+
+    spec_pool = ThreadPoolExecutor(1, thread_name_prefix="spec-parse")
+    spec: dict = {"text": None, "fut": None}
+
+    def spec_launch(text: str) -> None:
+        if spec["text"] == text and spec["fut"] is not None:
+            return
+        if spec["fut"] is not None:
+            spec["fut"].result()  # single-slot engine: serialize generations
+        def run():
+            engine.generate(render_prompt(text, {"last_query": None}),
+                            max_new_tokens=64, greedy=True)
+            return time.perf_counter()
+        spec["text"], spec["fut"] = text, spec_pool.submit(run)
+
     def feed_paced(audio: np.ndarray, deadline: float) -> tuple[str | None, float]:
         final_text = None
         for j in range(0, len(audio) - frame, frame):
@@ -180,6 +198,8 @@ def main() -> None:
             for kind, text in stt.feed(audio[j:j + frame]):
                 if kind == "final":
                     final_text = text
+                elif kind == "spec_final":
+                    spec_launch(text)
             # an emptied stream buffer means the utterance closed even when
             # the transcript was empty (random weights) — the clock must
             # stop here either way or the metric silently inflates
@@ -188,18 +208,32 @@ def main() -> None:
         return final_text, deadline
 
     e2e_ms, stt_ms, parse_ms = [], [], []
+    spec_hits = 0
     for i in range(9):
         stt.reset()
+        old = spec["fut"]
+        spec["text"], spec["fut"] = None, None
+        if old is not None:
+            old.result()  # drain any carryover before reusing the engine
         _, t_end_speech = feed_paced(speech, time.perf_counter())
         t0 = t_end_speech  # the real-time moment the speaker stopped
         final_text, _ = feed_paced(silence, t_end_speech)
         t1 = time.perf_counter()
-        # random weights transcribe garbage; parse cost is what's measured,
-        # so fall back to a fixed utterance when the final came back empty
-        text = final_text or utterances[i % len(utterances)]
-        engine.generate(render_prompt(text, {"last_query": None}),
-                        max_new_tokens=64, greedy=True)
-        t2 = time.perf_counter()
+        if (final_text and spec["fut"] is not None
+                and spec["text"] == final_text):
+            # speculation hit: the parse ran inside the endpoint window;
+            # e2e ends when BOTH the endpoint confirmed and the parse landed
+            t2 = max(t1, spec["fut"].result())
+            spec_hits += 1
+        else:
+            if spec["fut"] is not None:
+                spec["fut"].result()  # wasted speculation; drain the slot
+            # random weights transcribe garbage; parse cost is what's
+            # measured, so fall back to a fixed utterance on an empty final
+            text = final_text or utterances[i % len(utterances)]
+            engine.generate(render_prompt(text, {"last_query": None}),
+                            max_new_tokens=64, greedy=True)
+            t2 = time.perf_counter()
         stt_ms.append((t1 - t0) * 1e3)
         parse_ms.append((t2 - t1) * 1e3)
         e2e_ms.append((t2 - t0) * 1e3)
@@ -208,11 +242,14 @@ def main() -> None:
     p95 = float(np.percentile(e2e_ms, 95))
     stt_p50 = float(np.percentile(stt_ms, 50))
     parse_p50 = float(np.percentile(parse_ms, 50))
+    spec_rate = spec_hits / len(e2e_ms)
     print(
         f"[bench] e2e p50 {p50:.1f}ms p95 {p95:.1f}ms over {len(e2e_ms)} runs "
-        f"(endpoint+final-STT {stt_p50:.1f}ms, parse {parse_p50:.1f}ms; the "
-        f"350 ms endpoint trailing-silence window is included — the reference "
-        f"burned 1000 ms on its debounce alone)",
+        f"(endpoint+final-STT {stt_p50:.1f}ms, post-endpoint parse "
+        f"{parse_p50:.1f}ms, speculative-parse hit rate "
+        f"{100 * spec_rate:.0f}%; the 350 ms endpoint trailing-silence "
+        f"window is included — the reference burned 1000 ms on its debounce "
+        f"alone)",
         file=sys.stderr,
     )
     # decode efficiency vs the weight-read HBM roofline. The MARGINAL rate
@@ -243,7 +280,15 @@ def main() -> None:
             f"[bench] decode {ms_tok:.2f} ms/token marginal (CPU run; roofline n/a)",
             file=sys.stderr,
         )
-    print(f"[bench] parse-only p50 {parse_p50:.1f}ms "
+    # parse-only (round-1's metric, for continuity) — measured standalone
+    # now that the e2e loop hides the parse inside the endpoint window
+    po = []
+    for u in utterances[:3]:
+        t = time.perf_counter()
+        engine.generate(render_prompt(u, {"last_query": None}),
+                        max_new_tokens=64, greedy=True)
+        po.append((time.perf_counter() - t) * 1e3)
+    print(f"[bench] parse-only p50 {float(np.percentile(po, 50)):.1f}ms "
           f"(round-1's metric, for continuity)", file=sys.stderr)
 
     print(
@@ -256,6 +301,7 @@ def main() -> None:
                 # a CPU fallback row must be distinguishable from the v5e
                 # headline in the JSON itself, not only on stderr
                 "backend": "tpu" if on_tpu else "cpu",
+                "spec_hit_rate": round(spec_rate, 2),
             }
         )
     )

@@ -165,3 +165,33 @@ def test_make_parser_env_routes_pp_backend(monkeypatch):
             assert e.kind == "schema_validation_failed"
     finally:
         parser.close()
+
+
+def test_speculative_parse_stateless_ok_stateful_409(rule_server):
+    """speculative=true is a no-op for stateless parsers (parse is pure)
+    but must be refused by session-keyed backends, which would otherwise
+    commit a provisional turn to the session transcript."""
+    r = httpx.post(rule_server.url + "/parse",
+                   json={"text": "search for hubs", "context": {},
+                         "speculative": True})
+    assert r.status_code == 200
+    assert r.json()["intents"][0]["type"] == "search"
+
+    class _SessionParser:
+        wants_session = True
+
+        def parse(self, text, context, session_id=None):
+            raise AssertionError("speculative parse must not reach a "
+                                 "session-keyed backend")
+
+    with AppServer(build_app(_SessionParser())) as srv:
+        r = httpx.post(srv.url + "/parse",
+                       json={"text": "search for hubs", "session_id": "s",
+                             "context": {}, "speculative": True})
+        assert r.status_code == 409
+        assert r.json()["error"] == "speculation_unsupported"
+        # the non-speculative retry goes through to the parser
+        r2 = httpx.post(srv.url + "/parse",
+                        json={"text": "search for hubs", "session_id": "s",
+                              "context": {}})
+        assert r2.status_code == 500  # our stub raises AssertionError

@@ -159,3 +159,27 @@ def test_trailing_silence_property_needs_a_real_pause():
     assert not ep.in_trailing_silence  # ordinary inter-word gap
     ep.feed(np.zeros(int(16_000 * 0.14), dtype=np.float32))  # 200 ms total
     assert ep.in_trailing_silence  # >= half the closing window
+
+
+def test_spec_final_event_precedes_and_matches_confirmed_final(engine):
+    """During an uninterrupted closing pause the stream emits
+    ("spec_final", text) — the cue for downstream to start parsing inside
+    the endpoint window — and the confirming final carries the SAME text
+    (the speculation is reused, not recomputed)."""
+    ep = EnergyEndpointer(trailing_silence_ms=300, min_speech_ms=100)
+    stt = StreamingSTT(engine, partial_interval_s=60.0, endpointer=ep)
+    events = []
+    events += stt.feed(tone(300, 0.5))
+    # silence arrives in mic-sized (~60 ms) frames, as over the WS: the
+    # speculation fires mid-pause (~150 ms) and the endpoint closes later
+    # (300 ms) in a different feed call
+    frame = 16_000 * 60 // 1000
+    for j in range(0, 16_000, frame):
+        events += stt.feed(np.zeros(frame, dtype=np.float32))
+    kinds = [k for k, _ in events]
+    specs = [t for k, t in events if k == "spec_final"]
+    finals = [t for k, t in events if k == "final"]
+    assert finals, "endpoint must close the utterance"
+    assert specs, "a long closing pause must fire the speculation event"
+    assert specs[-1] == finals[0]
+    assert kinds.index("spec_final") < kinds.index("final")

@@ -158,3 +158,143 @@ def test_bad_control_frame_warns_not_crashes(stack):
     )
     warns = [e for e in events if e["type"] == "warn"]
     assert any("unknown control" in e.get("message", "") for e in warns)
+
+
+@pytest.fixture()
+def spec_stack(tmp_path):
+    """voice + counting brain + executor, for speculative-parse tests."""
+    calls: list = []
+
+    class CountingParser(RuleBasedParser):
+        def parse(self, text, context):
+            calls.append(text)
+            return super().parse(text, context)
+
+    brain = AppServer(build_brain(CountingParser())).__enter__()
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    scripted: list = []
+
+    def stt_factory():
+        return NullSTT(scripted=list(scripted))
+
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url,
+                                stt_factory=stt_factory))
+    ).__enter__()
+    yield {"voice": voice, "scripted": scripted, "calls": calls}
+    for srv in (voice, executor, brain):
+        srv.__exit__(None, None, None)
+
+
+def test_speculative_parse_confirmed_by_final_is_one_roundtrip(spec_stack):
+    """spec_final starts the parse inside the endpoint window; the matching
+    transcript_final DELIVERS that result — one brain roundtrip total, and
+    the intent event only appears after the final (never speculatively)."""
+    spec_stack["scripted"][:] = [
+        ("spec_final", "search for usb hubs"),
+        ("final", "search for usb hubs"),
+    ]
+    events = ws_session(
+        spec_stack["voice"].url,
+        [("binary", PCM_SILENCE), ("binary", PCM_SILENCE)],
+        ["execution_result"],
+    )
+    types = [e["type"] for e in events]
+    assert "intent" in types and "execution_result" in types
+    # the speculative parse was REUSED, not repeated
+    assert spec_stack["calls"] == ["search for usb hubs"]
+    # nothing is emitted between the speculation and the final: the first
+    # model-facing event after the warn/info preamble is transcript_final
+    first_payload = next(t for t in types if t not in ("warn", "info"))
+    assert first_payload == "transcript_final"
+
+
+def test_speculative_parse_superseded_by_different_final(spec_stack):
+    """The speaker resumed after the pause: the confirmed final differs
+    from the speculated text, so the speculation is discarded and the
+    final's own parse is delivered."""
+    spec_stack["scripted"][:] = [
+        ("spec_final", "sort by price"),
+        ("final", "search for red shoes"),
+    ]
+    events = ws_session(
+        spec_stack["voice"].url,
+        [("binary", PCM_SILENCE), ("binary", PCM_SILENCE)],
+        ["execution_result"],
+    )
+    intent_ev = next(e for e in events if e["type"] == "intent")
+    assert intent_ev["data"]["intents"][0]["type"] == "search"
+    assert intent_ev["data"]["intents"][0]["args"]["query"] == "red shoes"
+    # the final's text was parsed; the stale speculation may or may not
+    # have reached the brain before cancellation, but it is never delivered
+    assert spec_stack["calls"][-1] == "search for red shoes"
+
+
+def test_speculation_sticky_off_against_session_keyed_brain(tmp_path):
+    """A session-keyed brain refuses speculation with 409; the voice
+    service must remember that after the FIRST refusal and stop paying a
+    wasted roundtrip per utterance — while finals still parse normally."""
+    spec_calls = []
+    final_calls = []
+    rule = RuleBasedParser()
+
+    class SessionParser:
+        wants_session = True
+
+        def parse(self, text, context, session_id=None):
+            final_calls.append(text)
+            return rule.parse(text, context)
+
+    brain = AppServer(build_brain(SessionParser())).__enter__()
+
+    # count speculative requests at the HTTP layer: wrap the brain app's
+    # /parse by inspecting the request body via middleware-free approach —
+    # the 409 happens before the parser, so parser calls are finals only.
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    scripted = [
+        ("spec_final", "search for usb hubs"),
+        ("final", "search for usb hubs"),
+        ("spec_final", "scroll down"),
+        ("final", "scroll down"),
+    ]
+
+    def stt_factory():
+        return NullSTT(scripted=list(scripted))
+
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url,
+                                stt_factory=stt_factory))
+    ).__enter__()
+    try:
+        from tpu_voice_agent.utils import get_metrics
+
+        started0 = get_metrics().snapshot()["counters"].get(
+            "voice.spec_parse_started", 0)
+        events = ws_session(
+            voice.url,
+            [("binary", PCM_SILENCE)] * 4,
+            ["execution_result"],
+            timeout_s=30,
+        )
+        intents = [e for e in events if e["type"] == "intent"]
+        assert len(intents) >= 1
+        # both finals reached the parser (non-speculatively)
+        assert final_calls == ["search for usb hubs", "scroll down"]
+        # only the FIRST utterance attempted a speculation; the 409 made
+        # the second skip it entirely
+        started = get_metrics().snapshot()["counters"].get(
+            "voice.spec_parse_started", 0)
+        assert started - started0 == 1
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)

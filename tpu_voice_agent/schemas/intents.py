@@ -114,6 +114,13 @@ class ParseRequest(BaseModel):
     text: str = Field(min_length=1, max_length=4096)
     session_id: str | None = None
     context: dict[str, Any] = Field(default_factory=dict)
+    # the voice service sets this when parsing a PROVISIONAL transcript
+    # inside the endpoint's trailing-silence window (the final may yet
+    # differ). Stateless parsers ignore it (parse is pure); session-keyed
+    # backends that would commit the turn to a transcript refuse with 409
+    # speculation_unsupported rather than record a turn that may be
+    # discarded.
+    speculative: bool = False
 
 
 class ParseResponse(BaseModel):

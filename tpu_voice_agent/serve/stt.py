@@ -350,7 +350,10 @@ class SpeechEngine:
 class StreamingSTT:
     """Utterance-windowed streaming wrapper: feed PCM, get partial/final events.
 
-    Events: ("partial", text) while speech continues; ("final", text) when the
+    Events: ("partial", text) while speech continues; ("spec_final", text)
+    when the speaker has paused long enough that the utterance is plausibly
+    over (the speculative full-window transcription — downstream may start
+    parsing it inside the endpoint window); ("final", text) when the
     endpointer closes the utterance (the 1 s debounce replacement).
     """
 
@@ -412,6 +415,13 @@ class StreamingSTT:
                 and self._spec_at_speech != spoken):
             self._spec_final = self.engine.transcribe(self._buf)
             self._spec_at_speech = spoken
+            # surface the speculation so the PARSE can also start inside the
+            # endpoint window (VERDICT round-3 next #3: the transcription
+            # was speculated but the parse still waited out the window).
+            # Consumers treat it as a hint: a "final" with the same text
+            # confirms it; any other final supersedes it.
+            if self._spec_final.text:
+                events.append(("spec_final", self._spec_final.text))
 
         if ended:
             # final: exact full-window transcription (speculated above when

@@ -577,6 +577,16 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
                 {"error": "invalid_request", "detail": str(e)[:500]},
                 status=400, headers=headers,
             )
+        if preq.speculative and wants_session:
+            # a session-keyed backend (PlannerParser) COMMITS every turn to
+            # the session transcript; a speculative turn that the endpoint
+            # later revises would poison the session history. Refuse fast —
+            # the voice service falls back to parsing at final time.
+            return web.json_response(
+                {"error": "speculation_unsupported",
+                 "detail": "session-keyed backend commits turns; parse at final"},
+                status=409, headers=headers,
+            )
         loop = asyncio.get_running_loop()
         try:
             with tracer.span("parse", trace_id=trace_id, chars=len(preq.text)):
