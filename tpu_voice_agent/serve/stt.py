@@ -198,6 +198,13 @@ class SpeechEngine:
     def load_params(self, params) -> None:
         self.params = params
 
+    @property
+    def _param_dtype(self):
+        """Cache/state dtype rule shared by every decode path: follow the
+        params (f32-trained in-tree checkpoints must not round their K/V
+        through bf16; bf16 checkpoints keep the cheap cache)."""
+        return self.params["decoder"]["tok_emb"].dtype if self.params else jnp.bfloat16
+
     @classmethod
     def from_hf(cls, model_dir: str, language: str = "en", dtype=jnp.bfloat16, **kw) -> "SpeechEngine":
         """Serve a real HF Whisper checkpoint directory (config.json +
@@ -243,8 +250,7 @@ class SpeechEngine:
         L, nh, hd = self.cfg.dec_layers, self.cfg.n_heads, self.cfg.head_dim
         # dynamic_update_slice needs exact dtype agreement with the blocks
         # compute_cross_kv emits (enc_out dtype = params dtype)
-        dtype = self.params["decoder"]["tok_emb"].dtype if self.params else jnp.bfloat16
-        z = jnp.zeros((L, 1, self.cfg.enc_positions, nh, hd), dtype)
+        z = jnp.zeros((L, 1, self.cfg.enc_positions, nh, hd), self._param_dtype)
         anchor = max(0, total_frames - self.cfg.enc_positions) & ~1  # even
         return IncrementalState(cross_k=z, cross_v=jnp.zeros_like(z),
                                 consumed_frames=anchor, anchor_frames=anchor)
@@ -301,7 +307,7 @@ class SpeechEngine:
         One combined device_get; used by transcribe() and the streaming
         partial path so the two can never diverge."""
         t0 = time.perf_counter()
-        cache = init_self_cache(self.cfg, 1)
+        cache = init_self_cache(self.cfg, 1, dtype=self._param_dtype)
         bos = jnp.asarray(list(self.bos_ids), dtype=jnp.int32)[None, :]
         out, n, _ = _stt_decode_loop(
             self.params, self.cfg, cache, cross_kv, enc_mask, bos, self.suppress,

@@ -76,14 +76,20 @@ def install_prompt_prefix(engine) -> int:
 
 
 class EngineParser:
-    """Grammar-constrained decode on the in-tree engine (serialized)."""
+    """Grammar-constrained decode on the in-tree engine (serialized).
 
-    def __init__(self, engine, max_new_tokens: int = 512):
+    ``render`` maps (text, context) -> prompt string; the default is the
+    few-shot prompt. Distilled checkpoints (train.distill) pass their short
+    prompt instead — the task lives in the weights, so inference skips the
+    ~880-token prefix entirely."""
+
+    def __init__(self, engine, max_new_tokens: int = 512, render=None):
         self.engine = engine
         self.max_new_tokens = max_new_tokens
+        self.render = render or render_prompt
 
     def parse(self, text: str, context: dict) -> ParseResponse:
-        prompt = render_prompt(text, context)
+        prompt = self.render(text, context)
         try:
             res = self.engine.generate(
                 prompt, max_new_tokens=self.max_new_tokens, greedy=True, constrained=True

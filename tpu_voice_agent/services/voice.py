@@ -154,7 +154,15 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         state.drop_spec()
 
         async def run():
-            return await post_parse(state, text, http, speculative=True)
+            r = await post_parse(state, text, http, speculative=True)
+            if r.status_code == 409:
+                # flip the sticky flag HERE, not only on the consumed-hit
+                # path: a speculation superseded by a different final is
+                # reaped without inspection, and against a session-keyed
+                # brain every utterance would otherwise keep paying the
+                # wasted roundtrip
+                spec_supported["ok"] = False
+            return r
 
         get_metrics().inc("voice.spec_parse_started")
         state.spec = (text, asyncio.ensure_future(run()))
@@ -176,9 +184,8 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                     r = maybe
                     get_metrics().inc("voice.spec_parse_hit")
                 elif maybe is not None and maybe.status_code == 409:
-                    # stateful backend refused speculation; parse normally
-                    # and stop speculating against this brain
-                    spec_supported["ok"] = False
+                    # stateful backend refused speculation (run() already
+                    # flipped the sticky flag); parse normally
                     get_metrics().inc("voice.spec_parse_unsupported")
                 else:
                     get_metrics().inc("voice.spec_parse_failed")
@@ -259,63 +266,69 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
 
         loop = asyncio.get_running_loop()
         async with httpx.AsyncClient() as http:
-            async for msg in ws:
-                if msg.type == WSMsgType.BINARY:
-                    try:
-                        samples = pcm16_to_float(msg.data)
-                        # STT may run a model; keep the event loop responsive
-                        events = await loop.run_in_executor(None, state.stt.feed, samples)
-                    except Exception as e:
-                        # a truncated PCM packet must not kill the session
-                        await send(ws, "warn", message=f"bad audio frame: {e}")
-                        continue
-                    for kind, text in events:
-                        if kind == "partial":
-                            await send(ws, "transcript_partial", text=text)
-                        elif kind == "spec_final":
-                            # speaker paused: parse the provisional
-                            # transcript while the endpoint window runs out
-                            await speculate(state, text, http)
-                        else:
-                            await send(ws, "transcript_final", text=text)
-                            await handle_final(ws, state, text, http)
-                elif msg.type == WSMsgType.TEXT:
-                    try:
-                        ctrl = json.loads(msg.data)
-                    except json.JSONDecodeError:
-                        await send(ws, "warn", message="bad control frame")
-                        continue
-                    ctype = ctrl.get("type")
-                    if ctype == "context_update":
-                        state.context.update(ctrl.get("data") or {})
-                        # an in-flight speculative parse saw the OLD context
-                        state.drop_spec()
-                        await send(ws, "info", message="context updated")
-                    elif ctype == "text":
-                        # typed command path: same pipeline minus STT
-                        text = str(ctrl.get("text") or "")
-                        if text:
-                            await send(ws, "transcript_final", text=text)
-                            await handle_final(ws, state, text, http)
-                    elif ctype == "confirm_execute":
-                        # UI approved risky intents: execute them now
+            # the finally reaps any in-flight speculative task even
+            # when the loop exits by exception (e.g. a send racing an
+            # abrupt disconnect) - otherwise the orphan task logs
+            # 'Task exception was never retrieved' on GC
+            try:
+                async for msg in ws:
+                    if msg.type == WSMsgType.BINARY:
                         try:
-                            intents = [Intent.model_validate(i) for i in ctrl.get("intents") or []]
+                            samples = pcm16_to_float(msg.data)
+                            # STT may run a model; keep the event loop responsive
+                            events = await loop.run_in_executor(None, state.stt.feed, samples)
                         except Exception as e:
-                            await send(ws, "warn", message=f"bad intents: {e}")
+                            # a truncated PCM packet must not kill the session
+                            await send(ws, "warn", message=f"bad audio frame: {e}")
                             continue
-                        if intents:
-                            await execute_and_report(ws, state, intents, http)
-                    elif ctype == "reset":
-                        state.stt.reset()
-                        state.context = {}
-                        state.drop_spec()
-                        await send(ws, "info", message="state reset")
-                    else:
-                        await send(ws, "warn", message=f"unknown control type {ctype!r}")
-                elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
-                    break
-            state.drop_spec()
+                        for kind, text in events:
+                            if kind == "partial":
+                                await send(ws, "transcript_partial", text=text)
+                            elif kind == "spec_final":
+                                # speaker paused: parse the provisional
+                                # transcript while the endpoint window runs out
+                                await speculate(state, text, http)
+                            else:
+                                await send(ws, "transcript_final", text=text)
+                                await handle_final(ws, state, text, http)
+                    elif msg.type == WSMsgType.TEXT:
+                        try:
+                            ctrl = json.loads(msg.data)
+                        except json.JSONDecodeError:
+                            await send(ws, "warn", message="bad control frame")
+                            continue
+                        ctype = ctrl.get("type")
+                        if ctype == "context_update":
+                            state.context.update(ctrl.get("data") or {})
+                            # an in-flight speculative parse saw the OLD context
+                            state.drop_spec()
+                            await send(ws, "info", message="context updated")
+                        elif ctype == "text":
+                            # typed command path: same pipeline minus STT
+                            text = str(ctrl.get("text") or "")
+                            if text:
+                                await send(ws, "transcript_final", text=text)
+                                await handle_final(ws, state, text, http)
+                        elif ctype == "confirm_execute":
+                            # UI approved risky intents: execute them now
+                            try:
+                                intents = [Intent.model_validate(i) for i in ctrl.get("intents") or []]
+                            except Exception as e:
+                                await send(ws, "warn", message=f"bad intents: {e}")
+                                continue
+                            if intents:
+                                await execute_and_report(ws, state, intents, http)
+                        elif ctype == "reset":
+                            state.stt.reset()
+                            state.context = {}
+                            state.drop_spec()
+                            await send(ws, "info", message="state reset")
+                        else:
+                            await send(ws, "warn", message=f"unknown control type {ctype!r}")
+                    elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                        break
+            finally:
+                state.drop_spec()
         return ws
 
     async def index(_req: web.Request) -> web.FileResponse:

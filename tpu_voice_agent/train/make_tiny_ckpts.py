@@ -1,0 +1,55 @@
+"""Train + save the in-tree tiny checkpoints (round-3 VERDICT next #2).
+
+Usage: python -m tpu_voice_agent.train.make_tiny_ckpts [out_dir]
+
+Produces two orbax checkpoints under ``out_dir`` (default ``checkpoints/``):
+- ``intent-tiny-distilled``  — test-tiny Llama distilled on the synthetic
+  utterance->intent corpus (short-prompt serving, evals.golden scores it)
+- ``whisper-tiny-overfit``   — whisper-test overfit on the acoustic-font
+  pairs (evals.wer scores it)
+
+Both reload through the real serving stack in benches/bench_quality.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(out_dir: str | None = None) -> None:
+    out = out_dir or (sys.argv[1] if len(sys.argv) > 1 else "checkpoints")
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # NOT redundant in this image: the axon TPU plugin force-prepends
+        # itself to jax_platforms regardless of the env var, so an operator
+        # who exported JAX_PLATFORMS=cpu must also pin the config (the same
+        # double-pin as tests/conftest.py and bench.py)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    def log(msg: str) -> None:
+        print(f"[make_tiny_ckpts] {msg}", file=sys.stderr, flush=True)
+
+    from .distill import (
+        INTENT_CKPT,
+        WHISPER_CKPT,
+        save_ckpt,
+        train_intent_model,
+        train_whisper_overfit,
+    )
+
+    log("training intent model (test-tiny distillation)...")
+    cfg, params, stats = train_intent_model(log=log)
+    path = save_ckpt(out, INTENT_CKPT, cfg, params, stats)
+    log(f"saved {path} ({stats})")
+
+    log("training whisper overfit (acoustic font)...")
+    wcfg, wparams, wstats = train_whisper_overfit(log=log)
+    path = save_ckpt(out, WHISPER_CKPT, wcfg, wparams, wstats)
+    log(f"saved {path} ({wstats})")
+
+
+if __name__ == "__main__":
+    main()
