@@ -101,6 +101,72 @@ def _devices_with_watchdog(timeout_s: float = 240.0):
     return devices
 
 
+def diagnose_on_chip(engine, bench_prompt: str, base_ms_tok, preset: str) -> None:
+    """PERF.md's three levers, pulled automatically on a live chip:
+
+    1. HLO int8-fusion audit (hypothesis 1: a materialized dequant triples
+       that weight's HBM traffic) — findings to stderr + full HLO on disk.
+    2. jax.profiler trace around one constrained generation (falsifies the
+       small-op-latency and while-loop-overhead hypotheses).
+    3. decode_unroll sweep {1,2,4} — each unroll is a fresh engine compile;
+       the marginal slope decides if loop overhead is on the critical path.
+    """
+    import gc
+
+    from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.services.brain import install_prompt_prefix
+    from tpu_voice_agent.utils.perfdiag import (
+        audit_dequant,
+        capture_profile,
+        decode_step_hlo,
+        marginal_ms_per_token,
+    )
+
+    art = "bench_artifacts"
+    os.makedirs(art, exist_ok=True)
+
+    # (1) HLO audit
+    hlo = decode_step_hlo(engine)
+    with open(os.path.join(art, "decode_step_hlo.txt"), "w") as f:
+        f.write(hlo)
+    audit = audit_dequant(hlo)
+    if audit["findings"]:
+        print("[bench] DIAG hlo-audit: MATERIALIZED DEQUANT FOUND "
+              f"(PERF.md hypothesis 1 CONFIRMED): {audit['findings']}",
+              file=sys.stderr)
+    else:
+        print(f"[bench] DIAG hlo-audit: no HBM-sized convert/multiply in the "
+              f"decode ENTRY ({audit['entry_instructions']} instructions) — "
+              "hypothesis 1 refuted; see profiler trace for hyp 2/3",
+              file=sys.stderr)
+
+    # (2) profiler trace
+    trace_dir = capture_profile(engine, bench_prompt,
+                                os.path.join(art, "profile"))
+    print(f"[bench] DIAG profiler trace captured under {trace_dir}",
+          file=sys.stderr)
+
+    # (3) unroll sweep (fresh compile per unroll; drop each engine before
+    # the next so int8 weights don't stack up in HBM)
+    results = {1: base_ms_tok}
+    for u in (2, 4):
+        eng_u = DecodeEngine(preset=preset, max_len=1024,
+                             prefill_buckets=(1024,), quant="int8",
+                             decode_unroll=u)
+        install_prompt_prefix(eng_u)
+        eng_u.generate(bench_prompt, max_new_tokens=8)  # compile
+        results[u] = marginal_ms_per_token(eng_u, bench_prompt)
+        del eng_u
+        gc.collect()
+    line = ", ".join(
+        f"unroll={u}: {v:.2f} ms/tok" if v is not None else f"unroll={u}: n/a"
+        for u, v in results.items())
+    best = min((u for u, v in results.items() if v is not None),
+               key=lambda u: results[u], default=1)
+    print(f"[bench] DIAG unroll sweep: {line} -> best decode_unroll={best}",
+          file=sys.stderr)
+
+
 def main() -> None:
     import jax
 
@@ -258,28 +324,32 @@ def main() -> None:
     # wildly understates the chip (round-2 measured 14% "of roofline" that
     # way vs 59% by slope). Two unconstrained runs at different lengths;
     # slope over their ACTUAL step counts cancels every fixed cost.
-    pts = {}
-    for n in (64, 192):
-        best = None
-        for _ in range(3):
-            r = engine.generate(render_prompt(utterances[0], {"last_query": None}),
-                                max_new_tokens=n, constrained=False,
-                                byte_budget=1_000_000, ignore_eos=True)
-            best = r if best is None or r.decode_ms < best.decode_ms else best
-        if best.steps > 0:
-            pts[best.steps] = min(pts.get(best.steps, best.decode_ms), best.decode_ms)
-    ks = sorted(pts)
-    if len(ks) >= 2 and ks[-1] > ks[0]:
-        ms_tok = (pts[ks[-1]] - pts[ks[0]]) / (ks[-1] - ks[0])
+    from tpu_voice_agent.utils.perfdiag import marginal_ms_per_token
+
+    bench_prompt = render_prompt(utterances[0], {"last_query": None})
+    ms_tok, steps_span = marginal_ms_per_token(engine, bench_prompt,
+                                               with_steps=True)
+    if ms_tok is not None:
         floor_ms = int8_weight_bytes(engine.cfg) / (V5E_HBM_GBPS * 1e9) * 1e3
         frac = floor_ms / ms_tok if on_tpu else float("nan")
         print(
             f"[bench] decode {ms_tok:.2f} ms/token marginal ({1e3 / ms_tok:.0f} tok/s, "
-            f"slope over steps {ks[0]}->{ks[-1]}); int8 weight-read floor "
-            f"{floor_ms:.2f} ms/token -> {100 * frac:.0f}% of HBM roofline" if on_tpu else
+            f"slope over steps {steps_span[0]}->{steps_span[1]}); int8 "
+            f"weight-read floor {floor_ms:.2f} ms/token -> "
+            f"{100 * frac:.0f}% of HBM roofline" if on_tpu else
             f"[bench] decode {ms_tok:.2f} ms/token marginal (CPU run; roofline n/a)",
             file=sys.stderr,
         )
+
+    # ---- automatic roofline diagnosis (round-3 VERDICT next #1): every
+    # successful chip window must yield the DIAGNOSIS, not just the number.
+    # Never let a diagnosis failure lose the headline JSON row.
+    if on_tpu and os.environ.get("BENCH_DIAG") != "0":
+        try:
+            diagnose_on_chip(engine, bench_prompt, ms_tok, preset)
+        except Exception as e:  # pragma: no cover - chip-only path
+            print(f"[bench] diagnosis failed (headline row unaffected): {e!r}",
+                  file=sys.stderr)
     # parse-only (round-1's metric, for continuity) — measured standalone
     # now that the e2e loop hides the parse inside the endpoint window
     po = []

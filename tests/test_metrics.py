@@ -75,3 +75,47 @@ def test_services_expose_metrics_endpoint():
             assert status == 200
             assert body["service"] == name
             assert "counters" in body["local"] and "counters" in body["runtime"]
+
+
+def test_perfdiag_audit_flags_materialized_dequant():
+    """The HLO audit must flag ENTRY-level convert/multiply with HBM-sized
+    outputs and ignore the same ops inside fused computations."""
+    from tpu_voice_agent.utils.perfdiag import audit_dequant
+
+    hlo = """\
+HloModule jit_forward
+
+%fused_computation.1 (p0: s8[2048,5632]) -> bf16[2048,5632] {
+  %p0 = s8[2048,5632]{1,0} parameter(0)
+  ROOT %c = bf16[2048,5632]{1,0} convert(%p0)
+}
+
+ENTRY %main (a: s8[2048,5632], b: bf16[1,2048]) -> bf16[1,5632] {
+  %a = s8[2048,5632]{1,0} parameter(0)
+  %b = bf16[1,2048]{1,0} parameter(1)
+  %dq = bf16[2048,5632]{1,0} convert(%a)
+  %small = bf16[1,2048]{1,0} multiply(%b, %b)
+  ROOT %mm = bf16[1,5632]{1,0} dot(%small, %dq)
+}
+"""
+    audit = audit_dequant(hlo, min_bytes=1 << 20)
+    assert len(audit["findings"]) == 1
+    op, dtype, shape, mb = audit["findings"][0]
+    assert op == "convert" and dtype == "bf16" and shape == (2048, 5632)
+    # the fused convert (same shape) and the small multiply were NOT flagged
+    assert audit["entry_instructions"] >= 4
+
+
+def test_perfdiag_decode_step_hlo_lowers_int8_engine():
+    """decode_step_hlo must lower/compile the real engine's decode forward
+    (int8 path included) and return parseable HLO text."""
+    from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.utils.perfdiag import audit_dequant, decode_step_hlo
+
+    eng = DecodeEngine(preset="test-tiny", max_len=256, prefill_buckets=(64,),
+                       quant="int8")
+    hlo = decode_step_hlo(eng)
+    assert "ENTRY" in hlo
+    audit = audit_dequant(hlo, min_bytes=1 << 30)  # sanity: parses, no 1GB tensors
+    assert audit["entry_instructions"] > 0
+    assert audit["findings"] == []

@@ -149,17 +149,26 @@ def _w(leaf):
     return leaf
 
 
+def quantize_leaf(w) -> dict:
+    """One weight -> {"q": int8, "s": f32 per-out-channel}. Exposed so the
+    pp engine can quantize leaf by leaf on already-sharded placements (a
+    whole-tree quantize would ship 70B's full bf16 tree through one chip).
+    Under jit over a GLOBAL sharded array the axis=-2 max is the global
+    max (GSPMD inserts the cross-shard reduce), so per-shard quantization
+    is bit-identical to whole-tree quantization."""
+    wf = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / 127.0
+    s = jnp.where(s == 0.0, 1.0, s)
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
 def quantize_params(params: dict) -> dict:
     """Weight-only symmetric int8, per-output-channel scales. Norms and the
     embedding table (a gather, already cheap) stay in their original dtype;
     every matmul weight becomes {"q": int8, "s": f32} resolved by _w()."""
 
-    def quant(w):
-        wf = w.astype(jnp.float32)
-        s = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / 127.0
-        s = jnp.where(s == 0.0, 1.0, s)
-        q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
-        return {"q": q, "s": s}
+    quant = quantize_leaf
 
     L = params["layers"]
     return {

@@ -24,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..models.llama import (
-    LlamaConfig, _attend, _layer_out, _layer_qkv, rms_norm, rope_tables,
+    LlamaConfig, _attend, _layer_out, _layer_qkv, _w, rms_norm, rope_tables,
 )
 
 
@@ -202,7 +202,7 @@ def llama_pp_forward_cached(
     )(staged, staged_cache["k"], staged_cache["v"], x)
 
     y = rms_norm(y, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", y, params["lm_head"], preferred_element_type=jnp.float32)
+    logits = jnp.einsum("btd,dv->btv", y, _w(params["lm_head"]), preferred_element_type=jnp.float32)
     return logits, {"k": ck, "v": cv}
 
 
@@ -216,26 +216,41 @@ def pp_tp_mesh(pp: int, tp: int, devices: list | None = None) -> Mesh:
     return Mesh(np.array(devices[: pp * tp]).reshape(pp, tp), ("pp", "tp"))
 
 
-def staged_tp_shardings(mesh: Mesh) -> dict:
+def staged_tp_shardings(mesh: Mesh, staged: dict | None = None) -> dict:
     """NamedSharding pytree for ``stage_params`` output on a (pp, tp) mesh:
     stage axis over pp, Megatron column/row tensor parallelism over tp
     (wq/wk/wv/w_gate/w_up shard their output dim, wo/w_down their input
-    dim; norms replicate within the stage)."""
+    dim; norms replicate within the stage).
+
+    With ``staged`` (the actual staged tree), int8 ``{"q","s"}`` leaves get
+    structure-matching shardings: q keeps the weight's spec; the per-OUT-
+    channel scales ride tp only for column-parallel weights (row-parallel
+    wo/w_down keep their full output on every shard, so their scales
+    replicate) — the 70B flagship is int8 or it does not fit v5e-8
+    (utils/hbm_budget.py)."""
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    return {
-        "attn_norm": ns("pp", None, None),
-        "wq": ns("pp", None, None, "tp"),
-        "wk": ns("pp", None, None, "tp"),
-        "wv": ns("pp", None, None, "tp"),
-        "wo": ns("pp", None, "tp", None),
-        "mlp_norm": ns("pp", None, None),
-        "w_gate": ns("pp", None, None, "tp"),
-        "w_up": ns("pp", None, None, "tp"),
-        "w_down": ns("pp", None, "tp", None),
+    col, row = ("pp", None, None, "tp"), ("pp", None, "tp", None)
+    specs = {
+        "attn_norm": ("pp", None, None),
+        "wq": col, "wk": col, "wv": col,
+        "wo": row,
+        "mlp_norm": ("pp", None, None),
+        "w_gate": col, "w_up": col,
+        "w_down": row,
     }
+    out = {}
+    for name, spec in specs.items():
+        if staged is not None and isinstance(staged.get(name), dict):
+            # scales are (S, L/S, 1, out): shard out with tp only when the
+            # weight itself is column-parallel (out dim sharded)
+            s_spec = ("pp", None, None, "tp" if spec == col else None)
+            out[name] = {"q": ns(*spec), "s": ns(*s_spec)}
+        else:
+            out[name] = ns(*spec)
+    return out
 
 
 def _tp_block_cached(x, p, k_cache, v_cache, positions, kv_len_mask,
@@ -336,9 +351,11 @@ def pp_tp_forward_cached(
         # over both axes to replicate across stages
         return jax.lax.psum(y, "pp"), ck[None], cv[None]
 
-    in_spec = {
-        k: P(*v.spec) for k, v in staged_tp_shardings(mesh).items()
-    }
+    in_spec = jax.tree.map(
+        lambda ns: P(*ns.spec),
+        staged_tp_shardings(mesh, params["staged"]),
+        is_leaf=lambda x: isinstance(x, NamedSharding),
+    )
     cache_spec = P("pp", None, None, None, "tp", None)
     y, ck, cv = shard_map(
         local, mesh=mesh,
@@ -348,7 +365,7 @@ def pp_tp_forward_cached(
     )(params["staged"], staged_cache["k"], staged_cache["v"], x)
 
     y = rms_norm(y, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", y, params["lm_head"], preferred_element_type=jnp.float32)
+    logits = jnp.einsum("btd,dv->btv", y, _w(params["lm_head"]), preferred_element_type=jnp.float32)
     return logits, {"k": ck, "v": cv}
 
 
@@ -405,4 +422,4 @@ def llama_pp_forward(
     y = pipeline_apply(staged, x_micro, stage_fn, mesh).reshape(B, T, cfg.dim)
 
     y = rms_norm(y, params["final_norm"], cfg.norm_eps)
-    return jnp.einsum("btd,dv->btv", y, params["lm_head"], preferred_element_type=jnp.float32)
+    return jnp.einsum("btd,dv->btv", y, _w(params["lm_head"]), preferred_element_type=jnp.float32)

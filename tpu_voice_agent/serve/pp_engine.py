@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models.llama import LlamaConfig, init_params
+from ..models.llama import LlamaConfig, init_params, quantize_leaf as _quant_leaf
 from ..parallel.pipeline import (
     init_pp_tp_cache,
     pp_tp_forward_cached,
@@ -105,18 +105,25 @@ class PPDecodeEngine(DecodeEngine):
         tokenizer=None,
         fsm=None,
         init_weights: bool = True,
+        quant: str | None = None,  # None | "int8" — the 70B flagship is
+        # int8 or it does not fit v5e-8 (utils/hbm_budget.py: bf16 weights
+        # alone would need ~16 GiB/chip before cache or head tensors)
     ):
         if mesh is None or "pp" not in mesh.shape:
             raise ValueError("PPDecodeEngine needs a mesh with a 'pp' axis "
                              "(parallel.pipeline.pp_tp_mesh)")
+        if quant not in (None, "int8"):
+            raise ValueError(f"unknown quant {quant!r}")
         # the parent builds tokenizer/FSM/tables/byte accounting; mesh=None
         # because the dense engine's dp×tp layout does not apply here — the
-        # pipeline forward owns all sharding
+        # pipeline forward owns all sharding (quant is handled here too:
+        # the parent would quantize into dp×tp shardings)
         super().__init__(
             preset=preset, cfg=cfg, mesh=None, seed=seed, max_len=max_len,
             batch_slots=batch_slots, prefill_buckets=prefill_buckets,
             kernels="xla", tokenizer=tokenizer, fsm=fsm, init_weights=False,
         )
+        self.quant = quant
         self.pmesh = mesh
         self.pp = mesh.shape["pp"]
         self.tp = mesh.shape.get("tp", 1)
@@ -131,7 +138,6 @@ class PPDecodeEngine(DecodeEngine):
             raise ValueError("PPDecodeEngine is dense-model only (70B planner)")
 
         self._rep = NamedSharding(mesh, P())
-        self._staged_sh = staged_tp_shardings(mesh)
         if init_weights:
             raw = init_params(c, jax.random.PRNGKey(seed))
             self.load_params(raw)
@@ -146,26 +152,58 @@ class PPDecodeEngine(DecodeEngine):
 
     def load_params(self, params) -> None:
         """Install a flat llama param tree (init/orbax/hf_import layout):
-        layers are staged onto pp and tp-sharded; head tensors replicate."""
+        layers are staged onto pp and tp-sharded; head tensors replicate.
+
+        With ``quant="int8"`` weights quantize PER LEAF, each already
+        placed on its staged tp sharding before the (donated) quantize runs
+        — at 70B a whole-tree quantize would ship the full ~140 GB bf16
+        tree through one 16 GiB chip; per-leaf sharded, the worst transient
+        is one layer-stack shard (~2.3 GB/chip bf16) plus its int8 copy."""
         if "staged" in params:  # already staged
             self.params = params
             return
-        staged = jax.device_put(
-            stage_params(params["layers"], self.pp), self._staged_sh)
+        already_q = isinstance(params.get("lm_head"), dict) and "q" in params["lm_head"]
+        quantizing = self.quant == "int8" and not already_q
+        staged_host = stage_params(params["layers"], self.pp)
+        if quantizing:
+            skeleton = {k: ({"q": 0, "s": 0} if k.startswith("w") else 0)
+                        for k in staged_host}
+            sh = staged_tp_shardings(self.pmesh, skeleton)
+            staged = {}
+            for name, leaf in staged_host.items():
+                if name.startswith("w"):
+                    # bf16 leaf lands directly on the weight's tp sharding;
+                    # the quantize then runs shard-local and donates it
+                    dev = jax.device_put(
+                        leaf, NamedSharding(self.pmesh, sh[name]["q"].spec))
+                    staged[name] = jax.jit(
+                        _quant_leaf, out_shardings=sh[name],
+                        donate_argnums=0)(dev)
+                else:
+                    staged[name] = jax.device_put(leaf, sh[name])
+            lm_head = jax.jit(_quant_leaf, out_shardings=self._rep)(
+                jax.device_put(params["lm_head"], self._rep))
+        else:
+            staged = jax.device_put(
+                staged_host, staged_tp_shardings(self.pmesh, staged_host))
+            lm_head = jax.device_put(params["lm_head"], self._rep)
         self.params = {
             "embed": jax.device_put(params["embed"], self._rep),
             "staged": staged,
             "final_norm": jax.device_put(params["final_norm"], self._rep),
-            "lm_head": jax.device_put(params["lm_head"], self._rep),
+            "lm_head": lm_head,
         }
 
     @classmethod
     def from_hf(cls, model_dir: str, mesh=None, max_len: int = 2048,
                 batch_slots: int = 1,
                 prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048),
-                dtype=jnp.bfloat16, **_ignored) -> "PPDecodeEngine":
+                dtype=jnp.bfloat16, quant: str | None = None,
+                **_ignored) -> "PPDecodeEngine":
         """Serve a real HF checkpoint through the pp×tp pipeline (the 70B
-        import path; same loader as DecodeEngine.from_hf)."""
+        import path; same loader as DecodeEngine.from_hf). Pass
+        ``quant="int8"`` for the flagship config — at 70B it is int8 or it
+        does not fit v5e-8 (utils/hbm_budget.py)."""
         import os
 
         from ..ckpt.hf_import import llama_config_from_hf, llama_from_hf_state
@@ -176,7 +214,7 @@ class PPDecodeEngine(DecodeEngine):
         tok = load_hf_tokenizer(model_dir)
         eng = cls(cfg=cfg, mesh=mesh, max_len=max_len, batch_slots=batch_slots,
                   prefill_buckets=prefill_buckets, tokenizer=tok,
-                  init_weights=False)
+                  init_weights=False, quant=quant)
         eng.load_params(llama_from_hf_state(model_dir, cfg, dtype=dtype))
         return eng
 

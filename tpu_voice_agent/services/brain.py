@@ -717,13 +717,13 @@ def make_parser_from_env() -> IntentParser:
         from ..parallel.pipeline import pp_tp_mesh
         from ..serve import PPDecodeEngine
 
-        warn_unused("pp", BRAIN_PAGED=paged, BRAIN_QUANT=quant, BRAIN_MOE=moe)
+        warn_unused("pp", BRAIN_PAGED=paged, BRAIN_MOE=moe)
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
         ndev = len(jax.devices())
         pp = int(os.environ.get("BRAIN_PP", "0")) or min(2, ndev)
         tp = int(os.environ.get("BRAIN_TP", "0")) or max(1, ndev // pp)
         return _wrap_batched(PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(pp, tp),
-                                            batch_slots=slots))
+                                            batch_slots=slots, quant=quant))
     if backend.startswith("planner"):
         # long-session transcripts as model context; BRAIN_SP sizes the
         # sequence-parallel axis (default: every visible device)

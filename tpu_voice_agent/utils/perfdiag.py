@@ -1,0 +1,129 @@
+"""Automatic decode-perf diagnosis (round-3 VERDICT next #1).
+
+The chip behind this harness's tunnel is intermittently reachable, so every
+successful TPU window must yield the DIAGNOSIS, not just the headline
+number. Three probes, all scripted so ``bench.py`` runs them unattended:
+
+- ``decode_step_hlo`` / ``audit_dequant``: lower the engine's T=1 decode
+  forward at its real serving shapes, compile, and scan the optimized HLO's
+  ENTRY computation for materialized dequantization — ``convert``/
+  ``multiply`` instructions with HBM-sized outputs. A mis-fused int8
+  dequant triples that weight's traffic (int8 read + bf16 write + bf16
+  read); docs/PERF.md hypothesis 1.
+- ``capture_profile``: one ``jax.profiler`` trace around a constrained
+  generation (PERF.md's falsifier for hypotheses 2/3).
+- the ``decode_unroll`` sweep lives in ``bench.py`` (it needs the bench's
+  engine-construction knobs); ``marginal_ms_per_token`` here is the shared
+  slope measurement.
+"""
+
+from __future__ import annotations
+
+import re
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+_INSTR = re.compile(
+    r"=\s*(?P<dtype>\w+)\[(?P<shape>[\d,]*)\][^\s]*\s+(?P<op>[\w-]+)\(")
+
+
+def decode_step_hlo(engine) -> str:
+    """Optimized HLO of the single-token decode forward at the engine's
+    serving shapes (B=1, its cache capacity, its quantized params)."""
+    import jax.numpy as jnp
+
+    from ..models.llama import forward, init_kv_cache
+
+    cache = init_kv_cache(engine.cfg, 1, engine.max_len)
+    tok = jnp.zeros((1, 1), jnp.int32)
+    pos = jnp.zeros((1, 1), jnp.int32)
+    lowered = forward.lower(
+        engine.params, engine.cfg, tok, pos, cache, engine.rules,
+        attn_impl=engine.kernels, unroll=engine.decode_unroll,
+    )
+    return lowered.compile().as_text()
+
+
+def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
+    """Scan the ENTRY computation for materialized dequant-shaped
+    instructions. In optimized HLO every ENTRY-level instruction's result
+    is a real buffer; a ``convert`` or ``multiply`` producing >= min_bytes
+    there means a weight-sized intermediate hits HBM instead of fusing into
+    the consuming matmul. Returns {findings: [(op, dtype, shape, mbytes)],
+    entry_instructions: N}."""
+    findings = []
+    n_entry = 0
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if line and not line[0].isspace():
+            in_entry = line.startswith("ENTRY")
+            continue
+        if not in_entry:
+            continue
+        m = _INSTR.search(line)
+        if not m:
+            continue
+        n_entry += 1
+        dtype = m.group("dtype")
+        if dtype not in _DTYPE_BYTES:
+            continue
+        dims = [int(d) for d in m.group("shape").split(",") if d]
+        size = _DTYPE_BYTES[dtype]
+        for d in dims:
+            size *= d
+        if size >= min_bytes and m.group("op") in ("convert", "multiply"):
+            findings.append((m.group("op"), dtype, tuple(dims),
+                             round(size / 2**20, 1)))
+    return {"findings": findings, "entry_instructions": n_entry}
+
+
+def capture_profile(engine, prompt: str, out_dir: str,
+                    max_new_tokens: int = 64) -> str:
+    """One profiler trace around a constrained generation; returns the
+    trace directory (inspect with tensorboard / xprof)."""
+    import os
+
+    import jax
+
+    os.makedirs(out_dir, exist_ok=True)
+    with jax.profiler.trace(out_dir):
+        engine.generate(prompt, max_new_tokens=max_new_tokens, greedy=True)
+    return out_dir
+
+
+def marginal_ms_per_token(engine, prompt: str, lengths=(64, 192),
+                          tries: int = 3,
+                          with_steps: bool = False):
+    """Marginal decode ms/token by slope over two generation lengths —
+    cancels the fixed dispatch/tunnel cost that poisons ms/steps at short
+    lengths (the round-2 '14% of roofline' artifact).
+
+    ``with_steps=True`` returns (slope, (steps_lo, steps_hi)) so callers
+    report the ACTUAL step counts the slope spans (a run may stop short of
+    the requested length at the cache capacity or byte budget)."""
+    pts: dict[int, float] = {}
+    for n in lengths:
+        best = None
+        for _ in range(tries):
+            r = engine.generate(prompt, max_new_tokens=n, constrained=False,
+                                byte_budget=1_000_000, ignore_eos=True)
+            best = r if best is None or r.decode_ms < best.decode_ms else best
+        if best.steps > 0:
+            pts[best.steps] = min(pts.get(best.steps, best.decode_ms),
+                                  best.decode_ms)
+    ks = sorted(pts)
+    slope = None
+    if len(ks) >= 2 and ks[-1] > ks[0]:
+        s = (pts[ks[-1]] - pts[ks[0]]) / (ks[-1] - ks[0])
+        # a non-positive slope means the short run was slower than the long
+        # one — host contention noise, not a real rate; report "no reading"
+        # rather than a nonsense number
+        if s > 0:
+            slope = s
+    if with_steps:
+        return slope, (ks[0], ks[-1]) if len(ks) >= 2 else None
+    return slope
