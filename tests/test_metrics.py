@@ -78,32 +78,52 @@ def test_services_expose_metrics_endpoint():
 
 
 def test_perfdiag_audit_flags_materialized_dequant():
-    """The HLO audit must flag ENTRY-level convert/multiply with HBM-sized
-    outputs and ignore the same ops inside fused computations."""
+    """The audit must catch a materialized dequant in BOTH places it can
+    actually appear in the optimized decode HLO — a bare convert inside the
+    lax.scan-lowered while BODY (not ENTRY), and an ENTRY-level pure-dequant
+    fusion — while ignoring properly-fused dequants (fusion body containing
+    the consuming dot) and small ops."""
     from tpu_voice_agent.utils.perfdiag import audit_dequant
 
     hlo = """\
 HloModule jit_forward
 
-%fused_computation.1 (p0: s8[2048,5632]) -> bf16[2048,5632] {
+%fused_dequant.1 (p0: s8[2048,5632]) -> bf16[2048,5632] {
   %p0 = s8[2048,5632]{1,0} parameter(0)
   ROOT %c = bf16[2048,5632]{1,0} convert(%p0)
+}
+
+%fused_matmul.2 (p0: s8[2048,5632], p1: bf16[1,2048]) -> bf16[1,5632] {
+  %p0 = s8[2048,5632]{1,0} parameter(0)
+  %p1 = bf16[1,2048]{1,0} parameter(1)
+  %c = bf16[2048,5632]{1,0} convert(%p0)
+  ROOT %mm = bf16[1,5632]{1,0} dot(%p1, %c)
+}
+
+%while_body.3 (carry: bf16[1,2048]) -> bf16[1,2048] {
+  %carry = bf16[1,2048]{1,0} parameter(0)
+  %w = s8[2048,2048]{1,0} constant(0)
+  %dq2 = bf16[2048,2048]{1,0} convert(%w)
+  ROOT %mm2 = bf16[1,2048]{1,0} dot(%carry, %dq2)
 }
 
 ENTRY %main (a: s8[2048,5632], b: bf16[1,2048]) -> bf16[1,5632] {
   %a = s8[2048,5632]{1,0} parameter(0)
   %b = bf16[1,2048]{1,0} parameter(1)
-  %dq = bf16[2048,5632]{1,0} convert(%a)
+  %dqf = bf16[2048,5632]{1,0} fusion(%a), kind=kLoop, calls=%fused_dequant.1
   %small = bf16[1,2048]{1,0} multiply(%b, %b)
-  ROOT %mm = bf16[1,5632]{1,0} dot(%small, %dq)
+  %loop = bf16[1,2048]{1,0} while(%small), body=%while_body.3
+  ROOT %mm = bf16[1,5632]{1,0} fusion(%a, %loop), kind=kOutput, calls=%fused_matmul.2
 }
 """
     audit = audit_dequant(hlo, min_bytes=1 << 20)
-    assert len(audit["findings"]) == 1
-    op, dtype, shape, mb = audit["findings"][0]
-    assert op == "convert" and dtype == "bf16" and shape == (2048, 5632)
-    # the fused convert (same shape) and the small multiply were NOT flagged
-    assert audit["entry_instructions"] >= 4
+    got = {(op, shape) for op, dtype, shape, mb, comp in audit["findings"]}
+    # the while-body bare convert AND the ENTRY pure-dequant fusion
+    assert ("convert", (2048, 2048)) in got
+    assert ("fusion:dequant", (2048, 5632)) in got
+    # the matmul-containing fusion and the small multiply were NOT flagged
+    assert len(audit["findings"]) == 2
+    assert audit["scanned_instructions"] >= 6
 
 
 def test_perfdiag_decode_step_hlo_lowers_int8_engine():
@@ -117,5 +137,5 @@ def test_perfdiag_decode_step_hlo_lowers_int8_engine():
     hlo = decode_step_hlo(eng)
     assert "ENTRY" in hlo
     audit = audit_dequant(hlo, min_bytes=1 << 30)  # sanity: parses, no 1GB tensors
-    assert audit["entry_instructions"] > 0
+    assert audit["scanned_instructions"] > 0
     assert audit["findings"] == []

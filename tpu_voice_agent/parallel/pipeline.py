@@ -262,8 +262,6 @@ def _tp_block_cached(x, p, k_cache, v_cache, positions, kv_len_mask,
     wo / w_down contractions before their residual adds (the Megatron
     layout parallel.mesh expresses declaratively, hand-collectived because
     the pipeline schedule already lives inside shard_map)."""
-    from ..models.llama import _w
-
     B = x.shape[0]
     batch_idx = jnp.arange(B)[:, None]
     q, k, v = _layer_qkv(p, x, cfg, cos, sin,

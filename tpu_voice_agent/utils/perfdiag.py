@@ -29,6 +29,8 @@ _DTYPE_BYTES = {
 
 _INSTR = re.compile(
     r"=\s*(?P<dtype>\w+)\[(?P<shape>[\d,]*)\][^\s]*\s+(?P<op>[\w-]+)\(")
+_COMP_HEADER = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(")
+_CALLS = re.compile(r"calls=%?(?P<name>[\w.\-]+)")
 
 
 def decode_step_hlo(engine) -> str:
@@ -48,37 +50,94 @@ def decode_step_hlo(engine) -> str:
     return lowered.compile().as_text()
 
 
+def _instr_bytes(m: "re.Match") -> int | None:
+    dtype = m.group("dtype")
+    if dtype not in _DTYPE_BYTES:
+        return None
+    size = _DTYPE_BYTES[dtype]
+    for d in m.group("shape").split(","):
+        if d:
+            size *= int(d)
+    return size
+
+
 def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
-    """Scan the ENTRY computation for materialized dequant-shaped
-    instructions. In optimized HLO every ENTRY-level instruction's result
-    is a real buffer; a ``convert`` or ``multiply`` producing >= min_bytes
-    there means a weight-sized intermediate hits HBM instead of fusing into
-    the consuming matmul. Returns {findings: [(op, dtype, shape, mbytes)],
-    entry_instructions: N}."""
-    findings = []
-    n_entry = 0
-    in_entry = False
+    """Find materialized dequant-shaped results anywhere they can hide.
+
+    The decode forward's layer weights are consumed inside the lax.scan-
+    lowered while BODY, not ENTRY, and after the fusion pass a materialized
+    dequant usually appears as a ``fusion`` instruction whose body is a
+    pure convert/scale chain — so the scan covers:
+
+    - every instruction in every EXECUTABLE computation (ENTRY, while
+      bodies, called computations — everything that is not a fusion body;
+      their results are real buffers): flag ``convert``/``multiply`` with
+      outputs >= min_bytes
+    - ``fusion`` instructions with outputs >= min_bytes whose called body
+      contains a >= min_bytes ``convert`` and NO matmul-class op — a pure
+      dequant fusion that materializes the bf16 weight instead of feeding
+      the consuming dot (a fusion that contains the dot is the GOOD case)
+
+    Returns {findings: [(op, dtype, shape, mbytes, computation)],
+    scanned_instructions: N}."""
+    comps: dict[str, list] = {}
+    order: list[str] = []
+    cur: str | None = None
     for line in hlo_text.splitlines():
         if line and not line[0].isspace():
-            in_entry = line.startswith("ENTRY")
+            m = _COMP_HEADER.match(line)
+            cur = m.group("name") if m else None
+            if cur is not None and cur not in comps:
+                comps[cur] = []
+                order.append(cur)
             continue
-        if not in_entry:
+        if cur is None:
             continue
         m = _INSTR.search(line)
-        if not m:
-            continue
-        n_entry += 1
-        dtype = m.group("dtype")
-        if dtype not in _DTYPE_BYTES:
-            continue
-        dims = [int(d) for d in m.group("shape").split(",") if d]
-        size = _DTYPE_BYTES[dtype]
-        for d in dims:
-            size *= d
-        if size >= min_bytes and m.group("op") in ("convert", "multiply"):
-            findings.append((m.group("op"), dtype, tuple(dims),
-                             round(size / 2**20, 1)))
-    return {"findings": findings, "entry_instructions": n_entry}
+        if m:
+            comps[cur].append((m, line))
+
+    # fusion bodies = computations referenced by a fusion's calls=...
+    fusion_bodies: set[str] = set()
+    for instrs in comps.values():
+        for m, line in instrs:
+            if m.group("op") == "fusion":
+                cm = _CALLS.search(line)
+                if cm:
+                    fusion_bodies.add(cm.group("name"))
+
+    matmul_ops = {"dot", "dot-general", "convolution", "custom-call"}
+
+    def body_is_pure_dequant(name: str) -> bool:
+        instrs = comps.get(name, [])
+        has_big_convert = any(
+            m.group("op") == "convert"
+            and (_instr_bytes(m) or 0) >= min_bytes
+            for m, _ in instrs)
+        has_matmul = any(m.group("op") in matmul_ops for m, _ in instrs)
+        return has_big_convert and not has_matmul
+
+    findings = []
+    n = 0
+    for name, instrs in comps.items():
+        if name in fusion_bodies:
+            continue  # results live inside a fusion; not materialized
+        for m, line in instrs:
+            n += 1
+            size = _instr_bytes(m)
+            if size is None or size < min_bytes:
+                continue
+            op = m.group("op")
+            dims = tuple(int(d) for d in m.group("shape").split(",") if d)
+            if op in ("convert", "multiply"):
+                findings.append((op, m.group("dtype"), dims,
+                                 round(size / 2**20, 1), name))
+            elif op == "fusion":
+                cm = _CALLS.search(line)
+                if cm and body_is_pure_dequant(cm.group("name")):
+                    findings.append(("fusion:dequant", m.group("dtype"), dims,
+                                     round(size / 2**20, 1), name))
+    return {"findings": findings, "scanned_instructions": n}
 
 
 def capture_profile(engine, prompt: str, out_dir: str,
