@@ -34,13 +34,21 @@ def test_corpus_labels_are_grammar_valid():
         assert fsm.walk(ids) >= 0, f"label left the grammar: {resp_json[:80]}"
 
 
+@pytest.fixture(scope="module")
+def trained_intent():
+    """ONE scaled-down training run shared by the serve + ckpt tests (a
+    1-core box pays ~0.35 s/step; two separate trainings doubled the
+    module's wall-clock for no extra coverage)."""
+    return distill.train_intent_model(steps=260, corpus_n=1000, seq_len=176,
+                                      batch=16)
+
+
 @pytest.mark.slow
-def test_intent_distillation_learns_and_serves():
+def test_intent_distillation_learns_and_serves(trained_intent):
     """A scaled-down training run must (a) collapse the loss and (b) yield
     a parser that, through the REAL grammar-constrained engine with the
     short distilled prompt, classifies utterances far above chance."""
-    cfg, params, stats = distill.train_intent_model(
-        steps=320, corpus_n=1200, seq_len=176, batch=16)
+    cfg, params, stats = trained_intent
     assert stats["final_loss"] < stats["first_loss"] * 0.1, stats
     parser = distill.intent_engine_from(cfg, params)
     # probe with held-out utterances from the easy families (chance over
@@ -84,11 +92,10 @@ def test_whisper_overfit_transcribes_and_roundtrips_ckpt(tmp_path):
 
 
 @pytest.mark.slow
-def test_intent_ckpt_roundtrip_preserves_parses(tmp_path):
+def test_intent_ckpt_roundtrip_preserves_parses(tmp_path, trained_intent):
     """save_ckpt/load_ckpt through orbax must reproduce the parser's output
     token-for-token (the serve path the bench harness uses)."""
-    cfg, params, stats = distill.train_intent_model(
-        steps=60, corpus_n=300, seq_len=176, batch=16)
+    cfg, params, stats = trained_intent
     from tpu_voice_agent.models.llama import LlamaConfig
 
     distill.save_ckpt(str(tmp_path), distill.INTENT_CKPT, cfg, params, stats)

@@ -93,6 +93,13 @@ HloModule jit_forward
   ROOT %c = bf16[2048,5632]{1,0} convert(%p0)
 }
 
+%fused_scale.4 (p0: bf16[4096,4096], p1: bf16[1,4096]) -> bf16[4096,4096] {
+  %p0 = bf16[4096,4096]{1,0} parameter(0)
+  %p1 = bf16[1,4096]{1,0} parameter(1)
+  %bc = bf16[4096,4096]{1,0} broadcast(%p1)
+  ROOT %m = bf16[4096,4096]{1,0} multiply(%p0, %bc)
+}
+
 %fused_matmul.2 (p0: s8[2048,5632], p1: bf16[1,2048]) -> bf16[1,5632] {
   %p0 = s8[2048,5632]{1,0} parameter(0)
   %p1 = bf16[1,2048]{1,0} parameter(1)
@@ -111,6 +118,9 @@ ENTRY %main (a: s8[2048,5632], b: bf16[1,2048]) -> bf16[1,5632] {
   %a = s8[2048,5632]{1,0} parameter(0)
   %b = bf16[1,2048]{1,0} parameter(1)
   %dqf = bf16[2048,5632]{1,0} fusion(%a), kind=kLoop, calls=%fused_dequant.1
+  %w2 = bf16[4096,4096]{1,0} constant(0)
+  %s2 = bf16[1,4096]{1,0} constant(0)
+  %scf = bf16[4096,4096]{1,0} fusion(%w2, %s2), kind=kLoop, calls=%fused_scale.4
   %small = bf16[1,2048]{1,0} multiply(%b, %b)
   %loop = bf16[1,2048]{1,0} while(%small), body=%while_body.3
   ROOT %mm = bf16[1,5632]{1,0} fusion(%a, %loop), kind=kOutput, calls=%fused_matmul.2
@@ -118,11 +128,13 @@ ENTRY %main (a: s8[2048,5632], b: bf16[1,2048]) -> bf16[1,5632] {
 """
     audit = audit_dequant(hlo, min_bytes=1 << 20)
     got = {(op, shape) for op, dtype, shape, mb, comp in audit["findings"]}
-    # the while-body bare convert AND the ENTRY pure-dequant fusion
+    # the while-body bare convert, the ENTRY pure-dequant (convert) fusion,
+    # AND the multiply-only scale fusion (convert constant-folded away)
     assert ("convert", (2048, 2048)) in got
     assert ("fusion:dequant", (2048, 5632)) in got
+    assert ("fusion:dequant", (4096, 4096)) in got
     # the matmul-containing fusion and the small multiply were NOT flagged
-    assert len(audit["findings"]) == 2
+    assert len(audit["findings"]) == 3
     assert audit["scanned_instructions"] >= 6
 
 

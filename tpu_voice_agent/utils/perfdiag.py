@@ -81,7 +81,6 @@ def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
     Returns {findings: [(op, dtype, shape, mbytes, computation)],
     scanned_instructions: N}."""
     comps: dict[str, list] = {}
-    order: list[str] = []
     cur: str | None = None
     for line in hlo_text.splitlines():
         if line and not line[0].isspace():
@@ -89,7 +88,6 @@ def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
             cur = m.group("name") if m else None
             if cur is not None and cur not in comps:
                 comps[cur] = []
-                order.append(cur)
             continue
         if cur is None:
             continue
@@ -109,13 +107,17 @@ def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
     matmul_ops = {"dot", "dot-general", "convolution", "custom-call"}
 
     def body_is_pure_dequant(name: str) -> bool:
+        # a dequant body carries a weight-sized convert OR scale multiply
+        # (XLA may constant-fold the convert away and leave only the
+        # multiply); a body that also contains the consuming matmul is the
+        # GOOD case — the dequant feeds the dot without materializing
         instrs = comps.get(name, [])
-        has_big_convert = any(
-            m.group("op") == "convert"
+        has_big_dequant_op = any(
+            m.group("op") in ("convert", "multiply")
             and (_instr_bytes(m) or 0) >= min_bytes
             for m, _ in instrs)
         has_matmul = any(m.group("op") in matmul_ops for m, _ in instrs)
-        return has_big_convert and not has_matmul
+        return has_big_dequant_op and not has_matmul
 
     findings = []
     n = 0
