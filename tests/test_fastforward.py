@@ -127,3 +127,95 @@ def test_ff_respects_byte_budget():
     max_tok_bytes = max(len(tok.token_bytes(t)) for t in res.token_ids)
     assert n < budget + max_tok_bytes
     assert lit.startswith(res.text)
+
+
+def test_batched_ff_matches_single_request_ff():
+    """Round-3 VERDICT next #4: fast-forward under the BATCHER. Four
+    co-batched requests with ff=8 must be token-identical to the same four
+    run one-at-a-time through single-request generate() with ff=8 (same
+    f32 weights; batching must never change the distribution), and the
+    batcher must actually multi-emit (fewer chunks than tokens)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_voice_agent.models.llama import init_params
+    from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+    from tpu_voice_agent.services.prompts import render_prompt
+    from tpu_voice_agent.utils import get_metrics
+
+    single = DecodeEngine(preset="test-tiny", max_len=1024,
+                          prefill_buckets=(512, 1024), fast_forward=8,
+                          init_weights=False)
+    batched = DecodeEngine(preset="test-tiny", max_len=1024, batch_slots=4,
+                           prefill_buckets=(512, 1024), fast_forward=8,
+                           init_weights=False)
+    raw = init_params(single.cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    single.load_params(raw)
+    batched.load_params(raw)
+
+    prompts = [render_prompt(u, {}) for u in (
+        "search for usb hubs", "scroll down", "go back",
+        "take a screenshot",
+    )]
+    singles = [single.generate(p, max_new_tokens=160) for p in prompts]
+
+    m = get_metrics().snapshot()["counters"]
+    chunks0 = m.get("scheduler.chunks", 0)
+    toks0 = m.get("scheduler.tokens_generated", 0)
+    results = ContinuousBatcher(batched, chunk_steps=8,
+                                max_new_tokens=160).generate_many(prompts)
+    m = get_metrics().snapshot()["counters"]
+    chunks = m.get("scheduler.chunks", 0) - chunks0
+    toks = m.get("scheduler.tokens_generated", 0) - toks0
+
+    for s, r in zip(singles, results):
+        assert r.error is None
+        assert batched.fsm.walk(r.token_ids) >= 0
+        assert s.token_ids == r.token_ids, (s.text[:80], r.text[:80])
+    # multi-emission proof: without ff a chunk emits at most chunk_steps
+    # tokens per row; forced chains blow past that bound
+    assert toks > chunks * 8, (toks, chunks)
+
+
+def test_batched_ff_pallas_matches_xla():
+    """The frontier-read block-attention kernel (the lever that lifted the
+    single-request restriction) must be token-identical to the exact XLA
+    cache path at batch width."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_voice_agent.models.llama import init_params
+    from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+    from tpu_voice_agent.services.prompts import render_prompt
+
+    mk = lambda kern: DecodeEngine(
+        preset="test-tiny", max_len=1024, batch_slots=4,
+        prefill_buckets=(512, 1024), fast_forward=8, kernels=kern,
+        init_weights=False)
+    a, b = mk("xla"), mk("pallas")
+    raw = init_params(a.cfg, jax.random.PRNGKey(9), dtype=jnp.float32)
+    a.load_params(raw)
+    b.load_params(raw)
+    prompts = [render_prompt(u, {}) for u in (
+        "search for red shoes", "sort by price low to high",
+        "open the second result", "extract the table as csv",
+    )]
+    ra = ContinuousBatcher(a, chunk_steps=8, max_new_tokens=160).generate_many(prompts)
+    rb = ContinuousBatcher(b, chunk_steps=8, max_new_tokens=160).generate_many(prompts)
+    for x, y in zip(ra, rb):
+        assert x.error is None and y.error is None
+        assert b.fsm.walk(y.token_ids) >= 0
+        assert x.token_ids == y.token_ids, (x.text[:80], y.text[:80])
+
+
+def test_paged_engine_rejects_ff_loudly():
+    """A silent ff no-op on the paged engine would let an operator enable
+    it and measure nothing — refuse at construction until the paged block
+    kernel exists."""
+    from tpu_voice_agent.serve import PagedDecodeEngine
+
+    with pytest.raises(ValueError, match="fast_forward"):
+        PagedDecodeEngine(preset="test-tiny", max_len=512,
+                          prefill_buckets=(64,), fast_forward=8)

@@ -103,3 +103,51 @@ class TestMaskedArgmax:
         out = masked_argmax(logits, state, eng.tables.dense_mask)
         ref = masked_argmax_reference(logits, state, eng.tables.dense_mask)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_decode_block_attention_matches_reference():
+    """(B, T) query blocks against per-row frontiers: parity with the jnp
+    twin incl. intra-block causality, idle rows parked at slot 0, and an
+    odd cache length exercising the pad path."""
+    from tpu_voice_agent.ops import (
+        decode_block_attention,
+        decode_block_attention_reference,
+    )
+
+    B, T, nq, nkv, hd, S = 4, 5, 8, 4, 32, 96  # 96 % 64 != 0 -> pad path
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, T, nq, hd), jnp.float32)
+    kc = jax.random.normal(ks[1], (B, S, nkv, hd), jnp.float32)
+    vc = jax.random.normal(ks[2], (B, S, nkv, hd), jnp.float32)
+    q_pos = jnp.asarray([
+        [10, 11, 12, 13, 14],   # mid-sequence chain
+        [0, 0, 0, 0, 0],        # idle row parked at slot 0
+        [90, 91, 92, 93, 94],   # frontier near the odd end
+        [3, 4, 5, 5, 5],        # truncated chain duplicates its tail
+    ], jnp.int32)
+    ref = decode_block_attention_reference(q, kc, vc, q_pos)
+    out = decode_block_attention(q, kc, vc, q_pos, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_block_attention_layer_matches_plain():
+    """The stacked-cache layer variant must equal the plain kernel on the
+    selected plane (scalar-prefetched layer indexing)."""
+    from tpu_voice_agent.ops import (
+        decode_block_attention,
+        decode_block_attention_layer,
+    )
+
+    L, B, T, nq, nkv, hd, S = 3, 2, 4, 8, 4, 32, 128
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (B, T, nq, hd), jnp.float32)
+    kc = jax.random.normal(ks[1], (L, B, S, nkv, hd), jnp.float32)
+    vc = jax.random.normal(ks[2], (L, B, S, nkv, hd), jnp.float32)
+    q_pos = jnp.asarray([[20, 21, 22, 23], [7, 8, 9, 9]], jnp.int32)
+    for li in range(L):
+        plain = decode_block_attention(q, kc[li], vc[li], q_pos, block_k=64)
+        stacked = decode_block_attention_layer(q, kc, vc, q_pos,
+                                               jnp.int32(li), block_k=64)
+        np.testing.assert_allclose(np.asarray(stacked), np.asarray(plain),
+                                   rtol=1e-6, atol=1e-6)

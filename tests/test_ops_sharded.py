@@ -93,3 +93,26 @@ def eng_generate_one(eng):
 
     b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=48)
     return b.generate_many(["<|user|>\nsearch for mice\n<|assistant|>\n"])[0]
+
+
+def test_sharded_decode_block_attention_matches_single_device(mesh):
+    """The batched-ff block kernel under shard_map on the dp×tp mesh must
+    agree with the single-device kernel (batch over dp, heads over tp)."""
+    from tpu_voice_agent.ops import (
+        decode_block_attention_layer,
+        sharded_decode_block_attention_layer,
+    )
+
+    L, B, T, nq, nkv, hd, S = 2, 4, 3, 8, 4, 32, 128
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = jax.random.normal(ks[0], (B, T, nq, hd), jnp.float32)
+    kc = jax.random.normal(ks[1], (L, B, S, nkv, hd), jnp.float32)
+    vc = jax.random.normal(ks[2], (L, B, S, nkv, hd), jnp.float32)
+    q_pos = jnp.asarray([[5, 6, 7], [0, 0, 0], [40, 41, 42], [99, 100, 101]],
+                        jnp.int32)
+    for li in range(L):
+        ref = decode_block_attention_layer(q, kc, vc, q_pos, jnp.int32(li))
+        out = sharded_decode_block_attention_layer(
+            mesh, q, kc, vc, q_pos, jnp.int32(li))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)

@@ -57,6 +57,11 @@ class LlamaConfig:
 
 # Parameter-count-faithful presets; vocab_size is overridden from the
 # tokenizer at engine start.
+# widest mid-sequence block the Pallas frontier-read kernel serves; wider
+# blocks (suffix prefill buckets) take the exact XLA cache path. Covers
+# grammar fast-forward steps (1 + chain width, default width 8).
+MAX_BLOCK_DECODE_T = 16
+
 PRESETS: dict[str, LlamaConfig] = {
     "test-tiny": LlamaConfig(dim=128, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=256, max_seq_len=256),
     "tinyllama-1.1b": LlamaConfig(dim=2048, n_layers=22, n_heads=32, n_kv_heads=4, ffn_dim=5632),
@@ -451,6 +456,21 @@ def forward(
             mesh = rules.mesh if rules is not None else None
             attn = sharded_decode_attention_layer(
                 mesh, q[:, 0], kc, vc, frontier + 1, li
+            ).reshape(B, T, -1)
+        elif (attn_impl == "pallas" and not fresh_block
+              and T <= MAX_BLOCK_DECODE_T):
+            from ..ops import sharded_decode_block_attention_layer
+
+            # small mid-sequence block: the grammar fast-forward step is a
+            # (B, 1+W) forward, and the XLA cache fallback reads the cache
+            # at CAPACITY for every row (the round-3 reason ff was
+            # single-request only). This kernel reads each row's cache up
+            # to its own frontier, with intra-block causality from the
+            # queries' write positions — batched ff costs a T=1 step plus
+            # the riding chain tokens.
+            mesh = rules.mesh if rules is not None else None
+            attn = sharded_decode_block_attention_layer(
+                mesh, q, kc, vc, positions, li
             ).reshape(B, T, -1)
         elif attn_impl == "pallas" and fresh_block:
             from ..ops import sharded_flash_attention

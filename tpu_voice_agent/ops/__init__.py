@@ -23,6 +23,10 @@ from .decode_attention import (
     decode_attention,
     decode_attention_layer,
     decode_attention_reference,
+    decode_block_attention,
+    decode_block_attention_layer,
+    decode_block_attention_reference,
+    sharded_decode_block_attention_layer,
     sharded_decode_attention,
     sharded_decode_attention_layer,
 )
@@ -41,6 +45,10 @@ __all__ = [
     "decode_attention",
     "decode_attention_layer",
     "decode_attention_reference",
+    "decode_block_attention",
+    "decode_block_attention_layer",
+    "decode_block_attention_reference",
+    "sharded_decode_block_attention_layer",
     "sharded_decode_attention",
     "sharded_decode_attention_layer",
     "grouped_matmul",

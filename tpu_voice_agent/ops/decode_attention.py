@@ -179,7 +179,7 @@ def decode_attention_layer(
     # traffic it exists to eliminate. Take a smaller block instead; oddly
     # sized caches must be bucketed by the caller (engines already do).
     block_k = min(block_k, S)
-    while S % block_k and block_k >= 32:
+    while S % block_k and block_k > 32:
         block_k //= 2
     if S % block_k:
         raise ValueError(
@@ -317,3 +317,280 @@ def decode_attention_reference(
         preferred_element_type=jnp.float32,
     )
     return out.reshape(B, nq, hd).astype(q.dtype)
+
+
+# --------------------------------------------------------------- block decode
+#
+# Grammar fast-forward under the BATCHER (round-3 VERDICT next #4): a forced-
+# chain step is a (B, 1+W) forward. The XLA cache-attention fallback reads the
+# cache at its full CAPACITY for every row, which is why ff was restricted to
+# single-request generate(). This kernel is the lifted restriction: T queries
+# per row attend the row's cache up to its own frontier — tile gating keeps
+# the read proportional to actual context, exactly like the T=1 kernel, and
+# intra-block causality comes from the queries' write positions (slot index
+# == token position for contiguous caches).
+
+
+def _decode_block_kernel(
+    scalars_ref,  # SMEM (B*T [+1]) int32 — q positions row-major [+ layer]
+    q_ref,  # (1, nkv, T*group, hd)
+    k_ref,  # (1, block_k, nkv, hd) — or (1, 1, bk, nkv, hd) stacked view
+    v_ref,
+    o_ref,  # (1, nkv, T*group, hd)
+    acc_ref,  # VMEM (nkv, T*group, hd) f32
+    m_ref,  # VMEM (nkv, T*group, 128) f32
+    l_ref,
+    *,
+    scale: float,
+    nkv: int,
+    group: int,
+    T: int,
+    block_k: int,
+    stacked: bool = False,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    rows = T * group
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # per-query frontiers: row r of the folded (T*group) dim belongs to
+    # query index r // group; its last visible slot is its own position.
+    # Tile gating needs the true block max — computed over all T entries
+    # (T is tiny, static unroll), NOT assumed to be the last query's, so
+    # arbitrary q_positions orderings stay correct
+    max_pos = scalars_ref[b * T]
+    for _i in range(1, T):
+        max_pos = jnp.maximum(max_pos, scalars_ref[b * T + _i])
+
+    @pl.when(j * block_k <= max_pos)
+    def _tile():
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 1)
+        # gather each row's own position out of SMEM via a small static loop
+        # (T is tiny); builds a (rows, 1) frontier column
+        qpos_rows = jnp.zeros((rows, 1), jnp.int32)
+        for i in range(T):
+            qpos_rows = jnp.where(
+                (jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // group) == i,
+                scalars_ref[b * T + i], qpos_rows)
+        valid = k_pos <= qpos_rows  # causal + frontier in one mask
+        for h in range(nkv):
+            q = q_ref[0, h].astype(jnp.float32)  # (rows, hd)
+            k = (k_ref[0, 0, :, h] if stacked else k_ref[0, :, h]).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # (rows, bk)
+            s = jnp.where(valid, s, _NEG_INF)
+
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            vblk = (v_ref[0, 0, :, h] if stacked else v_ref[0, :, h]).astype(jnp.float32)
+            pv = jax.lax.dot_general(
+                p, vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        o_ref[0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_k", "interpret"))
+def decode_block_attention(
+    q: jax.Array,  # (B, T, nq, hd) — a small block of queries per row
+    k_cache: jax.Array,  # (B, S, nkv, hd)
+    v_cache: jax.Array,
+    q_positions: jax.Array,  # (B, T) int32 — each query's cache position
+    *,
+    scale: float | None = None,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Returns (B, T, nq, hd) in q.dtype. Query i attends cache slots
+    [0, q_positions[b, i]] — the caller has already written the block's k/v
+    at those positions (forward's contract)."""
+    B, T, nq, hd = q.shape
+    S, nkv = k_cache.shape[1], k_cache.shape[2]
+    assert nq % nkv == 0
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+
+    block_k = min(block_k, S)
+    if S % block_k:
+        S_pad = -(-S // block_k) * block_k
+        pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
+        k_cache = jnp.pad(k_cache, pad)
+        v_cache = jnp.pad(v_cache, pad)
+        S = S_pad
+    # (B, T, nkv, group, hd) -> (B, nkv, T, group, hd) -> fold (T, group)
+    qg = q.reshape(B, T, nkv, group, hd).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(B, nkv, T * group, hd)
+
+    grid = (B, S // block_k)
+    kernel = functools.partial(
+        _decode_block_kernel, scale=scale, nkv=nkv, group=group, T=T,
+        block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B * T,), lambda b, j: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, nkv, T * group, hd), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, nkv, hd), lambda b, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, block_k, nkv, hd), lambda b, j: (b, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, T * group, hd), lambda b, j: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, T * group, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, T * group, hd), jnp.float32),
+            pltpu.VMEM((nkv, T * group, 128), jnp.float32),
+            pltpu.VMEM((nkv, T * group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_positions.reshape(-1).astype(jnp.int32), qg, k_cache, v_cache)
+    return (out.reshape(B, nkv, T, group, hd)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, T, nq, hd))
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_k", "interpret"))
+def decode_block_attention_layer(
+    q: jax.Array,  # (B, T, nq, hd)
+    k_cache: jax.Array,  # (L, B, S, nkv, hd) — the FULL stacked cache
+    v_cache: jax.Array,
+    q_positions: jax.Array,  # (B, T) int32
+    layer: jax.Array,  # scalar int32
+    *,
+    scale: float | None = None,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """decode_block_attention reading one layer's plane of the stacked cache
+    via scalar prefetch (same rationale as decode_attention_layer: slicing
+    cache[li] in the scan body materializes a full-plane copy per layer)."""
+    B, T, nq, hd = q.shape
+    S, nkv = k_cache.shape[2], k_cache.shape[3]
+    assert nq % nkv == 0
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+    block_k = min(block_k, S)
+    while S % block_k and block_k > 32:
+        block_k //= 2
+    if S % block_k:
+        raise ValueError(
+            f"stacked block-decode kernel needs cache length {S} divisible "
+            f"by a >=32 block; size the cache to a power-of-two bucket")
+    qg = q.reshape(B, T, nkv, group, hd).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(B, nkv, T * group, hd)
+
+    scalars = jnp.concatenate([
+        q_positions.reshape(-1).astype(jnp.int32),
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+    ])
+    kernel = functools.partial(
+        _decode_block_kernel, scale=scale, nkv=nkv, group=group, T=T,
+        block_k=block_k, stacked=True,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, nkv, T * group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, nkv, hd),
+                         lambda b, j, sc: (sc[B * T], b, j, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, nkv, hd),
+                         lambda b, j, sc: (sc[B * T], b, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, T * group, hd),
+                               lambda b, j, sc: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, T * group, hd), jnp.float32),
+            pltpu.VMEM((nkv, T * group, 128), jnp.float32),
+            pltpu.VMEM((nkv, T * group, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, T * group, hd), q.dtype),
+        interpret=interpret,
+    )(scalars, qg, k_cache, v_cache)
+    return (out.reshape(B, nkv, T, group, hd)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, T, nq, hd))
+
+
+def sharded_decode_block_attention_layer(
+    mesh,
+    q: jax.Array,  # (B, T, nq, hd)
+    k_cache: jax.Array,  # (L, B, S, nkv, hd)
+    v_cache: jax.Array,
+    q_positions: jax.Array,  # (B, T)
+    layer: jax.Array,
+    **kw,
+) -> jax.Array:
+    """decode_block_attention_layer over a (dp, tp) mesh (None -> plain)."""
+    if mesh is None:
+        return decode_block_attention_layer(q, k_cache, v_cache, q_positions,
+                                            layer, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    B, T, nq = q.shape[0], q.shape[1], q.shape[2]
+    nkv = k_cache.shape[3]
+    tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
+    dp_ax = "dp" if (dp > 1 and B % dp == 0) else None
+    qs = P(dp_ax, None, tp_ax, None)
+    cs = P(None, dp_ax, None, tp_ax, None)
+    fn = jax.shard_map(
+        functools.partial(decode_block_attention_layer, **kw),
+        mesh=mesh,
+        in_specs=(qs, cs, cs, P(dp_ax, None), P()),
+        out_specs=qs,
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, q_positions.astype(jnp.int32), layer)
+
+
+def decode_block_attention_reference(
+    q: jax.Array,  # (B, T, nq, hd)
+    k_cache: jax.Array,  # (B, S, nkv, hd)
+    v_cache: jax.Array,
+    q_positions: jax.Array,  # (B, T)
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Pure-jnp twin of ``decode_block_attention``."""
+    B, T, nq, hd = q.shape
+    S, nkv = k_cache.shape[1], k_cache.shape[2]
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    qg = q.reshape(B, T, nkv, group, hd)
+    scores = jnp.einsum("btkgh,bskh->btkgs", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(S)[None, None, :] <= q_positions[:, :, None]  # (B, T, S)
+    scores = jnp.where(valid[:, :, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "btkgs,bskh->btkgh", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, T, nq, hd).astype(q.dtype)

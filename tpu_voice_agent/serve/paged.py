@@ -214,6 +214,15 @@ class PagedDecodeEngine(DecodeEngine):
 
     def __init__(self, *args, block_size: int = 128, pool_blocks: int | None = None,
                  **kw):
+        if kw.get("fast_forward"):
+            # the paged chunk loop takes T=1 steps; a silent no-op here
+            # would let an operator enable ff and measure nothing. Batched
+            # ff needs a paged (T-query) block-attention kernel — until
+            # that lands, refuse loudly. (The DENSE engine serves ff at
+            # any batch width.)
+            raise ValueError(
+                "fast_forward is not supported by PagedDecodeEngine yet; "
+                "use the dense DecodeEngine for batched grammar ff")
         super().__init__(*args, **kw)
         bs = block_size
         self.block_size = bs

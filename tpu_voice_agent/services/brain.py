@@ -653,9 +653,11 @@ def make_parser_from_env() -> IntentParser:
 
     log = logging.getLogger("tpu_voice_agent.brain")
     slots = int(os.environ.get("BRAIN_BATCH", "1"))
-    # grammar fast-forward applies to the single-slot generate() path only
-    # (BRAIN_FF=0 disables); the batcher keeps T=1 decode steps
-    ff = int(os.environ.get("BRAIN_FF", "8")) if slots == 1 else 0
+    # grammar fast-forward (BRAIN_FF=0 disables): serves at ANY batch width
+    # on the dense engine — chain steps run the frontier-read block kernel
+    # (round-3's single-slot restriction is lifted). The paged engine takes
+    # T=1 steps and rejects ff, so its route below never receives it.
+    ff = int(os.environ.get("BRAIN_FF", "8"))
     paged = os.environ.get("BRAIN_PAGED") == "1"
     quant = os.environ.get("BRAIN_QUANT") or None
     moe = "grouped" if os.environ.get("BRAIN_MOE") == "grouped" else None
