@@ -86,6 +86,12 @@ def main(n_sessions: int = 32) -> None:
                               prefill_buckets=(1024,),
                               quant="int8" if tpu else None), "_paged")
 
+    # paged + ff: forced chains through the paged frontier-read block
+    # kernel — round-3 next #4's "across dense and paged layouts"
+    run_one(PagedDecodeEngine(preset=preset, max_len=2048, batch_slots=slots,
+                              prefill_buckets=(1024,), fast_forward=8,
+                              quant="int8" if tpu else None), "_ff_paged")
+
 
 if __name__ == "__main__":
     main(int(sys.argv[1]) if len(sys.argv) > 1 else 32)

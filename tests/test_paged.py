@@ -237,3 +237,52 @@ def test_sharded_paged_attention_rejects_dp_indivisible(mesh):
     with pytest.raises(ValueError, match="divisible by dp"):
         sharded_paged_attention(mesh, q, k_pool, v_pool, tables, kv_len,
                                 jnp.int32(0))
+
+
+def test_paged_block_attention_matches_contiguous_reference():
+    """The batched-ff paged kernel: T queries against pool blocks must
+    equal the dense block reference over the gathered contiguous cache
+    (per-query causality, non-contiguous tables, multiple layers)."""
+    from tpu_voice_agent.ops import paged_block_attention
+    from tpu_voice_agent.ops.decode_attention import (
+        decode_block_attention_reference,
+    )
+
+    L, N, bs, B, T, nq, nkv, hd = 2, 8, 16, 3, 4, 8, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, T, nq, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (L, N, bs, nkv, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (L, N, bs, nkv, hd), jnp.float32)
+    tables = jnp.asarray([[1, 2, 0, 0], [3, 4, 5, 0], [6, 7, 1, 2]], jnp.int32)
+    q_pos = jnp.asarray([[5, 6, 7, 8], [20, 21, 22, 22], [30, 31, 32, 33]],
+                        jnp.int32)
+    for li in range(L):
+        kc = kp[li][tables].reshape(B, 4 * bs, nkv, hd)
+        vc = vp[li][tables].reshape(B, 4 * bs, nkv, hd)
+        ref = decode_block_attention_reference(q, kc, vc, q_pos)
+        out = paged_block_attention(q, kp, vp, tables, q_pos, jnp.int32(li))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_ff_coverage_reconciles_to_actual_frontier():
+    """decode_chunk claims the worst-case ff span before dispatch; the
+    scheduler's reconcile hook must clamp the growth target back to the
+    REAL frontier so the claim never compounds across chunks (a grammar
+    that rarely forces chains would otherwise race every table to
+    max_len), and a tight pool must still serve ff requests."""
+    eng = _paged(3, pool_blocks=40, fast_forward=8)
+    install_prompt_prefix(eng)
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=96)
+    res = b.generate_many(PROMPTS)
+    for r in res:
+        assert r.error is None
+        assert eng.fsm.walk(r.token_ids) >= 0
+    # direct contract: the hook clamps live slots only
+    eng._slot_owned[0] = [5]
+    eng._slot_owned[1] = []
+    eng._next_pos[0] = 4000
+    eng._next_pos[1] = 4000
+    eng.reconcile_coverage(np.asarray([950, 123, 0]))
+    assert eng._next_pos[0] == 950
+    assert eng._next_pos[1] == 4000  # dead slot untouched (stale pos row)

@@ -570,6 +570,18 @@ def forward_paged(
             attn = sharded_paged_attention(
                 mesh, q[:, 0], kp, vp, block_tables, frontier + 1, li
             ).reshape(B, T, -1)
+        elif (attn_impl == "pallas" and not fresh_block
+              and T <= MAX_BLOCK_DECODE_T):
+            from ..ops import sharded_paged_block_attention
+
+            # small mid-sequence block (grammar fast-forward chain step):
+            # the paged twin of the dense frontier-read block kernel — T
+            # queries per row read the row's own pool blocks up to its own
+            # positions; no per-layer table gather
+            mesh = rules.mesh if rules is not None else None
+            attn = sharded_paged_block_attention(
+                mesh, q, kp, vp, block_tables, positions, li
+            ).reshape(B, T, -1)
         elif fresh_block and T > 1:
             # fresh sequence starting at position 0: attention over the
             # block's own k/v IS attention over the sequence — no pool

@@ -35,6 +35,8 @@ from .grouped_matmul import grouped_matmul, grouped_matmul_reference
 from .paged_attention import (
     paged_attention,
     paged_attention_reference,
+    paged_block_attention,
+    sharded_paged_block_attention,
     sharded_paged_attention,
 )
 
@@ -57,6 +59,8 @@ __all__ = [
     "masked_argmax_reference",
     "sharded_masked_argmax",
     "paged_attention",
+    "paged_block_attention",
+    "sharded_paged_block_attention",
     "paged_attention_reference",
     "sharded_paged_attention",
 ]

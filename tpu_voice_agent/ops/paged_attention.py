@@ -238,3 +238,198 @@ def paged_attention_reference(
     out = jnp.einsum("bkgs,bskh->bkgh", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.reshape(B, nq, hd).astype(q.dtype)
+
+
+# --------------------------------------------------------------- block decode
+#
+# Paged twin of ops.decode_attention's block kernel: grammar fast-forward
+# under the batcher takes (B, 1+W) steps, and the paged pool must serve them
+# without gathering each row's whole table to a contiguous cache (the T>1
+# XLA fallback's cost). T queries fold into the row dimension; per-query
+# write positions give intra-block causality; tile gating skips pool blocks
+# beyond the row's last query.
+
+
+def _paged_block_kernel(
+    scalars_ref,  # SMEM: [q_pos (B*T,) | layer (1,) | table (B*max_blocks,)]
+    q_ref,  # (1, nkv, T*group, hd)
+    k_ref,  # (1, 1, bs, nkv, hd) — pool block picked by the index map
+    v_ref,
+    o_ref,  # (1, nkv, T*group, hd)
+    acc_ref,  # VMEM (nkv, T*group, hd) f32
+    m_ref,  # VMEM (nkv, T*group, 128) f32
+    l_ref,
+    *,
+    scale: float,
+    nkv: int,
+    group: int,
+    T: int,
+    bs: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    rows = T * group
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # true block max over all T query positions (no ordering assumption)
+    max_pos = scalars_ref[b * T]
+    for _i in range(1, T):
+        max_pos = jnp.maximum(max_pos, scalars_ref[b * T + _i])
+
+    @pl.when(j * bs <= max_pos)
+    def _tile():
+        k_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        qpos_rows = jnp.zeros((rows, 1), jnp.int32)
+        for i in range(T):
+            qpos_rows = jnp.where(
+                (jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // group) == i,
+                scalars_ref[b * T + i], qpos_rows)
+        valid = k_pos <= qpos_rows  # causal + frontier in one mask
+        for h in range(nkv):
+            q = q_ref[0, h].astype(jnp.float32)  # (rows, hd)
+            k = k_ref[0, 0, :, h].astype(jnp.float32)  # (bs, hd)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale
+            s = jnp.where(valid, s, _NEG_INF)
+
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, v_ref[0, 0, :, h].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        o_ref[0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_block_attention(
+    q: jax.Array,  # (B, T, nq, hd) — a small block of queries per row
+    k_pool: jax.Array,  # (L, N, bs, nkv, hd)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    q_positions: jax.Array,  # (B, T) int32 — each query's sequence position
+    layer: jax.Array,  # scalar int32
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Returns (B, T, nq, hd). Query i attends positions [0, q_positions
+    [b, i]] of its row's paged sequence (the caller has already scattered
+    the block's k/v at those positions). Unused table entries must hold a
+    valid block id — tiles beyond the row's last query are skipped."""
+    B, T, nq, hd = q.shape
+    bs, nkv = k_pool.shape[2], k_pool.shape[3]
+    max_blocks = block_tables.shape[1]
+    assert nq % nkv == 0
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+    qg = q.reshape(B, T, nkv, group, hd).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(B, nkv, T * group, hd)
+
+    scalars = jnp.concatenate([
+        q_positions.astype(jnp.int32).reshape(-1),
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        block_tables.astype(jnp.int32).reshape(-1),
+    ])
+    kernel = functools.partial(
+        _paged_block_kernel, scale=scale, nkv=nkv, group=group, T=T, bs=bs
+    )
+    BT = B * T
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, nkv, T * group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, bs, nkv, hd),
+                lambda b, j, sc, M=max_blocks: (sc[BT], sc[BT + 1 + b * M + j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, nkv, hd),
+                lambda b, j, sc, M=max_blocks: (sc[BT], sc[BT + 1 + b * M + j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, T * group, hd),
+                               lambda b, j, sc: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, T * group, hd), jnp.float32),
+            pltpu.VMEM((nkv, T * group, 128), jnp.float32),
+            pltpu.VMEM((nkv, T * group, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, T * group, hd), q.dtype),
+        interpret=interpret,
+    )(scalars, qg, k_pool, v_pool)
+    return (out.reshape(B, nkv, T, group, hd)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, T, nq, hd))
+
+
+def sharded_paged_block_attention(
+    mesh,
+    q: jax.Array,  # (B, T, nq, hd)
+    k_pool: jax.Array,  # (L, N, bs, nkv, hd)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) GLOBAL block ids
+    q_positions: jax.Array,  # (B, T)
+    layer: jax.Array,
+    **kw,
+) -> jax.Array:
+    """paged_block_attention over a (dp, tp) mesh — same layout contract as
+    sharded_paged_attention (pool blocks over dp, kv heads over tp, each dp
+    group's rows reference only its own block range)."""
+    if mesh is None:
+        return paged_block_attention(q, k_pool, v_pool, block_tables,
+                                     q_positions, layer, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    B, T, nq = q.shape[0], q.shape[1], q.shape[2]
+    N, nkv = k_pool.shape[1], k_pool.shape[3]
+    tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
+    if dp > 1 and (B % dp != 0 or N % dp != 0):
+        raise ValueError(
+            f"sharded_paged_block_attention: batch B={B} and pool blocks "
+            f"N={N} must both be divisible by dp={dp}")
+    dp_ax = "dp" if dp > 1 else None
+    local_blocks = N // dp if dp_ax else N
+
+    def local(q, kp, vp, bt, qp, layer):
+        if dp_ax is not None:
+            bt = bt - jax.lax.axis_index("dp") * local_blocks
+        return paged_block_attention(q, kp, vp, bt, qp, layer, **kw)
+
+    qs = P(dp_ax, None, tp_ax, None)
+    ps = P(None, dp_ax, None, tp_ax, None)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(qs, ps, ps, P(dp_ax, None), P(dp_ax, None), P()),
+        out_specs=qs,
+        check_vma=False,
+    )
+    return fn(q, k_pool, v_pool, block_tables.astype(jnp.int32),
+              q_positions.astype(jnp.int32), layer)

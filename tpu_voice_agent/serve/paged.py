@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..grammar.fsm import fsm_advance
 from ..models.llama import forward_paged
 from .engine import DecodeEngine, _mask_sample_advance
 
@@ -147,7 +148,13 @@ def paged_chunk_decode_loop(
     max_pos = block_tables.shape[1] * k_pool.shape[2]
     if max_len is not None:
         max_pos = min(max_pos, max_len)
-    out = jnp.full((B, chunk_steps), pad_id, dtype=jnp.int32)
+    use_ff = constrained and tables.ff_tokens is not None
+    W = tables.ff_tokens.shape[1] if use_ff else 0
+    cap = chunk_steps * (1 + W)
+    # ff emission scatters through a trash column (index `cap`), exactly
+    # like the dense loop
+    out = jnp.full((B, cap + 1 if use_ff else chunk_steps), pad_id,
+                   dtype=jnp.int32)
     eos0 = (~active) & (cur == eos_id)
 
     carry0 = (k_pool, v_pool, cur, pos, fsm_state, active, eos0, nbytes,
@@ -188,10 +195,81 @@ def paged_chunk_decode_loop(
         active = active & ~stop
         return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
 
+    def ff_body(c):
+        # the dense ff_body's paged twin: cur + its state's forced chain in
+        # one (B, 1+W) forward_paged. Writes land through the block tables
+        # (parked wholesale at the trash block for idle rows via
+        # write_mask); attention runs the paged frontier-read block kernel
+        # under kernels="pallas". Chain caps mirror the dense loop with
+        # max_pos (table-covered capacity ∧ engine max_len) as the bound —
+        # the engine's decode_chunk grew every live row's table to cover a
+        # full ff chunk before dispatch.
+        kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
+        iw = jnp.arange(1 + W)[None, :]
+        chain = tables.ff_tokens[state]  # (B, W); -1 pads
+        k = jnp.minimum(jnp.minimum(tables.ff_len[state], left - 1),
+                        max_pos - 1 - pos)
+        chain_bytes = jnp.cumsum(
+            jnp.where(chain >= 0, byte_len_table[jnp.maximum(chain, 0)], 0), axis=1)
+        rem = (byte_budget - nbytes - byte_len_table[cur])[:, None]
+        k = jnp.minimum(k, jnp.sum(chain_bytes <= rem, axis=1))
+        k = jnp.where(active, jnp.maximum(k, 0), 0)
+
+        ci = jnp.clip(iw - 1, 0, jnp.maximum(k[:, None] - 1, 0))
+        chain_tok = jnp.take_along_axis(chain, ci, axis=1)
+        step_tok = jnp.where(active, cur, pad_id)
+        blk_tok = jnp.where(iw == 0, step_tok[:, None],
+                            jnp.where(k[:, None] > 0, chain_tok, step_tok[:, None]))
+        # idle rows park at position 0 (writes are parked via write_mask
+        # anyway): keeps their attention frontier at ONE tile instead of
+        # streaming a finished row's whole covered context every layer
+        write_pos = jnp.where(active, pos, 0)
+        blk_pos = write_pos[:, None] + jnp.minimum(iw, k[:, None])
+
+        valid = (iw <= k[:, None]) & active[:, None]
+        tgt = jnp.where(valid, jnp.minimum(n[:, None] + iw, cap - 1), cap)
+        out = out.at[jnp.arange(B)[:, None], tgt].set(
+            jnp.where(valid, blk_tok, pad_id))
+        emitted = jnp.where(active, 1 + k, 0)
+        n = n + emitted
+        chain_valid = (iw >= 1) & (iw <= k[:, None]) & active[:, None]
+        nbytes = (nbytes + jnp.where(active, byte_len_table[cur], 0)
+                  + jnp.sum(jnp.where(chain_valid,
+                                      byte_len_table[jnp.maximum(chain_tok, 0)], 0),
+                            axis=1))
+        left = left - emitted
+
+        def cstep(s, xs):
+            t, i = xs
+            s2 = fsm_advance(tables, s, jnp.maximum(t, 0))
+            return jnp.where(i < k, s2, s), None
+
+        s_end, _ = jax.lax.scan(cstep, state, (chain.T, jnp.arange(W)))
+
+        logits, kp, vp = forward_paged(
+            params, cfg, blk_tok, blk_pos, kp, vp,
+            block_tables, rules=rules, attn_impl=kernels, write_mask=active,
+            trash_idx=trash_idx,
+        )
+        logits_k = jnp.take_along_axis(logits, k[:, None, None], axis=1)[:, 0, :]
+        key, kk = jax.random.split(key)
+        nxt, state_next = _mask_sample_advance(
+            logits_k, s_end, tables, kk, temperature, greedy,
+            constrained, kernels, rules, logit_mask
+        )
+        state = jnp.where(active, state_next, state)
+        cur = jnp.where(active, nxt, cur)
+        pos = jnp.where(active, pos + 1 + k, pos)
+
+        eos = eos | (active & (cur == eos_id))
+        stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_pos - 1) | (left <= 0)
+        active = active & ~stop
+        return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
+
     (k_pool, v_pool, cur, pos, state, active, eos, nbytes, left, out, n, _, _) = (
-        jax.lax.while_loop(cond, body, carry0)
+        jax.lax.while_loop(cond, ff_body if use_ff else body, carry0)
     )
-    return out, n, eos, k_pool, v_pool, cur, pos, state, active, nbytes, left
+    return out[:, : cap if use_ff else chunk_steps], n, eos, k_pool, v_pool, cur, pos, state, active, nbytes, left
 
 
 class PagedDecodeEngine(DecodeEngine):
@@ -214,15 +292,6 @@ class PagedDecodeEngine(DecodeEngine):
 
     def __init__(self, *args, block_size: int = 128, pool_blocks: int | None = None,
                  **kw):
-        if kw.get("fast_forward"):
-            # the paged chunk loop takes T=1 steps; a silent no-op here
-            # would let an operator enable ff and measure nothing. Batched
-            # ff needs a paged (T-query) block-attention kernel — until
-            # that lands, refuse loudly. (The DENSE engine serves ff at
-            # any batch width.)
-            raise ValueError(
-                "fast_forward is not supported by PagedDecodeEngine yet; "
-                "use the dense DecodeEngine for batched grammar ff")
         super().__init__(*args, **kw)
         bs = block_size
         self.block_size = bs
@@ -374,6 +443,17 @@ class PagedDecodeEngine(DecodeEngine):
 
     # ------------------------------------------------------------ decode
 
+    def reconcile_coverage(self, pos_h) -> None:
+        """Post-chunk hook (scheduler): clamp each live slot's growth
+        target to its ACTUAL frontier. decode_chunk must claim the
+        worst-case ff span before dispatch, but a grammar that rarely
+        forces chains would otherwise compound (1+W)x per chunk until
+        every table covered max_len — the dense worst-case footprint this
+        engine exists to avoid."""
+        for b in range(self.batch_slots):
+            if self._slot_owned[b]:
+                self._next_pos[b] = min(self._next_pos[b], int(pos_h[b]))
+
     def _grow(self, slot: int, upto: int) -> None:
         """Extend a slot's table so positions < upto have blocks."""
         bs = self.block_size
@@ -389,22 +469,33 @@ class PagedDecodeEngine(DecodeEngine):
     def decode_chunk(self, cur, pos, fsm, active, nbytes, tokens_left, key,
                      temperature: float, byte_budget: int, chunk_steps: int,
                      greedy: bool):
+        # a fast-forward chunk can emit up to (1+W) tokens per step — the
+        # table must cover the worst case BEFORE dispatch (a mid-chunk
+        # write past the covered blocks would scribble on the pool). The
+        # worst-case claim does NOT compound across chunks: the scheduler
+        # reconciles _next_pos to each row's ACTUAL frontier after every
+        # chunk (reconcile_coverage), so over-allocation stays bounded by
+        # one chunk's span instead of racing every table to max_len
+        W = (self.tables_ff.ff_tokens.shape[1]
+             if self.tables_ff is not None else 0)
+        span = chunk_steps * (1 + W)
         for b in range(self.batch_slots):
             if self._slot_owned[b]:  # request in flight on this slot
                 try:
-                    self._grow(b, self._next_pos[b] + chunk_steps + 1)
+                    self._grow(b, self._next_pos[b] + span + 1)
                 except PoolExhausted:
                     # per-request isolation at decode time too: the slot
                     # that cannot grow truncates cleanly (finished=False)
                     # at its already-covered positions; the batch lives on
                     tokens_left = tokens_left.at[b].set(0)
                     continue
-                self._next_pos[b] = min(self._next_pos[b] + chunk_steps, self.max_len)
+                self._next_pos[b] = min(self._next_pos[b] + span, self.max_len)
         out, n, eos, self.k_pool, self.v_pool, cur, pos, fsm, active, nbytes, left = (
             paged_chunk_decode_loop(
                 self.params, self.cfg, self.k_pool, self.v_pool, self.block_tables,
                 cur, pos, fsm, active, nbytes, tokens_left,
-                self.tables, self.byte_len_table,
+                self.tables_ff if self.tables_ff is not None else self.tables,
+                self.byte_len_table,
                 key, jnp.float32(temperature), jnp.int32(byte_budget),
                 trash_idx=self._trash_idx, rules=self.rules,
                 logit_mask=self.logit_mask, chunk_steps=chunk_steps,

@@ -181,10 +181,16 @@ class ContinuousBatcher:
         )
         # one transfer for everything the host needs this chunk (a combined
         # device_get is ONE tunnel round trip; separate gets pay one each)
-        out_h, n_h, act_h, eos_h = (
-            np.asarray(x) for x in jax.device_get((out, n, self.active, eos))
+        out_h, n_h, act_h, eos_h, pos_h = (
+            np.asarray(x)
+            for x in jax.device_get((out, n, self.active, eos, self.pos))
         )
         self._active_h = np.array(act_h)
+        # paged engines clamp their block-growth targets to the actual
+        # frontier (the ff worst-case claim must not compound per chunk)
+        reconcile = getattr(eng, "reconcile_coverage", None)
+        if reconcile is not None:
+            reconcile(pos_h)
 
         from ..utils import get_metrics
 

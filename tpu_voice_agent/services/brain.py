@@ -654,9 +654,8 @@ def make_parser_from_env() -> IntentParser:
     log = logging.getLogger("tpu_voice_agent.brain")
     slots = int(os.environ.get("BRAIN_BATCH", "1"))
     # grammar fast-forward (BRAIN_FF=0 disables): serves at ANY batch width
-    # on the dense engine — chain steps run the frontier-read block kernel
-    # (round-3's single-slot restriction is lifted). The paged engine takes
-    # T=1 steps and rejects ff, so its route below never receives it.
+    # on the dense AND paged engines — chain steps run the frontier-read
+    # block kernels (round-3's single-slot restriction is lifted)
     ff = int(os.environ.get("BRAIN_FF", "8"))
     paged = os.environ.get("BRAIN_PAGED") == "1"
     quant = os.environ.get("BRAIN_QUANT") or None
@@ -707,7 +706,7 @@ def make_parser_from_env() -> IntentParser:
             pool = int(os.environ.get("BRAIN_POOL_BLOCKS", "0")) or None
             return _wrap_batched(PagedDecodeEngine(
                 preset=preset, cfg=cfg, batch_slots=max(slots, 1),
-                pool_blocks=pool, quant=quant))
+                pool_blocks=pool, quant=quant, fast_forward=ff))
         return _wrap_engine(DecodeEngine(preset=preset, cfg=cfg, batch_slots=slots,
                                          fast_forward=ff, quant=quant))
     if backend.startswith("pp"):
