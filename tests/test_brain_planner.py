@@ -67,7 +67,8 @@ class _StubPlanner:
         from types import SimpleNamespace
 
         self._mk = lambda: SimpleNamespace(ids=list(range(5)), pos=5,
-                                           anchors=1, last_logits=object())
+                                           anchors=1, last_logits=object(),
+                                           cache=None)
         self.plan_text = plan_text
         self.bytes_per_session = bytes_per_session
 
@@ -409,3 +410,162 @@ def test_plan_many_preserves_slot0_kv_of_early_finishers():
     for sess, (k0, v0) in zip(sessions, before):
         np.testing.assert_array_equal(np.asarray(sess.cache["k"][:, 0, 0]), k0)
         np.testing.assert_array_equal(np.asarray(sess.cache["v"][:, 0, 0]), v0)
+
+
+class _CountingPlanner(_StubPlanner):
+    """Stub that counts plan decodes (speculation must not double-decode)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.plans = 0
+
+    def plan_many(self, sessions, max_new_tokens=None, **kw):
+        self.plans += len(sessions)
+        return super().plan_many(sessions, max_new_tokens, **kw)
+
+
+def test_planner_speculative_commit_is_one_decode():
+    """spec(text) then final(text): the provisional turn IS the turn —
+    the final must deliver the cached response with ZERO extra decode and
+    the transcript must hold the turn exactly once."""
+    planner = _CountingPlanner()
+    parser = PlannerParser(planner)
+    r1 = parser.parse("scroll down", {}, session_id="s", speculative=True)
+    n_after_spec = len(parser._sessions["s"].ids)
+    r2 = parser.parse("scroll down", {}, session_id="s")
+    assert planner.plans == 1
+    assert r2.model_dump() == r1.model_dump()
+    assert len(parser._sessions["s"].ids) == n_after_spec  # no double record
+    assert getattr(parser._sessions["s"], "pending_spec", None) is None
+
+
+def test_planner_speculative_mismatch_rolls_back():
+    """spec("sort...") then final("scroll...") on a WARM session: the
+    provisional turn is undone before the real turn — the transcript must
+    equal a twin session that never speculated."""
+    parser = PlannerParser(_CountingPlanner())
+    parser.parse("first turn", {}, session_id="a")  # warm the session
+    twin = list(parser._sessions["a"].ids)
+    parser.parse("sort by price", {}, session_id="a", speculative=True)
+    parser.parse("scroll down", {}, session_id="a")  # DIFFERENT final
+
+    ref = PlannerParser(_CountingPlanner())
+    ref.parse("first turn", {}, session_id="a")
+    assert list(ref._sessions["a"].ids) == twin
+    ref.parse("scroll down", {}, session_id="a")
+    assert list(parser._sessions["a"].ids) == list(ref._sessions["a"].ids)
+
+
+def test_planner_speculative_fresh_session_mismatch_drops_provisional():
+    """A session that only exists speculatively must vanish on mismatch —
+    the final's turn is the session's FIRST turn."""
+    parser = PlannerParser(_CountingPlanner())
+    parser.parse("sort by price", {}, session_id="n", speculative=True)
+    parser.parse("scroll down", {}, session_id="n")
+    ref = PlannerParser(_CountingPlanner())
+    ref.parse("scroll down", {}, session_id="n")
+    assert list(parser._sessions["n"].ids) == list(ref._sessions["n"].ids)
+
+
+def test_planner_eviction_rolls_back_pending_speculation():
+    """Evicting a session mid-speculation must undo the provisional turn:
+    the commit marker cannot survive, so a matching final re-parses from
+    the CLEAN transcript (never double-records)."""
+    parser = PlannerParser(_CountingPlanner(bytes_per_session=1 << 20),
+                           hbm_budget_bytes=1)  # evict aggressively
+    parser.max_sessions = 1
+    parser.parse("first turn", {}, session_id="a")
+    parser.parse("sort by price", {}, session_id="a", speculative=True)
+    parser.parse("other session", {}, session_id="b")  # evicts "a" (parked)
+    parser.parse("sort by price", {}, session_id="a")  # matching final
+    ref = PlannerParser(_CountingPlanner())
+    ref.parse("first turn", {}, session_id="a")
+    ref.parse("sort by price", {}, session_id="a")
+    assert list(parser._sessions["a"].ids) == list(ref._sessions["a"].ids)
+
+
+def test_real_planner_speculative_commit_matches_plain_turns():
+    """Integration on the REAL planner: [spec A, commit A, turn B] must
+    leave the session token-identical to a twin that ran [A, B] plainly,
+    and the committed response must equal the plain response."""
+    mk = lambda: LongSessionPlanner(
+        preset="test-tiny", mesh=sp_mesh(4), ctx_buckets=(2048,),
+        extend_buckets=(64,), max_new_tokens=200,
+    )
+    p1 = PlannerParser(mk(), max_new_tokens=200)
+    p2 = PlannerParser(mk(), max_new_tokens=200)
+
+    def turn(parser, text, **kw):
+        try:
+            return parser.parse(text, {}, session_id="s", **kw)
+        except Exception as e:  # truncation is legal for random weights
+            return e
+
+    ra_spec = turn(p1, "search for usb hubs", speculative=True)
+    ra_fin = turn(p1, "search for usb hubs")
+    rb1 = turn(p1, "scroll down")
+    ra_plain = turn(p2, "search for usb hubs")
+    rb2 = turn(p2, "scroll down")
+    if not isinstance(ra_spec, Exception):
+        assert ra_fin.model_dump() == ra_spec.model_dump()
+        assert ra_plain.model_dump() == ra_spec.model_dump()
+    if "s" in p1._sessions and "s" in p2._sessions:
+        assert list(p1._sessions["s"].ids) == list(p2._sessions["s"].ids)
+    if not isinstance(rb1, Exception) and not isinstance(rb2, Exception):
+        assert rb1.model_dump() == rb2.model_dump()
+
+
+def test_planner_http_speculative_now_200(planner_server):
+    """The /parse route accepts speculative requests for the planner
+    backend (two-phase turns replaced the round-4-early 409)."""
+    r = _parse_spec(planner_server, "search for usb hubs", "sp1", True)
+    assert r.status_code in (200, 422)
+    r2 = _parse_spec(planner_server, "search for usb hubs", "sp1", False)
+    assert r2.status_code in (200, 422)
+    if r.status_code == 200 and r2.status_code == 200:
+        assert r.json() == r2.json()
+
+
+def _parse_spec(srv, text, session_id, speculative):
+    return httpx.post(f"http://127.0.0.1:{srv.port}/parse",
+                      json={"text": text, "session_id": session_id,
+                            "context": {}, "speculative": speculative},
+                      timeout=300.0)
+
+
+def test_planner_speculative_commit_requires_same_context():
+    """A context_update between spec and final changes what the parse
+    should see: same TEXT with different CONTEXT must not deliver the
+    stale old-context plan — it rolls back and re-parses."""
+    planner = _CountingPlanner()
+    parser = PlannerParser(planner)
+    parser.parse("sort by price", {"page": 1}, session_id="c", speculative=True)
+    parser.parse("sort by price", {"page": 2}, session_id="c")
+    assert planner.plans == 2  # no stale commit
+    ref = PlannerParser(_CountingPlanner())
+    ref.parse("sort by price", {"page": 2}, session_id="c")
+    assert list(parser._sessions["c"].ids) == list(ref._sessions["c"].ids)
+
+
+def test_planner_failed_speculation_preserves_committed_history():
+    """A speculative turn that truncates (the likeliest failure: the
+    provisional transcript is a half-finished utterance) must NOT destroy
+    the session's committed turns — the snapshot restores and the matching
+    final re-parses from the clean transcript."""
+    import pytest as _pytest
+
+    from tpu_voice_agent.services.brain import ParserError
+
+    planner = _CountingPlanner()
+    parser = PlannerParser(planner)
+    parser.parse("first turn", {}, session_id="h")  # committed history
+    clean = list(parser._sessions["h"].ids)
+    planner.plan_text = '{"version":"1.0","int'  # truncation
+    with _pytest.raises(ParserError):
+        parser.parse("sort by price", {}, session_id="h", speculative=True)
+    # the session SURVIVED with its committed transcript intact
+    assert "h" in parser._sessions
+    assert list(parser._sessions["h"].ids) == clean
+    planner.plan_text = _PLAN_OK
+    r = parser.parse("sort by price", {}, session_id="h")
+    assert r.intents

@@ -117,9 +117,9 @@ class ParseRequest(BaseModel):
     # the voice service sets this when parsing a PROVISIONAL transcript
     # inside the endpoint's trailing-silence window (the final may yet
     # differ). Stateless parsers ignore it (parse is pure); session-keyed
-    # backends that would commit the turn to a transcript refuse with 409
-    # speculation_unsupported rather than record a turn that may be
-    # discarded.
+    # backends either run the turn two-phase (PlannerParser: snapshot +
+    # commit/rollback) or refuse with 409 speculation_unsupported rather
+    # than record a turn that may be discarded.
     speculative: bool = False
 
 

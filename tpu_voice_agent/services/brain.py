@@ -235,6 +235,7 @@ class PlannerParser:
 
     wants_session = True  # build_app passes ParseRequest.session_id through
     concurrent_safe = True  # build_app skips the global serialization lock
+    supports_speculation = True  # two-phase turns (snapshot + commit/rollback)
     max_sessions = 32
 
     def __init__(self, planner, max_new_tokens: int | None = None,
@@ -374,6 +375,20 @@ class PlannerParser:
             if victim is None:
                 break  # everything live is mid-turn; nothing evictable
             sess = self._sessions.pop(victim)
+            pend = getattr(sess, "pending_spec", None)
+            if pend is not None:
+                # evicting a session mid-speculation: undo the provisional
+                # turn (its snapshot shadow-pins a second cache — parking
+                # both would double the host copy, and the commit marker
+                # cannot survive a cold restart anyway)
+                sess.pending_spec = None
+                if pend["snap"] is None:
+                    # the session ONLY exists speculatively: drop it whole
+                    # (parking it would preserve a turn the matching final
+                    # would then record a second time)
+                    get_metrics().inc("planner.sessions_evicted")
+                    continue
+                self._restore(sess, pend["snap"])
             get_metrics().inc("planner.sessions_evicted")
             if 0 < self.planner.session_bytes(sess) <= self.park_budget_bytes or (
                 self.park_budget_bytes > 0 and self.planner.session_bytes(sess) == 0
@@ -404,11 +419,71 @@ class PlannerParser:
             self._parked.popitem(last=False)
             get_metrics().inc("planner.sessions_dropped")
 
-    def parse(self, text: str, context: dict, session_id: str | None = None) -> ParseResponse:
+    # ------------------------------------------------- speculative turns
+    #
+    # The voice service starts a /parse on the PROVISIONAL transcript while
+    # the endpoint window runs out. For stateless parsers that is free; a
+    # session-keyed planner COMMITS every turn, so speculation here is
+    # two-phase: the speculative turn runs normally but records an undo
+    # snapshot on the session. The matching final COMMITS (returns the
+    # cached response, zero decode); anything else ROLLS BACK the
+    # transcript first. Snapshots are host-side pointer copies — cache
+    # arrays are immutable jax values (extend/plan REPLACE sess.cache, the
+    # batched plan path even restores slot-0 K/V), so keeping the old refs
+    # costs no copy; the shadowed old cache stays alive at most one
+    # utterance window, and eviction rolls pending sessions back first.
+
+    @staticmethod
+    def _snapshot(sess) -> tuple:
+        return (list(sess.ids), sess.cache, sess.pos, sess.last_logits,
+                sess.anchors)
+
+    @staticmethod
+    def _restore(sess, snap) -> None:
+        sess.ids, sess.cache, sess.pos, sess.last_logits, sess.anchors = (
+            list(snap[0]), snap[1], snap[2], snap[3], snap[4])
+
+    def parse(self, text: str, context: dict, session_id: str | None = None,
+              speculative: bool = False) -> ParseResponse:
+        from ..utils import get_metrics
+
         user = json.dumps({"text": text, "context": context}, separators=(",", ":"))
         sess, lock = self._checkout(session_id)
         keep = None
         try:
+            pend = getattr(sess, "pending_spec", None) if sess is not None else None
+            if pend is not None:
+                sess.pending_spec = None
+                if not speculative and pend["user"] == user:
+                    # commit: the speculative turn IS this turn (same text
+                    # AND same context — a context_update between spec and
+                    # final must NOT deliver the old-context plan) — the
+                    # session already carries it; deliver without decoding
+                    get_metrics().inc("planner.spec_commits")
+                    keep = sess
+                    return pend["resp"]
+                # superseded (speaker resumed / context changed): undo the
+                # provisional turn before handling the real one
+                get_metrics().inc("planner.spec_rollbacks")
+                if pend["snap"] is None:
+                    sess = None  # the session only existed speculatively
+                else:
+                    self._restore(sess, pend["snap"])
+            snap = self._snapshot(sess) if (speculative and sess is not None) else None
+
+            def fail(kind: str, detail: str, cause=None):
+                # a FAILED speculative turn must never cost committed
+                # history: restore the undo snapshot and keep the session
+                # (the matching final re-parses from the clean transcript).
+                # Failed REAL turns keep the pre-speculation semantics —
+                # the session drops, because its transcript and cache may
+                # be out of sync / end in malformed half-JSON.
+                nonlocal keep, sess
+                if speculative and snap is not None:
+                    self._restore(sess, snap)
+                    keep = sess
+                raise ParserError(kind, detail) from cause
+
             try:
                 if sess is None:
                     sess = self.planner.start(render_prompt(text, context))
@@ -416,16 +491,13 @@ class PlannerParser:
                     self.planner.extend(sess, f"\n<|user|>\n{user}\n<|assistant|>\n")
                 out_text, _ = self._gather.plan(sess, self.max_new_tokens)
             except ValueError as e:
-                # the session is dropped (not re-stored): a failed extend /
-                # re-anchor leaves transcript and cache out of sync, so the
-                # next turn on this session_id cold-starts cleanly instead
-                raise ParserError("llm_error", str(e)) from e
+                fail("llm_error", str(e), e)
             model, err = parse_response_from_json(out_text)
             if model is None:
-                # truncation (token budget before EOS): drop the session too
-                # — its transcript now ends in malformed half-JSON that
-                # would poison every later turn
-                raise ParserError("schema_validation_failed", err or "invalid")
+                # truncation (token budget before EOS)
+                fail("schema_validation_failed", err or "invalid")
+            if speculative and session_id is not None:
+                sess.pending_spec = {"user": user, "resp": model, "snap": snap}
             keep = sess
             return model
         finally:
@@ -552,9 +624,15 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
                 return parser.parse(*args)
 
     wants_session = getattr(parser, "wants_session", False)
+    # stateless parsers are trivially speculation-safe (parse is pure);
+    # session-keyed ones must OPT IN with two-phase turns (PlannerParser)
+    spec_ok = getattr(parser, "supports_speculation", not wants_session)
 
     def do_parse(preq: ParseRequest) -> ParseResponse:
         if wants_session:
+            if spec_ok:
+                return locked_parse(preq.text, preq.context, preq.session_id,
+                                    preq.speculative)
             return locked_parse(preq.text, preq.context, preq.session_id)
         return locked_parse(preq.text, preq.context)
 
@@ -583,11 +661,11 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
                 {"error": "invalid_request", "detail": str(e)[:500]},
                 status=400, headers=headers,
             )
-        if preq.speculative and wants_session:
-            # a session-keyed backend (PlannerParser) COMMITS every turn to
-            # the session transcript; a speculative turn that the endpoint
-            # later revises would poison the session history. Refuse fast —
-            # the voice service falls back to parsing at final time.
+        if preq.speculative and not spec_ok:
+            # a session-keyed backend that COMMITS every turn cannot parse
+            # a transcript the endpoint may still revise. Refuse fast — the
+            # voice service falls back to parsing at final time. (The
+            # PlannerParser opts in via two-phase commit/rollback turns.)
             return web.json_response(
                 {"error": "speculation_unsupported",
                  "detail": "session-keyed backend commits turns; parse at final"},
