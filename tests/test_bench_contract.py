@@ -1,0 +1,41 @@
+"""The driver contract: ``python bench.py`` must ALWAYS land one parseable
+JSON row on stdout (round-2 recorded nothing because the process died;
+round-3's row only existed thanks to the CPU re-exec watchdog). This test
+runs the real bench as a subprocess the way the driver does and pins the
+row's schema, so a bench regression fails CI instead of a round capture."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.mark.slow
+def test_bench_emits_one_parseable_row():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # never touch the (flaky) tunnel from CI
+    # reuse the suite's compile cache (bench.py doesn't set one itself) so
+    # warm runs of this check cost minutes less
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", str(ROOT / ".jax_cache"))
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py")], cwd=ROOT, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be exactly ONE JSON row: {lines}"
+    row = json.loads(lines[0])
+    assert row["metric"] == "voice_to_intent_p50_e2e"
+    assert row["unit"] == "ms"
+    assert row["value"] > 0
+    assert row["vs_baseline"] > 0
+    assert row["backend"] in ("cpu", "tpu")
+    assert 0.0 <= row["spec_hit_rate"] <= 1.0
+    # the stderr narrative carries the breakdown the JSON can't
+    assert "e2e p50" in proc.stderr
