@@ -298,3 +298,86 @@ def test_speculation_sticky_off_against_session_keyed_brain(tmp_path):
     finally:
         for srv in (voice, executor, brain):
             srv.__exit__(None, None, None)
+
+
+def test_speculation_commits_on_session_keyed_planner_brain(tmp_path):
+    """Full-stack closure of the endpoint-window win on the PLANNER brain:
+    spec_final starts a speculative /parse that the planner records
+    two-phase; the matching transcript_final COMMITS it (zero extra plan
+    decode) and the intent is delivered. One WS, three real services."""
+    from tpu_voice_agent.services.brain import PlannerParser
+    from tpu_voice_agent.utils import get_metrics
+
+    class OneShotPlanner:
+        """Deterministic stub planner (same seam as test_brain_planner)."""
+
+        max_new_tokens = 64
+        PLAN = (
+            '{"version":"1.0","intents":[{"type":"scroll","target":null,'
+            '"args":{"direction":"down"},"priority":1,'
+            '"requires_confirmation":false,"timeout_ms":15000,"retries":0}],'
+            '"context_updates":{},"confidence":0.9,"tts_summary":"ok",'
+            '"follow_up_question":null}'
+        )
+
+        def __init__(self):
+            self.plans = 0
+
+        def start(self, text):
+            from types import SimpleNamespace
+
+            return SimpleNamespace(ids=list(range(4)), pos=4, anchors=1,
+                                   last_logits=object(), cache=None)
+
+        def extend(self, sess, text):
+            sess.ids.extend([7] * 2)
+
+        def plan_many(self, sessions, max_new_tokens=None, **kw):
+            self.plans += len(sessions)
+            for s in sessions:
+                s.ids.extend([9] * 3)
+            return [(self.PLAN, [9] * 3) for _ in sessions]
+
+        def session_bytes(self, sess):
+            return 0
+
+        def park(self, sess):
+            pass
+
+        def unpark(self, sess):
+            pass
+
+        def parked_bytes(self, sess):
+            return 0
+
+    planner = OneShotPlanner()
+    brain = AppServer(build_brain(PlannerParser(planner))).__enter__()
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    scripted = [("spec_final", "scroll down"), ("final", "scroll down")]
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url,
+                                stt_factory=lambda: NullSTT(scripted=list(scripted))))
+    ).__enter__()
+    try:
+        commits0 = get_metrics().snapshot()["counters"].get(
+            "planner.spec_commits", 0)
+        events = ws_session(
+            voice.url,
+            [("binary", PCM_SILENCE), ("binary", PCM_SILENCE)],
+            ["execution_result"],
+        )
+        intent_ev = next(e for e in events if e["type"] == "intent")
+        assert intent_ev["data"]["intents"][0]["type"] == "scroll"
+        # ONE plan decode total: the final committed the speculative turn
+        assert planner.plans == 1
+        commits = get_metrics().snapshot()["counters"].get(
+            "planner.spec_commits", 0)
+        assert commits - commits0 == 1
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)

@@ -686,9 +686,16 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
                 {"error": "llm_error", "detail": str(e)[:500]}, status=500,
                 headers={"x-trace-id": trace_id},
             )
-        return web.json_response(
-            resp.model_dump(), headers={"x-trace-id": trace_id}
-        )
+        ok_headers = {"x-trace-id": trace_id}
+        # (speculative implies spec_ok here — the 409 gate already fired)
+        if preq.speculative and wants_session and preq.session_id:
+            # this turn is PENDING on the session (two-phase): the caller
+            # must send the matching non-speculative parse to COMMIT it
+            # (zero decode — the cached response comes back), or the next
+            # turn rolls it back. The voice service routes its endpoint
+            # confirmation through exactly that commit when it sees this.
+            ok_headers["x-speculation-pending"] = "1"
+        return web.json_response(resp.model_dump(), headers=ok_headers)
 
 
     app.router.add_get("/health", health)

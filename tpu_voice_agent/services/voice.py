@@ -180,7 +180,18 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                     maybe = await task
                 except Exception:
                     maybe = None
-                if maybe is not None and maybe.status_code == 200:
+                if (maybe is not None and maybe.status_code == 200
+                        and maybe.headers.get("x-speculation-pending") == "1"):
+                    # two-phase backend: the speculative turn is PENDING on
+                    # the server session — fall through to the normal parse,
+                    # which COMMITS it (zero decode, the cached plan comes
+                    # back; one local roundtrip, no model latency). Using
+                    # the speculative body directly would leave the pending
+                    # marker set and the NEXT turn would roll back a plan
+                    # we already delivered.
+                    get_metrics().inc("voice.spec_parse_hit")
+                    get_metrics().inc("voice.spec_parse_commit")
+                elif maybe is not None and maybe.status_code == 200:
                     r = maybe
                     get_metrics().inc("voice.spec_parse_hit")
                 elif maybe is not None and maybe.status_code == 409:
