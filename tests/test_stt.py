@@ -183,3 +183,127 @@ def test_spec_final_event_precedes_and_matches_confirmed_final(engine):
     assert specs, "a long closing pause must fire the speculation event"
     assert specs[-1] == finals[0]
     assert kinds.index("spec_final") < kinds.index("final")
+
+
+def test_early_close_fires_before_the_window(engine):
+    """VERDICT round-4 next #9: once the speculative parse is reported
+    grammar-complete and the transcript stays stable, the utterance closes
+    at early_close_ms instead of waiting out the full trailing window."""
+    ep = EnergyEndpointer(trailing_silence_ms=600, min_speech_ms=100)
+    stt = StreamingSTT(engine, partial_interval_s=60.0, endpointer=ep,
+                       early_close_ms=400.0)
+    stt.feed(tone(300, 0.5))
+    frame = 16_000 * 60 // 1000
+    events, final_at = [], None
+    for j in range(0, 1200, 60):
+        for ev in stt.feed(np.zeros(frame, dtype=np.float32)):
+            events.append(ev)
+            if ev[0] == "spec_final":
+                stt.parse_complete(ev[1])  # consumer: parse done, complete
+        if any(k == "final" for k, _ in events):
+            final_at = j + 60
+            break
+    specs = [t for k, t in events if k == "spec_final"]
+    finals = [t for k, t in events if k == "final"]
+    assert specs and finals
+    assert finals[0] == specs[-1]  # the speculation is delivered, not redone
+    # closed at ~420-480 ms of silence — far inside the 600 ms window
+    assert final_at is not None and final_at < 540
+    assert stt.early_closes == 1 and stt.window_closes == 0
+
+
+def test_early_close_needs_the_parse_completion(engine):
+    """No parse_complete notification -> the full window applies (the knob
+    is armed but inert for consumers that never speculate)."""
+    ep = EnergyEndpointer(trailing_silence_ms=600, min_speech_ms=100)
+    stt = StreamingSTT(engine, partial_interval_s=60.0, endpointer=ep,
+                       early_close_ms=400.0)
+    stt.feed(tone(300, 0.5))
+    frame = 16_000 * 60 // 1000
+    final_at = None
+    for j in range(0, 1200, 60):
+        if any(k == "final" for k, _ in stt.feed(np.zeros(frame, dtype=np.float32))):
+            final_at = j + 60
+            break
+    assert final_at is not None and final_at >= 600
+    assert stt.early_closes == 0 and stt.window_closes == 1
+
+
+def test_early_close_stale_notification_is_inert(engine):
+    """A parse_complete for some OTHER text (raced transcript revision)
+    must never close the utterance early."""
+    ep = EnergyEndpointer(trailing_silence_ms=600, min_speech_ms=100)
+    stt = StreamingSTT(engine, partial_interval_s=60.0, endpointer=ep,
+                       early_close_ms=400.0)
+    stt.feed(tone(300, 0.5))
+    stt.parse_complete("completely different transcript")
+    frame = 16_000 * 60 // 1000
+    final_at = None
+    for j in range(0, 1200, 60):
+        if any(k == "final" for k, _ in stt.feed(np.zeros(frame, dtype=np.float32))):
+            final_at = j + 60
+            break
+    assert final_at is not None and final_at >= 600
+    assert stt.early_closes == 0 and stt.window_closes == 1
+
+
+def test_early_close_resumed_speech_rearms(engine):
+    """Speech resuming between the speculation and the early-close point
+    invalidates everything: no early close, and the delivered final equals
+    the direct transcription of the FULL buffer (same exactness contract as
+    test_speculative_final_stays_exact_after_resumed_speech)."""
+    ep = EnergyEndpointer(trailing_silence_ms=600, min_speech_ms=100)
+    stt = StreamingSTT(engine, partial_interval_s=60.0, endpointer=ep,
+                       early_close_ms=400.0)
+    frame = 16_000 * 60 // 1000
+    events = []
+    events += stt.feed(tone(300, 0.5))
+    for _ in range(6):  # 360 ms pause: spec fires (300 ms), close (400) not yet
+        for ev in stt.feed(np.zeros(frame, dtype=np.float32)):
+            events.append(ev)
+            if ev[0] == "spec_final":
+                stt.parse_complete(ev[1])
+    assert not any(k == "final" for k, _ in events)
+    events += stt.feed(tone(420, 0.4))  # resume: speculation + notify stale
+    silence_ms = 0
+    for _ in range(20):
+        new = stt.feed(np.zeros(frame, dtype=np.float32))
+        silence_ms += 60
+        # do NOT notify parse_complete for the new speculation: the final
+        # must come from the full window
+        events += new
+        if any(k == "final" for k, _ in new):
+            break
+    finals = [t for k, t in events if k == "final"]
+    assert finals and silence_ms >= 600
+    assert stt.early_closes == 0 and stt.window_closes == 1
+
+
+def test_early_close_disabled_with_none(engine):
+    ep = EnergyEndpointer(trailing_silence_ms=600, min_speech_ms=100)
+    stt = StreamingSTT(engine, partial_interval_s=60.0, endpointer=ep,
+                       early_close_ms=None)
+    stt.feed(tone(300, 0.5))
+    frame = 16_000 * 60 // 1000
+    final_at = None
+    for j in range(0, 1200, 60):
+        for ev in stt.feed(np.zeros(frame, dtype=np.float32)):
+            if ev[0] == "spec_final":
+                stt.parse_complete(ev[1])
+            if ev[0] == "final":
+                final_at = j + 60
+        if final_at:
+            break
+    assert final_at is not None and final_at >= 600
+    assert stt.early_closes == 0 and stt.window_closes == 1
+
+
+def test_endpointer_force_end_respects_min_speech():
+    ep = EnergyEndpointer(trailing_silence_ms=600, min_speech_ms=200)
+    assert not ep.force_end()  # nothing to close
+    ep.feed(tone(440, 0.08))  # 80 ms < min_speech 200 ms
+    assert not ep.force_end()  # blip guard applies to early closes too
+    assert ep.in_speech  # untouched
+    ep.feed(tone(440, 0.3))
+    assert ep.force_end()
+    assert not ep.in_speech

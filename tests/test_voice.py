@@ -300,6 +300,97 @@ def test_speculation_sticky_off_against_session_keyed_brain(tmp_path):
             srv.__exit__(None, None, None)
 
 
+def test_speculation_latch_reprobes_after_n_skips(tmp_path, monkeypatch):
+    """The sticky 409 latch is not app-lifetime (round-4 advisor finding):
+    after VOICE_RESPEC_AFTER skipped utterances one speculation re-probes,
+    so a brain restarted into a speculation-capable backend recovers
+    without a voice restart."""
+    monkeypatch.setenv("VOICE_RESPEC_AFTER", "2")
+    rule = RuleBasedParser()
+
+    class SessionParser:
+        wants_session = True
+
+        def parse(self, text, context, session_id=None):
+            return rule.parse(text, context)
+
+    brain = AppServer(build_brain(SessionParser())).__enter__()
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    # 5 utterances: spec #1 latches; #2 and #3 skip; #4 re-probes (409
+    # latches again); #5 skips. => exactly 2 speculative attempts.
+    scripted = []
+    for i in range(5):
+        scripted += [("spec_final", f"scroll down"), ("final", "scroll down")]
+
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url,
+                                stt_factory=lambda: NullSTT(scripted=list(scripted))))
+    ).__enter__()
+    try:
+        from tpu_voice_agent.utils import get_metrics
+
+        started0 = get_metrics().snapshot()["counters"].get(
+            "voice.spec_parse_started", 0)
+        ws_session(voice.url, [("binary", PCM_SILENCE)] * 10,
+                   ["__never__"], timeout_s=8)
+        started = get_metrics().snapshot()["counters"].get(
+            "voice.spec_parse_started", 0)
+        assert started - started0 == 2
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)
+
+
+def test_transient_409_does_not_latch(tmp_path):
+    """A 409 whose body is NOT the brain's speculation_unsupported refusal
+    (a proxy, a restarting upstream) must not permanently disable
+    speculation (round-4 advisor finding)."""
+    from aiohttp import web
+
+    calls = {"spec": 0}
+    rule = RuleBasedParser()
+
+    async def parse(request):
+        body = await request.json()
+        if body.get("speculative"):
+            calls["spec"] += 1
+            return web.json_response({"error": "upstream_restarting"},
+                                     status=409)
+        res = rule.parse(body["text"], body.get("context") or {})
+        return web.json_response(json.loads(res.model_dump_json()))
+
+    app = web.Application()
+    app.router.add_post("/parse", parse)
+    brain = AppServer(app).__enter__()
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    scripted = [
+        ("spec_final", "scroll down"), ("final", "scroll down"),
+        ("spec_final", "scroll down"), ("final", "scroll down"),
+    ]
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url,
+                                stt_factory=lambda: NullSTT(scripted=list(scripted))))
+    ).__enter__()
+    try:
+        ws_session(voice.url, [("binary", PCM_SILENCE)] * 4,
+                   ["__never__"], timeout_s=8)
+        # BOTH utterances attempted speculation: no latch on a foreign 409
+        assert calls["spec"] == 2
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)
+
+
 def test_speculation_commits_on_session_keyed_planner_brain(tmp_path):
     """Full-stack closure of the endpoint-window win on the PLANNER brain:
     spec_final starts a speculative /parse that the planner records

@@ -5,6 +5,13 @@ The reference adds a fixed 1000 ms debounce to EVERY command
 pipeline. This endpointer closes an utterance after `trailing_silence_ms` of
 sub-threshold energy instead, typically clawing back 600-700 ms. A model-free
 adaptive noise floor keeps it robust to mic gain differences.
+
+Round 5 makes the window itself adaptive (VERDICT round-4 next #9: the
+fixed 350 ms window had become 97% of the measured CPU e2e): the consumer
+(StreamingSTT) may close the utterance EARLY via ``force_end`` once its
+own evidence — a speculative transcript stable across consecutive silent
+frames AND a grammar-complete speculative parse — says the command is
+over. The endpointer stays model-free; the policy lives in the caller.
 """
 
 from __future__ import annotations
@@ -20,10 +27,19 @@ class EnergyEndpointer:
         trailing_silence_ms: int = 350,
         min_speech_ms: int = 200,
         threshold_mult: float = 3.0,
+        spec_silence_ms: int | None = None,
     ):
         self.sr = sample_rate
+        self.frame_ms = frame_ms
         self.frame = int(sample_rate * frame_ms / 1000)
         self.trailing_frames = max(1, trailing_silence_ms // frame_ms)
+        # silence needed before the speculative final fires; default half
+        # the closing window (the round-3 tuning). Lower = speculate more
+        # eagerly (more wasted transcribes on inter-word gaps, but the
+        # adaptive early close can then land sooner)
+        self.spec_frames = (max(1, spec_silence_ms // frame_ms)
+                            if spec_silence_ms is not None
+                            else max(1, self.trailing_frames // 2))
         self.min_speech_frames = max(1, min_speech_ms // frame_ms)
         self.threshold_mult = threshold_mult
         self.noise_floor = 1e-4
@@ -44,15 +60,36 @@ class EnergyEndpointer:
 
     @property
     def in_trailing_silence(self) -> bool:
-        """Mid-utterance silence long enough (half the closing window,
-        175 ms at defaults) that the utterance content is plausibly frozen —
-        the cue for StreamingSTT to compute the final transcription
-        speculatively. The threshold trades wasted speculations against
-        hidden latency: inter-word gaps (< ~150 ms) never fire, a long
-        inter-phrase pause may fire one discarded transcribe, and on the
-        true final pause the transcription still overlaps most of the
-        remaining confirmation window."""
-        return self.in_speech and self._silence_run >= max(1, self.trailing_frames // 2)
+        """Mid-utterance silence long enough (``spec_frames``; half the
+        closing window, 175 ms, at defaults) that the utterance content is
+        plausibly frozen — the cue for StreamingSTT to compute the final
+        transcription speculatively. The threshold trades wasted
+        speculations against hidden latency: at the default, inter-word
+        gaps (< ~150 ms) never fire; a lower ``spec_silence_ms`` may fire a
+        discarded transcribe per inter-phrase pause but lets the adaptive
+        early close land sooner."""
+        return self.in_speech and self._silence_run >= self.spec_frames
+
+    @property
+    def silence_run_ms(self) -> float:
+        """Current mid-utterance silence run, for caller-side policies."""
+        return self._silence_run * self.frame_ms
+
+    def force_end(self) -> bool:
+        """Close the current utterance NOW (adaptive early endpoint).
+
+        The caller — not the endpointer — owns the evidence that the
+        utterance is over (stable speculative transcript + grammar-complete
+        parse); this just performs the same state turnover a natural window
+        expiry would. Returns False (and changes nothing) when there is no
+        utterance to close or it is still below ``min_speech_ms`` (the blip
+        guard applies to early closes too)."""
+        if not self.in_speech or self._speech_frames < self.min_speech_frames:
+            return False
+        self.in_speech = False
+        self._speech_frames = 0
+        self._silence_run = 0
+        return True
 
     def feed(self, samples: np.ndarray) -> bool:
         """Feed float32 samples; True when an utterance just ended."""

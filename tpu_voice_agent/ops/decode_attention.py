@@ -167,7 +167,13 @@ def decode_attention_layer(
     the BlockSpec index map. The per-layer ``cache[li]`` slice a scan body
     would otherwise materialize for the kernel is a full-plane HBM copy per
     layer per token — this kernel makes the decode loop's cache traffic the
-    attended keys only."""
+    attended keys only.
+
+    Cache-length contract: S must be divisible by some block >= 32 (16-wide
+    k-tiles waste the TPU's (8,128) lane tiling, so the fallback chain
+    stops at 32 and raises instead). The in-tree engines already bucket
+    cache capacity to powers of two; external callers must size S
+    accordingly — e.g. 96 works (block 32), 80 does not."""
     B, nq, hd = q.shape
     S, nkv = k_cache.shape[2], k_cache.shape[3]
     assert nq % nkv == 0
@@ -483,7 +489,10 @@ def decode_block_attention_layer(
 ) -> jax.Array:
     """decode_block_attention reading one layer's plane of the stacked cache
     via scalar prefetch (same rationale as decode_attention_layer: slicing
-    cache[li] in the scan body materializes a full-plane copy per layer)."""
+    cache[li] in the scan body materializes a full-plane copy per layer).
+
+    Same cache-length contract as decode_attention_layer: S divisible by a
+    block >= 32, or ValueError — size caches to power-of-two buckets."""
     B, T, nq, hd = q.shape
     S, nkv = k_cache.shape[2], k_cache.shape[3]
     assert nq % nkv == 0

@@ -469,6 +469,17 @@ class PagedDecodeEngine(DecodeEngine):
     def decode_chunk(self, cur, pos, fsm, active, nbytes, tokens_left, key,
                      temperature: float, byte_budget: int, chunk_steps: int,
                      greedy: bool):
+        """One dispatch of up to ``chunk_steps`` constrained decode steps.
+
+        CALLER OBLIGATION: after consuming the chunk's results, pass the
+        returned ``pos`` (host-fetched) to ``reconcile_coverage``. The
+        worst-case (1+W)x-per-step block claim below is only clamped back
+        to the actual frontier by that hook; a driver that skips it
+        compounds the claim toward max_len per slot — recreating the dense
+        footprint this engine exists to avoid. (The clamp cannot live here:
+        ``pos`` is a device array mid-async-dispatch, and a host read at
+        this point would stall the chain — ContinuousBatcher reconciles
+        from the host copy it fetches anyway.)"""
         # a fast-forward chunk can emit up to (1+W) tokens per step — the
         # table must cover the worst case BEFORE dispatch (a mid-chunk
         # write past the covered blocks would scribble on the pool). The

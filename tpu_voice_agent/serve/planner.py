@@ -71,12 +71,15 @@ class LongSessionPlanner:
         extend_buckets: tuple[int, ...] = (32, 128, 512),
         max_new_tokens: int = 256,
         kernels: str = "xla",
-        fast_forward: int = 0,  # grammar forced-chain width for B=1 plans.
+        fast_forward: int = 0,  # grammar forced-chain width.
         # OFF by default: ff emits the canonical tokenization of forced
         # byte runs, which changes the model-visible token history and can
         # legitimately diverge from the T=1 path at later free choices —
         # enabling it trades the plan()/plan_many token-identity property
-        # for single-session latency (batched groups always keep T=1)
+        # for latency. With kernels="pallas" the (1+W) step rides the
+        # frontier-read block kernel at ANY batch width, so batched groups
+        # fast-forward too; under kernels="xla" batched groups stay T=1
+        # (the XLA fallback would re-read every row's full cache per step)
     ):
         if mesh is None or "sp" not in mesh.shape:
             raise ValueError("LongSessionPlanner needs a mesh with an 'sp' axis")
@@ -295,11 +298,16 @@ class LongSessionPlanner:
             # (tiny: (L, Bp, nkv, hd)) and restore it after the loop.
             slot0_k = cache["k"][:, :, 0]
             slot0_v = cache["v"][:, :, 0]
-            # fast-forward only at Bp == 1: a (1+W)-token step at batch
-            # width would re-read every row's cache through the XLA
-            # attention fallback (same policy as the engine batcher)
+            # fast-forward at batch width rides the Pallas frontier-read
+            # block kernel (the round-4 lift that removed the engine
+            # batcher's Bp==1 restriction, ops/decode_attention.py). Under
+            # kernels="xla" the (1+W)-token step would re-read every row's
+            # full cache through the XLA attention fallback, so batched
+            # groups there still decode one token per step.
+            batched_ff_ok = Bp == 1 or self.kernels == "pallas"
             tables = (self.tables_ff
-                      if Bp == 1 and self.tables_ff is not None else self.tables)
+                      if batched_ff_ok and self.tables_ff is not None
+                      else self.tables)
             buf, count, eos, cache, cur, pos, _, _, _, _, _ = chunk_decode_loop(
                 self.params, self.cfg, cache,
                 tok0, pos0, fsm0,

@@ -153,6 +153,9 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
 
 def main() -> None:
     load_env_cascade()
+    from ...utils.devinit import pin_platform_from_env
+
+    pin_platform_from_env()  # JAX_PLATFORMS=cpu must beat the axon plugin
     from .summarize import make_summarizer_from_env
 
     port = int(os.environ.get("EXECUTOR_PORT", "7081"))

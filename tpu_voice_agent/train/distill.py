@@ -409,6 +409,173 @@ WHISPER_EVAL_TEXTS = [
 ]
 
 
+def render_speech_jittered(text: str, rng: np.random.Generator,
+                           sr: int = 16_000) -> np.ndarray:
+    """Augmented render: tempo (char duration), amplitude, and additive
+    noise vary per call — the variation that forces the encoder to learn
+    the char->chord mapping instead of memorizing waveforms (round-4's
+    held-out attempt failed at WER 0.96 on 10 clean training sentences)."""
+    char_ms = int(rng.uniform(48, 72))
+    amp = float(rng.uniform(0.55, 1.1))
+    audio = render_speech(text, sr=sr, char_ms=char_ms) * amp
+    noise = rng.normal(0.0, rng.uniform(0.002, 0.02), len(audio))
+    return (audio + noise).astype(np.float32)
+
+
+def whisper_train_sentences(n: int = 240, seed: int = 7) -> list[str]:
+    """Deterministic synthetic command bank, sentence-disjoint from
+    WHISPER_EVAL_TEXTS (asserted). Word overlap with the eval set is
+    deliberate — the unit being generalized is the acoustic font's
+    char->chord code, and held-out SENTENCES prove the decoder is reading
+    the audio rather than reciting a memorized training line."""
+    verbs = ["search", "look", "find", "open", "click", "press", "scroll",
+             "go", "sort", "filter", "upload", "extract", "close", "cancel",
+             "take", "submit", "select", "type", "show", "read"]
+    nouns = ["shoes", "laptops", "headphones", "cameras", "books", "jackets",
+             "phones", "bags", "watches", "chairs", "links", "buttons",
+             "forms", "pages", "results", "images", "prices", "tables",
+             "resume", "screenshot", "menu", "cart", "reviews", "filters"]
+    adjs = ["red", "blue", "green", "black", "white", "cheap", "new", "big",
+            "small", "wireless", "leather", "second", "last", "top", "old"]
+    templates = [
+        "{v} for {a} {n}", "{v} the {n}", "{v} {n}", "{v} the {a} {n}",
+        "{a} {n}", "{v} for {n}", "{v} up", "{v} down", "{v} back",
+        "{v} that now", "{v} the {n} now", "{v} my {n}",
+    ]
+    rng = np.random.default_rng(seed)
+    eval_set = set(WHISPER_EVAL_TEXTS)
+    out: list[str] = []
+    seen: set[str] = set()
+    while len(out) < n:
+        t = templates[int(rng.integers(len(templates)))]
+        s = t.format(v=verbs[int(rng.integers(len(verbs)))],
+                     n=nouns[int(rng.integers(len(nouns)))],
+                     a=adjs[int(rng.integers(len(adjs)))])
+        # bucket budget: 200 mel frames = 2 s = 33 chars at 60 ms/char,
+        # and the tempo jitter reaches 72 ms/char -> cap at 27
+        if s in seen or s in eval_set or len(s) > 27:
+            continue
+        seen.add(s)
+        out.append(s)
+    assert not set(out) & eval_set
+    return out
+
+
+def train_whisper_generalize(
+    steps: int = 4000,
+    batch: int = 24,
+    variants: int = 3,
+    n_sentences: int = 240,
+    lr: float = 2e-3,
+    seed: int = 0,
+    log=None,
+):
+    """Train whisper-test to READ the acoustic font: a 240-sentence
+    synthetic command bank with tempo/amplitude/noise augmentation
+    (render_speech_jittered), with WHISPER_EVAL_TEXTS held out entirely
+    (VERDICT round-4 next #3 — the committed overfit checkpoint's 0.0 WER
+    is a train-set number and is now labeled as such). Returns
+    (cfg, params, stats); score held-out WER via whisper_engine_from.
+
+    Reference parity note: this stands in for Deepgram transcribing speech
+    it was never trained on (apps/voice/src/deepgram.ts:33-45), at the
+    scale this zero-egress image permits."""
+    import optax
+
+    from ..audio.mel import MelConfig, log_mel_spectrogram
+    from ..grammar.intent_grammar import default_tokenizer
+    from ..models.whisper import (
+        PRESETS as WPRESETS,
+        compute_cross_kv,
+        decoder_forward,
+        encoder_forward,
+        init_params,
+        init_self_cache,
+    )
+
+    texts = whisper_train_sentences(n_sentences)
+    tokenizer = default_tokenizer()
+    base = WPRESETS["whisper-test"]
+    cfg = replace(base, vocab_size=tokenizer.vocab_size)
+    mel_cfg = MelConfig(n_mels=cfg.n_mels)
+    bucket = cfg.max_audio_frames
+    rng = np.random.default_rng(seed)
+
+    # ---- precompute augmented mel variants (the mel front-end is fixed;
+    # only the waveforms vary). R = n_sentences * variants rows.
+    mel_fn = jax.jit(partial(log_mel_spectrogram, cfg=mel_cfg))
+    rows_mel, rows_valid, rows_sent = [], [], []
+    for si, text in enumerate(texts):
+        for _ in range(variants):
+            audio = render_speech_jittered(text, rng)
+            n_frames = min(max(1, len(audio) // mel_cfg.hop), bucket)
+            padded = np.zeros(bucket * mel_cfg.hop, dtype=np.float32)
+            padded[: len(audio)] = audio[: len(padded)]
+            rows_mel.append(np.asarray(mel_fn(jnp.asarray(padded)))[:bucket])
+            v = np.zeros(bucket // 2, bool)
+            v[: max(1, n_frames // 2)] = True
+            rows_valid.append(v)
+            rows_sent.append(si)
+    mel_all = np.stack(rows_mel)
+    valid_all = np.stack(rows_valid)
+    sent_all = np.asarray(rows_sent)
+
+    ids_rows = [tokenizer.encode(t, bos=True) + [tokenizer.eos_id] for t in texts]
+    max_text = max(len(r) for r in ids_rows)
+    toks_all = np.full((len(texts), max_text), tokenizer.pad_id, np.int32)
+    mask_all = np.zeros((len(texts), max_text), np.float32)
+    for i, ids in enumerate(ids_rows):
+        toks_all[i, : len(ids)] = ids
+        mask_all[i, 1: len(ids)] = 1.0
+
+    params = jax.jit(partial(init_params, cfg, dtype=jnp.float32))(
+        jax.random.PRNGKey(seed))
+    sched = optax.cosine_decay_schedule(lr, steps, alpha=0.05)
+    optimizer = optax.adamw(sched, weight_decay=0.01)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, mel_j, valid_j, toks_j, mask_j):
+        B = mel_j.shape[0]
+        enc = encoder_forward(params, cfg, mel_j)
+        ckv = compute_cross_kv(params, cfg, enc)
+        cache = init_self_cache(cfg, B, dtype=jnp.float32)
+        T = toks_j.shape[1]
+        pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+        logits, _ = decoder_forward(params, cfg, toks_j, pos, cache, ckv, valid_j)
+        logp = jax.nn.log_softmax(logits[:, :-1, :].astype(jnp.float32), axis=-1)
+        tgt = toks_j[:, 1:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        m = mask_j[:, 1:]
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+    @jax.jit
+    def step_fn(params, opt_state, mel_j, valid_j, toks_j, mask_j):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, mel_j, valid_j, toks_j, mask_j)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    t0 = time.perf_counter()
+    first = ema = None
+    R = mel_all.shape[0]
+    for s in range(steps):
+        pick = rng.choice(R, size=batch, replace=False)
+        si = sent_all[pick]
+        params, opt_state, loss = step_fn(
+            params, opt_state,
+            jnp.asarray(mel_all[pick]), jnp.asarray(valid_all[pick]),
+            jnp.asarray(toks_all[si]), jnp.asarray(mask_all[si]))
+        lf = float(loss)
+        first = lf if first is None else first
+        ema = lf if ema is None else 0.98 * ema + 0.02 * lf
+        if log and (s % 200 == 0 or s == steps - 1):
+            log(f"whisper-gen step {s}/{steps} loss {lf:.4f} (ema {ema:.4f})")
+    stats = {"steps": steps, "sentences": len(texts), "variants": variants,
+             "first_loss": first, "final_loss_ema": round(ema, 4),
+             "train_s": round(time.perf_counter() - t0, 1)}
+    return cfg, params, stats
+
+
 def train_whisper_overfit(
     texts: list[str] | None = None,
     steps: int = 500,
@@ -519,6 +686,7 @@ def whisper_engine_from(cfg, params):
 
 INTENT_CKPT = "intent-tiny-distilled"
 WHISPER_CKPT = "whisper-tiny-overfit"
+WHISPER_GEN_CKPT = "whisper-tiny-heldout"
 
 
 def save_ckpt(root: str, name: str, cfg, params, stats: dict) -> str:
@@ -533,6 +701,17 @@ def save_ckpt(root: str, name: str, cfg, params, stats: dict) -> str:
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1, default=str)
     return path
+
+
+def load_ckpt_path(path: str, cfg_cls):
+    """load_ckpt over a single path string (service env specs like
+    ``BRAIN_BACKEND=distilled:<dir>``). A bare name resolves against the
+    CWD — NOT silently under checkpoints/ — so the error a caller prints
+    names a path that was actually checked."""
+    import os
+
+    root, name = os.path.split(path.rstrip("/"))
+    return load_ckpt(root or ".", name, cfg_cls)
 
 
 def load_ckpt(root: str, name: str, cfg_cls):
