@@ -20,6 +20,55 @@ sys.path.insert(0, str(Path(__file__).parent))
 from common import emit, log, on_tpu  # noqa: E402
 
 
+def plan_token_budget() -> int:
+    """Measure the ACTUAL token-length distribution of intent plans
+    (round-4 VERDICT weak #6: every bench assumed a 64-token budget).
+    Serializes the rule parser's plan for each golden case + a slice of
+    the distill corpus exactly the way the constrained decoder emits it
+    (compact JSON), tokenizes, and reports p50/p95. Returns the p95
+    (rounded up to 8) as the budget the throughput rows decode with."""
+    import json as _json
+
+    import numpy as np
+
+    from tpu_voice_agent.evals.golden import GOLDEN_INTENT_CASES
+    from tpu_voice_agent.grammar.intent_grammar import default_tokenizer
+    from tpu_voice_agent.services.brain import RuleBasedParser
+    from tpu_voice_agent.train.distill import synth_intent_corpus
+
+    tok = default_tokenizer()
+    rule = RuleBasedParser()
+    lengths = []
+    texts = [(c.text, c.context or {}) for c in GOLDEN_INTENT_CASES]
+    texts += [(t, ctx) for t, ctx, _ in synth_intent_corpus(n=120)]
+    dropped = 0
+    for text, ctx in texts:
+        try:
+            resp = rule.parse(text, ctx)
+        except Exception:
+            dropped += 1
+            continue
+        plan = _json.dumps(resp.model_dump(), separators=(",", ":"))
+        lengths.append(len(tok.encode(plan)) + 1)  # + EOS
+    if dropped:
+        # no silent caps: a skew in the measured distribution must be
+        # visible next to the numbers it skews
+        log(f"plan_token_budget: {dropped}/{len(texts)} plans failed to "
+            "parse and were dropped from the distribution")
+    if not lengths:
+        log("plan_token_budget: NO plans parsed; falling back to the "
+            "round-4 measured p95 of 128")
+        return 128
+    p50 = float(np.percentile(lengths, 50))
+    p95 = float(np.percentile(lengths, 95))
+    mx = max(lengths)
+    log(f"plan token lengths over {len(lengths)} plans: p50 {p50:.0f}, "
+        f"p95 {p95:.0f}, max {mx} -> decode budget {int(-(-p95 // 8) * 8)}")
+    emit("plan_tokens_p50", p50, "tokens")
+    emit("plan_tokens_p95", p95, "tokens")
+    return int(-(-p95 // 8) * 8)
+
+
 def main(n_sessions: int = 32) -> None:
     from tpu_voice_agent.serve import DecodeEngine
     from tpu_voice_agent.serve.scheduler import ContinuousBatcher
@@ -29,6 +78,7 @@ def main(n_sessions: int = 32) -> None:
     tpu = on_tpu()
     preset = "tinyllama-1.1b" if tpu else "test-tiny"
     slots = 32 if tpu else 3
+    budget = plan_token_budget()  # measured, not the old assumed 64
 
     def prompt(i: int) -> str:
         return render_prompt(f"search for item {i} and sort by price", {})
@@ -38,7 +88,8 @@ def main(n_sessions: int = 32) -> None:
         submit+drain (stepping manually so the paged pool's REAL peak
         occupancy gets sampled at chunk boundaries), aggregate, emit."""
         P = install_prompt_prefix(engine)
-        batcher = ContinuousBatcher(engine, chunk_steps=16, max_new_tokens=64)
+        batcher = ContinuousBatcher(engine, chunk_steps=16,
+                                    max_new_tokens=budget)
         label = suffix.lstrip("_") or "dense"
         log(f"[{label}] preset={preset} slots={slots} sessions={n_sessions} "
             f"prefix={P}tok")
@@ -91,6 +142,72 @@ def main(n_sessions: int = 32) -> None:
     run_one(PagedDecodeEngine(preset=preset, max_len=2048, batch_slots=slots,
                               prefill_buckets=(1024,), fast_forward=8,
                               quant="int8" if tpu else None), "_ff_paged")
+
+    # pp layout ± ff (round-4 VERDICT weak #4: the flagship pipeline
+    # engine had no fast-forward path). One visible device -> pp=1, tp=1:
+    # the pipeline FORWARD and its full-mask attention still run, which is
+    # exactly why ff pays here — a (B, 1+W) step reads the same cache as
+    # a (B, 1) step. The tok/s delta between these two rows is the win.
+    from tpu_voice_agent.parallel.pipeline import pp_tp_mesh
+    from tpu_voice_agent.serve import PPDecodeEngine
+
+    import jax
+
+    ndev = len(jax.devices())
+    pp_axes = (min(2, ndev), 1)
+    run_one(PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(*pp_axes),
+                           max_len=2048, batch_slots=slots,
+                           prefill_buckets=(1024,),
+                           quant="int8" if tpu else None), "_pp")
+    run_one(PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(*pp_axes),
+                           max_len=2048, batch_slots=slots,
+                           prefill_buckets=(1024,), fast_forward=8,
+                           quant="int8" if tpu else None), "_ff_pp")
+
+    eightb_rows(budget)
+
+
+def eightb_rows(budget: int) -> None:
+    """BASELINE.md's PRIMARY metric (intents/sec/chip at 8B-class) gets
+    its first number (round-4 VERDICT weak #6). Random-init llama3-8b
+    through the real constrained engine; weights are random but decode
+    cost is weight-shape-bound, so tok/s is real. On CPU a full 32-session
+    sweep would run hours at ~seconds/token, so the rate is measured as
+    the MARGINAL ms/token slope (fixed costs cancel; same method as
+    bench.py's roofline row) and intents/s/chip derives from the measured
+    plan-length budget — labeled derived. On-chip the same code measures
+    directly at serving batch width."""
+    import os
+
+    if os.environ.get("BENCH_8B") != "1":
+        log("8B-class row is opt-in (BENCH_8B=1): it allocates ~16 GB of "
+            "bf16 random weights and decodes at seconds/token on CPU")
+        return
+    from tpu_voice_agent.serve import DecodeEngine
+    from tpu_voice_agent.services.brain import install_prompt_prefix
+    from tpu_voice_agent.services.prompts import render_prompt
+    from tpu_voice_agent.utils.perfdiag import marginal_ms_per_token
+
+    tpu = on_tpu()
+    log("[8b] building random-init llama3-8b engine (bf16 ~16 GB host RAM; "
+        "int8 on chip)")
+    eng = DecodeEngine(preset="llama3-8b", max_len=1024,
+                       prefill_buckets=(1024,),
+                       quant="int8" if tpu else None, fast_forward=8)
+    install_prompt_prefix(eng)
+    prompt = render_prompt("search for wireless headphones", {})
+    eng.generate(prompt, max_new_tokens=4)  # compile
+    ms_tok = marginal_ms_per_token(eng, prompt)
+    if ms_tok is None:
+        log("[8b] marginal slope unavailable")
+        return
+    tok_s = 1e3 / ms_tok
+    intents_s = tok_s / budget
+    log(f"[8b] decode {ms_tok:.1f} ms/token marginal -> {tok_s:.1f} tok/s/chip, "
+        f"/ {budget}-token measured plan budget = {intents_s:.2f} intents/s/chip "
+        f"(decode-bound derivation; {'on-chip' if tpu else 'CPU-labeled'})")
+    emit("tokens_per_s_8b", tok_s, "tok/s/chip")
+    emit("intents_per_s_8b_derived", intents_s, "intents/s/chip")
 
 
 if __name__ == "__main__":

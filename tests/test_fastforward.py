@@ -173,9 +173,13 @@ def test_batched_ff_matches_single_request_ff():
         assert r.error is None
         assert batched.fsm.walk(r.token_ids) >= 0
         assert s.token_ids == r.token_ids, (s.text[:80], r.text[:80])
-    # multi-emission proof: without ff a chunk emits at most chunk_steps
-    # tokens per row; forced chains blow past that bound
-    assert toks > chunks * 8, (toks, chunks)
+    # multi-emission proof, per ROW: a row resident for every chunk gets
+    # at most chunks * chunk_steps forwards, and without ff one forward
+    # emits one token — so ANY row whose token count exceeds that bound
+    # must have multi-emitted. (The old aggregate `toks > chunks * 8`
+    # passed vacuously once several rows co-resided per chunk.)
+    assert max(len(r.token_ids) for r in results) > chunks * 8, (
+        [len(r.token_ids) for r in results], chunks)
 
 
 def test_batched_ff_pallas_matches_xla():
@@ -280,3 +284,54 @@ def test_batched_ff_paged_pallas_matches_dense_pallas():
         assert x.error is None and y.error is None
         assert paged.fsm.walk(y.token_ids) >= 0
         assert x.token_ids == y.token_ids, (x.text[:80], y.text[:80])
+
+
+def test_batched_ff_pp_matches_dense():
+    """Round-4 VERDICT weak #4: the pp×tp flagship layout had no
+    fast-forward at all — the layout that most needs fewer steps took T=1
+    steps through JSON scaffolding. The pipeline forward's positions-
+    indexed cache writes + full-mask attend handle (B, 1+W) steps, so
+    ff'd pp decode must be token-identical to the ff'd dense engine (same
+    f32 weights; chunk_decode_loop and the forced tables are shared code),
+    and it must actually multi-emit."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_voice_agent.models.llama import init_params
+    from tpu_voice_agent.parallel.pipeline import pp_tp_mesh
+    from tpu_voice_agent.serve import DecodeEngine, PPDecodeEngine
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+    from tpu_voice_agent.services.prompts import render_prompt
+    from tpu_voice_agent.utils import get_metrics
+
+    dense = DecodeEngine(preset="test-tiny", max_len=1024, batch_slots=2,
+                         prefill_buckets=(512, 1024), fast_forward=8,
+                         init_weights=False)
+    pp = PPDecodeEngine(preset="test-tiny", mesh=pp_tp_mesh(2, 2),
+                        max_len=1024, batch_slots=2,
+                        prefill_buckets=(512, 1024), fast_forward=8,
+                        init_weights=False)
+    raw = init_params(dense.cfg, jax.random.PRNGKey(21), dtype=jnp.float32)
+    dense.load_params(raw)
+    pp.load_params(raw)
+    prompts = [
+        render_prompt("search for mechanical keyboards", {}),
+        render_prompt("take a screenshot", {"last_query": "keyboards"}),
+    ]
+    rd = ContinuousBatcher(dense, chunk_steps=8, max_new_tokens=160).generate_many(prompts)
+    m0 = get_metrics().snapshot()["counters"]
+    chunks0 = m0.get("scheduler.chunks", 0)
+    toks0 = m0.get("scheduler.tokens_generated", 0)
+    rp = ContinuousBatcher(pp, chunk_steps=8, max_new_tokens=160).generate_many(prompts)
+    m1 = get_metrics().snapshot()["counters"]
+    chunks = m1.get("scheduler.chunks", 0) - chunks0
+    toks = m1.get("scheduler.tokens_generated", 0) - toks0
+    for d, p in zip(rd, rp):
+        assert d.error is None and p.error is None
+        assert pp.fsm.walk(p.token_ids) >= 0
+        assert d.token_ids == p.token_ids, (d.text[:80], p.text[:80])
+    # multi-emission on the pipeline layout, per ROW: a row resident for
+    # every chunk gets at most chunks * chunk_steps forwards; without ff
+    # that bounds its token count — a row past the bound multi-emitted
+    assert max(len(r.token_ids) for r in rp) > chunks * 8, (
+        [len(r.token_ids) for r in rp], chunks)

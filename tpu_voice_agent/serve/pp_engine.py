@@ -108,6 +108,14 @@ class PPDecodeEngine(DecodeEngine):
         quant: str | None = None,  # None | "int8" — the 70B flagship is
         # int8 or it does not fit v5e-8 (utils/hbm_budget.py: bf16 weights
         # alone would need ~16 GiB/chip before cache or head tensors)
+        fast_forward: int = 0,  # grammar forced-chain width. On THIS
+        # layout ff is a pure step-count win (round-4 VERDICT weak #4):
+        # pipeline attention already reads the full masked cache every
+        # step (_attend over kv_len_mask — there is no frontier-read
+        # kernel inside shard_map), so a (B, 1+W) step costs the same
+        # cache traffic as a (B, 1) step and the chain tokens ride free.
+        # Fewer steps also means fewer S-tick fill-drain traversals, the
+        # pp-specific overhead.
     ):
         if mesh is None or "pp" not in mesh.shape:
             raise ValueError("PPDecodeEngine needs a mesh with a 'pp' axis "
@@ -122,6 +130,7 @@ class PPDecodeEngine(DecodeEngine):
             preset=preset, cfg=cfg, mesh=None, seed=seed, max_len=max_len,
             batch_slots=batch_slots, prefill_buckets=prefill_buckets,
             kernels="xla", tokenizer=tokenizer, fsm=fsm, init_weights=False,
+            fast_forward=fast_forward,
         )
         self.quant = quant
         self.pmesh = mesh
@@ -199,6 +208,7 @@ class PPDecodeEngine(DecodeEngine):
                 batch_slots: int = 1,
                 prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048),
                 dtype=jnp.bfloat16, quant: str | None = None,
+                fast_forward: int = 0,
                 **_ignored) -> "PPDecodeEngine":
         """Serve a real HF checkpoint through the pp×tp pipeline (the 70B
         import path; same loader as DecodeEngine.from_hf). Pass
@@ -214,7 +224,7 @@ class PPDecodeEngine(DecodeEngine):
         tok = load_hf_tokenizer(model_dir)
         eng = cls(cfg=cfg, mesh=mesh, max_len=max_len, batch_slots=batch_slots,
                   prefill_buckets=prefill_buckets, tokenizer=tok,
-                  init_weights=False, quant=quant)
+                  init_weights=False, quant=quant, fast_forward=fast_forward)
         eng.load_params(llama_from_hf_state(model_dir, cfg, dtype=dtype))
         return eng
 
@@ -253,10 +263,15 @@ class PPDecodeEngine(DecodeEngine):
                      greedy: bool):
         from .engine import chunk_decode_loop
 
+        # fast-forward tables when enabled: the forced-chain (B, 1+W) step
+        # goes through the same pipeline forward (positions-indexed cache
+        # writes + full-mask attend handle any T), emitting chain tokens
+        # without extra full-cache reads
+        tables = self.tables_ff if self.tables_ff is not None else self.tables
         out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, _ = chunk_decode_loop(
             self.params, self.cfg, self.cache,
             cur, pos, fsm, active, nbytes, tokens_left,
-            self.tables, self.byte_len_table,
+            tables, self.byte_len_table,
             key, jnp.float32(temperature), jnp.int32(byte_budget),
             rules=None, logit_mask=self.logit_mask,
             chunk_steps=chunk_steps,

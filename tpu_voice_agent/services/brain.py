@@ -825,7 +825,8 @@ def make_parser_from_env() -> IntentParser:
         pp = int(os.environ.get("BRAIN_PP", "0")) or min(2, ndev)
         tp = int(os.environ.get("BRAIN_TP", "0")) or max(1, ndev // pp)
         return _wrap_batched(PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(pp, tp),
-                                            batch_slots=slots, quant=quant))
+                                            batch_slots=slots, quant=quant,
+                                            fast_forward=ff))
     if backend.startswith("planner"):
         # long-session transcripts as model context; BRAIN_SP sizes the
         # sequence-parallel axis (default: every visible device)

@@ -462,10 +462,10 @@ def whisper_train_sentences(n: int = 240, seed: int = 7) -> list[str]:
 
 
 def train_whisper_generalize(
-    steps: int = 4000,
+    steps: int = 6000,
     batch: int = 24,
-    variants: int = 3,
-    n_sentences: int = 240,
+    variants: int = 10,
+    n_sentences: int = 320,
     lr: float = 2e-3,
     seed: int = 0,
     log=None,
@@ -506,8 +506,12 @@ def train_whisper_generalize(
     mel_fn = jax.jit(partial(log_mel_spectrogram, cfg=mel_cfg))
     rows_mel, rows_valid, rows_sent = [], [], []
     for si, text in enumerate(texts):
-        for _ in range(variants):
-            audio = render_speech_jittered(text, rng)
+        for vi in range(variants):
+            # variant 0 is the CLEAN canonical render: serve-time audio
+            # (render_speech defaults) must be inside the training
+            # distribution, not only the jittered neighborhood around it
+            audio = (render_speech(text) if vi == 0
+                     else render_speech_jittered(text, rng))
             n_frames = min(max(1, len(audio) // mel_cfg.hop), bucket)
             padded = np.zeros(bucket * mel_cfg.hop, dtype=np.float32)
             padded[: len(audio)] = audio[: len(padded)]
@@ -534,8 +538,32 @@ def train_whisper_generalize(
     optimizer = optax.adamw(sched, weight_decay=0.01)
     opt_state = optimizer.init(params)
 
-    def loss_fn(params, mel_j, valid_j, toks_j, mask_j):
+    def spec_augment(key, mel):
+        """SpecAugment-style time/freq masking, applied per minibatch on
+        the precomputed mels: the first generalization attempt hit train
+        loss 4e-4 while CANONICAL-tempo renders of its own training
+        sentences scored 0.5 WER — pure waveform memorization. Masked
+        inputs can't be memorized; the model must read the char chords."""
+        B, T, M = mel.shape
+        kt, kf, kt0, kf0 = jax.random.split(key, 4)
+        # two time masks (width <= 10 frames < 2 chars) + one freq mask
+        tw = jax.random.randint(kt, (B, 2), 0, 11)
+        t0 = jax.random.randint(kt0, (B, 2), 0, T)
+        fw = jax.random.randint(kf, (B, 1), 0, 13)
+        f0 = jax.random.randint(kf0, (B, 1), 0, M)
+        trange = jnp.arange(T)[None, :]
+        frange = jnp.arange(M)[None, :]
+        tmask = jnp.ones((B, T), bool)
+        for i in range(2):
+            tmask &= ~((trange >= t0[:, i:i + 1])
+                       & (trange < t0[:, i:i + 1] + tw[:, i:i + 1]))
+        fmask = ~((frange >= f0[:, :1]) & (frange < f0[:, :1] + fw[:, :1]))
+        keep = tmask[:, :, None] & fmask[:, None, :]
+        return jnp.where(keep, mel, jnp.mean(mel, axis=(1, 2), keepdims=True))
+
+    def loss_fn(params, mel_j, valid_j, toks_j, mask_j, key):
         B = mel_j.shape[0]
+        mel_j = spec_augment(key, mel_j)
         enc = encoder_forward(params, cfg, mel_j)
         ckv = compute_cross_kv(params, cfg, enc)
         cache = init_self_cache(cfg, B, dtype=jnp.float32)
@@ -549,22 +577,24 @@ def train_whisper_generalize(
         return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
 
     @jax.jit
-    def step_fn(params, opt_state, mel_j, valid_j, toks_j, mask_j):
+    def step_fn(params, opt_state, mel_j, valid_j, toks_j, mask_j, key):
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, mel_j, valid_j, toks_j, mask_j)
+            params, mel_j, valid_j, toks_j, mask_j, key)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
     t0 = time.perf_counter()
     first = ema = None
     R = mel_all.shape[0]
+    aug_key = jax.random.PRNGKey(seed + 17)
     for s in range(steps):
         pick = rng.choice(R, size=batch, replace=False)
         si = sent_all[pick]
+        aug_key, sk = jax.random.split(aug_key)
         params, opt_state, loss = step_fn(
             params, opt_state,
             jnp.asarray(mel_all[pick]), jnp.asarray(valid_all[pick]),
-            jnp.asarray(toks_all[si]), jnp.asarray(mask_all[si]))
+            jnp.asarray(toks_all[si]), jnp.asarray(mask_all[si]), sk)
         lf = float(loss)
         first = lf if first is None else first
         ema = lf if ema is None else 0.98 * ema + 0.02 * lf
