@@ -80,14 +80,14 @@ def diagnose_on_chip(engine, bench_prompt: str, base_ms_tok, preset: str) -> Non
         f.write(hlo)
     audit = audit_dequant(hlo)
     if audit["findings"]:
-        print("[bench] DIAG hlo-audit: MATERIALIZED DEQUANT FOUND "
-              f"(PERF.md hypothesis 1 CONFIRMED): {audit['findings']}",
-              file=sys.stderr)
+        print("[bench] DIAG hlo-audit: WASTEFUL DEQUANT LOWERING FOUND "
+              f"(PERF.md hypothesis 1; materialized buffer or scale fused "
+              f"into the dot chain): {audit['findings']}", file=sys.stderr)
     else:
-        print(f"[bench] DIAG hlo-audit: no HBM-sized materialized dequant in "
-              f"any executable computation ({audit['scanned_instructions']} "
-              "instructions scanned) — hypothesis 1 refuted; see profiler "
-              "trace for hyp 2/3", file=sys.stderr)
+        print(f"[bench] DIAG hlo-audit: clean — no materialized dequant and "
+              f"no scale-in-dot surplus in any computation "
+              f"({audit['scanned_instructions']} instructions scanned); see "
+              "profiler trace for hyp 2/3", file=sys.stderr)
 
     # (2) profiler trace
     trace_dir = capture_profile(engine, bench_prompt,

@@ -96,7 +96,22 @@ def neural_rows() -> None:
     emit("intent_type_accuracy_neural", scores["type_accuracy"], "fraction")
     emit("intent_args_score_neural", scores["args_score"], "fraction")
 
-    # ---- whisper: overfit pairs through the real transcribe path
+    # ---- whisper. Two checkpoints, two very different claims:
+    # - the overfit checkpoint scores the sentences it TRAINED on — a
+    #   path-works number (audio->mel->encoder->cross-KV->constrained
+    #   decode learns end to end), labeled _trainset accordingly
+    # - the generalization checkpoint trained on a disjoint augmented
+    #   sentence bank; WHISPER_EVAL_TEXTS is a true HELD-OUT set for it,
+    #   so its row is the honest quality number (round-4 VERDICT next #3)
+    def score_eval_texts(eng) -> float:
+        total_err, total_words = 0.0, 0
+        for text in distill.WHISPER_EVAL_TEXTS:
+            hyp = eng.transcribe(distill.render_speech(text)).text
+            n = max(len(normalize_words(text)), 1)
+            total_err += wer(text, hyp) * n
+            total_words += n
+        return total_err / total_words
+
     loaded = distill.load_ckpt(root, distill.WHISPER_CKPT, WhisperConfig)
     if loaded is None:
         log(f"no {distill.WHISPER_CKPT} under {root}; training now (one-time)")
@@ -105,18 +120,27 @@ def neural_rows() -> None:
     else:
         wcfg, wparams = loaded
         log(f"loaded {distill.WHISPER_CKPT} from {root}")
-    eng = distill.whisper_engine_from(wcfg, wparams)
-    total_err, total_words = 0.0, 0
-    for text in distill.WHISPER_EVAL_TEXTS:
-        hyp = eng.transcribe(distill.render_speech(text)).text
-        n = max(len(normalize_words(text)), 1)
-        total_err += wer(text, hyp) * n
-        total_words += n
-    w = total_err / total_words
-    log(f"NEURAL whisper WER over {len(distill.WHISPER_EVAL_TEXTS)} "
-        f"acoustic-font pairs: {w:.3f}")
-    emit("whisper_wer_neural", w, "fraction")
+    w = score_eval_texts(distill.whisper_engine_from(wcfg, wparams))
+    log(f"NEURAL whisper TRAIN-SET WER over {len(distill.WHISPER_EVAL_TEXTS)} "
+        f"acoustic-font pairs: {w:.3f} (overfit ckpt; path proof, not quality)")
+    emit("whisper_wer_neural_trainset", w, "fraction")
     emit("whisper_wer_neural_pairs", len(distill.WHISPER_EVAL_TEXTS), "count")
+
+    loaded = distill.load_ckpt(root, distill.WHISPER_GEN_CKPT, WhisperConfig)
+    if loaded is None and os.environ.get("QUALITY_TRAIN_HELDOUT") == "1":
+        log(f"no {distill.WHISPER_GEN_CKPT} under {root}; training now "
+            "(~15 min CPU, one-time)")
+        gcfg, gparams, gstats = distill.train_whisper_generalize(log=log)
+        distill.save_ckpt(root, distill.WHISPER_GEN_CKPT, gcfg, gparams, gstats)
+        loaded = (gcfg, gparams)
+    if loaded is None:
+        log(f"no {distill.WHISPER_GEN_CKPT} under {root}; skipping held-out "
+            "WER (commit it or set QUALITY_TRAIN_HELDOUT=1 to train here)")
+    else:
+        gw = score_eval_texts(distill.whisper_engine_from(*loaded))
+        log(f"NEURAL whisper HELD-OUT WER over "
+            f"{len(distill.WHISPER_EVAL_TEXTS)} unseen sentences: {gw:.3f}")
+        emit("whisper_wer_neural_heldout", gw, "fraction")
 
 
 def wer_rows() -> None:

@@ -138,6 +138,61 @@ ENTRY %main (a: s8[2048,5632], b: bf16[1,2048]) -> bf16[1,5632] {
     assert audit["scanned_instructions"] >= 6
 
 
+def test_perfdiag_audit_scale_in_dot_and_tuple_fusions():
+    """Round-5 on-chip regression: (a) tuple-rooted fusion instructions
+    (``= (f32[..], f32[..]) fusion(...)``) don't parse as instructions, so
+    their bodies must still be excluded from the materialized scan (the
+    ``calls=`` collection is text-wide); (b) a B=1 matvec lowered as a
+    kLoop broadcast-multiply-reduce owns one weight-sized multiply per
+    reduce — the dot itself, clean — while an EXTRA weight-sized multiply
+    in the same body is a fused dequant scale (~2 surplus VPU ops per
+    weight; held decode at 1.69 vs the 1.18 ms/token floor until
+    models.llama._qe moved the scale to the dot output)."""
+    from tpu_voice_agent.utils.perfdiag import audit_dequant
+
+    clean = """\
+HloModule jit_forward
+
+%fused_dot.1 (p0: f32[2048], p1: s8[2048,5632]) -> (f32[5632], f32[5632]) {
+  %p0 = f32[2048]{0} parameter(0)
+  %bc = f32[2048,5632]{1,0} broadcast(%p0), dimensions={0}
+  %p1 = s8[2048,5632]{1,0} parameter(1)
+  %cv = f32[2048,5632]{1,0} convert(%p1)
+  %m1 = f32[2048,5632]{1,0} multiply(%bc, %cv)
+  %r1 = f32[5632]{0} reduce(%m1), dimensions={0}
+  %m2 = f32[2048,5632]{1,0} multiply(%bc, %cv)
+  %r2 = f32[5632]{0} reduce(%m2), dimensions={0}
+  ROOT %t = (f32[5632]{0}, f32[5632]{0}) tuple(%r1, %r2)
+}
+
+ENTRY %main (a: f32[2048], b: s8[2048,5632]) -> (f32[5632], f32[5632]) {
+  %a = f32[2048]{0} parameter(0)
+  %b = s8[2048,5632]{1,0} parameter(1)
+  ROOT %f = (f32[5632]{0}, f32[5632]{0}) fusion(%a, %b), kind=kLoop, calls=%fused_dot.1
+}
+"""
+    audit = audit_dequant(clean, min_bytes=1 << 20)
+    assert audit["findings"] == []  # the dot's own multiplies are not dequant
+
+    scaled = clean.replace(
+        "  %m1 = f32[2048,5632]{1,0} multiply(%bc, %cv)",
+        "  %sc = f32[2048,5632]{1,0} multiply(%cv, %cv)\n"
+        "  %m1 = f32[2048,5632]{1,0} multiply(%bc, %sc)")
+    audit = audit_dequant(scaled, min_bytes=1 << 20)
+    assert [f[0] for f in audit["findings"]] == ["fusion:scale-in-dot"]
+
+    # an unrelated SMALL reduce fused into the same body must not mask the
+    # scale multiply (operand tracking, not op counting, pairs dots with
+    # their multiplies)
+    masked = scaled.replace(
+        "  ROOT %t = (f32[5632]{0}, f32[5632]{0}) tuple(%r1, %r2)",
+        "  %p0s = f32[2048]{0} multiply(%p0, %p0)\n"
+        "  %rs = f32[]{} reduce(%p0s)\n"
+        "  ROOT %t = (f32[5632]{0}, f32[5632]{0}) tuple(%r1, %r2)")
+    audit = audit_dequant(masked, min_bytes=1 << 20)
+    assert [f[0] for f in audit["findings"]] == ["fusion:scale-in-dot"]
+
+
 def test_perfdiag_decode_step_hlo_lowers_int8_engine():
     """decode_step_hlo must lower/compile the real engine's decode forward
     (int8 path included) and return parseable HLO text."""

@@ -145,13 +145,35 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16
 
 
 def _w(leaf):
-    """Resolve a weight leaf: raw array, or int8 {"q", "s"} dequantized on
-    the fly (XLA fuses the convert+scale into the matmul's operand read, so
-    HBM traffic stays int8 — the point of weight-only quantization on a
-    memory-bound decode)."""
+    """Resolve a weight leaf to a dense array: raw array, or int8
+    {"q", "s"} dequantized (materialized). Only for consumers that need a
+    dense tensor — the Pallas grouped matmul, leaf-wise re-quantization.
+    Matmul call sites must use :func:`_qe` instead: feeding a dequantized
+    product into a dot makes the scale multiply the dot operand's producer
+    and XLA lowers the whole matvec as a kLoop broadcast-multiply-reduce on
+    the VPU (~5 f32 vector ops per weight) instead of an MXU dot — the
+    round-5 on-chip HLO audit caught exactly this (bench_artifacts/
+    decode_step_hlo.txt fused_computation.5; 1.69 ms/tok measured vs the
+    1.18 ms/tok int8 weight-read floor)."""
     if isinstance(leaf, dict) and "q" in leaf:
         return leaf["q"].astype(jnp.bfloat16) * leaf["s"].astype(jnp.bfloat16)
     return leaf
+
+
+def _qe(eq: str, x: jax.Array, leaf) -> jax.Array:
+    """``einsum(eq, x, W)`` in f32 where W may be an int8 ``{"q", "s"}``
+    leaf. The per-out-channel scale multiplies the OUTPUT —
+    ``(x @ q) * s == x @ (q * s)`` exactly, because ``s`` (from
+    ``quantize_leaf``'s axis=-2 max, shape ``(..., 1, out)``) is constant
+    along the contraction axis and broadcasts against every output shape
+    used here. The dot's weight operand therefore stays a bare
+    ``convert(s8)->bf16``, which XLA folds into the MXU operand read; the
+    scale costs O(out) work instead of O(in*out) per step."""
+    if isinstance(leaf, dict) and "q" in leaf:
+        out = jnp.einsum(eq, x, leaf["q"].astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        return out * leaf["s"].astype(jnp.float32)
+    return jnp.einsum(eq, x, leaf, preferred_element_type=jnp.float32)
 
 
 def quantize_leaf(w) -> dict:
@@ -256,9 +278,9 @@ def _layer_qkv(p, x, cfg: LlamaConfig, cos, sin, cs=_identity_cs,
     nkv = n_kv_heads if n_kv_heads is not None else cfg.n_kv_heads
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
     h = cs(h, "act")
-    q = jnp.einsum("btd,dh->bth", h, _w(p["wq"]), preferred_element_type=jnp.float32).astype(x.dtype)
-    k = jnp.einsum("btd,dh->bth", h, _w(p["wk"]), preferred_element_type=jnp.float32).astype(x.dtype)
-    v = jnp.einsum("btd,dh->bth", h, _w(p["wv"]), preferred_element_type=jnp.float32).astype(x.dtype)
+    q = _qe("btd,dh->bth", h, p["wq"]).astype(x.dtype)
+    k = _qe("btd,dh->bth", h, p["wk"]).astype(x.dtype)
+    v = _qe("btd,dh->bth", h, p["wv"]).astype(x.dtype)
     q = cs(q.reshape(B, T, nq, cfg.head_dim), "heads")
     k = cs(k.reshape(B, T, nkv, cfg.head_dim), "kv_heads")
     v = cs(v.reshape(B, T, nkv, cfg.head_dim), "kv_heads")
@@ -358,11 +380,10 @@ def _moe_ffn(p, h, cfg: LlamaConfig):
     C = moe_capacity(B * T, cfg.n_experts, cfg.top_k, cf)
     dispatch, combine = route_topk(p["router"], x2, cfg.n_experts, cfg.top_k, C)
     xe = jnp.einsum("tec,td->ecd", dispatch.astype(h.dtype), x2)  # (E, C, d)
-    gate = jnp.einsum("ecd,edf->ecf", xe, _w(p["moe_gate"]), preferred_element_type=jnp.float32)
-    up = jnp.einsum("ecd,edf->ecf", xe, _w(p["moe_up"]), preferred_element_type=jnp.float32)
+    gate = _qe("ecd,edf->ecf", xe, p["moe_gate"])
+    up = _qe("ecd,edf->ecf", xe, p["moe_up"])
     a = (jax.nn.silu(gate) * up).astype(h.dtype)
-    down = jnp.einsum("ecf,efd->ecd", a, _w(p["moe_down"]),
-                      preferred_element_type=jnp.float32).astype(h.dtype)
+    down = _qe("ecf,efd->ecd", a, p["moe_down"]).astype(h.dtype)
     return jnp.einsum("tec,ecd->td", combine.astype(h.dtype), down).reshape(B, T, d)
 
 
@@ -370,16 +391,16 @@ def _layer_out(p, x, attn, cfg: LlamaConfig, cs=_identity_cs):
     """Shared decoder-layer back half: output projection + residual, then
     the MLP (dense SwiGLU, or routed MoE when cfg.n_experts > 0) +
     residual. ``attn`` is (B, T, n_heads * head_dim)."""
-    attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32).astype(x.dtype)
+    attn = _qe("bth,hd->btd", attn, p["wo"]).astype(x.dtype)
     x = x + cs(attn, "act")
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
         return x + cs(_moe_ffn(p, h, cfg), "act")
-    gate = jnp.einsum("btd,df->btf", h, _w(p["w_gate"]), preferred_element_type=jnp.float32)
-    up = jnp.einsum("btd,df->btf", h, _w(p["w_up"]), preferred_element_type=jnp.float32)
+    gate = _qe("btd,df->btf", h, p["w_gate"])
+    up = _qe("btd,df->btf", h, p["w_up"])
     act = (jax.nn.silu(gate) * up).astype(x.dtype)
     act = cs(act, "ffn")
-    down = jnp.einsum("btf,fd->btd", act, _w(p["w_down"]), preferred_element_type=jnp.float32).astype(x.dtype)
+    down = _qe("btf,fd->btd", act, p["w_down"]).astype(x.dtype)
     return x + cs(down, "act")
 
 
@@ -493,7 +514,7 @@ def forward(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", x, _w(params["lm_head"]), preferred_element_type=jnp.float32)
+    logits = _qe("btd,dv->btv", x, params["lm_head"])
     logits = cs(logits, "logits")
     return logits, {"k": new_k, "v": new_v}
 
@@ -614,7 +635,7 @@ def forward_paged(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", x, _w(params["lm_head"]), preferred_element_type=jnp.float32)
+    logits = _qe("btd,dv->btv", x, params["lm_head"])
     logits = cs(logits, "logits")
     return logits, k_pool, v_pool
 

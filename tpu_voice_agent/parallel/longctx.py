@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.llama import (
-    LlamaConfig, _layer_out, _layer_qkv, _w, rms_norm, rope_tables,
+    LlamaConfig, _layer_out, _layer_qkv, _qe, rms_norm, rope_tables,
 )
 from .ring import ring_attention
 
@@ -94,6 +94,5 @@ def llama_sp_prefill(
     # lengths the (B, T, V) logits tensor is the single biggest waste a
     # long-context prefill can produce
     last_h = jnp.take_along_axis(x, last_index[:, None, None].astype(jnp.int32), axis=1)
-    logits = jnp.einsum("btd,dv->btv", last_h, _w(params["lm_head"]),
-                        preferred_element_type=jnp.float32)
+    logits = _qe("btd,dv->btv", last_h, params["lm_head"])
     return logits[:, 0, :], {"k": ks, "v": vs}

@@ -24,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..models.llama import (
-    LlamaConfig, _attend, _layer_out, _layer_qkv, _w, rms_norm, rope_tables,
+    LlamaConfig, _attend, _layer_out, _layer_qkv, _qe, rms_norm, rope_tables,
 )
 
 
@@ -202,7 +202,7 @@ def llama_pp_forward_cached(
     )(staged, staged_cache["k"], staged_cache["v"], x)
 
     y = rms_norm(y, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", y, _w(params["lm_head"]), preferred_element_type=jnp.float32)
+    logits = _qe("btd,dv->btv", y, params["lm_head"])
     return logits, {"k": ck, "v": cv}
 
 
@@ -270,14 +270,14 @@ def _tp_block_cached(x, p, k_cache, v_cache, positions, kv_len_mask,
     k_cache = k_cache.at[batch_idx, positions].set(k.astype(k_cache.dtype))
     v_cache = v_cache.at[batch_idx, positions].set(v.astype(v_cache.dtype))
     attn = _attend(q, k_cache, v_cache, positions, kv_len_mask)
-    attn = jnp.einsum("bth,hd->btd", attn, _w(p["wo"]), preferred_element_type=jnp.float32)
+    attn = _qe("bth,hd->btd", attn, p["wo"])
     x = x + jax.lax.psum(attn, "tp").astype(x.dtype)
 
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("btd,df->btf", h, _w(p["w_gate"]), preferred_element_type=jnp.float32)
-    up = jnp.einsum("btd,df->btf", h, _w(p["w_up"]), preferred_element_type=jnp.float32)
+    gate = _qe("btd,df->btf", h, p["w_gate"])
+    up = _qe("btd,df->btf", h, p["w_up"])
     act = (jax.nn.silu(gate) * up).astype(x.dtype)
-    down = jnp.einsum("btf,fd->btd", act, _w(p["w_down"]), preferred_element_type=jnp.float32)
+    down = _qe("btf,fd->btd", act, p["w_down"])
     return x + jax.lax.psum(down, "tp").astype(x.dtype), k_cache, v_cache
 
 
@@ -363,7 +363,7 @@ def pp_tp_forward_cached(
     )(params["staged"], staged_cache["k"], staged_cache["v"], x)
 
     y = rms_norm(y, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", y, _w(params["lm_head"]), preferred_element_type=jnp.float32)
+    logits = _qe("btd,dv->btv", y, params["lm_head"])
     return logits, {"k": ck, "v": cv}
 
 
@@ -420,4 +420,4 @@ def llama_pp_forward(
     y = pipeline_apply(staged, x_micro, stage_fn, mesh).reshape(B, T, cfg.dim)
 
     y = rms_norm(y, params["final_norm"], cfg.norm_eps)
-    return jnp.einsum("btd,dv->btv", y, _w(params["lm_head"]), preferred_element_type=jnp.float32)
+    return _qe("btd,dv->btv", y, params["lm_head"])

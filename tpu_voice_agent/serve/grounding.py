@@ -49,6 +49,14 @@ def grounding_literals() -> list[str]:
     return ['{"point":[', '],"label":"', '"}', ",", '"point"', '"label"']
 
 
+def prompt_text(instruction: str) -> str:
+    """The ONE chat template for grounding prompts — train.ground teacher-
+    forces exactly this string, so serve-time prompts are in-distribution
+    for the trained checkpoint."""
+    return (f"<|user|>\nGround this instruction to one page point: "
+            f"{instruction}\n<|assistant|>\n")
+
+
 @lru_cache(maxsize=1)
 def build_grounding_fsm() -> tuple[Tokenizer, TokenFSM]:
     corpus = [
@@ -212,9 +220,7 @@ class GroundingEngine:
         return cls(max_len=max_len, params=params, cfg=cfg, tokenizer=tok)
 
     def _prompt_ids(self, instruction: str) -> list[int]:
-        text = (f"<|user|>\nGround this instruction to one page point: "
-                f"{instruction}\n<|assistant|>\n")
-        return self.tok.encode(text, bos=False, eos=False)
+        return self.tok.encode(prompt_text(instruction), bos=False, eos=False)
 
     def ground(self, image: np.ndarray, instruction: str,
                max_new_tokens: int = 48) -> GroundingResult:

@@ -31,6 +31,8 @@ _INSTR = re.compile(
     r"=\s*(?P<dtype>\w+)\[(?P<shape>[\d,]*)\][^\s]*\s+(?P<op>[\w-]+)\(")
 _COMP_HEADER = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(")
 _CALLS = re.compile(r"calls=%?(?P<name>[\w.\-]+)")
+_RESULT_NAME = re.compile(r"^(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=")
+_OPERAND = re.compile(r"%([\w.\-]+)")
 
 
 def decode_step_hlo(engine) -> str:
@@ -62,26 +64,44 @@ def _instr_bytes(m: "re.Match") -> int | None:
 
 
 def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
-    """Find materialized dequant-shaped results anywhere they can hide.
+    """Find wasteful int8-dequant lowerings anywhere they can hide.
 
     The decode forward's layer weights are consumed inside the lax.scan-
-    lowered while BODY, not ENTRY, and after the fusion pass a materialized
-    dequant usually appears as a ``fusion`` instruction whose body is a
-    pure convert/scale chain — so the scan covers:
+    lowered while BODY, not ENTRY, and after the fusion pass the dequant
+    lives either in an executable computation (truly materialized) or
+    inside a fusion body. The scan therefore covers:
 
-    - every instruction in every EXECUTABLE computation (ENTRY, while
-      bodies, called computations — everything that is not a fusion body;
-      their results are real buffers): flag ``convert``/``multiply`` with
-      outputs >= min_bytes
-    - ``fusion`` instructions with outputs >= min_bytes whose called body
-      contains a >= min_bytes ``convert`` and NO matmul-class op — a pure
-      dequant fusion that materializes the bf16 weight instead of feeding
-      the consuming dot (a fusion that contains the dot is the GOOD case)
+    - every instruction in every EXECUTABLE computation (everything that
+      is not a fusion body; their results are real buffers): flag
+      ``convert``/``multiply`` with outputs >= min_bytes — a materialized
+      dequant triples that weight's HBM traffic
+    - every FUSION BODY: a dot lowered as a kLoop fusion (the B=1 matvec
+      case: the MXU can't fill from a one-row operand, so XLA's
+      broadcast-multiply-reduce on the VPU is the intended lowering) owns
+      weight-sized multiplies that are DIRECT operands of a ``reduce``/
+      ``dot`` — the dot's own x-broadcast product. Any other weight-sized
+      multiply is a per-element scale fused into the chain: not extra HBM
+      traffic, but ~2 extra VPU ops per weight, which is what held round
+      5's pre-fix decode at 1.69 vs the 1.18 ms/token weight-read floor
+      (fix: models.llama._qe moves the scale to the dot OUTPUT). A body
+      with NO reduce/matmul whose ROOT is weight-sized and carries a big
+      convert/multiply is a pure dequant fusion feeding a real buffer —
+      flagged for the same reason as the materialized case.
+
+    Round-5 bug fixed here: tuple-rooted fusion instructions
+    (``= (f32[..], f32[..]) fusion(...)``) never matched _INSTR, so their
+    ``calls=`` bodies were treated as executable computations and the
+    dot's own in-fusion convert/multiply chain was reported as
+    "materialized" even after the scale fix. ``calls=`` is now collected
+    from raw text, and fusion bodies get the multiply>reduce test above.
 
     Returns {findings: [(op, dtype, shape, mbytes, computation)],
     scanned_instructions: N}."""
     comps: dict[str, list] = {}
     cur: str | None = None
+    # fusion bodies from RAW text: calls= appears on fusion instructions
+    # regardless of whether their (possibly tuple) result shape parses
+    fusion_bodies = {m.group("name") for m in _CALLS.finditer(hlo_text)}
     for line in hlo_text.splitlines():
         if line and not line[0].isspace():
             m = _COMP_HEADER.match(line)
@@ -95,50 +115,55 @@ def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
         if m:
             comps[cur].append((m, line))
 
-    # fusion bodies = computations referenced by a fusion's calls=...
-    fusion_bodies: set[str] = set()
-    for instrs in comps.values():
-        for m, line in instrs:
-            if m.group("op") == "fusion":
-                cm = _CALLS.search(line)
-                if cm:
-                    fusion_bodies.add(cm.group("name"))
-
-    matmul_ops = {"dot", "dot-general", "convolution", "custom-call"}
-
-    def body_is_pure_dequant(name: str) -> bool:
-        # a dequant body carries a weight-sized convert OR scale multiply
-        # (XLA may constant-fold the convert away and leave only the
-        # multiply); a body that also contains the consuming matmul is the
-        # GOOD case — the dequant feeds the dot without materializing
-        instrs = comps.get(name, [])
-        has_big_dequant_op = any(
-            m.group("op") in ("convert", "multiply")
-            and (_instr_bytes(m) or 0) >= min_bytes
-            for m, _ in instrs)
-        has_matmul = any(m.group("op") in matmul_ops for m, _ in instrs)
-        return has_big_dequant_op and not has_matmul
-
     findings = []
     n = 0
+
+    def record(tag, m, size, name):
+        findings.append((tag, m.group("dtype"),
+                         tuple(int(d) for d in m.group("shape").split(",") if d),
+                         round(size / 2**20, 1), name))
+
     for name, instrs in comps.items():
-        if name in fusion_bodies:
-            continue  # results live inside a fusion; not materialized
+        in_fusion = name in fusion_bodies
+        big_multiplies, big_converts = {}, []
+        dot_operands: set[str] = set()
+        n_dotlike = 0
+        root_big = False
         for m, line in instrs:
             n += 1
-            size = _instr_bytes(m)
-            if size is None or size < min_bytes:
-                continue
             op = m.group("op")
-            dims = tuple(int(d) for d in m.group("shape").split(",") if d)
-            if op in ("convert", "multiply"):
-                findings.append((op, m.group("dtype"), dims,
-                                 round(size / 2**20, 1), name))
-            elif op == "fusion":
-                cm = _CALLS.search(line)
-                if cm and body_is_pure_dequant(cm.group("name")):
-                    findings.append(("fusion:dequant", m.group("dtype"), dims,
-                                     round(size / 2**20, 1), name))
+            size = _instr_bytes(m)
+            big = size is not None and size >= min_bytes
+            if in_fusion:
+                if big and line.lstrip().startswith("ROOT"):
+                    root_big = True
+                if op in ("reduce", "dot", "dot-general", "convolution"):
+                    n_dotlike += 1
+                    # first-level operands of the reduce/dot: the multiply
+                    # implementing the dot itself shows up here
+                    dot_operands.update(_OPERAND.findall(
+                        line.split(op + "(", 1)[-1]))
+                elif op == "multiply" and big:
+                    nm = _RESULT_NAME.match(line.lstrip())
+                    big_multiplies[nm.group("name") if nm else line] = (m, size)
+                elif op == "convert" and big:
+                    big_converts.append((m, size))
+            elif big and op in ("convert", "multiply"):
+                record(op, m, size, name)
+        if not in_fusion:
+            continue
+        if n_dotlike == 0:
+            # no dot in the body: a big convert/multiply here is a pure
+            # dequant fusion — but only a weight-sized ROOT means a real
+            # HBM buffer is written (a small root, e.g. a slice of the
+            # converted weight, materializes nothing big)
+            if root_big:
+                for m, size in (list(big_multiplies.values()) + big_converts)[:1]:
+                    record("fusion:dequant", m, size, name)
+        else:
+            for nm, (m, size) in big_multiplies.items():
+                if nm not in dot_operands:
+                    record("fusion:scale-in-dot", m, size, name)
     return {"findings": findings, "scanned_instructions": n}
 
 
