@@ -63,6 +63,13 @@ def intent_rows() -> None:
     emit("intent_args_score", scores["args_score"], "fraction")
     emit("intent_eval_errors", scores["errors"], "count")
 
+    from tpu_voice_agent.evals import score_parser_dialogs
+
+    ds = score_parser_dialogs(parser)
+    log(f"dialog eval [{tag}]: {ds}")
+    emit("dialog_type_accuracy", ds["type_accuracy"], "fraction")
+    emit("dialog_args_score", ds["args_score"], "fraction")
+
 
 def neural_rows() -> None:
     """REAL neural quality numbers with zero external weights (round-3
@@ -95,6 +102,28 @@ def neural_rows() -> None:
     log(f"NEURAL intent eval (distilled test-tiny, short prompt): {scores}")
     emit("intent_type_accuracy_neural", scores["type_accuracy"], "fraction")
     emit("intent_args_score_neural", scores["args_score"], "fraction")
+
+    # ---- multi-turn dialogs with the SAME distilled weights, two ways:
+    # stateless context-threading (voice-service semantics) and session
+    # transcripts through the planner backend (round-4 VERDICT next #8)
+    from tpu_voice_agent.evals import score_parser_dialogs
+    from tpu_voice_agent.parallel.ring import sp_mesh
+    from tpu_voice_agent.serve import LongSessionPlanner
+    from tpu_voice_agent.services.brain import PlannerParser
+
+    ds = score_parser_dialogs(parser)
+    log(f"NEURAL dialog eval (stateless ctx threading): {ds}")
+    emit("dialog_type_accuracy_neural", ds["type_accuracy"], "fraction")
+    emit("dialog_args_score_neural", ds["args_score"], "fraction")
+
+    planner = LongSessionPlanner(cfg=cfg, mesh=sp_mesh(1),
+                                 ctx_buckets=(512, 1024), fast_forward=8)
+    planner.load_params(params)
+    pparser = PlannerParser(planner, render=distill.distilled_prompt)
+    dsp = score_parser_dialogs(pparser, session=True)
+    log(f"NEURAL dialog eval (planner session transcripts): {dsp}")
+    emit("dialog_type_accuracy_planner", dsp["type_accuracy"], "fraction")
+    emit("dialog_args_score_planner", dsp["args_score"], "fraction")
 
     # ---- whisper. Two checkpoints, two very different claims:
     # - the overfit checkpoint scores the sentences it TRAINED on — a
@@ -141,6 +170,23 @@ def neural_rows() -> None:
         log(f"NEURAL whisper HELD-OUT WER over "
             f"{len(distill.WHISPER_EVAL_TEXTS)} unseen sentences: {gw:.3f}")
         emit("whisper_wer_neural_heldout", gw, "fraction")
+
+    # ---- grounding: point-in-bbox accuracy on held-out page layouts
+    # through the real GroundingEngine (round-4 VERDICT next #4 — the one
+    # model family that had zero semantic proof)
+    from tpu_voice_agent.train.ground import (
+        grounding_engine_from, load_ground_ckpt, score_grounding)
+
+    gl = load_ground_ckpt(root)
+    if gl is None:
+        log(f"no grounding-tiny under {root}; skipping grounding accuracy "
+            "(train via make_tiny_ckpts)")
+    else:
+        gs = score_grounding(grounding_engine_from(*gl))
+        log(f"NEURAL grounding held-out layouts: {gs}")
+        emit("grounding_point_in_bbox", gs["point_in_bbox"], "fraction")
+        emit("grounding_label_match", gs["label_match"], "fraction")
+        emit("grounding_chance", gs["chance"], "fraction")
 
 
 def wer_rows() -> None:

@@ -39,8 +39,48 @@ def trained_intent():
     """ONE scaled-down training run shared by the serve + ckpt tests (a
     1-core box pays ~0.35 s/step; two separate trainings doubled the
     module's wall-clock for no extra coverage)."""
-    return distill.train_intent_model(steps=260, corpus_n=1000, seq_len=176,
-                                      batch=16)
+    return distill.train_intent_model(steps=260, corpus_n=1000, seq_len=320,
+                                      dialogs_n=60, batch=16)
+
+
+def test_dialogs_disjoint_from_golden():
+    """No golden utterance — single-turn case OR dialog turn — may appear
+    in the training dialogs (a golden dialog's search phrase showing up in
+    training would hand the copy task its answer)."""
+    from tpu_voice_agent.evals.golden import GOLDEN_DIALOGS, GOLDEN_INTENT_CASES
+
+    golden = {c.text for c in GOLDEN_INTENT_CASES}
+    for d in GOLDEN_DIALOGS:
+        golden.update(d.turns)
+    for turns in distill.synth_intent_dialogs(150, seed=4):
+        assert not {t for t, _, _ in turns} & golden
+
+
+def test_dialog_batches_put_eos_target_at_mid_plan_ends():
+    """The position AT a mid-dialog plan's last token must target EOS with
+    loss on (that is how a served turn stops decoding) while the
+    teacher-forced TRANSCRIPT continues with the next <|user|> segment —
+    planner transcripts never contain EOS (serve.planner.plan_many)."""
+    from tpu_voice_agent.grammar.intent_grammar import build_intent_fsm
+
+    tok, _ = build_intent_fsm()
+    dlg = distill.synth_intent_dialogs(1, seed=2)[0]
+    assert len(dlg) >= 2
+    toks, tgts, masks = distill.build_intent_batches(
+        [], tok, 512, 1, dialogs=[dlg])
+    toks, tgts, masks = toks[0, 0], tgts[0, 0], masks[0, 0]
+    eos_positions = [i for i in range(len(toks))
+                     if tgts[i] == tok.eos_id and masks[i] > 0]
+    # one termination target per turn
+    assert len(eos_positions) == len(dlg), eos_positions
+    for p in eos_positions[:-1]:  # mid-dialog ends
+        # the transcript itself continues (teacher-forced input is NOT eos)
+        assert toks[p + 1] != tok.eos_id
+        # and the next literal tokens open the next user turn
+        tail = tok.decode([int(t) for t in toks[p + 1: p + 6]])
+        assert tail.startswith("\n<|user|>"), repr(tail)
+    # the final plan terminates in-transcript
+    assert toks[eos_positions[-1] + 1] == tok.eos_id
 
 
 @pytest.mark.slow
@@ -64,6 +104,27 @@ def test_intent_distillation_learns_and_serves(trained_intent):
     scores = score_parser(parser, cases)
     assert scores["errors"] == 0
     assert scores["type_accuracy"] >= 0.5, scores
+
+
+@pytest.mark.slow
+def test_distilled_weights_serve_through_planner_sessions(trained_intent):
+    """The planner-distilled backend shape: distilled cfg/params behind the
+    session-keyed planner with the SHORT prompt, a 2-turn session feeding
+    the second turn only the transcript (context={}). Scaled-down training
+    -> assert structure (valid plans, session reuse), not semantics."""
+    from tpu_voice_agent.parallel.ring import sp_mesh
+    from tpu_voice_agent.serve import LongSessionPlanner
+    from tpu_voice_agent.services.brain import PlannerParser
+
+    cfg, params, _ = trained_intent
+    planner = LongSessionPlanner(cfg=cfg, mesh=sp_mesh(1),
+                                 ctx_buckets=(512, 1024))
+    planner.load_params(params)
+    parser = PlannerParser(planner, render=distill.distilled_prompt)
+    r1 = parser.parse("search for red shoes", {}, session_id="t")
+    r2 = parser.parse("open the second result", {}, session_id="t")
+    assert r1.intents and r2.intents  # grammar-valid plans both turns
+    assert parser.session_count() == 1  # one session carried both turns
 
 
 @pytest.mark.slow

@@ -1,0 +1,145 @@
+"""Grounding trainer + quality proof (round-4 VERDICT next #4).
+
+Until round 5 grounding was the one model family with zero semantic
+evidence: bench_grounding grounded random noise with random-init weights,
+and the executor's VL click fallback had never been shown to click the
+right thing. These tests prove each link:
+
+- the synthetic page generator yields disjoint, regex/grammar-valid rows
+- a scaled-down training run learns through the REAL GroundingEngine, and
+  the checkpoint round-trips orbax save/load
+- (slow, committed-checkpoint) held-out layouts score point-in-bbox far
+  above chance, and the executor service resolves a click the DOM scan
+  cannot via the trained grounder over a real rendered screenshot
+
+Reference parity: augments the reference's DOM-scan-only targeting
+(apps/executor/src/dom-analyzer.ts:34-448; BASELINE config 5).
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from tpu_voice_agent.train import ground
+
+
+def test_sample_page_disjoint_bboxes_and_bounds():
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        img, widgets = ground.sample_page(rng)
+        assert img.shape == (ground.PAGE, ground.PAGE, 3)
+        assert img.dtype == np.uint8
+        for i, a in enumerate(widgets):
+            ax, ay, aw, ah = a["bbox"]
+            assert 0 <= ax and ax + aw <= ground.PAGE
+            assert 0 <= ay and ay + ah <= ground.PAGE
+            for b in widgets[i + 1:]:
+                bx, by, bw, bh = b["bbox"]
+                # disjoint with the 8px margin used by the generator
+                assert (ax + aw < bx or bx + bw < ax
+                        or ay + ah < by or by + bh < ay)
+
+
+def test_build_rows_targets_are_grammar_reachable():
+    """Every teacher target must be emittable by the point-grammar FSM —
+    mass trained onto unreachable sequences would never decode."""
+    from tpu_voice_agent.serve.grounding import build_grounding_fsm
+
+    tok, fsm = build_grounding_fsm()
+    _, instrs, targets, _ = ground.build_rows(12, seed=3)
+    for t in targets:
+        ids = tok.encode(t, bos=False, eos=False)
+        assert tok.decode(ids) == t
+        assert fsm.walk(ids) >= 0, f"target left the grammar: {t}"
+
+
+def test_train_smoke_and_ckpt_roundtrip(tmp_path):
+    """Three steps of the real trainer, orbax round trip, and a ground()
+    call through the real engine (random-quality output; shape contract)."""
+    cfg, params, stats = ground.train_grounding(steps=3, batch=4, n_pages=8)
+    assert stats["first_loss"] > 0
+    path = ground.save_ground_ckpt(str(tmp_path), cfg, params, stats)
+    loaded = ground.load_ground_ckpt(str(tmp_path))
+    assert loaded is not None
+    lcfg, lparams = loaded
+    assert lcfg == cfg
+    eng = ground.grounding_engine_from(lcfg, lparams)
+    rng = np.random.default_rng(0)
+    img, widgets = ground.sample_page(rng)
+    res = eng.ground(img, "click the " + widgets[0]["cls"], max_new_tokens=32)
+    assert 0 <= res.x_norm <= 999 and 0 <= res.y_norm <= 999
+
+
+COMMITTED = os.path.join(os.path.dirname(__file__), "..", "checkpoints")
+# existence probe only — restoring the full checkpoint at collection time
+# would tax every pytest run that merely collects this module
+HAS_CKPT = os.path.exists(os.path.join(COMMITTED, ground.GROUND_CKPT, "meta.json"))
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not HAS_CKPT, reason="no committed grounding-tiny ckpt")
+def test_committed_grounding_accuracy_beats_chance():
+    """The committed checkpoint must ground held-out layouts (and one
+    never-trained instruction template) point-in-bbox far above chance
+    (~4% for a uniform point; ~33% for center-of-random-widget)."""
+    cfg, params = ground.load_ground_ckpt(COMMITTED)
+    eng = ground.grounding_engine_from(cfg, params)
+    scores = ground.score_grounding(eng, n_pages=30)
+    assert scores["pages"] >= 25
+    assert scores["point_in_bbox"] >= 0.6, scores
+    assert scores["point_in_bbox"] > 5 * scores["chance"], scores
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not HAS_CKPT, reason="no committed grounding-tiny ckpt")
+def test_executor_vl_fallback_resolves_click_dom_cannot(tmp_path):
+    """End to end through the executor service: a click whose text matches
+    NO analyzed element routes through the trained grounder over the real
+    rendered screenshot and snaps onto the correct DOM selector — the
+    augmentation the reference's DOM-only analyzer cannot do."""
+    import httpx
+    from PIL import Image
+
+    from tpu_voice_agent.services.executor.grounding import TPUGrounder
+    from tpu_voice_agent.services.executor.page import FakeElement, FakePage
+    from tpu_voice_agent.services.executor.server import build_app
+    from tpu_voice_agent.services.executor.session import SessionManager
+
+    from .http_helper import AppServer
+
+    # deterministic page whose render the trained model has never seen
+    rng = np.random.default_rng(20260731)
+    img, widgets = ground.sample_page(rng)
+    target = next(w for w in widgets if "button" in w["cls"])
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+
+    elements = []
+    for i, w in enumerate(widgets):
+        x, y, bw, bh = w["bbox"]
+        elements.append(FakeElement(
+            f"#w{i}", tag="button", role="button",
+            # element text deliberately does NOT contain the instruction
+            # text, so the interpreter's analyzed-text click misses
+            text=w["cls"].split()[0].capitalize(),
+            name=w["cls"], bbox=(float(x), float(y), float(bw), float(bh))))
+    page = FakePage(elements=elements, url="https://demo.local/g",
+                    screenshot_png=buf.getvalue())
+    manager = SessionManager(page_factory=lambda: page,
+                             artifacts_root=str(tmp_path / "a"),
+                             uploads_dir=str(tmp_path / "u"))
+    grounder = TPUGrounder(ckpt_dir=COMMITTED)
+
+    instruction = "click the " + target["cls"]
+    with AppServer(build_app(manager, grounder=grounder)) as srv:
+        r = httpx.post(srv.url + "/execute", json={
+            "intents": [{"type": "click", "args": {"text": instruction}}],
+        }, timeout=120)
+    assert r.status_code == 200
+    step = r.json()["results"][0]
+    assert step["ok"], step.get("error")
+    sel = "#w" + str(widgets.index(target))
+    assert step["data"]["by"] == "grounded_selector", step["data"]
+    assert step["data"]["selector"] == sel, (step["data"], target)

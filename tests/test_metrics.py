@@ -192,6 +192,26 @@ ENTRY %main (a: f32[2048], b: s8[2048,5632]) -> (f32[5632], f32[5632]) {
     audit = audit_dequant(masked, min_bytes=1 << 20)
     assert [f[0] for f in audit["findings"]] == ["fusion:scale-in-dot"]
 
+    # a pure-dequant fusion with a TUPLE root (no reduce/dot) materializes
+    # weight-sized buffers even though the ROOT line itself never parses —
+    # its operands must be resolved against the body's big converts
+    tuple_dequant = """\
+HloModule m
+
+%fused_dq.1 (p0: s8[2048,5632]) -> (bf16[2048,5632], bf16[2048,5632]) {
+  %p0 = s8[2048,5632]{1,0} parameter(0)
+  %cv = bf16[2048,5632]{1,0} convert(%p0)
+  ROOT %t = (bf16[2048,5632]{1,0}, bf16[2048,5632]{1,0}) tuple(%cv, %cv)
+}
+
+ENTRY %main (a: s8[2048,5632]) -> (bf16[2048,5632], bf16[2048,5632]) {
+  %a = s8[2048,5632]{1,0} parameter(0)
+  ROOT %f = (bf16[2048,5632]{1,0}, bf16[2048,5632]{1,0}) fusion(%a), kind=kLoop, calls=%fused_dq.1
+}
+"""
+    audit = audit_dequant(tuple_dequant, min_bytes=1 << 20)
+    assert [f[0] for f in audit["findings"]] == ["fusion:dequant"]
+
 
 def test_perfdiag_decode_step_hlo_lowers_int8_engine():
     """decode_step_hlo must lower/compile the real engine's decode forward

@@ -1,4 +1,11 @@
-from .golden import GOLDEN_INTENT_CASES, score_case, score_parser
+from .golden import (
+    GOLDEN_DIALOGS,
+    GOLDEN_INTENT_CASES,
+    score_case,
+    score_parser,
+    score_parser_dialogs,
+)
 from .wer import wer
 
-__all__ = ["GOLDEN_INTENT_CASES", "score_case", "score_parser", "wer"]
+__all__ = ["GOLDEN_DIALOGS", "GOLDEN_INTENT_CASES", "score_case",
+           "score_parser", "score_parser_dialogs", "wer"]

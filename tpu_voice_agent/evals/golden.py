@@ -115,6 +115,52 @@ GOLDEN_INTENT_CASES: list[GoldenCase] = [
 ]
 
 
+@dataclass(frozen=True)
+class GoldenDialog:
+    """Multi-turn case: earlier turns establish context (a search, a page),
+    the LAST turn is scored. Mirrors the reference's context few-shot
+    (apps/brain/src/server.ts:38-50: "open the second result" after a
+    search) — the capability the single-turn cases cannot probe."""
+    turns: tuple[str, ...]
+    expected_types: tuple[str, ...]  # for the final turn's plan
+    facts: tuple[tuple[int, str, Any], ...] = ()
+
+
+GOLDEN_DIALOGS: list[GoldenDialog] = [
+    GoldenDialog(
+        ("search for ergonomic drafting stools", "open the second result"),
+        ("click",),
+        facts=((0, "args.index", 2),),
+    ),
+    GoldenDialog(
+        ("find noise cancelling earmuffs", "sort these by price from low to high"),
+        ("sort",),
+        facts=((0, "args.field", "price"), (0, "args.direction", "asc")),
+    ),
+    GoldenDialog(
+        ("search for portable projectors", "open the fourth link"),
+        ("click",),
+        facts=((0, "args.index", 4),),
+    ),
+    GoldenDialog(
+        ("search for suede messenger bags", "take a screenshot of this page"),
+        ("screenshot",),
+    ),
+    GoldenDialog(
+        ("find budget camcorders", "open the first result and scroll down"),
+        ("click", "scroll"),
+        facts=((0, "args.index", 1), (1, "args.direction", "down")),
+    ),
+    GoldenDialog(
+        ("search for copper tea kettles",
+         "sort by rating high to low",
+         "extract the table as csv"),
+        ("extract_table",),
+        facts=((0, "args.format", "csv"),),
+    ),
+]
+
+
 def _dig(obj: Any, path: str) -> Any:
     cur = obj
     for part in path.split("."):
@@ -149,6 +195,43 @@ def score_case(case: GoldenCase, resp: Any) -> tuple[bool, float]:
         if idx < len(intents) and _fact_holds(intents[idx], path, want):
             held += 1
     return type_match, held / len(case.facts)
+
+
+def score_parser_dialogs(parser, dialogs: list[GoldenDialog] | None = None,
+                         session: bool = False) -> dict:
+    """Run each dialog's turns in order and score the FINAL turn.
+
+    ``session=False`` threads context the way the voice service does for
+    stateless parsers: each turn's ``context_updates`` merge into the next
+    turn's context dict (apps/voice/src/server.ts:162-170 semantics).
+    ``session=True`` instead passes a per-dialog ``session_id`` so a
+    session-keyed parser (the planner backend) carries its own transcript —
+    the long-session path the reference has no analog for."""
+    dialogs = dialogs if dialogs is not None else GOLDEN_DIALOGS
+    type_hits = 0
+    args_total = 0.0
+    errors = 0
+    for di, dlg in enumerate(dialogs):
+        ctx: dict = {}
+        resp = None
+        try:
+            for turn in dlg.turns:
+                if session:
+                    resp = parser.parse(turn, {}, session_id=f"golden-dlg-{di}")
+                else:
+                    resp = parser.parse(turn, dict(ctx))
+                    updates = getattr(resp, "context_updates", None) or {}
+                    ctx.update(updates)
+        except Exception:
+            errors += 1
+            continue
+        case = GoldenCase(dlg.turns[-1], dlg.expected_types, facts=dlg.facts)
+        tm, ascore = score_case(case, resp)
+        type_hits += int(tm)
+        args_total += ascore
+    n = len(dialogs)
+    return {"dialogs": n, "errors": errors,
+            "type_accuracy": type_hits / n, "args_score": args_total / n}
 
 
 def score_parser(parser, cases: list[GoldenCase] | None = None) -> dict:

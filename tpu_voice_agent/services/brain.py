@@ -239,10 +239,15 @@ class PlannerParser:
     max_sessions = 32
 
     def __init__(self, planner, max_new_tokens: int | None = None,
-                 hbm_budget_bytes: int | None = None):
+                 hbm_budget_bytes: int | None = None, render=None):
         from collections import OrderedDict
 
         self.planner = planner
+        # session-start prompt renderer: the few-shot prefix by default;
+        # distilled checkpoints pass train.distill.distilled_prompt (the
+        # task lives in their weights — the ~880-token prefix would be
+        # out-of-distribution for them, not just wasted prefill)
+        self.render = render or render_prompt
         # never exceed the planner's reserved headroom: its bucket
         # accounting guarantees max_new_tokens slots past the transcript,
         # so a larger request here would truncate mid-JSON at the bucket
@@ -486,7 +491,7 @@ class PlannerParser:
 
             try:
                 if sess is None:
-                    sess = self.planner.start(render_prompt(text, context))
+                    sess = self.planner.start(self.render(text, context))
                 else:
                     self.planner.extend(sess, f"\n<|user|>\n{user}\n<|assistant|>\n")
                 out_text, _ = self._gather.plan(sess, self.max_new_tokens)
@@ -827,6 +832,33 @@ def make_parser_from_env() -> IntentParser:
         return _wrap_batched(PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(pp, tp),
                                             batch_slots=slots, quant=quant,
                                             fast_forward=ff))
+    if backend.startswith("planner-distilled"):
+        # the in-tree trained intent checkpoint behind the SESSION-KEYED
+        # planner: multi-turn transcripts with the distilled short prompt
+        # (round-4 VERDICT next #8 — multi-turn quality through the planner
+        # with a trained model). BRAIN_BACKEND=planner-distilled[:<dir>]
+        import jax
+
+        from ..models.llama import LlamaConfig
+        from ..parallel.ring import sp_mesh
+        from ..serve import LongSessionPlanner
+        from ..train import distill
+
+        warn_unused("planner-distilled", BRAIN_PAGED=paged, BRAIN_QUANT=quant,
+                    BRAIN_MOE=moe)
+        path = (backend.split(":", 1)[1] if ":" in backend
+                else os.path.join("checkpoints", distill.INTENT_CKPT))
+        loaded = distill.load_ckpt_path(path, LlamaConfig)
+        if loaded is None:
+            raise ValueError(f"no distilled intent checkpoint at {path} "
+                             "(run python -m tpu_voice_agent.train.make_tiny_ckpts)")
+        cfg, params = loaded
+        sp = int(os.environ.get("BRAIN_SP", "0")) or len(jax.devices())
+        planner = LongSessionPlanner(cfg=cfg, mesh=sp_mesh(sp),
+                                     ctx_buckets=(512, 1024, 2048),
+                                     fast_forward=ff)
+        planner.load_params(params)
+        return PlannerParser(planner, render=distill.distilled_prompt)
     if backend.startswith("planner"):
         # long-session transcripts as model context; BRAIN_SP sizes the
         # sequence-parallel axis (default: every visible device)

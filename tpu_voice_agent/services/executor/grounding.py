@@ -54,12 +54,13 @@ class TPUGrounder:
     """
 
     def __init__(self, preset: str = "qwen2vl-test", max_len: int = 256,
-                 model_dir: str | None = None):
+                 model_dir: str | None = None, ckpt_dir: str | None = None):
         import threading
 
         self.preset = preset
         self.max_len = max_len
         self.model_dir = model_dir  # real HF checkpoint dir (qwen2vl-hf:<dir>)
+        self.ckpt_dir = ckpt_dir  # in-tree trained orbax dir (ground-ckpt:<dir>)
         self._engine = None
         self._build_lock = threading.Lock()  # warm thread vs request thread
 
@@ -71,6 +72,15 @@ class TPUGrounder:
                 if self.model_dir:
                     self._engine = GroundingEngine.from_hf(
                         self.model_dir, max_len=max(self.max_len, 512))
+                elif self.ckpt_dir:
+                    from ...train.ground import grounding_engine_from, load_ground_ckpt
+
+                    loaded = load_ground_ckpt(self.ckpt_dir)
+                    if loaded is None:
+                        raise FileNotFoundError(
+                            f"no grounding-tiny checkpoint under {self.ckpt_dir}")
+                    self._engine = grounding_engine_from(
+                        *loaded, max_len=self.max_len)
                 else:
                     self._engine = GroundingEngine(preset=self.preset,
                                                    max_len=self.max_len)

@@ -65,9 +65,11 @@ class FakePage:
     vi.fn() page.
     """
 
-    def __init__(self, elements: list[FakeElement] | None = None, url: str = "about:blank"):
+    def __init__(self, elements: list[FakeElement] | None = None, url: str = "about:blank",
+                 screenshot_png: bytes | None = None):
         self.url = url
         self.title = "Fake Page"
+        self.screenshot_png = screenshot_png  # real PNG for VL-grounding tests
         self.elements: list[FakeElement] = elements or []
         self.actions: list[tuple] = []
         self.history: list[str] = [url]
@@ -276,13 +278,11 @@ class FakePage:
     def screenshot(self, path: str, full_page: bool = True) -> None:
         self._maybe_fail("screenshot")
         with open(path, "wb") as f:
-            # 1x1 transparent PNG
-            f.write(
-                bytes.fromhex(
-                    "89504e470d0a1a0a0000000d4948445200000001000000010802000000907753de"
-                    "0000000c49444154789c63606060000000040001f61738550000000049454e44ae426082"
-                )
-            )
+            # injected page image (VL-grounding tests) or a 1x1 PNG
+            f.write(self.screenshot_png or bytes.fromhex(
+                "89504e470d0a1a0a0000000d4948445200000001000000010802000000907753de"
+                "0000000c49444154789c63606060000000040001f61738550000000049454e44ae426082"
+            ))
         self.actions.append(("screenshot", path))
 
     def close(self) -> None:

@@ -44,6 +44,12 @@ def make_grounder_from_env():
         from .grounding import TPUGrounder
 
         return TPUGrounder(model_dir=arg)
+    if name == "ground-ckpt":
+        # in-tree trained grounding checkpoint (train.ground, orbax layout;
+        # default the committed checkpoints/ root)
+        from .grounding import TPUGrounder
+
+        return TPUGrounder(ckpt_dir=arg or "checkpoints")
     raise ValueError(f"unknown EXECUTOR_GROUNDING {spec!r}")
 
 

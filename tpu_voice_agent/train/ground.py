@@ -1,0 +1,306 @@
+"""Grounding quality with zero egress: synthetic screenshots -> trained
+Qwen2-VL-test checkpoint -> point-in-bbox accuracy (round-4 VERDICT next #4).
+
+Until round 5, grounding was the one model family with zero semantic proof:
+``benches/bench_grounding.py`` grounded a random-noise image with
+random-init weights (latency only), and the executor's VL click fallback
+(services/executor/actions.py grounded_click) had never been shown to click
+the right thing. This module closes that the same way ``train/distill.py``
+did for STT — a deterministic synthetic task at the scale this zero-egress
+image permits, trained end to end through the REAL serving stack:
+
+- ``sample_page`` renders a 112x112 "web page" of 3 visually distinct
+  widgets (search box, submit button, cart, menu, ...) at random
+  non-overlapping positions with known bboxes. Widget identity is carried
+  by color/shape (plus a drawn text label): a 2-layer d32 vision tower
+  cannot OCR 5-px glyphs, so class-identifiable appearance is the visual
+  analog of the acoustic font ``distill.render_speech`` uses for STT.
+- ``train_grounding`` teacher-forces the exact serve-time token layout
+  (vision prefix + ``serve.grounding.prompt_text`` chat template +
+  grammar-shaped ``{"point":[x,y],"label":"..."}`` target) through
+  ``models.qwen2vl.forward_embeds``, training vision tower + LM jointly.
+- ``score_grounding`` runs the REAL ``GroundingEngine.ground`` (letterbox,
+  M-RoPE prefill, constrained whole-decode-in-one-dispatch loop) on
+  HELD-OUT page layouts and scores point-in-target-bbox accuracy.
+  Chance for a uniform-random point is the mean target-bbox area fraction
+  (~4% of the page); picking the center of a random widget scores ~1/3.
+
+Reference parity: this AUGMENTS the reference's DOM-scan-only targeting
+(apps/executor/src/dom-analyzer.ts:34-448) — the capability BASELINE
+config 5 names; the reference has no vision path at all.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GROUND_CKPT = "grounding-tiny"
+
+PAGE = 112  # == qwen2vl-test vision img_size: letterbox is the identity
+
+# class name -> (fill RGB, (w, h) base size). Colors are far apart in RGB
+# so 28-px vision cells resolve identity; sizes differ so shape helps too.
+WIDGETS: dict[str, tuple[tuple[int, int, int], tuple[int, int]]] = {
+    "search box": ((66, 133, 244), (52, 14)),
+    "submit button": ((52, 168, 83), (34, 16)),
+    "cancel button": ((234, 67, 53), (34, 16)),
+    "cart button": ((251, 140, 0), (26, 18)),
+    "menu button": ((156, 39, 176), (20, 20)),
+    "login button": ((0, 172, 193), (30, 14)),
+    "upload button": ((121, 85, 72), (30, 18)),
+    "home link": ((255, 214, 0), (24, 12)),
+}
+
+TRAIN_TEMPLATES = [
+    "click the {c}", "press the {c}", "tap the {c}", "open the {c}",
+    "find the {c}",
+]
+# held-out phrasing: score_grounding uses these, so the eval also proves the
+# instruction side survives a template never seen in training
+EVAL_TEMPLATES = ["click the {c}", "select the {c}"]
+
+
+def sample_page(rng: np.random.Generator, n_widgets: int = 3):
+    """One synthetic page: returns (img uint8 (PAGE, PAGE, 3),
+    widgets=[{"cls", "bbox": (x, y, w, h)}]). Placement is rejection-
+    sampled to keep bboxes disjoint (8 px margin) so point-in-bbox is
+    unambiguous."""
+    from PIL import Image, ImageDraw
+
+    im = Image.new("RGB", (PAGE, PAGE), (250, 250, 250))
+    draw = ImageDraw.Draw(im)
+    classes = rng.choice(list(WIDGETS), size=n_widgets, replace=False)
+    placed: list[dict] = []
+    for cls in classes:
+        color, (bw, bh) = WIDGETS[cls]
+        bw = int(bw * rng.uniform(0.85, 1.15))
+        bh = int(bh * rng.uniform(0.85, 1.15))
+        for _ in range(100):
+            x = int(rng.integers(2, PAGE - bw - 2))
+            y = int(rng.integers(2, PAGE - bh - 2))
+            if all(x + bw + 8 < p["bbox"][0] or p["bbox"][0] + p["bbox"][2] + 8 < x
+                   or y + bh + 8 < p["bbox"][1] or p["bbox"][1] + p["bbox"][3] + 8 < y
+                   for p in placed):
+                break
+        else:  # crowded sample: skip this widget rather than overlap
+            continue
+        draw.rectangle([x, y, x + bw, y + bh], fill=color,
+                       outline=(40, 40, 40))
+        # tiny label text: auxiliary realism; identity signal is color/shape
+        draw.text((x + 2, y + max(0, bh // 2 - 5)), cls.split()[0][:6],
+                  fill=(255, 255, 255))
+        placed.append({"cls": str(cls), "bbox": (x, y, bw, bh)})
+    return np.asarray(im, dtype=np.uint8), placed
+
+
+def _target_string(bbox: tuple[int, int, int, int], cls: str) -> str:
+    x, y, w, h = bbox
+    xn = min(999, round((x + w / 2) / PAGE * 1000))
+    yn = min(999, round((y + h / 2) / PAGE * 1000))
+    return json.dumps({"point": [xn, yn], "label": cls},
+                      separators=(",", ":"))
+
+
+def build_rows(n_pages: int, seed: int, templates: list[str] | None = None):
+    """(images f32 (R, PAGE, PAGE, 3), instructions, targets, widgets-per-
+    page). One training row per page: a uniformly chosen widget is the
+    target."""
+    rng = np.random.default_rng(seed)
+    templates = templates or TRAIN_TEMPLATES
+    imgs, instrs, targets, pages = [], [], [], []
+    for _ in range(n_pages):
+        img, widgets = sample_page(rng)
+        if not widgets:
+            continue
+        w = widgets[int(rng.integers(len(widgets)))]
+        t = templates[int(rng.integers(len(templates)))]
+        imgs.append(img.astype(np.float32) / 255.0)
+        instrs.append(t.format(c=w["cls"]))
+        targets.append(_target_string(w["bbox"], w["cls"]))
+        pages.append(widgets)
+    return np.stack(imgs), instrs, targets, pages
+
+
+def train_grounding(
+    steps: int = 4000,
+    batch: int = 16,
+    n_pages: int = 512,
+    lr: float = 2e-3,
+    seed: int = 0,
+    log=None,
+):
+    """Train qwen2vl-test on the synthetic grounding task; returns
+    (cfg, params, stats). Serve via ``grounding_engine_from``."""
+    import optax
+
+    from ..models.qwen2vl import (
+        PRESETS,
+        embed_tokens,
+        forward_embeds,
+        init_kv_cache,
+        init_params,
+        text_positions3,
+        vision_forward,
+        vision_token_positions,
+    )
+    from ..serve.grounding import build_grounding_fsm, prompt_text
+
+    tok, _ = build_grounding_fsm()
+    cfg = replace(PRESETS["qwen2vl-test"], vocab_size=tok.vocab_size)
+    nv, gm = cfg.vision.n_tokens, cfg.vision.merged_grid
+
+    imgs, instrs, targets, _ = build_rows(n_pages, seed)
+    R = imgs.shape[0]
+
+    # serve-time token layout: [bos] + prompt + target + [eos], vision prefix
+    rows, loss_lo = [], []
+    for ins, tgt in zip(instrs, targets):
+        p = [tok.bos_id] + tok.encode(prompt_text(ins), bos=False, eos=False)
+        t = tok.encode(tgt, bos=False, eos=False) + [tok.eos_id]
+        rows.append(p + t)
+        loss_lo.append(len(p))  # predictions at [len(p)-1, len(row)-2] score
+    T = max(len(r) for r in rows)
+    toks = np.full((R, T), tok.pad_id, np.int32)
+    mask = np.zeros((R, T), np.float32)
+    for i, (r, lo) in enumerate(zip(rows, loss_lo)):
+        toks[i, : len(r)] = r
+        mask[i, lo: len(r)] = 1.0  # CE on target + eos tokens
+    vis_pos = np.asarray(vision_token_positions(cfg.vision))
+
+    params = jax.jit(partial(init_params, cfg, dtype=jnp.float32))(
+        jax.random.PRNGKey(seed))
+    sched = optax.cosine_decay_schedule(lr, steps, alpha=0.05)
+    optimizer = optax.adamw(sched, weight_decay=0.01)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, img_j, toks_j, mask_j):
+        B = img_j.shape[0]
+        vis = vision_forward(params["vision"], cfg.vision, img_j)  # (B, nv, D)
+        txt = embed_tokens(params, toks_j)
+        embeds = jnp.concatenate([vis, txt], axis=1)
+        S = nv + T
+        slots = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+        vp = jnp.broadcast_to(jnp.asarray(vis_pos)[:, None, :], (3, B, nv))
+        tp = text_positions3(gm, T, batch=B)
+        pos3 = jnp.concatenate([vp, tp], axis=2)
+        cache = init_kv_cache(cfg, B, S, dtype=jnp.float32)
+        logits, _ = forward_embeds(params, cfg, embeds, slots, pos3, cache)
+        lt = logits[:, nv - 1: nv + T - 1]  # predicts text token at same idx
+        logp = jax.nn.log_softmax(lt.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, toks_j[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * mask_j) / jnp.maximum(jnp.sum(mask_j), 1.0)
+
+    @jax.jit
+    def step_fn(params, opt_state, img_j, toks_j, mask_j):
+        loss, grads = jax.value_and_grad(loss_fn)(params, img_j, toks_j, mask_j)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(seed + 1)
+    t0 = time.perf_counter()
+    first = ema = None
+    for s in range(steps):
+        pick = rng.choice(R, size=batch, replace=False)
+        params, opt_state, loss = step_fn(
+            params, opt_state, jnp.asarray(imgs[pick]),
+            jnp.asarray(toks[pick]), jnp.asarray(mask[pick]))
+        lf = float(loss)
+        first = lf if first is None else first
+        ema = lf if ema is None else 0.98 * ema + 0.02 * lf
+        if log and (s % 200 == 0 or s == steps - 1):
+            log(f"grounding step {s}/{steps} loss {lf:.4f} (ema {ema:.4f})")
+    stats = {"steps": steps, "pages": R, "first_loss": first,
+             "final_loss_ema": round(ema, 4),
+             "train_s": round(time.perf_counter() - t0, 1)}
+    return cfg, params, stats
+
+
+def grounding_engine_from(cfg, params, max_len: int = 192):
+    """Serve a trained (f32) grounding checkpoint in bf16 — the engine's
+    serving dtype (its KV cache is bf16; f32 params would down-cast on
+    every cache write). The quality eval runs through exactly this cast,
+    so the reported accuracy is the served accuracy."""
+    from ..serve.grounding import GroundingEngine
+
+    params = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16)
+        if hasattr(a, "dtype") and a.dtype == jnp.float32 else a, params)
+    return GroundingEngine(params=jax.device_put(params), cfg=cfg,
+                           max_len=max_len)
+
+
+def save_ground_ckpt(root: str, cfg, params, stats: dict) -> str:
+    """distill.save_ckpt can't round-trip Qwen2VLConfig (its ``vision``
+    field is a nested dataclass that json-serializes as a string), so the
+    grounding checkpoint flattens it under a "vision" sub-dict."""
+    import os
+
+    from ..ckpt.orbax_io import save_params
+
+    path = os.path.join(root, GROUND_CKPT)
+    save_params(path, params)
+    meta = {"config": {
+        **{k: getattr(cfg, k) for k in cfg.__dataclass_fields__
+           if k != "vision"},
+        "vision": {k: getattr(cfg.vision, k)
+                   for k in cfg.vision.__dataclass_fields__},
+    }, "stats": stats}
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return path
+
+
+def load_ground_ckpt(root: str):
+    """Returns (cfg, params) or None when absent."""
+    import os
+
+    from ..ckpt.orbax_io import restore_params
+    from ..models.qwen2vl import Qwen2VLConfig, VisionConfig
+
+    path = os.path.join(root, GROUND_CKPT)
+    meta_path = os.path.join(path, "meta.json")
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        raw = json.load(f)["config"]
+    vision = VisionConfig(**raw.pop("vision"))
+    raw = {k: (tuple(v) if isinstance(v, list) else v) for k, v in raw.items()}
+    cfg = Qwen2VLConfig(vision=vision, **raw)
+    return cfg, restore_params(path)
+
+
+def score_grounding(engine, n_pages: int = 40, seed: int = 1234) -> dict:
+    """Held-out accuracy through the REAL GroundingEngine.ground: fresh
+    layouts (disjoint seed) and an eval template bank including a phrasing
+    never trained on. Returns {point_in_bbox, label_match, chance, pages}.
+    ``chance`` is the mean target-bbox area fraction — what a uniform
+    random point would score."""
+    from ..serve.grounding import GroundingEngine
+
+    rng = np.random.default_rng(seed)
+    hits = labels = total = 0
+    chance_area = 0.0
+    for i in range(n_pages):
+        img, widgets = sample_page(rng)
+        if not widgets:
+            continue
+        w = widgets[int(rng.integers(len(widgets)))]
+        t = EVAL_TEMPLATES[i % len(EVAL_TEMPLATES)]
+        res = engine.ground(img, t.format(c=w["cls"]), max_new_tokens=32)
+        px, py = GroundingEngine.to_page_px(res, PAGE, PAGE)
+        x, y, bw, bh = w["bbox"]
+        hits += int(x <= px <= x + bw and y <= py <= y + bh)
+        labels += int(res.label == w["cls"])
+        chance_area += (bw * bh) / (PAGE * PAGE)
+        total += 1
+    return {"point_in_bbox": round(hits / max(total, 1), 4),
+            "label_match": round(labels / max(total, 1), 4),
+            "chance": round(chance_area / max(total, 1), 4),
+            "pages": total}

@@ -10,6 +10,10 @@ Produces three orbax checkpoints under ``out_dir`` (default ``checkpoints/``):
 - ``whisper-tiny-heldout``   — whisper-test trained on a DISJOINT augmented
   sentence bank; WHISPER_EVAL_TEXTS is held out, so its WER generalizes.
   This is the script's long pole (~15 min CPU); skip with CKPT_HELDOUT=0.
+- ``grounding-tiny``         — qwen2vl-test trained on synthetic widget
+  screenshots (train.ground); scored point-in-bbox on held-out layouts.
+  Also slow on one CPU core (~1 h; a TPU window trains it in minutes);
+  skip with CKPT_GROUND=0.
 
 Both reload through the real serving stack in benches/bench_quality.py.
 """
@@ -64,6 +68,14 @@ def main(out_dir: str | None = None) -> None:
         gcfg, gparams, gstats = train_whisper_generalize(log=log)
         path = save_ckpt(out, WHISPER_GEN_CKPT, gcfg, gparams, gstats)
         log(f"saved {path} ({gstats})")
+
+    if os.environ.get("CKPT_GROUND") != "0":
+        from .ground import save_ground_ckpt, train_grounding
+
+        log("training grounding (synthetic widget screenshots)...")
+        qcfg, qparams, qstats = train_grounding(log=log)
+        path = save_ground_ckpt(out, qcfg, qparams, qstats)
+        log(f"saved {path} ({qstats})")
 
 
 if __name__ == "__main__":

@@ -46,6 +46,27 @@ def loss_fn(params, cfg: LlamaConfig, tokens, loss_mask, rules=None):
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
+def loss_fn_targets(params, cfg: LlamaConfig, tokens, targets, loss_mask,
+                    rules=None):
+    """Cross-entropy with EXPLICIT per-position targets (still teacher-
+    forced on ``tokens``). Multi-turn planner transcripts need this: the
+    position after a mid-dialog plan's last token must put its mass on EOS
+    (that is how a served turn stops) while the transcript itself continues
+    with the next ``<|user|>`` segment — a shifted-input loss would train
+    that position toward the literal next transcript token and the turn
+    would never terminate. ``targets[i]`` is the label for the prediction
+    made AT position i (i.e. the conventional ids[i+1], overridden with
+    EOS at mid-dialog plan ends)."""
+    B, T = tokens.shape
+    cache = init_kv_cache(cfg, B, T, dtype=jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    logits, _ = forward(params, cfg, tokens, positions, cache, rules, remat=True)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    mask = loss_mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def make_train_step(cfg: LlamaConfig, optimizer=None, rules=None):
     """Build (init_state, train_step). train_step is jit-ready; shardings come
     from the params/opt-state placements (jit infers) plus activation rules."""

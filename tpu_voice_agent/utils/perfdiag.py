@@ -98,6 +98,8 @@ def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
     Returns {findings: [(op, dtype, shape, mbytes, computation)],
     scanned_instructions: N}."""
     comps: dict[str, list] = {}
+    roots: dict[str, str] = {}  # raw ROOT line per computation — tuple
+    # roots never match _INSTR, so they must be kept outside the instr scan
     cur: str | None = None
     # fusion bodies from RAW text: calls= appears on fusion instructions
     # regardless of whether their (possibly tuple) result shape parses
@@ -111,6 +113,8 @@ def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
             continue
         if cur is None:
             continue
+        if line.lstrip().startswith("ROOT"):
+            roots[cur] = line.lstrip()
         m = _INSTR.search(line)
         if m:
             comps[cur].append((m, line))
@@ -125,10 +129,11 @@ def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
 
     for name, instrs in comps.items():
         in_fusion = name in fusion_bodies
-        big_multiplies, big_converts = {}, []
+        big_multiplies, big_converts = {}, {}
         dot_operands: set[str] = set()
         n_dotlike = 0
         root_big = False
+        root_line = None
         for m, line in instrs:
             n += 1
             op = m.group("op")
@@ -143,22 +148,30 @@ def audit_dequant(hlo_text: str, min_bytes: int = 8 << 20) -> dict:
                     # implementing the dot itself shows up here
                     dot_operands.update(_OPERAND.findall(
                         line.split(op + "(", 1)[-1]))
-                elif op == "multiply" and big:
+                elif op in ("multiply", "convert") and big:
                     nm = _RESULT_NAME.match(line.lstrip())
-                    big_multiplies[nm.group("name") if nm else line] = (m, size)
-                elif op == "convert" and big:
-                    big_converts.append((m, size))
+                    bucket = big_multiplies if op == "multiply" else big_converts
+                    bucket[nm.group("name") if nm else line] = (m, size)
             elif big and op in ("convert", "multiply"):
                 record(op, m, size, name)
         if not in_fusion:
             continue
+        # tuple ROOTs never parse via _INSTR (their shape is a tuple), so
+        # the raw ROOT line is scanned instead: a big convert/multiply
+        # feeding the tuple root IS a materialized buffer
+        root_raw = roots.get(name, "")
+        if not root_big and "tuple(" in root_raw:
+            ops = set(_OPERAND.findall(root_raw.split("tuple(", 1)[-1]))
+            root_big = bool(ops & (big_multiplies.keys()
+                                   | big_converts.keys()))
         if n_dotlike == 0:
             # no dot in the body: a big convert/multiply here is a pure
             # dequant fusion — but only a weight-sized ROOT means a real
             # HBM buffer is written (a small root, e.g. a slice of the
             # converted weight, materializes nothing big)
             if root_big:
-                for m, size in (list(big_multiplies.values()) + big_converts)[:1]:
+                for m, size in (list(big_multiplies.values())
+                                + list(big_converts.values()))[:1]:
                     record("fusion:dequant", m, size, name)
         else:
             for nm, (m, size) in big_multiplies.items():
