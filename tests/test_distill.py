@@ -39,8 +39,7 @@ def trained_intent():
     """ONE scaled-down training run shared by the serve + ckpt tests (a
     1-core box pays ~0.35 s/step; two separate trainings doubled the
     module's wall-clock for no extra coverage)."""
-    return distill.train_intent_model(steps=260, corpus_n=1000, seq_len=320,
-                                      dialogs_n=60, batch=16)
+    return distill.train_intent_model(steps=260, seq_len=320, batch=16)
 
 
 def test_dialogs_disjoint_from_golden():

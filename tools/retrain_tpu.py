@@ -1,0 +1,89 @@
+"""One-shot retrain driver for the in-tree tiny checkpoints on a live TPU.
+
+The round-5 training upgrades (multi-turn dialogs + copy-heavy corpus for
+the intent model, the new grounding task, a bigger disjoint bank for the
+whisper generalization checkpoint) are too slow for this image's single
+CPU core (~7 h for grounding alone) but take minutes on the chip — each
+train step is one dispatch, so the ~70 ms tunnel round trip, not the
+math, is the per-step cost at these model sizes.
+
+Run while the TPU window is open (stop tools/tpu_probe.py first — the
+chip is single-tenant): ``python tools/retrain_tpu.py [out_dir]``.
+Each checkpoint saves IMMEDIATELY after its training so a tunnel flap
+mid-run keeps everything already finished; quality scores print at the
+end (and are re-checked on CPU by benches/bench_quality.py either way).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def log(msg: str) -> None:
+    print(f"[retrain {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def main(out: str = "checkpoints") -> None:
+    import jax
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+
+    from tpu_voice_agent.evals import score_parser, score_parser_dialogs
+    from tpu_voice_agent.evals.wer import normalize_words, wer
+    from tpu_voice_agent.train import distill, ground
+
+    results: dict = {}
+
+    # ---- 1. intent v2 (multi-turn dialogs + copy-heavy corpus)
+    log("training intent v2...")
+    cfg, params, stats = distill.train_intent_model(log=log)
+    distill.save_ckpt(out, distill.INTENT_CKPT, cfg, params, stats)
+    log(f"saved intent ({stats})")
+    parser = distill.intent_engine_from(cfg, params)
+    results["intent_golden"] = score_parser(parser)
+    log(f"golden: {results['intent_golden']}")
+    results["intent_dialogs_stateless"] = score_parser_dialogs(parser)
+    log(f"dialogs stateless: {results['intent_dialogs_stateless']}")
+
+    # ---- 2. grounding
+    log("training grounding...")
+    gcfg, gparams, gstats = ground.train_grounding(log=log)
+    ground.save_ground_ckpt(out, gcfg, gparams, gstats)
+    log(f"saved grounding ({gstats})")
+    eng = ground.grounding_engine_from(gcfg, gparams)
+    results["grounding"] = ground.score_grounding(eng)
+    log(f"grounding held-out: {results['grounding']}")
+
+    # ---- 3. whisper generalization v2 (bigger disjoint bank)
+    log("training whisper-gen v2 (640 sentences x 8 variants)...")
+    wcfg, wparams, wstats = distill.train_whisper_generalize(
+        steps=9000, n_sentences=640, variants=8, log=log)
+    weng = distill.whisper_engine_from(wcfg, wparams)
+    te = tw = 0.0
+    for t in distill.WHISPER_EVAL_TEXTS:
+        hyp = weng.transcribe(distill.render_speech(t)).text
+        n = max(len(normalize_words(t)), 1)
+        te += wer(t, hyp) * n
+        tw += n
+        log(f"  ref={t!r} hyp={hyp!r}")
+    w2 = te / tw
+    results["whisper_heldout_wer_v2"] = w2
+    log(f"held-out WER v2: {w2:.4f} (committed v1: 0.4194)")
+    if w2 < 0.4194:
+        distill.save_ckpt(out, distill.WHISPER_GEN_CKPT, wcfg, wparams, wstats)
+        log("v2 beats v1 -> saved over whisper-tiny-heldout")
+    else:
+        log("v2 does NOT beat v1 -> keeping the committed checkpoint")
+
+    print(json.dumps(results, indent=1, default=str))
+
+
+if __name__ == "__main__":
+    main(*(sys.argv[1:2]))

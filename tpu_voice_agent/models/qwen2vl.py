@@ -132,7 +132,21 @@ PRESETS: dict[str, Qwen2VLConfig] = {
 # ---------------------------------------------------------------- params
 
 
-def init_vision_params(cfg: VisionConfig, out_dim: int, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+def init_vision_params(cfg: VisionConfig, out_dim: int, key: jax.Array, dtype=jnp.bfloat16,
+                       pos_embed: bool = True) -> dict:
+    """``pos_embed=True`` adds a learned absolute position embedding over
+    the MERGED vision tokens (applied in vision_forward when the key is
+    present). RoPE — 2D in the tower, M-RoPE in the decoder — encodes
+    position only in attention SCORES: the value vector a decoder head
+    retrieves from a matched vision token is position-free, so a shallow
+    decoder can find "the orange widget" but cannot read out WHERE it was
+    (round-5 grounding trainings plateaued with point accuracy at chance
+    while class accuracy generalized, for exactly this reason; deep VLMs
+    build multi-hop positional probes a 2-layer test config cannot). An
+    explicit embedding puts the coordinates in the VALUES — one attention
+    hop reads content + position together. HF checkpoints have no such
+    tensor, so ``qwen2vl_from_hf_state`` simply omits the key and imported
+    towers are bit-identical to before."""
     d, hd, L = cfg.d_model, cfg.head_dim, cfg.n_layers
     patch_in = cfg.patch_size * cfg.patch_size * 3
     merged_in = cfg.merge_size * cfg.merge_size * d
@@ -172,6 +186,11 @@ def init_vision_params(cfg: VisionConfig, out_dim: int, key: jax.Array, dtype=jn
             "w2": w(ks[8], merged_in, out_dim),
             "b2": zeros(out_dim),
         },
+        # scale matches the merger output's activation std (~0.5 at init):
+        # a 0.02-scale embedding starts ~27x under the content noise floor
+        # and the decoder never learns to read it (measured round 5)
+        **({"pos_embed": w(ks[9], cfg.n_tokens, out_dim, scale=0.5)}
+           if pos_embed else {}),
     }
 
 
@@ -309,6 +328,11 @@ def vision_forward(params: dict, cfg: VisionConfig, images: jax.Array, rules=Non
     h = jax.nn.gelu(h, approximate=False).astype(jnp.bfloat16)  # HF merger: exact erf GELU
     out = (jnp.einsum("bno,od->bnd", h, params["merger"]["w2"],
                       preferred_element_type=jnp.float32) + params["merger"]["b2"].astype(jnp.float32))
+    if "pos_embed" in params:
+        # learned absolute positions in the VALUES (see init_vision_params:
+        # RoPE alone leaves retrieved vision values position-free, which a
+        # shallow decoder cannot localize from); HF imports lack the key
+        out = out + params["pos_embed"].astype(jnp.float32)[None]
     return cs(out.astype(jnp.bfloat16), "act")
 
 

@@ -829,9 +829,16 @@ def make_parser_from_env() -> IntentParser:
         ndev = len(jax.devices())
         pp = int(os.environ.get("BRAIN_PP", "0")) or min(2, ndev)
         tp = int(os.environ.get("BRAIN_TP", "0")) or max(1, ndev // pp)
+        # ff defaults OFF here, unlike every other engine: the round-5
+        # on-chip capture measured fast-forward HURTING the staged layout
+        # (219.6 -> 135.5 tok/s, 6.4 -> 4.8 intents/s; BENCH_tpu_20260731_
+        # 031554.json) — the wide (B, 1+W) step multiplies the per-stage
+        # fill-drain bubble where the dense/paged layouts ride it free.
+        # CPU measured the opposite (+14%), so the knob stays available.
+        ppff = int(os.environ.get("BRAIN_FF", "0"))
         return _wrap_batched(PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(pp, tp),
                                             batch_slots=slots, quant=quant,
-                                            fast_forward=ff))
+                                            fast_forward=ppff))
     if backend.startswith("planner-distilled"):
         # the in-tree trained intent checkpoint behind the SESSION-KEYED
         # planner: multi-turn transcripts with the distilled short prompt

@@ -80,12 +80,25 @@ def _golden_texts() -> set[str]:
 
 _SYLLS = ["ka", "lo", "mi", "zu", "ta", "ren", "vor", "bex", "dal", "nix",
           "pra", "sum", "tir", "wob", "gim", "fen", "hul", "jaz", "qui", "yol"]
+_CONS = "bcdfghjklmnpqrstvwxz"
+_VOWS = "aeiou"
 
 
 def _pseudo_word(rng) -> str:
     """Novel pronounceable non-word — the model cannot memorize these, so
     search queries / button names built from them force TRUE copying (an
-    induction-head behavior) instead of bank-item recall."""
+    induction-head behavior) instead of bank-item recall. Two generators:
+    syllable-bank compounds (common BPE pieces) and char-level CV strings
+    (rare pieces / byte fallbacks — the hardest copy class, covering real
+    but bank-unseen English like "mechanical" or "checkout" whose
+    tokenizations the syllable bank never produces)."""
+    if rng.random() < 0.35:
+        n = int(rng.integers(4, 10))
+        chars = []
+        for i in range(n):
+            bank = _CONS if i % 2 == 0 else _VOWS
+            chars.append(bank[int(rng.integers(len(bank)))])
+        return "".join(chars)
     k = int(rng.integers(2, 4))
     return "".join(_SYLLS[int(rng.integers(len(_SYLLS)))] for _ in range(k))
 
@@ -112,19 +125,24 @@ def synth_intent_corpus(n: int = 4000, seed: int = 0) -> list[tuple[str, dict, s
 
     def noun_phrase() -> str:
         # pseudo-words force copy generalization (they cannot be
-        # memorized); the 0.55 share + mixed bank/pseudo phrases are the
-        # round-5 copy-strengthening lever (golden args gap: the model
-        # nailed types but garbled free-text spans — "waterproof hiking
-        # boots" -> "waterproof bished blaptops")
-        r = rng.random()
-        if r < 0.2:
-            return _pseudo_word(rng)
-        if r < 0.4:
-            return f"{_pseudo_word(rng)} {_pseudo_word(rng)}"
-        if r < 0.55:  # mixed: a real adjective over an unseen noun & v.v.
-            return (f"{pick(_ADJS)} {_pseudo_word(rng)}" if rng.random() < 0.5
-                    else f"{_pseudo_word(rng)} {pick(_NOUNS)}")
-        return f"{pick(_ADJS)} {pick(_NOUNS)}"
+        # memorized). Phrase SHAPE varies 1-4 words with bank/pseudo words
+        # mixed per-slot: golden misses like "waterproof hiking boots" and
+        # "usb c chargers" are 3-word shapes the old 2-word templates never
+        # produced — the copy circuit must be shape-general, not just
+        # vocab-general (round-5 streaming-v4 lever; v3 hit ~0 loss on its
+        # own distribution yet still missed these shapes).
+        n = 1 + int(rng.random() < 0.75) + int(rng.random() < 0.35) \
+            + int(rng.random() < 0.15)
+        words = []
+        for i in range(n):
+            r = rng.random()
+            if r < 0.45:
+                words.append(_pseudo_word(rng))
+            elif i == 0 and n > 1:
+                words.append(pick(_ADJS))
+            else:
+                words.append(pick(_NOUNS))
+        return " ".join(words)
 
     makers = []
 
@@ -144,7 +162,7 @@ def synth_intent_corpus(n: int = 4000, seed: int = 0) -> list[tuple[str, dict, s
     @fam(2)
     def _navigate():
         s = pick(_SITES)
-        if rng.random() < 0.3:
+        if rng.random() < 0.5:
             s = _pseudo_word(rng) + pick([".com", ".org", ".net", ".io"])
         return pick(["go to {s}", "open {s}", "navigate to {s}",
                      "navigate to {s} please"]).format(s=s), {}, None
@@ -171,7 +189,7 @@ def synth_intent_corpus(n: int = 4000, seed: int = 0) -> list[tuple[str, dict, s
 
     @fam(3)
     def _click_text():
-        b = _pseudo_word(rng) if rng.random() < 0.4 else pick(_BUTTONS)
+        b = _pseudo_word(rng) if rng.random() < 0.55 else pick(_BUTTONS)
         return pick(["click the {b} button", "click {b}",
                      "click on the {b} button"]).format(b=b), {}, None
 
@@ -520,27 +538,49 @@ def train_intent_model(
     dialogs_n: int = 900,
     lr: float = 3e-3,
     seed: int = 0,
+    stream: bool = True,
+    dim: int | None = None,
+    n_layers: int | None = None,
+    ffn_dim: int | None = None,
     log=None,
 ):
     """Train test-tiny on the synthetic corpus + multi-turn planner-shaped
     dialogs; returns (cfg, params, stats). f32 weights (bf16 rounding hurts
-    at this scale and the model is tiny). seq_len 320 fits the 2-3 turn
-    transcripts; the round-5 budget bump (1400 -> 2600 steps, copy-heavier
-    corpus, dialog mixing) targets the golden args gap (0.7 vs the rule
-    teacher's 0.967 — free-text copying was the failure mode)."""
+    at this scale and the model is tiny). ``dim``/``n_layers``/``ffn_dim``
+    optionally widen the student past the test-tiny preset (the checkpoint
+    carries its own config, so serving is unchanged) — byte-level copying
+    over a long JSON prompt is the task's hard part and benefits from a
+    third layer / wider residual stream.
+
+    ``stream=True`` (round-5 fix for the golden args gap): every step draws
+    a FRESH corpus/dialog sample with a step-derived seed, so pseudo-word
+    copy spans never repeat across the run. The fixed-corpus variant
+    collapsed train loss to ~1e-3 by MEMORIZING the ~6k completions —
+    scoring worse on golden copying ("search for mechanical keyboards" ->
+    query "wireless keyboards", a bank recall) than a shorter run. With
+    never-repeating spans, copying the prompt is the only strategy that
+    reduces loss. ``stream=False`` keeps the epoch path (corpus_n /
+    dialogs_n sized) for comparisons."""
     import optax
 
     from ..grammar.intent_grammar import build_intent_fsm
     from ..models.llama import PRESETS, init_params
     from .step import loss_fn_targets
 
+    if stream and (corpus_n != 5000 or dialogs_n != 900):
+        import warnings
+
+        warnings.warn(
+            "corpus_n/dialogs_n size a FIXED corpus and are ignored under "
+            "stream=True (fresh data every step); pass stream=False to use "
+            "them", stacklevel=2)
     tokenizer, _ = build_intent_fsm()
     cfg = replace(PRESETS["test-tiny"], vocab_size=tokenizer.vocab_size,
                   max_seq_len=seq_len)
-    corpus = synth_intent_corpus(corpus_n, seed=seed)
-    dialogs = synth_intent_dialogs(dialogs_n, seed=seed + 11)
-    toks, tgts, masks = build_intent_batches(
-        corpus, tokenizer, seq_len, batch, seed, dialogs=dialogs)
+    if dim or n_layers or ffn_dim:
+        cfg = replace(cfg, dim=dim or cfg.dim,
+                      n_layers=n_layers or cfg.n_layers,
+                      ffn_dim=ffn_dim or cfg.ffn_dim)
     params = jax.jit(partial(init_params, cfg, dtype=jnp.float32))(
         jax.random.PRNGKey(seed))
 
@@ -557,21 +597,48 @@ def train_intent_model(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    if stream:
+        def batch_for(s: int):
+            # fresh data every step: ~1/4 dialog rows, the rest single-turn.
+            # Over-generate so seq_len drops still leave a full batch (and
+            # retry bigger in the pathological all-dropped case).
+            extra = 6
+            while True:
+                c = synth_intent_corpus(batch + extra,
+                                        seed=seed + 1000 + s * 2)
+                d = synth_intent_dialogs(max(2, batch // 4),
+                                         seed=seed + 999_983 + s * 2)
+                out = build_intent_batches(c, tokenizer, seq_len, batch,
+                                           seed + s, dialogs=d)
+                if out[0].shape[0] > 0:
+                    return out
+                extra *= 2
+    else:
+        corpus = synth_intent_corpus(corpus_n, seed=seed)
+        dialogs = synth_intent_dialogs(dialogs_n, seed=seed + 11)
+        toks_e, tgts_e, masks_e = build_intent_batches(
+            corpus, tokenizer, seq_len, batch, seed, dialogs=dialogs)
+
+        def batch_for(s: int):
+            b = s % toks_e.shape[0]
+            return toks_e[b: b + 1], tgts_e[b: b + 1], masks_e[b: b + 1]
+
     t0 = time.perf_counter()
     first = last = None
-    nb = toks.shape[0]
+    n_seen = 0
     for s in range(steps):
-        b = s % nb
+        toks, tgts, masks = batch_for(s)
+        n_seen += int(toks.shape[0] * toks.shape[1])
         params, opt_state, loss = step_fn(
-            params, opt_state, jnp.asarray(toks[b]), jnp.asarray(tgts[b]),
-            jnp.asarray(masks[b]))
+            params, opt_state, jnp.asarray(toks[0]), jnp.asarray(tgts[0]),
+            jnp.asarray(masks[0]))
         if s == 0:
             first = float(loss)
         if log and (s % 100 == 0 or s == steps - 1):
             log(f"intent train step {s}/{steps} loss {float(loss):.4f}")
     last = float(loss)
-    stats = {"steps": steps, "examples": int(toks.shape[0] * batch),
-             "dialogs": len(dialogs), "first_loss": first, "final_loss": last,
+    stats = {"steps": steps, "examples": n_seen, "stream": stream,
+             "first_loss": first, "final_loss": last,
              "train_s": round(time.perf_counter() - t0, 1)}
     return cfg, params, stats
 
