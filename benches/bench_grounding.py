@@ -16,7 +16,7 @@ from pathlib import Path
 import numpy as np
 
 sys.path.insert(0, str(Path(__file__).parent))
-from common import emit, log, on_tpu, percentile  # noqa: E402
+from common import checkpoints_dir, emit, log, on_tpu, percentile  # noqa: E402
 
 
 def main(iters: int = 8) -> None:
@@ -33,7 +33,7 @@ def main(iters: int = 8) -> None:
         # init — latency only); quality rows live in bench_quality.py
         from tpu_voice_agent.train.ground import grounding_engine_from, load_ground_ckpt
 
-        loaded = load_ground_ckpt("checkpoints")
+        loaded = load_ground_ckpt(checkpoints_dir())
         if loaded is not None:
             engine = grounding_engine_from(*loaded)
             log("preset=qwen2vl-test (trained checkpoints/grounding-tiny)")

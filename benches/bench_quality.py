@@ -19,7 +19,7 @@ from __future__ import annotations
 import os
 import sys
 
-from common import emit, log  # noqa: E402 (adds repo root to sys.path)
+from common import checkpoints_dir, emit, log  # noqa: E402 (adds repo root to sys.path)
 
 
 def intent_rows() -> None:
@@ -80,7 +80,7 @@ def neural_rows() -> None:
     if os.environ.get("QUALITY_NEURAL") == "0":
         log("QUALITY_NEURAL=0; skipping neural quality rows")
         return
-    root = os.environ.get("QUALITY_CKPT_DIR", "checkpoints")
+    root = os.environ.get("QUALITY_CKPT_DIR") or checkpoints_dir()
 
     from tpu_voice_agent.evals import score_parser
     from tpu_voice_agent.evals.wer import wer, normalize_words
@@ -116,8 +116,10 @@ def neural_rows() -> None:
     emit("dialog_type_accuracy_neural", ds["type_accuracy"], "fraction")
     emit("dialog_args_score_neural", ds["args_score"], "fraction")
 
+    # ff deliberately off: forced-chain canonical emission derails the
+    # trained model at later free choices (services/brain.py note)
     planner = LongSessionPlanner(cfg=cfg, mesh=sp_mesh(1),
-                                 ctx_buckets=(512, 1024), fast_forward=8)
+                                 ctx_buckets=(512, 1024))
     planner.load_params(params)
     pparser = PlannerParser(planner, render=distill.distilled_prompt)
     dsp = score_parser_dialogs(pparser, session=True)

@@ -18,6 +18,11 @@ _ROOT = str(Path(__file__).parent.parent)
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
+
+def checkpoints_dir() -> str:
+    """Repo-root-anchored checkpoints/ (benches run with cwd benches/)."""
+    return str(Path(_ROOT) / "checkpoints")
+
 # Device-init hardening (VERDICT round-4 weak #1: run_all.py --quick hung
 # >9.5 min unpinned on this image's flaky axon tunnel). Import-time is the
 # right place: every bench imports common before touching jax, so the first

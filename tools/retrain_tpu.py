@@ -41,28 +41,41 @@ def main(out: str = "checkpoints") -> None:
 
     results: dict = {}
 
-    # ---- 1. intent v2 (multi-turn dialogs + copy-heavy corpus)
-    log("training intent v2...")
+    # ---- 1. intent (multi-turn dialogs + copy-heavy streaming corpus)
+    log("training intent...")
     cfg, params, stats = distill.train_intent_model(log=log)
-    distill.save_ckpt(out, distill.INTENT_CKPT, cfg, params, stats)
-    log(f"saved intent ({stats})")
     parser = distill.intent_engine_from(cfg, params)
-    results["intent_golden"] = score_parser(parser)
-    log(f"golden: {results['intent_golden']}")
-    results["intent_dialogs_stateless"] = score_parser_dialogs(parser)
-    log(f"dialogs stateless: {results['intent_dialogs_stateless']}")
+    stats["golden"] = results["intent_golden"] = score_parser(parser)
+    log(f"golden: {stats['golden']}")
+    stats["dialogs"] = results["intent_dialogs_stateless"] = (
+        score_parser_dialogs(parser))
+    log(f"dialogs stateless: {stats['dialogs']}")
+    # scores ride in meta.json so the committed artifact records them
+    distill.save_ckpt(out, distill.INTENT_CKPT, cfg, params, stats)
+    log("saved intent")
 
     # ---- 2. grounding
     log("training grounding...")
     gcfg, gparams, gstats = ground.train_grounding(log=log)
-    ground.save_ground_ckpt(out, gcfg, gparams, gstats)
-    log(f"saved grounding ({gstats})")
     eng = ground.grounding_engine_from(gcfg, gparams)
-    results["grounding"] = ground.score_grounding(eng)
-    log(f"grounding held-out: {results['grounding']}")
+    gstats["held_out"] = results["grounding"] = ground.score_grounding(eng)
+    log(f"grounding held-out: {gstats['held_out']}")
+    ground.save_ground_ckpt(out, gcfg, gparams, gstats)
+    log("saved grounding")
 
-    # ---- 3. whisper generalization v2 (bigger disjoint bank)
-    log("training whisper-gen v2 (640 sentences x 8 variants)...")
+    # ---- 3. whisper generalization (bigger disjoint bank); only replaces
+    # the incumbent when the new held-out WER beats the WER recorded in
+    # the incumbent's own meta.json (a hardcoded threshold would let a
+    # worse rerun silently replace a better checkpoint)
+    import os
+
+    incumbent_wer = 1.0
+    meta_path = os.path.join(out, distill.WHISPER_GEN_CKPT, "meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            incumbent_wer = float(
+                json.load(f)["stats"].get("held_out_wer", 1.0))
+    log(f"training whisper-gen (incumbent held-out WER {incumbent_wer})...")
     wcfg, wparams, wstats = distill.train_whisper_generalize(
         steps=9000, n_sentences=640, variants=8, log=log)
     weng = distill.whisper_engine_from(wcfg, wparams)
@@ -74,13 +87,13 @@ def main(out: str = "checkpoints") -> None:
         tw += n
         log(f"  ref={t!r} hyp={hyp!r}")
     w2 = te / tw
-    results["whisper_heldout_wer_v2"] = w2
-    log(f"held-out WER v2: {w2:.4f} (committed v1: 0.4194)")
-    if w2 < 0.4194:
+    wstats["held_out_wer"] = results["whisper_heldout_wer"] = round(w2, 4)
+    log(f"held-out WER: {w2:.4f} (incumbent {incumbent_wer})")
+    if w2 < incumbent_wer:
         distill.save_ckpt(out, distill.WHISPER_GEN_CKPT, wcfg, wparams, wstats)
-        log("v2 beats v1 -> saved over whisper-tiny-heldout")
+        log("beats incumbent -> saved over whisper-tiny-heldout")
     else:
-        log("v2 does NOT beat v1 -> keeping the committed checkpoint")
+        log("does NOT beat incumbent -> keeping the committed checkpoint")
 
     print(json.dumps(results, indent=1, default=str))
 

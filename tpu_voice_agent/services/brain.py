@@ -861,9 +861,13 @@ def make_parser_from_env() -> IntentParser:
                              "(run python -m tpu_voice_agent.train.make_tiny_ckpts)")
         cfg, params = loaded
         sp = int(os.environ.get("BRAIN_SP", "0")) or len(jax.devices())
+        # ff stays at the planner's own default (OFF): forced-chain
+        # emission rewrites the token history into canonical runs and the
+        # trained model derails at later free choices (measured: every
+        # golden dialog truncates mid-string under ff=8, all pass under
+        # ff=0 — exactly the divergence the planner docstring warns about)
         planner = LongSessionPlanner(cfg=cfg, mesh=sp_mesh(sp),
-                                     ctx_buckets=(512, 1024, 2048),
-                                     fast_forward=ff)
+                                     ctx_buckets=(512, 1024, 2048))
         planner.load_params(params)
         return PlannerParser(planner, render=distill.distilled_prompt)
     if backend.startswith("planner"):

@@ -601,7 +601,9 @@ def train_intent_model(
         def batch_for(s: int):
             # fresh data every step: ~1/4 dialog rows, the rest single-turn.
             # Over-generate so seq_len drops still leave a full batch (and
-            # retry bigger in the pathological all-dropped case).
+            # retry bigger in the pathological all-dropped case). Only the
+            # FIRST (batch)-row block trains — slice to it so stats count
+            # what was actually consumed, not the surplus.
             extra = 6
             while True:
                 c = synth_intent_corpus(batch + extra,
@@ -611,7 +613,7 @@ def train_intent_model(
                 out = build_intent_batches(c, tokenizer, seq_len, batch,
                                            seed + s, dialogs=d)
                 if out[0].shape[0] > 0:
-                    return out
+                    return tuple(a[:1] for a in out)
                 extra *= 2
     else:
         corpus = synth_intent_corpus(corpus_n, seed=seed)
