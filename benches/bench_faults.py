@@ -1,0 +1,173 @@
+"""Fault-path tail latency: p50/p99 utterance latency under injected faults.
+
+Boots the real voice service (scripted Null STT, typed-command path) against
+a brain that FAILS /parse calls in deterministic BURSTS (503 shed) and the
+fake-page executor. Bursts, not every-Nth: an isolated fault is always
+absorbed by the immediate retry, so scattered injection would only ever
+measure retry latency — a burst longer than the attempt budget forces real
+degraded (rule-based) utterances and consecutive failures trip the breaker,
+so the measured tail covers retries AND breaker trips AND degradation. The
+fault rate stays ~BENCH_FAULT_RATE overall (burst of BURST calls every
+BURST/rate calls).
+
+Measures command -> intent event latency per utterance — the tail the
+WhisperFlow-style serving papers care about and the happy-path benches
+never see. Emits the standard one-JSON-row-per-metric contract
+(benches/common.py) plus a ``BENCH_faults_<ts>.json`` artifact under
+``bench_artifacts/``.
+
+Knobs: BENCH_FAULT_RATE (default 0.10), BENCH_FAULT_UTTERANCES (default 200).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log, percentile  # noqa: E402
+
+COMMANDS = ["scroll down", "go back", "search for usb hubs",
+            "take a screenshot", "sort by price"]
+
+
+BURST = 3  # consecutive faulted calls per burst (> retry budget)
+
+
+def build_stack(burst_period: int):
+    """voice + flaky brain + fake-page executor on real sockets."""
+    import tempfile
+
+    from aiohttp import web
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.serve.stt import NullSTT
+    from tpu_voice_agent.services.brain import RuleBasedParser
+    from tpu_voice_agent.services.executor import SessionManager
+    from tpu_voice_agent.services.executor import build_app as build_executor
+    from tpu_voice_agent.services.executor.page import FakePage
+    from tpu_voice_agent.services.voice import VoiceConfig
+    from tpu_voice_agent.services.voice import build_app as build_voice
+
+    rule = RuleBasedParser()
+    counts = {"parse": 0, "faults": 0}
+
+    async def parse(request):
+        counts["parse"] += 1
+        if burst_period and counts["parse"] % burst_period < BURST:
+            counts["faults"] += 1
+            return web.json_response(
+                {"error": "overloaded", "detail": "injected fault"},
+                status=503, headers={"Retry-After": "0"})
+        body = await request.json()
+        res = rule.parse(body["text"], body.get("context") or {})
+        return web.json_response(json.loads(res.model_dump_json()))
+
+    brain_app = web.Application()
+    brain_app.router.add_post("/parse", parse)
+    brain = AppServer(brain_app).__enter__()
+
+    tmp = tempfile.mkdtemp(prefix="bench_faults_")
+    manager = SessionManager(page_factory=FakePage.demo,
+                             artifacts_root=os.path.join(tmp, "art"),
+                             uploads_dir=os.path.join(tmp, "up"))
+    executor = AppServer(build_executor(manager)).__enter__()
+    voice = AppServer(build_voice(VoiceConfig(
+        brain_url=brain.url, executor_url=executor.url,
+        stt_factory=lambda: NullSTT(),
+        parse_timeout_s=10.0, retry_attempts=2,
+        breaker_threshold=3, breaker_reset_s=0.2,
+    ))).__enter__()
+    return (voice, executor, brain), counts
+
+
+async def drive(voice_url: str, n_utterances: int):
+    """One live WS; per-utterance command->intent latency (ms)."""
+    import aiohttp
+
+    lat_ms: list[float] = []
+    degraded = 0
+    async with aiohttp.ClientSession() as sess:
+        async with sess.ws_connect(
+                voice_url.replace("http", "ws") + "/stream") as ws:
+            for i in range(n_utterances):
+                text = COMMANDS[i % len(COMMANDS)]
+                t0 = time.perf_counter()
+                await ws.send_json({"type": "text", "text": text})
+                while True:
+                    msg = await ws.receive(timeout=30.0)
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        raise RuntimeError(
+                            f"session dropped at utterance {i}: {msg.type}")
+                    ev = json.loads(msg.data)
+                    if ev["type"] == "intent":
+                        lat_ms.append((time.perf_counter() - t0) * 1e3)
+                        degraded += 1 if ev.get("degraded") else 0
+                        break
+                    if ev["type"] == "error":
+                        raise RuntimeError(f"utterance {i} died: {ev}")
+                # modest think time so an open circuit maps to a realistic
+                # handful of degraded utterances rather than dominating the
+                # run (real speakers pause for seconds; back-to-back sends
+                # would measure the breaker window, not the fault tail)
+                await asyncio.sleep(0.05)
+            # drain the fire-and-forget execute backlog before teardown so
+            # server-side tasks aren't destroyed mid-flight
+            while True:
+                try:
+                    msg = await ws.receive(timeout=1.0)
+                except asyncio.TimeoutError:
+                    break
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    break
+    return lat_ms, degraded
+
+
+def main() -> None:
+    rate = float(os.environ.get("BENCH_FAULT_RATE", "0.10"))
+    n = int(os.environ.get("BENCH_FAULT_UTTERANCES", "200"))
+    burst_period = int(round(BURST / rate)) if rate > 0 else 0
+    servers, counts = build_stack(burst_period)
+    voice = servers[0]
+    try:
+        log(f"{n} utterances, ~{rate:.0%} injected brain-fault rate "
+            f"(bursts of {BURST} every {burst_period} calls)")
+        lat_ms, degraded = asyncio.run(drive(voice.url, n))
+    finally:
+        for srv in servers:
+            srv.__exit__(None, None, None)
+
+    p50 = percentile(lat_ms, 50)
+    p99 = percentile(lat_ms, 99)
+    injected = counts["faults"] / max(1, counts["parse"])
+    log(f"{len(lat_ms)}/{n} utterances answered ({degraded} degraded); "
+        f"{counts['faults']}/{counts['parse']} parses faulted "
+        f"({injected:.1%}); p50 {p50:.1f} ms, p99 {p99:.1f} ms")
+    emit("fault_utt_ms_p50", p50, "ms")
+    emit("fault_utt_ms_p99", p99, "ms")
+    emit("fault_degraded_utterances", degraded, "count")
+    emit("fault_injected_rate", injected, "fraction")
+
+    # BENCH_* artifact: the fault-path tail lands in the perf trajectory
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art = art_dir / f"BENCH_faults_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_faults",
+        "utterances": n,
+        "fault_rate_injected": round(injected, 4),
+        "degraded_utterances": degraded,
+        "fault_utt_ms_p50": round(p50, 3),
+        "fault_utt_ms_p99": round(p99, 3),
+    }, indent=1))
+    log(f"artifact: {art}")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,10 +12,11 @@ import sys
 from pathlib import Path
 
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
-           "bench_quality.py"]
+           "bench_quality.py", "bench_faults.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
-# when no checkpoint is configured; the heavy latency benches are dropped)
-QUICK_BENCHES = ["bench_quality.py"]
+# when no checkpoint is configured; the heavy latency benches are dropped;
+# the fault drill stays — it is service-level, no model, seconds on CPU)
+QUICK_BENCHES = ["bench_quality.py", "bench_faults.py"]
 
 
 def main() -> None:

@@ -5,6 +5,9 @@ is replaced on the next command). Here faults are injectable at every seam
 — STT stream, decode lane, fake page — and the serving loops survive them.
 """
 
+import asyncio
+import json
+
 import numpy as np
 import pytest
 
@@ -53,11 +56,19 @@ def test_voice_session_survives_stt_fault():
                 frame = np.zeros(1600, "<i2").tobytes()
                 await ws.send_bytes(frame)  # hits the injected fault
                 await ws.send_bytes(frame)  # stream must have recovered
-                async with asyncio.timeout(20):
-                    async for msg in ws:
-                        events.append(json.loads(msg.data))
-                        if any(e["type"] == "transcript_partial" for e in events):
-                            break
+                # (asyncio.timeout is 3.11+; receive(timeout=) spells the
+                # same bound on every supported interpreter)
+                end = asyncio.get_event_loop().time() + 20
+                while asyncio.get_event_loop().time() < end:
+                    try:
+                        msg = await ws.receive(timeout=1.0)
+                    except asyncio.TimeoutError:
+                        continue
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        break
+                    events.append(json.loads(msg.data))
+                    if any(e["type"] == "transcript_partial" for e in events):
+                        break
         return events
 
     with AppServer(app) as srv:
@@ -111,6 +122,335 @@ def test_worker_thread_healthy_probe(stt_engine, tiny_batch_engine):
     co.start()
     try:
         assert co.healthy()
+    finally:
+        co.stop()
+    assert not co.healthy()
+
+
+# ---------------------------------------------------------------------------
+# Cross-service resilience drills (deadlines, breakers, degradation — the
+# fault model SURVEY §5 says the reference only handles by hand).
+# ---------------------------------------------------------------------------
+
+
+class _WsDriver:
+    """One LIVE WebSocket session across multiple commands — the whole point
+    of the drills is that a single session survives the outage, so each
+    command must NOT get a fresh connection the way test_voice.ws_session
+    does."""
+
+    def __init__(self, ws):
+        self.ws = ws
+        self.events: list[dict] = []
+
+    async def command(self, text: str) -> None:
+        await self.ws.send_json({"type": "text", "text": text})
+
+    async def until(self, type_: str, timeout_s: float = 10.0) -> dict:
+        import aiohttp
+
+        loop = asyncio.get_event_loop()
+        end = loop.time() + timeout_s
+        while loop.time() < end:
+            try:
+                msg = await self.ws.receive(timeout=1.0)
+            except asyncio.TimeoutError:
+                continue
+            assert msg.type == aiohttp.WSMsgType.TEXT, f"session dropped: {msg.type}"
+            ev = json.loads(msg.data)
+            self.events.append(ev)
+            if ev["type"] == type_:
+                return ev
+        raise AssertionError(f"no {type_!r} event within {timeout_s}s; saw "
+                             f"{[e['type'] for e in self.events]}")
+
+
+def _voice_stack(tmp_path, brain_url: str, **cfg_kw):
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.executor import SessionManager, build_app as build_executor
+    from tpu_voice_agent.services.executor.page import FakePage
+    from tpu_voice_agent.services.voice import VoiceConfig, build_app as build_voice
+
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    voice = AppServer(build_voice(VoiceConfig(
+        brain_url=brain_url,
+        executor_url=cfg_kw.pop("executor_url", executor.url),
+        stt_factory=lambda: NullSTT(),
+        **cfg_kw,
+    ))).__enter__()
+    return voice, executor
+
+
+def test_brain_down_degrades_to_rule_parse_then_recovers(tmp_path):
+    """The acceptance drill: kill the brain mid-session. The SAME WS serves
+    rule-based parses tagged degraded:true while the circuit is open (zero
+    further brain roundtrips), /health reports degraded, and full parsing
+    resumes automatically once the half-open probe finds the brain back."""
+    import aiohttp
+    from aiohttp import web
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import RuleBasedParser
+
+    rule = RuleBasedParser()
+    broken = {"on": False}
+    calls = {"n": 0}
+
+    async def parse(request):
+        calls["n"] += 1
+        if broken["on"]:
+            return web.json_response({"error": "overloaded", "detail": "down"},
+                                     status=503, headers={"Retry-After": "0"})
+        body = await request.json()
+        res = rule.parse(body["text"], body.get("context") or {})
+        return web.json_response(json.loads(res.model_dump_json()))
+
+    brain_app = web.Application()
+    brain_app.router.add_post("/parse", parse)
+    brain = AppServer(brain_app).__enter__()
+    # reset window long enough that the zero-roundtrip assertion below
+    # cannot race a half-open probe on a slow machine
+    voice, executor = _voice_stack(
+        tmp_path, brain.url,
+        parse_timeout_s=5.0, retry_attempts=1,
+        breaker_threshold=1, breaker_reset_s=2.0,
+    )
+
+    async def drive():
+        async with aiohttp.ClientSession() as sess:
+            async with sess.ws_connect(
+                    voice.url.replace("http", "ws") + "/stream") as ws:
+                d = _WsDriver(ws)
+
+                # healthy brain: a normal (untagged) intent
+                await d.command("scroll down")
+                ev = await d.until("intent")
+                assert "degraded" not in ev
+                brain_calls_healthy = calls["n"]
+
+                # brain dies: the 503 trips the breaker; the session gets a
+                # rule-based parse tagged degraded — not a terminal error
+                broken["on"] = True
+                await d.command("scroll down")
+                ev = await d.until("intent")
+                assert ev["degraded"] is True
+                assert ev["data"]["intents"][0]["type"] == "scroll"
+
+                # circuit open: the next command degrades WITHOUT a roundtrip
+                calls_after_trip = calls["n"]
+                await d.command("search for lamps")
+                ev = await d.until("intent")
+                assert ev["degraded"] is True
+                assert ev["data"]["intents"][0]["type"] == "search"
+                assert calls["n"] == calls_after_trip
+
+                # /health says degraded during the outage
+                async with sess.get(voice.url + "/health") as r:
+                    h = await r.json()
+                assert h["status"] == "degraded" and h["breakers"]["brain"] != "closed"
+
+                # brain recovers; after the reset window the half-open probe
+                # succeeds and full parsing resumes, untagged
+                broken["on"] = False
+                await asyncio.sleep(2.2)  # past the 2.0s reset window
+                await d.command("scroll down")
+                ev = await d.until("intent")
+                assert "degraded" not in ev
+                assert calls["n"] > calls_after_trip
+
+                async with sess.get(voice.url + "/health") as r:
+                    h = await r.json()
+                assert h["status"] == "ok"
+
+                # counters surfaced through /metrics
+                async with sess.get(voice.url + "/metrics") as r:
+                    m = await r.json()
+                counters = m["runtime"]["counters"]
+                assert counters.get("voice.degraded_parses", 0) >= 2
+                assert counters.get("resilience.brain.breaker_opened", 0) >= 1
+                return brain_calls_healthy
+
+    try:
+        assert asyncio.run(drive()) >= 1
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)
+
+
+def test_executor_unreachable_reports_error_session_survives(tmp_path):
+    """A dead executor produces execution_error events; the WS session (and
+    the parse pipeline) keeps working."""
+    import aiohttp
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import RuleBasedParser, build_app as build_brain
+
+    brain = AppServer(build_brain(RuleBasedParser())).__enter__()
+    voice, executor = _voice_stack(
+        tmp_path, brain.url,
+        executor_url="http://127.0.0.1:1",  # nothing listens here
+        exec_timeout_s=5.0, retry_attempts=2,
+        breaker_threshold=2, breaker_reset_s=60.0,
+    )
+
+    async def drive():
+        async with aiohttp.ClientSession() as sess:
+            async with sess.ws_connect(
+                    voice.url.replace("http", "ws") + "/stream") as ws:
+                d = _WsDriver(ws)
+                await d.command("take a screenshot")
+                await d.until("execution_error")
+                # session still parses (and reports) the next command
+                await d.command("take a screenshot")
+                assert (await d.until("intent"))["data"]["intents"][0]["type"] == "screenshot"
+                await d.until("execution_error")
+
+    try:
+        asyncio.run(drive())
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)
+
+
+def test_brain_sheds_expired_deadline_before_decode():
+    """An x-deadline-ms budget of 0 is shed with 503 + Retry-After before
+    any parser work; a live budget parses normally."""
+    import httpx
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import RuleBasedParser, build_app as build_brain
+    from tpu_voice_agent.utils import get_metrics
+
+    with AppServer(build_brain(RuleBasedParser())) as srv:
+        shed0 = get_metrics().snapshot()["counters"].get("brain.shed_deadline_expired", 0)
+        r = httpx.post(srv.url + "/parse", json={"text": "scroll down"},
+                       headers={"x-deadline-ms": "0"})
+        assert r.status_code == 503
+        assert "Retry-After" in r.headers
+        assert r.json()["error"] == "overloaded"
+        shed = get_metrics().snapshot()["counters"].get("brain.shed_deadline_expired", 0)
+        assert shed - shed0 == 1
+
+        r = httpx.post(srv.url + "/parse", json={"text": "scroll down"},
+                       headers={"x-deadline-ms": "30000"})
+        assert r.status_code == 200
+
+
+def test_executor_sheds_expired_deadline():
+    import httpx
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.executor import SessionManager, build_app as build_executor
+    from tpu_voice_agent.services.executor.page import FakePage
+
+    manager = SessionManager(page_factory=FakePage.demo)
+    with AppServer(build_executor(manager)) as srv:
+        r = httpx.post(srv.url + "/execute",
+                       json={"intents": [{"type": "screenshot"}]},
+                       headers={"x-deadline-ms": "0"})
+        assert r.status_code == 503 and "Retry-After" in r.headers
+        r = httpx.post(srv.url + "/execute",
+                       json={"intents": [{"type": "screenshot"}]},
+                       headers={"x-deadline-ms": "30000"})
+        assert r.status_code == 200
+
+
+def test_brain_sheds_overload_at_inflight_cap():
+    """Past the inflight cap /parse answers 503 + Retry-After immediately
+    instead of queueing behind the busy parser."""
+    import threading
+
+    import httpx
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import RuleBasedParser, build_app as build_brain
+
+    entered = threading.Event()
+    gate = threading.Event()
+    rule = RuleBasedParser()
+
+    class SlowParser:
+        def parse(self, text, context):
+            entered.set()
+            assert gate.wait(10)
+            return rule.parse(text, context)
+
+    with AppServer(build_brain(SlowParser(), max_inflight=1)) as srv:
+        results = []
+        t = threading.Thread(target=lambda: results.append(
+            httpx.post(srv.url + "/parse", json={"text": "scroll down"},
+                       timeout=15)))
+        t.start()
+        try:
+            assert entered.wait(5)  # first request is admitted and decoding
+            r = httpx.post(srv.url + "/parse", json={"text": "scroll down"})
+            assert r.status_code == 503 and "Retry-After" in r.headers
+            # health still answers while saturated, and says so
+            h = httpx.get(srv.url + "/health")
+            assert h.status_code == 200 and h.json()["status"] == "degraded"
+        finally:
+            gate.set()
+            t.join(timeout=10)
+        assert results and results[0].status_code == 200
+        h = httpx.get(srv.url + "/health")
+        assert h.json()["status"] == "ok"
+
+
+class _DeadableBatcher:
+    """Fake batcher (no engine, no jax): completes one pending request per
+    step, or kills the worker THREAD outright when armed — SystemExit is not
+    an Exception, so it escapes the loop's survival handler exactly like an
+    interpreter-level thread death."""
+
+    def __init__(self):
+        self.pending: list = []
+        self.slots: list = []
+        self.results: dict = {}
+        self.die = False
+        self._n = 0
+
+    def submit(self, prompt: str) -> int:
+        rid, self._n = self._n, self._n + 1
+        self.pending.append((rid, prompt))
+        return rid
+
+    def step(self) -> None:
+        if self.die:
+            self.die = False
+            raise SystemExit("injected worker death")
+        if self.pending:
+            rid, prompt = self.pending.pop(0)
+            self.results[rid] = f"done:{prompt}"
+
+    def reset(self) -> None:
+        self.pending = []
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_watchdog_restarts_dead_worker_and_fails_inflight_fast():
+    import time
+
+    co = ColocatedServing(None, _DeadableBatcher())
+    co.start()
+    co.start_watchdog(interval_s=0.05)
+    try:
+        co.batcher.die = True
+        fut = co.submit_parse("doomed")  # wakes the worker into SystemExit
+        with pytest.raises(RuntimeError, match="worker died"):
+            fut.result(timeout=5)  # failed fast by the watchdog, no hang
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not co.healthy():
+            time.sleep(0.01)
+        assert co.healthy(), "watchdog did not restart the serving loop"
+        assert co.stats.restarts == 1
+        fut2 = co.submit_parse("revived")
+        assert fut2.result(timeout=5) == "done:revived"
     finally:
         co.stop()
     assert not co.healthy()

@@ -1,0 +1,293 @@
+"""Unit tests for the shared resilience kit (utils.resilience).
+
+Every state machine takes an injectable clock/rng, so these tests drive
+deadline expiry, breaker trips/resets, and backoff schedules without
+sleeping."""
+
+import asyncio
+
+import httpx
+import pytest
+
+from tpu_voice_agent.utils.resilience import (
+    DEADLINE_HEADER,
+    AdmissionController,
+    BreakerOpenError,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExpired,
+    RetryPolicy,
+    post_with_resilience,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------- deadline
+
+
+def test_deadline_budget_and_expiry():
+    clk = FakeClock()
+    d = Deadline.after(2.0, clock=clk)
+    assert not d.expired and d.remaining_s() == pytest.approx(2.0)
+    clk.advance(1.5)
+    assert d.remaining_s() == pytest.approx(0.5)
+    clk.advance(1.0)
+    assert d.expired and d.remaining_s() == 0.0
+
+
+def test_deadline_header_roundtrip():
+    clk = FakeClock()
+    d = Deadline.after(1.5, clock=clk)
+    hdr = {DEADLINE_HEADER: d.header_value()}
+    assert hdr[DEADLINE_HEADER] == "1500"
+    d2 = Deadline.from_headers(hdr, clock=clk)
+    assert d2 is not None and d2.remaining_s() == pytest.approx(1.5)
+    # downstream sees the budget the wire carried, not the origin's clock
+    clk.advance(2.0)
+    assert d2.expired
+
+
+def test_deadline_from_headers_tolerates_absent_and_garbage():
+    assert Deadline.from_headers({}) is None
+    assert Deadline.from_headers({DEADLINE_HEADER: "not-a-number"}) is None
+    d = Deadline.from_headers({DEADLINE_HEADER: "-50"})
+    assert d is not None and d.expired  # negative budget: already expired
+
+
+# ------------------------------------------------------------------ retry
+
+
+def test_retry_backoff_grows_and_caps():
+    p = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=0.5, jitter=0.0)
+    delays = [p.backoff_s(a) for a in range(5)]
+    assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_retry_jitter_bounds():
+    p = RetryPolicy(base_delay_s=0.2, multiplier=1.0, jitter=0.5)
+    lo = p.backoff_s(0, rng=lambda: 0.0)
+    hi = p.backoff_s(0, rng=lambda: 1.0)
+    assert lo == pytest.approx(0.1)   # (1 - jitter) * delay
+    assert hi == pytest.approx(0.2)   # full delay
+
+
+# ---------------------------------------------------------------- breaker
+
+
+def test_breaker_trips_after_threshold_and_half_open_recovers():
+    clk = FakeClock()
+    br = CircuitBreaker("dep", failure_threshold=3, reset_after_s=5.0, clock=clk)
+    for _ in range(2):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()  # third consecutive failure trips it
+    assert br.state == "open"
+    assert not br.allow()  # fail fast, no socket touch
+    clk.advance(5.1)
+    assert br.state == "half_open"
+    assert br.allow()       # the single probe passes
+    assert not br.allow()   # ...but only the single probe
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    clk = FakeClock()
+    br = CircuitBreaker("dep", failure_threshold=1, reset_after_s=1.0, clock=clk)
+    br.record_failure()
+    assert br.state == "open"
+    clk.advance(1.5)
+    assert br.allow()  # probe admitted
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    # the reset window restarts from the failed probe
+    clk.advance(0.5)
+    assert not br.allow()
+    clk.advance(0.6)
+    assert br.allow()
+
+
+def test_breaker_abandoned_probe_does_not_wedge_half_open():
+    """A half-open probe whose caller vanished (cancelled WS, torn-down
+    client) never records success OR failure; after another reset window a
+    new probe must be admitted rather than rejecting forever."""
+    clk = FakeClock()
+    br = CircuitBreaker("dep", failure_threshold=1, reset_after_s=1.0, clock=clk)
+    br.record_failure()
+    clk.advance(1.1)
+    assert br.allow()       # probe admitted... and then abandoned
+    assert not br.allow()   # probe slot consumed
+    clk.advance(1.1)
+    assert br.allow()       # time escape: one fresh probe per reset window
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_success_resets_consecutive_failures():
+    br = CircuitBreaker("dep", failure_threshold=2)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"  # non-consecutive failures never trip
+
+
+# -------------------------------------------------------------- admission
+
+
+def test_admission_caps_inflight():
+    adm = AdmissionController("svc", max_inflight=2, retry_after_s=0.5)
+    assert adm.try_acquire() and adm.try_acquire()
+    assert adm.saturated and not adm.try_acquire()
+    adm.release()
+    assert not adm.saturated and adm.try_acquire()
+    adm.release(), adm.release()
+    assert adm.inflight == 0
+
+
+# ------------------------------------------------------------ budgeted POST
+
+
+class FakeResponse:
+    def __init__(self, status_code: int, headers=None):
+        self.status_code = status_code
+        self.headers = headers or {}
+
+
+class FakeHTTP:
+    """Scripted transport: each entry is a response to return or an
+    exception to raise, in call order."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls: list[dict] = []
+
+    async def post(self, url, json=None, headers=None, timeout=None):
+        self.calls.append({"headers": headers, "timeout": timeout})
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+async def _no_sleep(_s):
+    pass
+
+
+def test_post_retries_connect_errors_then_succeeds():
+    http = FakeHTTP([httpx.ConnectError("down"), httpx.ConnectError("down"),
+                     FakeResponse(200)])
+    r = asyncio.run(post_with_resilience(
+        http, "http://x/parse", json_body={}, deadline=Deadline.after(30),
+        policy=RetryPolicy(max_attempts=3, jitter=0.0), sleep=_no_sleep))
+    assert r.status_code == 200 and len(http.calls) == 3
+    # the propagated budget header rides every attempt
+    assert all(DEADLINE_HEADER in c["headers"] for c in http.calls)
+
+
+def test_post_does_not_retry_read_timeouts():
+    """A read timeout means the server may have ACTED on the request —
+    neither /parse session turns nor /execute browser actions are
+    idempotent, so the kit must not resend."""
+    http = FakeHTTP([httpx.ReadTimeout("slow"), FakeResponse(200)])
+    with pytest.raises(httpx.ReadTimeout):
+        asyncio.run(post_with_resilience(
+            http, "http://x/execute", json_body={}, deadline=Deadline.after(30),
+            policy=RetryPolicy(max_attempts=3, jitter=0.0), sleep=_no_sleep))
+    assert len(http.calls) == 1
+
+
+def test_post_retries_503_and_returns_final_503():
+    http = FakeHTTP([FakeResponse(503, {"Retry-After": "0"}),
+                     FakeResponse(503, {"Retry-After": "0"})])
+    r = asyncio.run(post_with_resilience(
+        http, "http://x/parse", json_body={}, deadline=Deadline.after(30),
+        policy=RetryPolicy(max_attempts=2, jitter=0.0), sleep=_no_sleep))
+    assert r.status_code == 503 and len(http.calls) == 2  # caller owns policy
+
+
+def test_post_fails_fast_on_open_breaker():
+    br = CircuitBreaker("dep", failure_threshold=1, reset_after_s=60.0)
+    http = FakeHTTP([httpx.ConnectError("down"), FakeResponse(200)])
+    with pytest.raises(httpx.ConnectError):
+        asyncio.run(post_with_resilience(
+            http, "http://x/parse", json_body={}, deadline=Deadline.after(30),
+            policy=RetryPolicy(max_attempts=1), breaker=br, sleep=_no_sleep))
+    assert br.state == "open"
+    with pytest.raises(BreakerOpenError):
+        asyncio.run(post_with_resilience(
+            http, "http://x/parse", json_body={}, deadline=Deadline.after(30),
+            policy=RetryPolicy(max_attempts=1), breaker=br, sleep=_no_sleep))
+    assert len(http.calls) == 1  # the open circuit never touched the socket
+
+
+def test_post_5xx_counts_as_breaker_failure_4xx_as_success():
+    """A reachable-but-wedged dependency (500 on every call) must trip the
+    circuit; semantic refusals (409/422) must not."""
+    br = CircuitBreaker("dep", failure_threshold=2, reset_after_s=60.0)
+
+    def post(status):
+        return asyncio.run(post_with_resilience(
+            FakeHTTP([FakeResponse(status)]), "http://x/parse", json_body={},
+            deadline=Deadline.after(30), policy=RetryPolicy(max_attempts=1),
+            breaker=br, sleep=_no_sleep))
+
+    assert post(500).status_code == 500 and br.state == "closed"
+    assert post(409).status_code == 409 and br.state == "closed"  # resets
+    post(500)
+    assert br.state == "closed"
+    post(500)  # second consecutive 5xx trips
+    assert br.state == "open"
+
+
+def test_post_raises_when_deadline_already_expired():
+    clk = FakeClock()
+    d = Deadline.after(0.0, clock=clk)
+    http = FakeHTTP([FakeResponse(200)])
+    with pytest.raises(DeadlineExpired):
+        asyncio.run(post_with_resilience(
+            http, "http://x/parse", json_body={}, deadline=d, sleep=_no_sleep))
+    assert not http.calls
+
+
+def test_post_attempt_is_bounded_by_wall_clock():
+    """httpx interprets a bare-float timeout per PHASE (connect, read, ...),
+    so the kit must bound the whole attempt itself — a stalled transport
+    must not overrun the hop budget."""
+    import time
+
+    class StallingHTTP:
+        async def post(self, url, json=None, headers=None, timeout=None):
+            await asyncio.sleep(30)
+
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExpired):
+        asyncio.run(post_with_resilience(
+            StallingHTTP(), "http://x/parse", json_body={},
+            deadline=Deadline.after(0.2),
+            policy=RetryPolicy(max_attempts=3, jitter=0.0), sleep=_no_sleep))
+    assert time.monotonic() - t0 < 5.0  # budget-bounded, not phase-bounded
+
+
+def test_post_stops_retrying_when_budget_cannot_cover_backoff():
+    clk = FakeClock()
+    d = Deadline(0.3, clock=clk)
+    # each connect error is instant; backoff of 1s exceeds the 0.3s budget,
+    # so the second attempt never happens and the transport error surfaces
+    http = FakeHTTP([httpx.ConnectError("down"), FakeResponse(200)])
+    with pytest.raises(httpx.ConnectError):
+        asyncio.run(post_with_resilience(
+            http, "http://x/parse", json_body={}, deadline=d,
+            policy=RetryPolicy(max_attempts=3, base_delay_s=1.0, jitter=0.0),
+            sleep=_no_sleep))
+    assert len(http.calls) == 1

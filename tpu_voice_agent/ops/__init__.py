@@ -18,6 +18,10 @@ correctness tests and as the CPU fallback; kernels run under
 without a chip.
 """
 
+# import-time side effect: installs jax.shard_map on old jax (the kernels
+# below call it at runtime); same install point parallel.* relies on
+from ..utils import jaxcompat as _jaxcompat  # noqa: F401
+
 from .flash_attention import flash_attention, attention_reference, sharded_flash_attention
 from .decode_attention import (
     decode_attention,

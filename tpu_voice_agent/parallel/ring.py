@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..utils.jaxcompat import shard_map  # jax.shard_map, gated for old jax
 
 _NEG_INF = -1e30
 

@@ -45,6 +45,7 @@ class ColocationStats:
     decode_busy_ms: float = 0.0
     decode_chunks: int = 0
     errors: int = 0  # decode-lane failures survived by the loop
+    restarts: int = 0  # dead workers revived by the watchdog
     max_stt_queue: int = 0
     max_parse_inflight: int = 0
     # dispatch-order trace: "stt" / "chunk" entries, for fairness asserts
@@ -72,6 +73,7 @@ class ColocatedServing:
         self._parse_futs: dict[int, Future] = {}
         self._abandoned: set[int] = set()  # tombstones applied by step()
         self._thread: threading.Thread | None = None
+        self._watchdog: threading.Thread | None = None
         self._stop = False
 
     # ------------------------------------------------------------ submit
@@ -239,6 +241,56 @@ class ColocatedServing:
         self._thread = threading.Thread(target=self._loop, name="colocate", daemon=True)
         self._thread.start()
 
+    def start_watchdog(self, interval_s: float = 0.5) -> None:
+        """Arm a liveness watchdog over the worker thread.
+
+        ``_loop`` survives ordinary exceptions itself, but a thread can
+        still die outright (BaseException escape, interpreter-level kill,
+        a bug in the survival path). Without the watchdog that is a silent
+        outage: submits queue forever and only /health notices. The
+        watchdog detects the dead worker, fails every inflight future fast
+        (callers see an error now, not a timeout later), resets the batcher
+        (its slot/cache state is suspect mid-chunk), and starts a fresh
+        serving loop."""
+        if self._watchdog is not None:
+            return
+        self._watchdog = threading.Thread(
+            target=self._watch, args=(interval_s,), name="colocate-watchdog",
+            daemon=True)
+        self._watchdog.start()
+
+    def _watch(self, interval_s: float) -> None:
+        import logging
+
+        from ..utils import get_metrics
+
+        log = logging.getLogger("tpu_voice_agent.colocate")
+        while True:
+            with self._work:
+                if self._stop:
+                    return
+                dead = self._thread is not None and not self._thread.is_alive()
+            if dead:
+                log.error("colocate worker died; failing inflight work and "
+                          "restarting the serving loop")
+                get_metrics().inc("colocate.worker_restarts")
+                self.stats.restarts += 1
+                exc = RuntimeError("serving worker died; work failed fast on restart")
+                # fail BOTH lanes: a queued STT job would otherwise wait on
+                # a loop that no longer exists
+                with self._lock:
+                    stt_jobs, self._stt_q[:] = list(self._stt_q), []
+                for _, fut in stt_jobs:
+                    self._set_future(fut, exc=exc)
+                self._fail_inflight(exc)  # also resets the suspect batcher
+                with self._work:
+                    if self._stop:
+                        return
+                    self._thread = threading.Thread(
+                        target=self._loop, name="colocate", daemon=True)
+                    self._thread.start()
+            time.sleep(interval_s)
+
     def stop(self) -> None:
         with self._work:
             self._stop = True
@@ -246,6 +298,9 @@ class ColocatedServing:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=30)
+            self._watchdog = None
 
     def healthy(self) -> bool:
         """Worker-liveness probe; a service embedding this runtime should

@@ -29,6 +29,12 @@ from aiohttp import web
 
 from ..schemas import Intent, ParseRequest, ParseResponse, Target, parse_response_from_json
 from ..utils import Tracer, load_env_cascade, new_trace_id
+from ..utils.resilience import (
+    AdmissionController,
+    Deadline,
+    DeadlineExpired,
+    shed_response,
+)
 from .prompts import render_prompt
 
 
@@ -122,6 +128,9 @@ class BatchedEngineParser:
         self.runtime = ColocatedServing(None, self.batcher)
         self.timeout_s = timeout_s
         self.runtime.start()
+        # liveness watchdog: a dead serving loop restarts with inflight
+        # futures failed fast instead of silently queueing forever
+        self.runtime.start_watchdog()
 
     def parse(self, text: str, context: dict) -> ParseResponse:
         prompt = render_prompt(text, context)
@@ -602,9 +611,17 @@ class RuleBasedParser:
 # ---------------------------------------------------------------- app
 
 
-def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Application:
+def build_app(parser: IntentParser, tracer: Tracer | None = None,
+              max_inflight: int | None = None) -> web.Application:
     tracer = tracer or Tracer("brain", emit=False)
     app = web.Application()
+    # admission control: past the inflight cap /parse answers 503 +
+    # Retry-After instead of queueing unboundedly behind the decode (the
+    # queue IS the tail latency; the voice service degrades on the 503)
+    admission = AdmissionController(
+        "brain",
+        max_inflight if max_inflight is not None
+        else int(os.environ.get("BRAIN_MAX_INFLIGHT", "32")))
     # A single-slot engine owns one KV cache and RNG, so concurrent parses
     # must serialize. A concurrent-safe parser (BatchedEngineParser) does
     # its own admission control — requests run truly concurrently, sharing
@@ -642,11 +659,20 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
         return locked_parse(preq.text, preq.context)
 
     async def health(_req: web.Request) -> web.Response:
-        body = {"ok": True, "service": "brain"}
+        """ok / degraded (saturated but serving) / unhealthy (dead worker)."""
+        body = {"ok": True, "service": "brain",
+                "inflight": admission.inflight,
+                "max_inflight": admission.max_inflight}
+        status = "ok"
+        if admission.saturated:
+            status = "degraded"  # shedding load, but alive
         probe = getattr(parser, "healthy", None)
         if probe is not None:
             body["worker_alive"] = bool(probe())
-            body["ok"] = body["worker_alive"]
+            if not body["worker_alive"]:
+                status = "unhealthy"
+        body["status"] = status
+        body["ok"] = status != "unhealthy"
         return web.json_response(body, status=200 if body["ok"] else 503)
 
     async def parse(req: web.Request) -> web.Response:
@@ -676,10 +702,33 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
                  "detail": "session-keyed backend commits turns; parse at final"},
                 status=409, headers=headers,
             )
+
+        def shed(reason: str, retry_after_s: float = 1.0) -> web.Response:
+            return shed_response("brain", reason, headers=headers,
+                                 retry_after_s=retry_after_s)
+
+        deadline = Deadline.from_headers(req.headers)
+        if deadline is not None and deadline.expired:
+            # the caller already gave up: answering with work would burn
+            # decode on a response nobody reads
+            return shed("deadline_expired", retry_after_s=0)
+        if not admission.try_acquire():
+            return shed("overload")
         loop = asyncio.get_running_loop()
+
+        def run_admitted(preq: ParseRequest) -> ParseResponse:
+            # re-check on the worker thread: queueing for the pool (or the
+            # engine lock) may have consumed the rest of the budget — shed
+            # BEFORE decode, not after
+            if deadline is not None and deadline.expired:
+                raise DeadlineExpired("budget consumed while queued")
+            return do_parse(preq)
+
         try:
             with tracer.span("parse", trace_id=trace_id, chars=len(preq.text)):
-                resp = await loop.run_in_executor(parse_pool, do_parse, preq)
+                resp = await loop.run_in_executor(parse_pool, run_admitted, preq)
+        except DeadlineExpired:
+            return shed("deadline_expired", retry_after_s=0)
         except ParserError as e:
             status = 422 if e.kind == "schema_validation_failed" else 500
             return web.json_response(
@@ -691,6 +740,8 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None) -> web.Applica
                 {"error": "llm_error", "detail": str(e)[:500]}, status=500,
                 headers={"x-trace-id": trace_id},
             )
+        finally:
+            admission.release()
         ok_headers = {"x-trace-id": trace_id}
         # (speculative implies spec_ok here — the 409 gate already fired)
         if preq.speculative and wants_session and preq.session_id:

@@ -16,6 +16,12 @@ from aiohttp import web
 
 from ...schemas import ExecuteRequest
 from ...utils import Tracer, load_env_cascade, new_trace_id
+from ...utils.resilience import (
+    AdmissionController,
+    Deadline,
+    DeadlineExpired,
+    shed_response,
+)
 from .actions import run_intents
 from .session import SessionManager
 
@@ -54,17 +60,29 @@ def make_grounder_from_env():
 
 
 def build_app(manager: SessionManager | None = None, tracer: Tracer | None = None,
-              grounder=None, summarizer=None) -> web.Application:
+              grounder=None, summarizer=None,
+              max_inflight: int | None = None) -> web.Application:
     manager = manager or SessionManager()
     tracer = tracer or Tracer("executor", emit=False)
     app = web.Application(client_max_size=64 * 1024 * 1024)
     # sessions are single-browser resources; serialize intent batches per proc
     exec_lock = threading.Lock()
+    # admission control: batches queue on exec_lock, so past the inflight cap
+    # /execute answers 503 + Retry-After rather than growing that queue
+    # without bound (the voice service retries on its remaining budget)
+    admission = AdmissionController(
+        "executor",
+        max_inflight if max_inflight is not None
+        else int(os.environ.get("EXECUTOR_MAX_INFLIGHT", "16")))
 
     async def health(_req: web.Request) -> web.Response:
-        return web.json_response(
-            {"ok": True, "service": "executor", "sessions": len(manager.sessions)}
-        )
+        status = "degraded" if admission.saturated else "ok"
+        return web.json_response({
+            "ok": True, "status": status, "service": "executor",
+            "sessions": len(manager.sessions),
+            "inflight": admission.inflight,
+            "max_inflight": admission.max_inflight,
+        })
 
     async def execute(req: web.Request) -> web.Response:
         trace_id = req.headers.get("x-trace-id", new_trace_id())
@@ -84,8 +102,22 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
                 status=400, headers=headers,
             )
 
+        def shed(reason: str, retry_after_s: float = 1.0) -> web.Response:
+            return shed_response("executor", reason, headers=headers,
+                                 retry_after_s=retry_after_s)
+
+        deadline = Deadline.from_headers(req.headers)
+        if deadline is not None and deadline.expired:
+            return shed("deadline_expired", retry_after_s=0)
+        if not admission.try_acquire():
+            return shed("overload")
+
         def work():
             with exec_lock:
+                # re-check AFTER winning the lock: the wait may have consumed
+                # the caller's whole budget — shed before touching the page
+                if deadline is not None and deadline.expired:
+                    raise DeadlineExpired("budget consumed waiting for exec_lock")
                 session = manager.open(ereq.session_id)
                 with tracer.span("execute", trace_id=trace_id, intents=len(ereq.intents)):
                     results = run_intents(
@@ -100,11 +132,15 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
 
         try:
             session, results = await asyncio.get_running_loop().run_in_executor(None, work)
+        except DeadlineExpired:
+            return shed("deadline_expired", retry_after_s=0)
         except Exception as e:
             return web.json_response(
                 {"error": "execution_error", "detail": str(e)[:500]},
                 status=500, headers=headers,
             )
+        finally:
+            admission.release()
         return web.json_response(
             {
                 "session_id": session.id,

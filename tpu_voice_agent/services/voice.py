@@ -30,6 +30,14 @@ from aiohttp import WSMsgType, web
 from ..audio.mel import pcm16_to_float
 from ..schemas import Intent, ParseResponse
 from ..utils import Tracer, get_metrics, load_env_cascade, new_trace_id
+from ..utils.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    Deadline,
+    ResilienceError,
+    RetryPolicy,
+    post_with_resilience,
+)
 
 
 class VoiceConfig:
@@ -38,10 +46,29 @@ class VoiceConfig:
         brain_url: str | None = None,
         executor_url: str | None = None,
         stt_factory=None,
+        parse_timeout_s: float | None = None,
+        exec_timeout_s: float | None = None,
+        retry_attempts: int | None = None,
+        breaker_threshold: int | None = None,
+        breaker_reset_s: float | None = None,
     ):
         self.brain_url = brain_url or os.environ.get("BRAIN_URL", "http://127.0.0.1:8090")
         self.executor_url = executor_url or os.environ.get("EXECUTOR_URL", "http://127.0.0.1:7081")
         self.stt_factory = stt_factory or stt_factory_from_env()
+        # per-hop time budgets (the old hardcoded 60/120 s stay the defaults);
+        # each budget is the WHOLE deadline for that hop — retries included —
+        # and propagates downstream via the x-deadline-ms header
+        self.parse_timeout_s = parse_timeout_s if parse_timeout_s is not None \
+            else float(os.environ.get("VOICE_PARSE_TIMEOUT_S", "60"))
+        self.exec_timeout_s = exec_timeout_s if exec_timeout_s is not None \
+            else float(os.environ.get("VOICE_EXEC_TIMEOUT_S", "120"))
+        # resilience knobs (shared by the brain and executor hops)
+        self.retry_attempts = retry_attempts if retry_attempts is not None \
+            else int(os.environ.get("VOICE_RETRY_ATTEMPTS", "3"))
+        self.breaker_threshold = breaker_threshold if breaker_threshold is not None \
+            else int(os.environ.get("VOICE_BREAKER_THRESHOLD", "3"))
+        self.breaker_reset_s = breaker_reset_s if breaker_reset_s is not None \
+            else float(os.environ.get("VOICE_BREAKER_RESET_S", "2.0"))
 
 
 def stt_factory_from_env():
@@ -148,22 +175,49 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     tracer = tracer or Tracer("voice", emit=False)
     app = web.Application()
 
+    # per-dependency circuits, shared across WS connections: one client's
+    # timeouts must warn the next client's calls. An open brain circuit is
+    # NOT terminal — handle_final degrades to the local rule-based parser
+    # and the half-open probe re-discovers a recovered brain automatically.
+    brain_breaker = CircuitBreaker(
+        "brain", failure_threshold=cfg.breaker_threshold,
+        reset_after_s=cfg.breaker_reset_s)
+    exec_breaker = CircuitBreaker(
+        "executor", failure_threshold=cfg.breaker_threshold,
+        reset_after_s=cfg.breaker_reset_s)
+    retry_policy = RetryPolicy(max_attempts=max(1, cfg.retry_attempts))
+    # the degraded-mode parser: zero model deps, same intent vocabulary —
+    # a brain outage downgrades parse quality instead of dropping sessions
+    from .brain import RuleBasedParser
+
+    fallback_parser = RuleBasedParser()
+
     async def health(_req: web.Request) -> web.Response:
-        return web.json_response({"ok": True, "service": "voice"})
+        breakers = {"brain": brain_breaker.state, "executor": exec_breaker.state}
+        status = "ok" if all(s == "closed" for s in breakers.values()) else "degraded"
+        # degraded still serves (that is the point) — 200 either way
+        return web.json_response({
+            "ok": status == "ok", "status": status, "service": "voice",
+            "breakers": breakers,
+        })
 
     async def send(ws: web.WebSocketResponse, type_: str, **payload) -> None:
         if not ws.closed:
             await ws.send_json({"type": type_, **payload})
 
-    async def post_parse(state: ClientState, text: str, http, speculative: bool = False):
-        """One /parse roundtrip (no events, no side effects — callable
-        speculatively). Returns the httpx response; raises on transport."""
-        return await http.post(
-            cfg.brain_url + "/parse",
-            json={"text": text, "session_id": state.convo_id,
-                  "context": state.context, "speculative": speculative},
+    async def post_parse(state: ClientState, text: str, http,
+                         speculative: bool = False, deadline: Deadline | None = None):
+        """One budgeted /parse roundtrip (no events, no side effects —
+        callable speculatively). Returns the httpx response; raises
+        BreakerOpenError/DeadlineExpired/transport errors."""
+        return await post_with_resilience(
+            http, cfg.brain_url + "/parse",
+            json_body={"text": text, "session_id": state.convo_id,
+                       "context": state.context, "speculative": speculative},
             headers={"x-trace-id": state.trace_id},
-            timeout=60.0,
+            deadline=deadline or Deadline.after(cfg.parse_timeout_s),
+            policy=retry_policy,
+            breaker=brain_breaker,
         )
 
     # sticky across the app: a 409 with the specific speculation_unsupported
@@ -188,6 +242,11 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             # here: with the eager spec threshold a single utterance can
             # fire several spec_final events and would burn through the
             # re-probe budget in a couple of commands
+            return
+        if brain_breaker.state != "closed":
+            # a tripped (or probing) brain circuit must not spend its
+            # half-open probe on speculative work — the final's parse is
+            # the probe that matters, and it has a local fallback
             return
         if state.spec is not None and state.spec[0] == text:
             return  # already in flight for this exact transcript
@@ -243,6 +302,10 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                 # usually it is already done and this await is free
                 try:
                     maybe = await task
+                except asyncio.CancelledError:
+                    if not task.cancelled():
+                        raise  # WE were cancelled, not the spec task
+                    maybe = None
                 except Exception:
                     maybe = None
                 if (maybe is not None and maybe.status_code == 200
@@ -268,27 +331,55 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             else:
                 _reap(task)
                 get_metrics().inc("voice.spec_parse_stale")
+        degraded_reason = None
         if r is None:
             with tracer.span("parse_roundtrip", trace_id=state.trace_id, chars=len(text)):
                 try:
                     r = await post_parse(state, text, http)
-                except Exception as e:
-                    await send(ws, "error", message=f"brain unreachable: {e}")
-                    return
-        if r.status_code != 200:
-            await send(ws, "error", message=f"brain error {r.status_code}", detail=r.text[:300])
-            return
-        try:
-            parsed = ParseResponse.model_validate(r.json())
-        except Exception as e:
-            await send(ws, "error", message=f"brain returned invalid payload: {e}")
-            return
+                except asyncio.CancelledError:
+                    # connection teardown mid-parse is not a brain fault —
+                    # it must unwind the handler, not masquerade as
+                    # "brain unreachable"
+                    raise
+                except (ResilienceError, httpx.HTTPError, OSError) as e:
+                    degraded_reason = (f"circuit open" if isinstance(e, BreakerOpenError)
+                                       else f"{type(e).__name__}: {e}")
+        if degraded_reason is None and r.status_code >= 500:
+            # the brain shed this request (503: overload / expired deadline)
+            # or failed server-side (500: engine crash, llm_error): a local
+            # degraded parse beats surfacing a terminal error either way.
+            # 4xx stays terminal — those are semantic answers about THIS
+            # request, not brain-health signals.
+            degraded_reason = f"brain error {r.status_code}"
+        if degraded_reason is not None:
+            # graceful degradation: the session survives a dead or drowning
+            # brain on the local rule-based parser; every event from this
+            # utterance is tagged so the UI can show reduced quality, and
+            # the breaker's half-open probe restores full parsing without
+            # operator action
+            get_metrics().inc("voice.degraded_parses")
+            parsed = fallback_parser.parse(text, state.context)
+            degraded = True
+            await send(ws, "warn", degraded=True,
+                       message=f"brain unavailable ({degraded_reason}); "
+                               "serving rule-based parse")
+        else:
+            degraded = False
+            if r.status_code != 200:
+                await send(ws, "error", message=f"brain error {r.status_code}", detail=r.text[:300])
+                return
+            try:
+                parsed = ParseResponse.model_validate(r.json())
+            except Exception as e:
+                await send(ws, "error", message=f"brain returned invalid payload: {e}")
+                return
 
-        await send(ws, "intent", data=parsed.model_dump())
+        tag = {"degraded": True} if degraded else {}
+        await send(ws, "intent", data=parsed.model_dump(), **tag)
         if parsed.tts_summary:
-            await send(ws, "tts", text=parsed.tts_summary)
+            await send(ws, "tts", text=parsed.tts_summary, **tag)
         if parsed.follow_up_question:
-            await send(ws, "tts", text=parsed.follow_up_question)
+            await send(ws, "tts", text=parsed.follow_up_question, **tag)
         # merge context updates (server.ts:162-170)
         state.context.update({k: v for k, v in parsed.context_updates.items()})
 
@@ -299,6 +390,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                 ws, "confirmation_required",
                 intents=[i.model_dump() for i in risky],
                 session_id=state.session_id,
+                **tag,
             )
         if safe:
             asyncio.ensure_future(execute_and_report(ws, state, safe, http))
@@ -309,16 +401,30 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
 
     async def _execute_locked(ws, state: ClientState, intents: list[Intent], http) -> None:
         try:
-            r = await http.post(
-                cfg.executor_url + "/execute",
-                json={
+            r = await post_with_resilience(
+                http, cfg.executor_url + "/execute",
+                json_body={
                     "session_id": state.session_id,
                     "intents": [i.model_dump() for i in intents],
                 },
                 headers={"x-trace-id": state.trace_id},
-                timeout=120.0,
+                deadline=Deadline.after(cfg.exec_timeout_s),
+                policy=retry_policy,
+                breaker=exec_breaker,
             )
-        except Exception as e:
+        except asyncio.CancelledError:
+            raise
+        except BreakerOpenError:
+            get_metrics().inc("voice.exec_shed")
+            await send(ws, "execution_error", degraded=True,
+                       message="executor unavailable (circuit open); "
+                               "command dropped — try again shortly")
+            return
+        except (ResilienceError, httpx.HTTPError, OSError, RuntimeError) as e:
+            # RuntimeError: a fire-and-forget execute can outlive the WS
+            # handler's AsyncClient ("client has been closed") — the session
+            # is already gone, so report-and-return beats an orphan-task
+            # traceback
             await send(ws, "execution_error", message=str(e))
             return
         if r.status_code != 200:

@@ -1,5 +1,16 @@
 from .envcfg import load_env_cascade, env_str, env_int, env_bool
 from .tracing import Span, Tracer, Metrics, get_metrics, new_trace_id
+from .resilience import (
+    DEADLINE_HEADER,
+    AdmissionController,
+    BreakerOpenError,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExpired,
+    ResilienceError,
+    RetryPolicy,
+    post_with_resilience,
+)
 
 __all__ = [
     "load_env_cascade",
@@ -11,4 +22,13 @@ __all__ = [
     "Metrics",
     "get_metrics",
     "new_trace_id",
+    "DEADLINE_HEADER",
+    "AdmissionController",
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExpired",
+    "ResilienceError",
+    "RetryPolicy",
+    "post_with_resilience",
 ]

@@ -1,0 +1,43 @@
+"""Gate jax API drift (the repo targets the promoted ``jax.shard_map``).
+
+Older images ship jax 0.4.x, where ``shard_map`` still lives at
+``jax.experimental.shard_map.shard_map`` and the replication-check kwarg is
+spelled ``check_rep`` instead of ``check_vma``. Every call site in this repo
+uses the new spelling; rather than littering try/excepts across ``ops`` and
+``parallel``, this module installs a translating wrapper AS ``jax.shard_map``
+when the top-level name is missing, so both import styles keep working:
+
+- ``from ..utils.jaxcompat import shard_map``   (parallel.ring/pipeline/expert)
+- ``jax.shard_map(...)`` at runtime             (ops kernels; importing
+  ``tpu_voice_agent.ops`` triggers the install)
+
+On a current jax this is a pure no-op passthrough.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def ensure_shard_map() -> None:
+    """Idempotently install ``jax.shard_map`` (and its companion VMA cast,
+    ``jax.lax.pcast``) on old jax."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        def _compat_shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                              check_vma=None, **kw):
+            if check_vma is not None:
+                kw["check_rep"] = check_vma
+            return _legacy(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)
+
+        jax.shard_map = _compat_shard_map
+    if not hasattr(jax.lax, "pcast"):
+        # pre-VMA jax has no varying/replicated type distinction to cast
+        # between; the identity is semantically exact there
+        jax.lax.pcast = lambda x, axes=None, *, to=None: x
+
+
+ensure_shard_map()
+shard_map = jax.shard_map

@@ -1,0 +1,377 @@
+"""Cross-service resilience kit: deadlines, retries, breakers, admission.
+
+The reference system's recovery story is manual — a dead browser is replaced
+on the next command (SURVEY.md §5) — and every HTTP seam in this reproduction
+inherited that fragility: one attempt, hardcoded timeout, terminal error on
+any transport fault. This module is the shared kit the three services wire
+through instead:
+
+- ``Deadline``             a request's remaining time budget; propagates
+                           across hops via the ``x-deadline-ms`` header so a
+                           downstream service can shed work the caller has
+                           already given up on (load shedding before decode,
+                           not after — the WhisperFlow/WhisperPipe framing of
+                           bounded tail latency as a serving property)
+- ``RetryPolicy``          jittered exponential backoff with a bounded
+                           attempt budget, always clipped to the deadline
+- ``CircuitBreaker``       per-dependency closed -> open -> half-open state
+                           machine; an open circuit fails fast (no socket
+                           touch) and one half-open probe rediscovers a
+                           recovered dependency automatically
+- ``AdmissionController``  inflight cap for servers: overload answers
+                           ``503 + Retry-After`` instead of queueing without
+                           bound
+- ``post_with_resilience`` the budgeted, breaker-guarded httpx POST the
+                           voice service uses for both its downstream hops
+
+Everything takes an injectable ``clock``/``rng`` so tests drive the state
+machines deterministically, and every transition lands in the process-global
+``Metrics`` registry (``resilience.*`` keys) so ``/metrics`` reflects fault
+behavior.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+from .tracing import get_metrics
+
+# remaining-budget propagation header: milliseconds left, clamped at 0
+DEADLINE_HEADER = "x-deadline-ms"
+
+
+class ResilienceError(Exception):
+    """Base for kit-raised failures (callers can catch the family)."""
+
+
+class DeadlineExpired(ResilienceError):
+    """The request's time budget ran out before a usable response."""
+
+
+class BreakerOpenError(ResilienceError):
+    """The dependency's circuit is open; the call was not attempted."""
+
+    def __init__(self, name: str):
+        super().__init__(f"circuit for {name!r} is open")
+        self.name = name
+
+
+# ------------------------------------------------------------------ deadline
+
+
+class Deadline:
+    """Absolute expiry on a monotonic clock, carried across hops as a
+    remaining-milliseconds header (absolute wall times don't survive clock
+    skew between hosts; remaining budgets do)."""
+
+    __slots__ = ("_expires_at", "_clock")
+
+    def __init__(self, budget_s: float, clock=time.monotonic):
+        self._clock = clock
+        self._expires_at = clock() + max(0.0, budget_s)
+
+    @classmethod
+    def after(cls, budget_s: float, clock=time.monotonic) -> "Deadline":
+        return cls(budget_s, clock=clock)
+
+    @classmethod
+    def from_headers(cls, headers, clock=time.monotonic) -> "Deadline | None":
+        """Parse the propagated budget; None when the caller sent none
+        (legacy clients keep working, they just opt out of shedding)."""
+        raw = headers.get(DEADLINE_HEADER)
+        if raw is None:
+            return None
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return cls(ms / 1e3, clock=clock)
+
+    def remaining_s(self) -> float:
+        return max(0.0, self._expires_at - self._clock())
+
+    @property
+    def expired(self) -> bool:
+        return self._clock() >= self._expires_at
+
+    def header_value(self) -> str:
+        return str(int(self.remaining_s() * 1e3))
+
+
+# -------------------------------------------------------------------- retry
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff: delay_n = base * mult^n, capped, with
+    ``jitter`` fraction of the delay re-rolled uniformly (full-jitter on
+    that slice) so synchronized clients don't retry in lockstep."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+    jitter: float = 0.5
+
+    def backoff_s(self, attempt: int, rng=random.random) -> float:
+        delay = min(self.max_delay_s,
+                    self.base_delay_s * self.multiplier ** attempt)
+        if self.jitter <= 0:
+            return delay
+        return delay * (1.0 - self.jitter) + delay * self.jitter * rng()
+
+
+# ------------------------------------------------------------------ breaker
+
+
+class CircuitBreaker:
+    """Per-dependency circuit: ``closed`` (normal) -> ``open`` after
+    ``failure_threshold`` consecutive failures (calls fail fast, no socket
+    touch) -> ``half_open`` after ``reset_after_s`` (``half_open_probes``
+    trial calls pass; success closes, failure re-opens). Thread-safe — the
+    services record results from event-loop and executor threads alike."""
+
+    def __init__(self, name: str, failure_threshold: int = 3,
+                 reset_after_s: float = 2.0, half_open_probes: int = 1,
+                 clock=time.monotonic):
+        self.name = name
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_after_s = reset_after_s
+        self.half_open_probes = max(1, half_open_probes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probes = 0
+        self._probe_at = 0.0  # when the last half-open probe was admitted
+
+    # state is advisory (a scrape label); allow() is the authoritative gate
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if (self._state == "open"
+                    and self._clock() - self._opened_at >= self.reset_after_s):
+                return "half_open"  # next allow() will admit a probe
+            return self._state
+
+    def allow(self) -> bool:
+        """Admission check for ONE call attempt; transitions open->half_open
+        when the reset window has elapsed."""
+        with self._lock:
+            now = self._clock()
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if now - self._opened_at < self.reset_after_s:
+                    get_metrics().inc(f"resilience.{self.name}.breaker_rejected")
+                    return False
+                self._state = "half_open"
+                self._probes = 0
+                get_metrics().inc(f"resilience.{self.name}.breaker_half_open")
+                self._gauge(1)
+            # half_open: admit a bounded number of probes
+            if self._probes < self.half_open_probes:
+                self._probes += 1
+                self._probe_at = now
+                return True
+            if now - self._probe_at >= self.reset_after_s:
+                # the outstanding probe was ABANDONED (caller cancelled,
+                # transport torn down) — neither record_* ever ran. Without
+                # a time escape half_open would wedge forever; re-admit one
+                # probe per reset window instead.
+                self._probes = 1
+                self._probe_at = now
+                return True
+            get_metrics().inc(f"resilience.{self.name}.breaker_rejected")
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state != "closed":
+                get_metrics().inc(f"resilience.{self.name}.breaker_closed")
+            self._state = "closed"
+            self._failures = 0
+            self._gauge(0)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == "half_open":
+                self._trip()  # the probe failed: straight back to open
+                return
+            self._failures += 1
+            if self._state == "closed" and self._failures >= self.failure_threshold:
+                self._trip()
+
+    def _trip(self) -> None:
+        self._state = "open"
+        self._failures = 0
+        self._opened_at = self._clock()
+        get_metrics().inc(f"resilience.{self.name}.breaker_opened")
+        self._gauge(2)
+
+    def _gauge(self, v: int) -> None:
+        get_metrics().set_gauge(f"resilience.{self.name}.breaker_state", v)
+
+
+# ---------------------------------------------------------------- admission
+
+
+class AdmissionController:
+    """Inflight cap: servers answer overload with ``503 + Retry-After``
+    instead of queueing unboundedly (the queue IS the tail latency)."""
+
+    def __init__(self, name: str, max_inflight: int, retry_after_s: float = 1.0):
+        self.name = name
+        self.max_inflight = max(1, max_inflight)
+        self.retry_after_s = retry_after_s
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @property
+    def saturated(self) -> bool:
+        with self._lock:
+            return self._inflight >= self.max_inflight
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                get_metrics().inc(f"resilience.{self.name}.shed_overload")
+                return False
+            self._inflight += 1
+            get_metrics().set_gauge(f"resilience.{self.name}.inflight",
+                                    self._inflight)
+            return True
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            get_metrics().set_gauge(f"resilience.{self.name}.inflight",
+                                    self._inflight)
+
+
+def shed_response(service: str, reason: str, *, headers=None,
+                  retry_after_s: float = 1.0):
+    """The one spelling of the overload/shed answer (503 + Retry-After +
+    ``brain.shed_*``-style counter) shared by every service — the voice-side
+    retry kit keys on exactly this contract, so it must not diverge per
+    service."""
+    from aiohttp import web
+
+    get_metrics().inc(f"{service}.shed_{reason}")
+    return web.json_response(
+        {"error": "overloaded", "detail": reason}, status=503,
+        headers={**(headers or {}), "Retry-After": str(int(retry_after_s))},
+    )
+
+
+# ------------------------------------------------------------ budgeted POST
+
+
+async def post_with_resilience(http, url: str, *, json_body, deadline: Deadline,
+                               headers=None, policy: RetryPolicy | None = None,
+                               breaker: CircuitBreaker | None = None,
+                               retry_statuses=(503,), retryable_excs=None,
+                               sleep=None, rng=random.random):
+    """One budgeted, breaker-guarded, retrying POST.
+
+    Retries only faults that are safe OR explicitly invited: connect-class
+    transport errors (the request never reached the server, so side effects
+    are impossible) and ``retry_statuses`` (503 shed — the server rejected
+    before doing work, and its ``Retry-After`` is honored as a backoff
+    floor). A read timeout or reset mid-response is NOT retried: the server
+    may have executed the request, and both downstream hops (/parse session
+    turns, /execute browser actions) are not idempotent.
+
+    Returns the final httpx response (including a final 503 — the caller
+    owns that policy decision). Raises ``BreakerOpenError`` without touching
+    the socket when the circuit is open, ``DeadlineExpired`` when the budget
+    ran out before any attempt completed, or the last transport error.
+    """
+    import asyncio
+
+    import httpx
+
+    policy = policy or RetryPolicy()
+    sleep = sleep or asyncio.sleep
+    if retryable_excs is None:
+        retryable_excs = (httpx.ConnectError, httpx.ConnectTimeout)
+    name = breaker.name if breaker is not None else "call"
+    last_exc: Exception | None = None
+    resp = None
+    for attempt in range(max(1, policy.max_attempts)):
+        if deadline.expired:
+            break
+        if breaker is not None and not breaker.allow():
+            raise BreakerOpenError(name)
+        hdrs = dict(headers or {})
+        hdrs[DEADLINE_HEADER] = deadline.header_value()
+        retry_after_s = 0.0
+        try:
+            # wait_for bounds the WHOLE attempt by wall clock: httpx applies
+            # a bare-float timeout per phase (connect, read, write, pool
+            # each), so connect stalls + read could otherwise overrun the
+            # hop budget severalfold
+            resp = await asyncio.wait_for(
+                http.post(url, json=json_body, headers=hdrs,
+                          timeout=deadline.remaining_s()),
+                timeout=deadline.remaining_s())
+            last_exc = None
+        except asyncio.TimeoutError:
+            if breaker is not None:
+                breaker.record_failure()
+            get_metrics().inc(f"resilience.{name}.transport_errors")
+            last_exc, resp = DeadlineExpired(
+                f"{name}: attempt exceeded the remaining budget"), None
+            break  # the budget is gone; a retry cannot fit
+        except retryable_excs as e:
+            last_exc, resp = e, None
+            if breaker is not None:
+                breaker.record_failure()
+            get_metrics().inc(f"resilience.{name}.transport_errors")
+        except httpx.HTTPError as e:
+            # non-retryable transport fault (read timeout/reset: the server
+            # may have acted on the request — retrying could double-execute)
+            if breaker is not None:
+                breaker.record_failure()
+            get_metrics().inc(f"resilience.{name}.transport_errors")
+            raise
+        else:
+            if resp.status_code not in retry_statuses:
+                if breaker is not None:
+                    # any 5xx is dependency-health evidence: a reachable but
+                    # wedged server (500 on every call) must still trip the
+                    # circuit, and a half-open probe answered 5xx must NOT
+                    # close it. 4xx (semantic refusals: 409 speculation,
+                    # 422 truncation) are healthy-transport answers.
+                    if resp.status_code >= 500:
+                        breaker.record_failure()
+                    else:
+                        breaker.record_success()
+                return resp
+            if breaker is not None:
+                breaker.record_failure()
+            try:
+                retry_after_s = float(resp.headers.get("Retry-After", 0))
+            except (TypeError, ValueError):
+                retry_after_s = 0.0
+        if attempt + 1 >= max(1, policy.max_attempts):
+            break
+        delay = max(policy.backoff_s(attempt, rng), retry_after_s)
+        if deadline.remaining_s() <= delay:
+            break  # the budget can't cover the wait, let alone the attempt
+        get_metrics().inc(f"resilience.{name}.retries")
+        await sleep(delay)
+    if resp is not None:
+        return resp
+    if last_exc is not None:
+        raise last_exc
+    raise DeadlineExpired(f"{name}: deadline expired before any attempt")

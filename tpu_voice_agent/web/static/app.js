@@ -113,10 +113,17 @@ function connect() {
   ws.onerror = () => setStatus("error");
   ws.onmessage = (ev) => {
     let m; try { m = JSON.parse(ev.data); } catch { return; }
+    // degraded: true rides any event parsed by the local fallback while the
+    // brain circuit is open — surface it instead of pretending all is well
+    if (m.degraded && m.type !== "warn") setStatus("warn", "degraded");
+    else if (m.type === "intent" && !m.degraded) setStatus("listening", audio ? "listening" : "connected");
     switch (m.type) {
       case "transcript_partial": showPartial(m.text); break;
       case "transcript_final": showFinal(m.text); break;
-      case "intent": intentEl.textContent = JSON.stringify(m.data, null, 2); break;
+      case "intent":
+        intentEl.textContent = (m.degraded ? "// DEGRADED: rule-based parse (brain offline)\n" : "")
+          + JSON.stringify(m.data, null, 2);
+        break;
       case "tts": addLine("tts", `🔊 ${m.text}`); break;
       case "confirmation_required":
         pendingRisky = m.intents;
