@@ -30,7 +30,7 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).parent))
-from common import _ROOT, emit, log, percentile  # noqa: E402
+from common import _ROOT, emit, log, percentile, snapshot_observability  # noqa: E402
 
 COMMANDS = ["scroll down", "go back", "search for usb hubs",
             "take a screenshot", "sort by price"]
@@ -134,10 +134,15 @@ def main() -> None:
     burst_period = int(round(BURST / rate)) if rate > 0 else 0
     servers, counts = build_stack(burst_period)
     voice = servers[0]
+    obs: dict = {}
     try:
         log(f"{n} utterances, ~{rate:.0%} injected brain-fault rate "
             f"(bursts of {BURST} every {burst_period} calls)")
         lat_ms, degraded = asyncio.run(drive(voice.url, n))
+        # observability snapshot BEFORE teardown: the SLO verdict and the
+        # per-stage latency decomposition land in the BENCH_* artifact, so
+        # the perf trajectory carries the breakdown, not just headlines
+        obs = snapshot_observability(voice.url)
     finally:
         for srv in servers:
             srv.__exit__(None, None, None)
@@ -165,6 +170,7 @@ def main() -> None:
         "degraded_utterances": degraded,
         "fault_utt_ms_p50": round(p50, 3),
         "fault_utt_ms_p99": round(p99, 3),
+        **obs,
     }, indent=1))
     log(f"artifact: {art}")
 

@@ -59,3 +59,26 @@ def percentile(xs, q) -> float:
     import numpy as np
 
     return float(np.percentile(xs, q))
+
+
+def snapshot_observability(service_url: str, timeout_s: float = 5.0) -> dict:
+    """One service's SLO verdict + per-stage latency decomposition, shaped
+    for embedding in a BENCH_* artifact (``{"slo": ..., "stage_latency_ms":
+    ..., "runtime_gauges": ...}``). Benches call it before teardown so the
+    artifact carries the stage breakdown, not just headline numbers;
+    failures degrade to {} — observability must never fail a bench run."""
+    import json as _json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(service_url.rstrip("/") + "/metrics",
+                                    timeout=timeout_s) as r:
+            m = _json.loads(r.read().decode())
+    except Exception as e:
+        log(f"observability snapshot failed: {e}")
+        return {}
+    return {
+        "slo": m.get("slo"),
+        "stage_latency_ms": m.get("local", {}).get("latency_ms", {}),
+        "runtime_gauges": m.get("runtime", {}).get("gauges", {}),
+    }

@@ -2,11 +2,19 @@
 metric. The root ``bench.py`` (the driver's single headline number) stays
 separate; this is the wide table.
 
+Besides streaming every bench's rows to stdout, the run is snapshotted into
+``bench_artifacts/BENCH_runall_<ts>.json``: all parsed metric rows per
+bench, plus the observability sections (``slo`` / ``stage_latency_ms``,
+written by benches that boot real services — bench_faults) merged in, so
+BENCH_* files carry the stage decomposition, not just headline numbers.
+
 Usage: python benches/run_all.py [--quick]
 """
 
 from __future__ import annotations
 
+import datetime
+import json
 import subprocess
 import sys
 from pathlib import Path
@@ -19,11 +27,34 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 QUICK_BENCHES = ["bench_quality.py", "bench_faults.py"]
 
 
+def _parse_rows(stdout: str) -> list[dict]:
+    """Benches emit one JSON object per stdout line (benches/common.emit);
+    anything unparseable is narrative and skipped."""
+    rows = []
+    for line in stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return rows
+
+
+def _newer_artifacts(art_dir: Path, since: set[Path]) -> list[Path]:
+    return sorted(p for p in art_dir.glob("BENCH_*.json") if p not in since)
+
+
 def main() -> None:
     here = Path(__file__).parent
     root = here.parent
+    art_dir = root / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
     quick = "--quick" in sys.argv[1:]
     failures = 0
+    summary: dict = {"quick": quick, "benches": {}}
+    pre_existing = set(art_dir.glob("BENCH_*.json"))
     for name in (QUICK_BENCHES if quick else BENCHES):
         print(f"[run_all] {name}", file=sys.stderr, flush=True)
         try:
@@ -41,13 +72,37 @@ def main() -> None:
                     (sys.stderr if stream == "stderr" else sys.stdout).write(out)
             print(f"[run_all] {name} TIMED OUT after {e.timeout:.0f}s",
                   file=sys.stderr, flush=True)
+            summary["benches"][name] = {"status": "timeout"}
             continue
         sys.stderr.write(proc.stderr)
         sys.stdout.write(proc.stdout)
         sys.stdout.flush()
+        entry: dict = {
+            "status": "ok" if proc.returncode == 0 else f"failed ({proc.returncode})",
+            "rows": _parse_rows(proc.stdout),
+        }
+        # merge the bench's own artifact (bench_faults carries the SLO
+        # verdict + stage decomposition) into the combined snapshot
+        for art in _newer_artifacts(art_dir, pre_existing):
+            pre_existing.add(art)
+            try:
+                body = json.loads(art.read_text())
+            except (OSError, json.JSONDecodeError):
+                continue
+            if body.get("bench") == name.removesuffix(".py"):
+                entry["artifact"] = art.name
+                for key in ("slo", "stage_latency_ms", "runtime_gauges"):
+                    if key in body:
+                        entry[key] = body[key]
+        summary["benches"][name] = entry
         if proc.returncode != 0:
             failures += 1
             print(f"[run_all] {name} FAILED ({proc.returncode})", file=sys.stderr)
+
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    combined = art_dir / f"BENCH_runall_{stamp}.json"
+    combined.write_text(json.dumps(summary, indent=1))
+    print(f"[run_all] combined artifact: {combined}", file=sys.stderr, flush=True)
     sys.exit(1 if failures else 0)
 
 

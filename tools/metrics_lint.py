@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Metric-name collision lint.
+
+One name must map to one metric type: a counter named ``x`` and a gauge
+named ``x`` registered from two call sites would silently shadow each other
+in the JSON snapshot and produce conflicting ``# TYPE`` lines in the
+Prometheus exposition. This lint statically scans the package source for
+every ``inc(...)`` / ``set_gauge(...)`` / ``observe_ms(...)`` registration
+(f-string name templates are normalized: ``{expr}`` -> ``*``) and fails on
+any name registered under more than one kind.
+
+The runtime half lives in ``Metrics.collisions()`` (kind tracking at
+registration time); this static half catches collisions between code paths
+no single test executes together. Wired into tier-1 via
+tests/test_observability.py; also runnable standalone:
+
+    python tools/metrics_lint.py [root_dir]
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+# .inc("name"  /  .set_gauge(f"a.{x}.b"  /  .observe_ms('name'
+_CALL = re.compile(
+    r"\.(?P<kind>inc|set_gauge|observe_ms)\(\s*(?P<f>f?)(?P<q>['\"])(?P<name>.+?)(?P=q)")
+_KIND = {"inc": "counter", "set_gauge": "gauge", "observe_ms": "histogram"}
+_PLACEHOLDER = re.compile(r"\{[^{}]*\}")
+
+
+def _normalize(name: str, is_fstring: bool) -> str:
+    return _PLACEHOLDER.sub("*", name) if is_fstring else name
+
+
+def scan_source(root: pathlib.Path) -> dict[str, dict[str, list[str]]]:
+    """name -> kind -> [file:line, ...] over every .py under root."""
+    reg: dict[str, dict[str, list[str]]] = {}
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in _CALL.finditer(line):
+                name = _normalize(m.group("name"), bool(m.group("f")))
+                kind = _KIND[m.group("kind")]
+                reg.setdefault(name, {}).setdefault(kind, []).append(
+                    f"{path.relative_to(root)}:{i}")
+    return reg
+
+
+def find_collisions(reg: dict[str, dict[str, list[str]]]) -> list[tuple[str, dict]]:
+    return sorted((name, kinds) for name, kinds in reg.items() if len(kinds) > 1)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = pathlib.Path(argv[0]) if argv else \
+        pathlib.Path(__file__).resolve().parents[1] / "tpu_voice_agent"
+    reg = scan_source(root)
+    collisions = find_collisions(reg)
+    print(f"[metrics-lint] {len(reg)} distinct metric names under {root}")
+    if not collisions:
+        print("[metrics-lint] ok — no name registered under more than one type")
+        return 0
+    for name, kinds in collisions:
+        print(f"[metrics-lint] COLLISION {name!r}:")
+        for kind, sites in sorted(kinds.items()):
+            for site in sites:
+                print(f"  {kind:<9} {site}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Cross-service trace waterfall viewer.
+
+Every service keeps its completed spans in a bounded per-process ring and
+serves them at ``GET /debug/trace/{trace_id}`` (utils.tracing). This tool
+fans out to the voice/brain/executor endpoints, merges the three span sets
+for one trace id on the shared wall clock, and renders the per-utterance
+waterfall the trace ids were built for:
+
+    audio-ingest -> STT-finalize -> parse (queue/prefill/decode) -> execute
+
+Usage:
+    python tools/traceview.py TRACE_ID [--voice URL] [--brain URL]
+        [--executor URL] [--json] [--width N]
+    python tools/traceview.py --self-test
+
+``--json`` prints the merged spans + derived stage splits as JSON instead
+of the text gantt. ``--self-test`` runs the merge/derive/render pipeline on
+synthetic spans (no services needed) — wired into tier-1 via
+tests/test_observability.py.
+
+Zero dependencies beyond the stdlib: this must work from an operator shell
+with nothing installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+DEFAULT_URLS = {
+    "voice": "http://127.0.0.1:7072",
+    "brain": "http://127.0.0.1:8090",
+    "executor": "http://127.0.0.1:7081",
+}
+
+# the canonical stage order of one utterance (derive_stages keys follow it)
+STAGE_SPANS = (
+    ("audio_ingest", "voice", "audio_ingest"),
+    ("stt_finalize", "voice", "stt_finalize"),
+    ("parse", "brain", "parse"),
+    ("execute", "executor", "execute"),
+)
+# fallbacks when a downstream ring has already evicted the trace: the
+# voice-side roundtrip spans still bound the same stages (minus network)
+STAGE_FALLBACKS = {
+    "parse": ("voice", "parse_roundtrip"),
+    "execute": ("voice", "execute_roundtrip"),
+}
+
+
+def fetch_spans(base_url: str, trace_id: str, timeout_s: float = 5.0) -> list[dict]:
+    """One service's spans for the id; [] when unreachable (a dead service
+    must not hide the other services' half of the waterfall)."""
+    url = f"{base_url.rstrip('/')}/debug/trace/{trace_id}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read().decode()).get("spans", [])
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"[traceview] {url}: {e}", file=sys.stderr)
+        return []
+
+
+def merge_spans(span_sets: list[list[dict]]) -> list[dict]:
+    """Merge per-service span lists into one wall-clock-ordered waterfall."""
+    merged = [dict(sp) for spans in span_sets for sp in spans]
+    merged.sort(key=lambda s: (s.get("wall_start_s", 0.0), s.get("svc", ""), s.get("span", "")))
+    return merged
+
+
+def _find(spans: list[dict], svc: str, name: str) -> dict | None:
+    for sp in spans:
+        if sp.get("svc") == svc and sp.get("span") == name:
+            return sp
+    return None
+
+
+def derive_stages(spans: list[dict]) -> dict:
+    """The stage-split dict: per-stage ms in utterance order, with the
+    parse stage decomposed into queue/prefill/decode when the brain span
+    carries those attrs (engine backends deposit them)."""
+    stages: dict = {}
+    for stage, svc, name in STAGE_SPANS:
+        sp = _find(spans, svc, name) or (
+            _find(spans, *STAGE_FALLBACKS[stage]) if stage in STAGE_FALLBACKS else None)
+        if sp is None:
+            continue
+        entry: dict = {"ms": sp.get("ms"), "svc": sp.get("svc"), "span": sp.get("span")}
+        if stage in ("parse", "execute"):
+            for k in ("queue_ms", "prefill_ms", "decode_ms"):
+                if k in sp:
+                    entry[k] = sp[k]
+        stages[stage] = entry
+    if spans:
+        t0 = min(s.get("wall_start_s", 0.0) for s in spans)
+        t1 = max(s.get("wall_end_s", 0.0) for s in spans)
+        stages["window_ms"] = round((t1 - t0) * 1e3, 3)
+    return stages
+
+
+def render_gantt(spans: list[dict], width: int = 64) -> str:
+    """Text gantt: one bar per span, scaled to the trace's wall window."""
+    if not spans:
+        return "(no spans)"
+    t0 = min(s.get("wall_start_s", 0.0) for s in spans)
+    t1 = max(s.get("wall_end_s", 0.0) for s in spans)
+    window = max(1e-9, t1 - t0)
+    label_w = max(len(f"{s.get('svc', '?')}.{s.get('span', '?')}") for s in spans) + 2
+    lines = []
+    for sp in spans:
+        start = sp.get("wall_start_s", t0) - t0
+        dur = max(0.0, sp.get("wall_end_s", t0) - sp.get("wall_start_s", t0))
+        lead = int(start / window * width)
+        bar = max(1, int(dur / window * width))
+        bar = min(bar, width - min(lead, width - 1))
+        label = f"{sp.get('svc', '?')}.{sp.get('span', '?')}".ljust(label_w)
+        lines.append(f"{label}|{' ' * lead}{'█' * bar}"
+                     f"{' ' * (width - lead - bar)}| {sp.get('ms', 0):9.2f} ms")
+    lines.append(f"{'window'.ljust(label_w)}|{'-' * width}| {window * 1e3:9.2f} ms")
+    return "\n".join(lines)
+
+
+def waterfall(trace_id: str, urls: dict[str, str], timeout_s: float = 5.0) -> dict:
+    """Fan out, merge, derive — the programmatic surface tests use."""
+    span_sets = [fetch_spans(u, trace_id, timeout_s=timeout_s) for u in urls.values()]
+    spans = merge_spans(span_sets)
+    return {"trace_id": trace_id, "spans": spans, "stages": derive_stages(spans)}
+
+
+# ------------------------------------------------------------- self-test
+
+
+def _synthetic_spans() -> list[list[dict]]:
+    t0 = 1_700_000_000.0
+
+    def sp(svc, span, start, ms, **attrs):
+        return {"svc": svc, "span": span, "trace": "selftest01", "ms": ms,
+                "wall_start_s": t0 + start, "wall_end_s": t0 + start + ms / 1e3,
+                **attrs}
+
+    voice = [
+        sp("voice", "audio_ingest", 0.0, 900.0),
+        sp("voice", "stt_finalize", 0.78, 120.0),
+        sp("voice", "parse_roundtrip", 0.9, 240.0),
+        sp("voice", "execute_roundtrip", 1.15, 80.0),
+    ]
+    brain = [sp("brain", "parse", 0.905, 230.0,
+                queue_ms=5.0, prefill_ms=60.0, decode_ms=160.0)]
+    executor = [sp("executor", "execute", 1.155, 70.0, queue_ms=2.0)]
+    return [voice, brain, executor]
+
+
+def self_test() -> int:
+    spans = merge_spans(_synthetic_spans())
+    assert [s["span"] for s in spans] == [
+        "audio_ingest", "stt_finalize", "parse_roundtrip", "parse",
+        "execute_roundtrip", "execute",
+    ], f"wall-clock merge order broke: {[s['span'] for s in spans]}"
+    stages = derive_stages(spans)
+    for stage in ("audio_ingest", "stt_finalize", "parse", "execute"):
+        assert stage in stages, f"missing stage {stage}: {stages}"
+    # the service-side spans win over the voice roundtrip fallbacks
+    assert stages["parse"]["svc"] == "brain" and stages["parse"]["decode_ms"] == 160.0
+    assert stages["execute"]["svc"] == "executor"
+    # fallback path: drop the brain's spans, the voice roundtrip steps in
+    fb = derive_stages(merge_spans([_synthetic_spans()[0]]))
+    assert fb["parse"]["span"] == "parse_roundtrip" and fb["parse"]["svc"] == "voice"
+    gantt = render_gantt(spans)
+    assert gantt.count("\n") == len(spans), "one gantt row per span + window"
+    assert "brain.parse" in gantt and "█" in gantt
+    assert render_gantt([]) == "(no spans)"
+    print(gantt)
+    print("traceview self-test ok")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("trace_id", nargs="?", help="trace id to assemble")
+    ap.add_argument("--voice", default=DEFAULT_URLS["voice"])
+    ap.add_argument("--brain", default=DEFAULT_URLS["brain"])
+    ap.add_argument("--executor", default=DEFAULT_URLS["executor"])
+    ap.add_argument("--json", action="store_true", help="JSON instead of gantt")
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        return self_test()
+    if not args.trace_id:
+        ap.error("TRACE_ID required (or --self-test)")
+    out = waterfall(args.trace_id,
+                    {"voice": args.voice, "brain": args.brain,
+                     "executor": args.executor})
+    if args.json:
+        print(json.dumps(out, indent=1))
+    else:
+        print(render_gantt(out["spans"], width=args.width))
+        print()
+        print(json.dumps(out["stages"], indent=1))
+    return 0 if out["spans"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,31 @@ class BlockAllocator:
     def blocks_in_use(self) -> int:
         return self.n_blocks - self.n_groups - sum(len(f) for f in self._free)
 
+    @property
+    def usable_blocks(self) -> int:
+        """Pool capacity net of the per-group reserved trash blocks."""
+        return self.n_blocks - self.n_groups
+
+    @property
+    def utilization(self) -> float:
+        """KV page utilization in [0, 1] — the saturation signal a scraper
+        watches to size ``BRAIN_POOL_BLOCKS`` against the live-token
+        working set (1.0 means the next admission raises PoolExhausted)."""
+        u = self.usable_blocks
+        return self.blocks_in_use / u if u > 0 else 0.0
+
+
+def record_pool_gauges(alloc: "BlockAllocator") -> None:
+    """Export one allocator's occupancy as runtime gauges. Called by the
+    continuous batcher each chunk (so the gauges track the live pool the
+    scheduler actually allocates from) and directly by tests."""
+    from ..utils import get_metrics
+
+    m = get_metrics()
+    m.set_gauge("paged.kv_blocks_used", float(alloc.blocks_in_use))
+    m.set_gauge("paged.kv_blocks_total", float(alloc.usable_blocks))
+    m.set_gauge("paged.kv_utilization", alloc.utilization)
+
 
 @partial(jax.jit, donate_argnames=("k_pool", "v_pool"))
 def _scatter_blocks(k_pool, v_pool, src_k, src_v, dst_idx):

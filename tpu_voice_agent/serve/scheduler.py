@@ -78,6 +78,10 @@ class ContinuousBatcher:
 
         self.slots: list[_Slot] = [_Slot() for _ in range(self.B)]
         self.pending: list[tuple[int, str]] = []
+        # enqueue timestamps keyed by request id (NOT widened pending
+        # tuples — colocate's tombstone filter unpacks 2-tuples): TTFT must
+        # cover queue wait, the component that actually degrades under load
+        self._enqueued_at: dict[int, float] = {}
         self.results: dict[int, GenerationResult] = {}
         self._next_id = 0
         self._rng = jax.random.PRNGKey(1234)
@@ -85,6 +89,10 @@ class ContinuousBatcher:
         # readback (each one is a full tunnel round trip); the mirror is
         # refreshed from the chunk's single combined device_get
         self._active_h = np.zeros((self.B,), dtype=bool)
+        # rolling tokens/sec gauge (EMA over chunks): the throughput signal
+        # continuous batching tunes against, without a scrape having to
+        # difference the tokens_generated counter itself
+        self._tps_ema = 0.0
 
     # ------------------------------------------------------------ submit
 
@@ -93,6 +101,7 @@ class ContinuousBatcher:
         the cache contents are garbage until fresh admissions overwrite
         them, which _admit and chunk_decode_loop handle per slot)."""
         self.pending.clear()
+        self._enqueued_at.clear()
         self.results.clear()
         self.slots = [_Slot() for _ in range(self.B)]
         self.active = jnp.zeros_like(self.active)
@@ -103,6 +112,7 @@ class ContinuousBatcher:
     def submit(self, prompt: str) -> int:
         rid = self._next_id
         self._next_id += 1
+        self._enqueued_at[rid] = time.perf_counter()
         self.pending.append((rid, prompt))
         return rid
 
@@ -143,6 +153,16 @@ class ContinuousBatcher:
         sl.prompt_len = n
         sl.prefill_ms = (time.perf_counter() - t0) * 1e3
         sl.eos = False
+        # TTFT: ENQUEUE through the first sampled token — queue wait
+        # included, because that is the component that degrades when all
+        # slots are busy (a prefill-only number stays flat exactly when
+        # real time-to-first-token blows up). The streaming-serving
+        # headline metric (WhisperFlow/WhisperKit report it first-class).
+        from ..utils import get_metrics
+
+        t_enq = self._enqueued_at.pop(rid, t0)
+        get_metrics().observe_ms("scheduler.ttft",
+                                 (time.perf_counter() - t_enq) * 1e3)
 
     # ------------------------------------------------------------ step
 
@@ -168,10 +188,19 @@ class ContinuousBatcher:
                     steps=0, finished=False, error=str(e),
                 )
 
+        # drop enqueue stamps with no pending entry left (requests admitted
+        # above pop their own; these are abandons — colocate tombstoning
+        # filters self.pending directly — which must not leak the dict)
+        if len(self._enqueued_at) > len(self.pending):
+            live = {r for r, _ in self.pending}
+            for r in [r for r in self._enqueued_at if r not in live]:
+                del self._enqueued_at[r]
+
         if not act.any():
             return
 
         eng = self.engine
+        t_chunk0 = time.perf_counter()
         self._rng, k = jax.random.split(self._rng)
         (out, n, eos, self.cur, self.pos, self.fsm, self.active,
          self.nbytes, self.tokens_left) = eng.decode_chunk(
@@ -197,8 +226,24 @@ class ContinuousBatcher:
         m = get_metrics()
         m.inc("scheduler.tokens_generated", float(n_h.sum()))
         m.inc("scheduler.chunks")
+        # saturation gauges: the signals continuous batching is tuned by —
+        # backlog (queue_depth), batch occupancy (slots used / total), KV
+        # page pressure (paged engines), and rolling throughput
         m.set_gauge("scheduler.queue_depth", len(self.pending))
         m.set_gauge("scheduler.active_slots", float(act_h.sum()))
+        m.set_gauge("scheduler.batch_slots", float(self.B))
+        m.set_gauge("scheduler.batch_occupancy", float(act_h.sum()) / self.B)
+        chunk_s = time.perf_counter() - t_chunk0
+        if chunk_s > 0:
+            inst = float(n_h.sum()) / chunk_s
+            self._tps_ema = inst if self._tps_ema == 0.0 \
+                else 0.8 * self._tps_ema + 0.2 * inst
+            m.set_gauge("scheduler.tokens_per_s", self._tps_ema)
+        alloc = getattr(eng, "allocator", None)
+        if alloc is not None:
+            from .paged import record_pool_gauges
+
+            record_pool_gauges(alloc)
 
         for b in range(self.B):
             sl = self.slots[b]

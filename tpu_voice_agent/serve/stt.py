@@ -405,6 +405,11 @@ class StreamingSTT:
         self._parse_done: str | None = None
         self._buf = np.zeros(0, dtype=np.float32)
         self._since_partial = 0.0
+        # cumulative processing deficit: feed() wall time in excess of the
+        # audio duration it consumed. >0 sustained means transcription is
+        # falling behind realtime (frames queue up faster than the model
+        # drains them) — the STT-side saturation gauge
+        self._feed_lag_s = 0.0
 
     def reset(self) -> None:
         self._buf = np.zeros(0, dtype=np.float32)
@@ -413,6 +418,7 @@ class StreamingSTT:
         self._spec_final = None
         self._spec_at_speech = -1
         self._parse_done = None
+        self._feed_lag_s = 0.0
         self.endpointer.reset()
 
     def parse_complete(self, text: str) -> None:
@@ -427,6 +433,7 @@ class StreamingSTT:
         self._parse_done = text
 
     def feed(self, samples: np.ndarray) -> list[tuple[str, str]]:
+        t_feed0 = time.perf_counter()
         sr = self.engine.mel_cfg.sample_rate
         events: list[tuple[str, str]] = []
         ended = self.endpointer.feed(samples)
@@ -510,6 +517,15 @@ class StreamingSTT:
                 res = self.engine.transcribe(self._buf)
                 if res.text:
                     events.append(("partial", res.text))
+
+        # saturation gauges: audio-seconds buffered vs processed. The lag
+        # accumulates each feed's wall-time excess over the audio duration
+        # it consumed and drains when processing runs ahead of realtime.
+        m = _metrics()
+        self._feed_lag_s = max(
+            0.0, self._feed_lag_s + (time.perf_counter() - t_feed0) - len(samples) / sr)
+        m.set_gauge("stt.feed_lag_s", round(self._feed_lag_s, 4))
+        m.set_gauge("stt.buffered_audio_s", round(len(self._buf) / sr, 4))
         return events
 
 

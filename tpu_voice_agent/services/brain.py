@@ -23,12 +23,13 @@ import json
 import os
 import re
 import threading
+import time
 from typing import Protocol
 
 from aiohttp import web
 
 from ..schemas import Intent, ParseRequest, ParseResponse, Target, parse_response_from_json
-from ..utils import Tracer, load_env_cascade, new_trace_id
+from ..utils import SLOTracker, Tracer, load_env_cascade, new_trace_id
 from ..utils.resilience import (
     AdmissionController,
     Deadline,
@@ -53,7 +54,14 @@ class ParserError(Exception):
 
 
 def _result_to_response(res) -> ParseResponse:
-    """GenerationResult -> ParseResponse with the reference error mapping."""
+    """GenerationResult -> ParseResponse with the reference error mapping.
+    Deposits the prefill/decode split as stage notes on the calling thread
+    so the /parse span (and therefore the trace waterfall) carries the
+    decode decomposition, not just the total."""
+    from ..utils.tracing import note_stage
+
+    note_stage("prefill_ms", round(res.prefill_ms, 3))
+    note_stage("decode_ms", round(res.decode_ms, 3))
     if res.error:
         raise ParserError("llm_error", res.error)
     if not res.finished:
@@ -645,6 +653,8 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
             with parse_lock:
                 return parser.parse(*args)
 
+    # per-request /parse latency + error budget against the SLO targets
+    slo = SLOTracker("brain")
     wants_session = getattr(parser, "wants_session", False)
     # stateless parsers are trivially speculation-safe (parse is pure);
     # session-keyed ones must OPT IN with two-phase turns (PlannerParser)
@@ -673,9 +683,19 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
                 status = "unhealthy"
         body["status"] = status
         body["ok"] = status != "unhealthy"
+        body["slo"] = slo.state()
         return web.json_response(body, status=200 if body["ok"] else 503)
 
     async def parse(req: web.Request) -> web.Response:
+        # the SLO sample covers the WHOLE request (queue + decode), and a
+        # 5xx — shed, deadline, engine crash — burns error budget; 4xx are
+        # semantic answers about the request, not service health
+        t_req0 = time.perf_counter()
+        resp = await _parse_inner(req, t_req0)
+        slo.record((time.perf_counter() - t_req0) * 1e3, ok=resp.status < 500)
+        return resp
+
+    async def _parse_inner(req: web.Request, t_req0: float) -> web.Response:
         trace_id = req.headers.get("x-trace-id", new_trace_id())
         headers = {"x-trace-id": trace_id}
         try:
@@ -715,18 +735,29 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         if not admission.try_acquire():
             return shed("overload")
         loop = asyncio.get_running_loop()
+        from ..utils.tracing import pop_stage_notes
+
+        notes: dict = {}
 
         def run_admitted(preq: ParseRequest) -> ParseResponse:
+            # queue_ms: arrival -> worker-thread start (thread pool + engine
+            # lock wait) — the queue/prefill/decode split traceview derives
+            notes["queue_ms"] = round((time.perf_counter() - t_req0) * 1e3, 3)
             # re-check on the worker thread: queueing for the pool (or the
             # engine lock) may have consumed the rest of the budget — shed
             # BEFORE decode, not after
             if deadline is not None and deadline.expired:
                 raise DeadlineExpired("budget consumed while queued")
-            return do_parse(preq)
+            pop_stage_notes()  # drop stale notes from a prior request
+            out = do_parse(preq)
+            # engine backends deposit prefill_ms/decode_ms on THIS thread
+            notes.update(pop_stage_notes())
+            return out
 
         try:
-            with tracer.span("parse", trace_id=trace_id, chars=len(preq.text)):
+            with tracer.span("parse", trace_id=trace_id, chars=len(preq.text)) as sp:
                 resp = await loop.run_in_executor(parse_pool, run_admitted, preq)
+                sp.attrs.update(notes)
         except DeadlineExpired:
             return shed("deadline_expired", retry_after_s=0)
         except ParserError as e:
@@ -755,9 +786,10 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
 
 
     app.router.add_get("/health", health)
-    from ..utils.tracing import make_metrics_handler
+    from ..utils.tracing import make_metrics_handler, make_trace_handler
 
-    app.router.add_get("/metrics", make_metrics_handler("brain", tracer))
+    app.router.add_get("/metrics", make_metrics_handler("brain", tracer, slo=slo))
+    app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("brain", tracer))
     app.router.add_post("/parse", parse)
     return app
 

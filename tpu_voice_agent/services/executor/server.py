@@ -11,11 +11,12 @@ from __future__ import annotations
 import asyncio
 import os
 import threading
+import time
 
 from aiohttp import web
 
 from ...schemas import ExecuteRequest
-from ...utils import Tracer, load_env_cascade, new_trace_id
+from ...utils import SLOTracker, Tracer, load_env_cascade, new_trace_id
 from ...utils.resilience import (
     AdmissionController,
     Deadline,
@@ -75,6 +76,9 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
         max_inflight if max_inflight is not None
         else int(os.environ.get("EXECUTOR_MAX_INFLIGHT", "16")))
 
+    # per-request /execute latency + error budget against the SLO targets
+    slo = SLOTracker("executor")
+
     async def health(_req: web.Request) -> web.Response:
         status = "degraded" if admission.saturated else "ok"
         return web.json_response({
@@ -82,9 +86,16 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
             "sessions": len(manager.sessions),
             "inflight": admission.inflight,
             "max_inflight": admission.max_inflight,
+            "slo": slo.state(),
         })
 
     async def execute(req: web.Request) -> web.Response:
+        t_req0 = time.perf_counter()
+        resp = await _execute_inner(req)
+        slo.record((time.perf_counter() - t_req0) * 1e3, ok=resp.status < 500)
+        return resp
+
+    async def _execute_inner(req: web.Request) -> web.Response:
         trace_id = req.headers.get("x-trace-id", new_trace_id())
         headers = {"x-trace-id": trace_id}
         try:
@@ -112,6 +123,8 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
         if not admission.try_acquire():
             return shed("overload")
 
+        t_q0 = time.perf_counter()
+
         def work():
             with exec_lock:
                 # re-check AFTER winning the lock: the wait may have consumed
@@ -119,7 +132,8 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
                 if deadline is not None and deadline.expired:
                     raise DeadlineExpired("budget consumed waiting for exec_lock")
                 session = manager.open(ereq.session_id)
-                with tracer.span("execute", trace_id=trace_id, intents=len(ereq.intents)):
+                with tracer.span("execute", trace_id=trace_id, intents=len(ereq.intents),
+                                 queue_ms=round((time.perf_counter() - t_q0) * 1e3, 3)):
                     results = run_intents(
                         session.page,
                         session.artifacts_dir,
@@ -184,9 +198,10 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
 
 
     app.router.add_get("/health", health)
-    from ...utils.tracing import make_metrics_handler
+    from ...utils.tracing import make_metrics_handler, make_trace_handler
 
-    app.router.add_get("/metrics", make_metrics_handler("executor", tracer))
+    app.router.add_get("/metrics", make_metrics_handler("executor", tracer, slo=slo))
+    app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("executor", tracer))
     app.router.add_post("/execute", execute)
     app.router.add_post("/uploads", uploads)
     app.router.add_post("/close", close)

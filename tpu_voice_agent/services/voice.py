@@ -22,6 +22,7 @@ import asyncio
 import json
 import os
 import threading
+import time
 
 import httpx
 import numpy as np
@@ -29,7 +30,7 @@ from aiohttp import WSMsgType, web
 
 from ..audio.mel import pcm16_to_float
 from ..schemas import Intent, ParseResponse
-from ..utils import Tracer, get_metrics, load_env_cascade, new_trace_id
+from ..utils import SLOTracker, Tracer, get_metrics, load_env_cascade, new_trace_id
 from ..utils.resilience import (
     BreakerOpenError,
     CircuitBreaker,
@@ -139,7 +140,20 @@ class ClientState:
         # under one key and turn 2 under another — or, worse, share a
         # default key across clients
         self.convo_id = new_trace_id()
+        # per-UTTERANCE trace id (rotated when a new utterance starts) so
+        # /debug/trace assembles one utterance's waterfall, not a whole
+        # connection's history under a single id
         self.trace_id = new_trace_id()
+        # per-utterance stage accounting for the latency_budget event:
+        # utt_t0 = perf_counter at the utterance's first audio frame;
+        # stages = the split dict accumulated capture -> final -> parse
+        self.utt_t0: float | None = None
+        self.stages: dict = {}
+        # trace id of the utterance whose risky plan awaits confirmation:
+        # the user's confirm click arrives AFTER later audio frames have
+        # rotated trace_id, and the confirmed execution belongs to the
+        # utterance that proposed it, not whatever is being spoken now
+        self.confirm_trace_id: str | None = None
         # serializes executor calls per client so the first execution's
         # session_id is threaded into the next (back-to-back commands must
         # share one browser session)
@@ -191,6 +205,9 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     from .brain import RuleBasedParser
 
     fallback_parser = RuleBasedParser()
+    # the north-star SLO: voice->intent (end-of-speech processing cost —
+    # STT finalize + parse; the speaker's own talking time is not latency)
+    slo = SLOTracker("voice")
 
     async def health(_req: web.Request) -> web.Response:
         breakers = {"brain": brain_breaker.state, "executor": exec_breaker.state}
@@ -199,6 +216,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         return web.json_response({
             "ok": status == "ok", "status": status, "service": "voice",
             "breakers": breakers,
+            "slo": slo.state(),
         })
 
     async def send(ws: web.WebSocketResponse, type_: str, **payload) -> None:
@@ -282,8 +300,22 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         get_metrics().inc("voice.spec_parse_started")
         state.spec = (text, asyncio.ensure_future(run()))
 
+    async def emit_budget(ws, state: ClientState, stages: dict | None = None) -> None:
+        """The per-utterance latency_budget event: the stage-split dict the
+        web HUD renders next to the degraded badge. total_ms is the
+        voice->intent(+execute) PROCESSING cost — audio_ingest_ms (which
+        includes the speaker's own talking time) is reported but not
+        summed."""
+        stages = dict(stages if stages is not None else state.stages)
+        stages["total_ms"] = round(sum(
+            stages.get(k, 0.0)
+            for k in ("stt_finalize_ms", "parse_ms", "execute_ms")), 3)
+        await send(ws, "latency_budget", trace_id=stages.pop("trace_id", state.trace_id),
+                   stages=stages)
+
     async def handle_final(ws, state: ClientState, text: str, http: httpx.AsyncClient) -> None:
         """transcript final -> brain -> gate -> executor (the hot path)."""
+        t_final0 = time.perf_counter()
         if not spec_supported["ok"]:
             # one skipped UTTERANCE per final; after RESPEC_AFTER of them
             # the next utterance re-probes speculation (a brain restarted
@@ -367,12 +399,22 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             degraded = False
             if r.status_code != 200:
                 await send(ws, "error", message=f"brain error {r.status_code}", detail=r.text[:300])
+                await utterance_failed(ws, state, t_final0)
                 return
             try:
                 parsed = ParseResponse.model_validate(r.json())
             except Exception as e:
                 await send(ws, "error", message=f"brain returned invalid payload: {e}")
+                await utterance_failed(ws, state, t_final0)
                 return
+
+        # voice->intent is decided HERE: the stage split below feeds the SLO
+        # tracker and the latency_budget event the web HUD renders
+        state.stages["parse_ms"] = round((time.perf_counter() - t_final0) * 1e3, 3)
+        if degraded:
+            state.stages["degraded"] = True
+        slo.record(state.stages.get("stt_finalize_ms", 0.0) + state.stages["parse_ms"],
+                   ok=True)
 
         tag = {"degraded": True} if degraded else {}
         await send(ws, "intent", data=parsed.model_dump(), **tag)
@@ -386,6 +428,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         safe = [i for i in parsed.intents if not i.is_risky() and i.type != "unknown"]
         risky = [i for i in parsed.intents if i.is_risky()]
         if risky:
+            state.confirm_trace_id = state.trace_id
             await send(
                 ws, "confirmation_required",
                 intents=[i.model_dump() for i in risky],
@@ -393,25 +436,56 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                 **tag,
             )
         if safe:
-            asyncio.ensure_future(execute_and_report(ws, state, safe, http))
+            # the latency_budget event follows the execution (execute_ms
+            # rides along); a risky-only plan reports without it. Both the
+            # stages dict AND the trace id are snapshotted NOW — the next
+            # utterance rotates state.trace_id while this task runs
+            asyncio.ensure_future(execute_and_report(
+                ws, state, safe, http,
+                stages=dict(state.stages, trace_id=state.trace_id),
+                trace_id=state.trace_id))
+        else:
+            await emit_budget(ws, state)
 
-    async def execute_and_report(ws, state: ClientState, intents: list[Intent], http) -> None:
+    async def utterance_failed(ws, state: ClientState, t_final0: float) -> None:
+        """Terminal parse failure: the utterance still costs SLO error
+        budget and still reports its (partial) stage split."""
+        state.stages["parse_ms"] = round((time.perf_counter() - t_final0) * 1e3, 3)
+        state.stages["error"] = True
+        slo.record(state.stages.get("stt_finalize_ms", 0.0) + state.stages["parse_ms"],
+                   ok=False)
+        await emit_budget(ws, state)
+
+    async def execute_and_report(ws, state: ClientState, intents: list[Intent], http,
+                                 stages: dict | None = None,
+                                 trace_id: str | None = None) -> None:
+        # trace_id is snapshotted by the CALLER (handle_final): this task is
+        # fire-and-forget, and state.trace_id rotates per utterance — reading
+        # it here would attribute a slow execution to the NEXT utterance
+        trace_id = trace_id or state.trace_id
+        t0 = time.perf_counter()
         async with state.exec_lock:
-            await _execute_locked(ws, state, intents, http)
+            await _execute_locked(ws, state, intents, http, trace_id)
+        if stages is not None:
+            stages["execute_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+            await emit_budget(ws, state, stages)
 
-    async def _execute_locked(ws, state: ClientState, intents: list[Intent], http) -> None:
+    async def _execute_locked(ws, state: ClientState, intents: list[Intent], http,
+                              trace_id: str) -> None:
         try:
-            r = await post_with_resilience(
-                http, cfg.executor_url + "/execute",
-                json_body={
-                    "session_id": state.session_id,
-                    "intents": [i.model_dump() for i in intents],
-                },
-                headers={"x-trace-id": state.trace_id},
-                deadline=Deadline.after(cfg.exec_timeout_s),
-                policy=retry_policy,
-                breaker=exec_breaker,
-            )
+            with tracer.span("execute_roundtrip", trace_id=trace_id,
+                             intents=len(intents)):
+                r = await post_with_resilience(
+                    http, cfg.executor_url + "/execute",
+                    json_body={
+                        "session_id": state.session_id,
+                        "intents": [i.model_dump() for i in intents],
+                    },
+                    headers={"x-trace-id": trace_id},
+                    deadline=Deadline.after(cfg.exec_timeout_s),
+                    policy=retry_policy,
+                    breaker=exec_breaker,
+                )
         except asyncio.CancelledError:
             raise
         except BreakerOpenError:
@@ -455,6 +529,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             try:
                 async for msg in ws:
                     if msg.type == WSMsgType.BINARY:
+                        t_feed0 = time.perf_counter()
                         try:
                             samples = pcm16_to_float(msg.data)
                             # STT may run a model; keep the event loop responsive
@@ -463,6 +538,21 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                             # a truncated PCM packet must not kill the session
                             await send(ws, "warn", message=f"bad audio frame: {e}")
                             continue
+                        t_feed1 = time.perf_counter()
+                        if state.utt_t0 is None:
+                            # a NEW utterance starts at SPEECH ONSET (not at
+                            # the first post-final frame — an open mic streams
+                            # silence continuously, and counting idle time as
+                            # audio_ingest would poison the histogram): fresh
+                            # trace id so /debug/trace shows one utterance's
+                            # waterfall (speculative parses fired
+                            # mid-utterance share it). STT backends without
+                            # an endpointer (NullSTT) arm on any frame.
+                            ep = getattr(state.stt, "endpointer", None)
+                            if ep is None or ep.in_speech or events:
+                                state.utt_t0 = t_feed0
+                                state.trace_id = new_trace_id()
+                                state.stages = {}
                         for kind, text in events:
                             if kind == "partial":
                                 await send(ws, "transcript_partial", text=text)
@@ -471,6 +561,20 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                                 # transcript while the endpoint window runs out
                                 await speculate(state, text, http)
                             else:
+                                # stage spans for the waterfall: the whole
+                                # capture window and the feed call that
+                                # finalized the transcript
+                                tracer.record_span(
+                                    "audio_ingest", state.trace_id,
+                                    state.utt_t0, t_feed1)
+                                tracer.record_span(
+                                    "stt_finalize", state.trace_id,
+                                    t_feed0, t_feed1, chars=len(text))
+                                state.stages.update(
+                                    audio_ingest_ms=round((t_feed1 - state.utt_t0) * 1e3, 3),
+                                    stt_finalize_ms=round((t_feed1 - t_feed0) * 1e3, 3),
+                                )
+                                state.utt_t0 = None
                                 await send(ws, "transcript_final", text=text)
                                 await handle_final(ws, state, text, http)
                     elif msg.type == WSMsgType.TEXT:
@@ -489,6 +593,9 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                             # typed command path: same pipeline minus STT
                             text = str(ctrl.get("text") or "")
                             if text:
+                                state.trace_id = new_trace_id()
+                                state.stages = {}
+                                state.utt_t0 = None
                                 await send(ws, "transcript_final", text=text)
                                 await handle_final(ws, state, text, http)
                         elif ctype == "confirm_execute":
@@ -499,7 +606,13 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                                 await send(ws, "warn", message=f"bad intents: {e}")
                                 continue
                             if intents:
-                                await execute_and_report(ws, state, intents, http)
+                                # attribute to the utterance that PROPOSED
+                                # the plan (frames spoken since the
+                                # confirmation prompt rotated state.trace_id)
+                                await execute_and_report(
+                                    ws, state, intents, http,
+                                    trace_id=state.confirm_trace_id)
+                                state.confirm_trace_id = None
                         elif ctype == "reset":
                             state.stt.reset()
                             state.context = {}
@@ -520,9 +633,10 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
 
 
     app.router.add_get("/health", health)
-    from ..utils.tracing import make_metrics_handler
+    from ..utils.tracing import make_metrics_handler, make_trace_handler
 
-    app.router.add_get("/metrics", make_metrics_handler("voice", tracer))
+    app.router.add_get("/metrics", make_metrics_handler("voice", tracer, slo=slo))
+    app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("voice", tracer))
     app.router.add_get("/stream", stream)
     app.router.add_get("/", index)
     from ..web import static_dir as _sd

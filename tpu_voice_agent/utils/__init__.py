@@ -1,5 +1,14 @@
 from .envcfg import load_env_cascade, env_str, env_int, env_bool
-from .tracing import Span, Tracer, Metrics, get_metrics, new_trace_id
+from .tracing import (
+    Span,
+    Tracer,
+    Metrics,
+    get_metrics,
+    log_event,
+    new_trace_id,
+    prometheus_exposition,
+)
+from .slo import SLOTracker
 from .resilience import (
     DEADLINE_HEADER,
     AdmissionController,
@@ -21,7 +30,10 @@ __all__ = [
     "Tracer",
     "Metrics",
     "get_metrics",
+    "log_event",
     "new_trace_id",
+    "prometheus_exposition",
+    "SLOTracker",
     "DEADLINE_HEADER",
     "AdmissionController",
     "BreakerOpenError",

@@ -51,12 +51,14 @@ def reexec_on_cpu(reason: str, tag: str = "bench") -> None:
     'pinned'). Operators who prefer a visible failure over a CPU row set
     BENCH_NO_CPU_FALLBACK=1 instead.
     """
+    from .tracing import log_event
+
     if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
-        print(f"[{tag}] {reason}; BENCH_NO_CPU_FALLBACK=1 — failing instead "
-              "of substituting CPU", file=sys.stderr, flush=True)
+        log_event(tag, "device_init_failed", reason=reason,
+                  action="fail (BENCH_NO_CPU_FALLBACK=1)")
         os._exit(7)
-    print(f"[{tag}] {reason}; re-exec pinned to CPU", file=sys.stderr,
-          flush=True)
+    log_event(tag, "device_init_failed", reason=reason,
+              action="re-exec pinned to CPU")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
         os.execve(sys.executable, [sys.executable] + sys.argv, env)

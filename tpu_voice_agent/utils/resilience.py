@@ -148,6 +148,10 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probes = 0
         self._probe_at = 0.0  # when the last half-open probe was admitted
+        # the state gauge exists from construction (0 = closed), not only
+        # after the first transition — a scraper must see every breaker,
+        # including the ones that have never tripped
+        self._gauge(0)
 
     # state is advisory (a scrape label); allow() is the authoritative gate
     @property
@@ -230,6 +234,10 @@ class AdmissionController:
         self.retry_after_s = retry_after_s
         self._lock = threading.Lock()
         self._inflight = 0
+        # scrape-visible from construction, like the breaker state gauge
+        get_metrics().set_gauge(f"resilience.{name}.inflight", 0)
+        get_metrics().set_gauge(f"resilience.{name}.max_inflight",
+                                self.max_inflight)
 
     @property
     def inflight(self) -> int:

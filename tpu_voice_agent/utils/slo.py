@@ -1,0 +1,139 @@
+"""Rolling-window SLO evaluation for the voice->intent latency budget.
+
+BASELINE.json's north star is **voice->intent p50 < 800 ms**; PR 1's
+resilience layer changes behavior on signals (breaker trips, sheds,
+degraded parses) that until now were only visible as log lines. This
+module closes the loop: each service feeds its request latencies and
+outcomes into an ``SLOTracker``, which evaluates a rolling window
+(``SLO_WINDOW_S``) against configurable p50/p99/error-rate targets and
+exports the verdict as
+
+- an ``slo: ok | at_risk | violated`` field in ``/health``
+- ``slo.<name>.*`` gauges in the process-global metrics registry (and
+  therefore the Prometheus exposition — state is 0/1/2)
+- the full evaluation dict in the JSON ``/metrics`` body
+
+``at_risk`` fires when a percentile crosses ``SLO_AT_RISK_FRACTION``
+(default 0.8) of its target — the early-warning band before the budget is
+actually blown; recovery is implicit (violating samples age out of the
+window). Percentiles use the same nearest-rank helper as ``Metrics``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from .tracing import get_metrics, nearest_rank
+
+STATES = ("ok", "at_risk", "violated")
+
+
+class SLOTracker:
+    """Thread-safe rolling window of (timestamp, latency_ms, ok) samples.
+
+    Env defaults (overridable per-instance via constructor args):
+    ``SLO_WINDOW_S`` (300), ``SLO_TARGET_P50_MS`` (800 — the BASELINE
+    north star), ``SLO_TARGET_P99_MS`` (4x p50 target),
+    ``SLO_ERROR_RATE`` (0.05), ``SLO_AT_RISK_FRACTION`` (0.8),
+    ``SLO_MIN_SAMPLES`` (5 — below it the verdict stays ``ok``: two slow
+    warmup requests must not page anyone).
+    """
+
+    MAX_SAMPLES = 8192  # hard cap independent of window (memory bound)
+
+    def __init__(self, name: str, *, window_s: float | None = None,
+                 target_p50_ms: float | None = None,
+                 target_p99_ms: float | None = None,
+                 error_rate_target: float | None = None,
+                 at_risk_fraction: float | None = None,
+                 min_samples: int | None = None,
+                 clock=time.monotonic):
+        env = os.environ.get
+        self.name = name
+        self.window_s = window_s if window_s is not None \
+            else float(env("SLO_WINDOW_S", "300"))
+        self.target_p50_ms = target_p50_ms if target_p50_ms is not None \
+            else float(env("SLO_TARGET_P50_MS", "800"))
+        self.target_p99_ms = target_p99_ms if target_p99_ms is not None \
+            else float(env("SLO_TARGET_P99_MS", str(self.target_p50_ms * 4)))
+        self.error_rate_target = error_rate_target if error_rate_target is not None \
+            else float(env("SLO_ERROR_RATE", "0.05"))
+        self.at_risk_fraction = at_risk_fraction if at_risk_fraction is not None \
+            else float(env("SLO_AT_RISK_FRACTION", "0.8"))
+        self.min_samples = min_samples if min_samples is not None \
+            else int(env("SLO_MIN_SAMPLES", "5"))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: deque[tuple[float, float, bool]] = deque(maxlen=self.MAX_SAMPLES)
+
+    def record(self, latency_ms: float, ok: bool = True) -> None:
+        with self._lock:
+            self._samples.append((self._clock(), float(latency_ms), bool(ok)))
+
+    def _windowed(self) -> list[tuple[float, float, bool]]:
+        cutoff = self._clock() - self.window_s
+        with self._lock:
+            while self._samples and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+            return list(self._samples)
+
+    def state(self) -> str:
+        return self.evaluate()["state"]
+
+    def evaluate(self) -> dict:
+        """Evaluate the window and export ``slo.<name>.*`` gauges."""
+        xs = self._windowed()
+        lat = sorted(ms for _, ms, _ in xs)
+        n = len(xs)
+        errors = sum(1 for _, _, ok in xs if not ok)
+        p50 = nearest_rank(lat, 0.50) if lat else None
+        p99 = nearest_rank(lat, 0.99) if lat else None
+        error_rate = errors / n if n else 0.0
+
+        state = "ok"
+        reasons: list[str] = []
+        if n >= self.min_samples:
+            checks = (
+                ("p50_ms", p50, self.target_p50_ms),
+                ("p99_ms", p99, self.target_p99_ms),
+                ("error_rate", error_rate, self.error_rate_target),
+            )
+            for label, value, target in checks:
+                if value is None or target <= 0:
+                    continue
+                if value > target:
+                    state = "violated"
+                    reasons.append(f"{label} {value:.3g} > target {target:.3g}")
+                elif value > target * self.at_risk_fraction and state == "ok":
+                    state = "at_risk"
+                    reasons.append(f"{label} {value:.3g} > "
+                                   f"{self.at_risk_fraction:.0%} of target {target:.3g}")
+
+        m = get_metrics()
+        m.set_gauge(f"slo.{self.name}.state", float(STATES.index(state)))
+        m.set_gauge(f"slo.{self.name}.window_samples", float(n))
+        m.set_gauge(f"slo.{self.name}.error_rate", error_rate)
+        if p50 is not None:
+            m.set_gauge(f"slo.{self.name}.p50_ms", p50)
+        if p99 is not None:
+            m.set_gauge(f"slo.{self.name}.p99_ms", p99)
+
+        return {
+            "name": self.name,
+            "state": state,
+            "reasons": reasons,
+            "window_s": self.window_s,
+            "samples": n,
+            "errors": errors,
+            "error_rate": round(error_rate, 4),
+            "p50_ms": round(p50, 3) if p50 is not None else None,
+            "p99_ms": round(p99, 3) if p99 is not None else None,
+            "targets": {
+                "p50_ms": self.target_p50_ms,
+                "p99_ms": self.target_p99_ms,
+                "error_rate": self.error_rate_target,
+            },
+        }

@@ -1,4 +1,4 @@
-"""Structured per-stage tracing + metrics.
+"""Structured per-stage tracing + metrics + Prometheus exposition.
 
 The reference has no tracing at all — observability is tagged console.log
 lines (SURVEY.md §5); the only latency numbers ever measured lived in a dead
@@ -6,15 +6,34 @@ demo's console.table (apps/executor/src/index.js:76-93). Here every request
 carries a trace id across capture -> STT -> parse -> execute hops, and each
 stage records a span, so the BASELINE metric (voice->intent p50) is measurable
 from day one.
+
+The collection plane on top of that (the part the one-line-JSON-to-stderr
+spans never had):
+
+- every completed span lands in a bounded per-process ring keyed by trace id
+  (``Tracer.spans_for``), served by ``GET /debug/trace/{trace_id}`` on every
+  service (``make_trace_handler``) so ``tools/traceview.py`` can reassemble a
+  cross-service waterfall for one utterance
+- ``TRACE_SINK=<path>`` additionally appends completed spans as JSONL for
+  offline analysis
+- ``Metrics`` keeps fixed log-spaced millisecond histogram buckets alongside
+  the bounded reservoir, and ``/metrics`` content-negotiates: JSON by
+  default, Prometheus text exposition (``text/plain; version=0.0.4``) when
+  requested — a standard scraper works with zero sidecars
+- ``log_event`` is the one spelling of ad-hoc structured stderr logging
+  (trace-id-correlated JSON lines), replacing bare ``print`` debugging
 """
 
 from __future__ import annotations
 
 import json
+import os
+import re
 import sys
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -23,12 +42,26 @@ def new_trace_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def log_event(service: str, event: str, trace_id: str | None = None, **fields) -> None:
+    """One structured log line to stderr: ``{"svc", "event", "trace"?, ...}``.
+    The single replacement for bare ``print(...)`` debugging — every ad-hoc
+    line becomes grep-able and (when a trace id is at hand) joinable against
+    the span ring."""
+    payload: dict = {"svc": service, "event": event}
+    if trace_id:
+        payload["trace"] = trace_id
+    payload.update({k: v for k, v in fields.items()
+                    if isinstance(v, (str, int, float, bool)) or v is None})
+    print(json.dumps(payload), file=sys.stderr, flush=True)
+
+
 @dataclass
 class Span:
     name: str
     trace_id: str
     start_s: float
     end_s: float = 0.0
+    wall_start_s: float = 0.0  # epoch seconds; comparable across processes
     attrs: dict = field(default_factory=dict)
 
     @property
@@ -36,21 +69,75 @@ class Span:
         return (self.end_s - self.start_s) * 1e3
 
 
+# span names become metric keys (f"{service}.{name}") and Prometheus label
+# material; per-request values smuggled into the NAME would explode metric
+# cardinality unboundedly, so names carrying attr-ish syntax are rejected
+_BAD_SPAN_NAME = re.compile(r"[{}=\s]")
+
+
+def _check_span_name(name: str) -> str:
+    if not name or _BAD_SPAN_NAME.search(name):
+        raise ValueError(
+            f"bad span name {name!r}: span names are metric keys and must "
+            "not contain '{', '}', '=' or whitespace — put per-request "
+            "values in attrs, not the name")
+    return name
+
+
+def nearest_rank(sorted_xs, q: float):
+    """The one percentile spelling shared by ``percentile_ms`` and
+    ``snapshot`` (they used to disagree on index rounding): nearest-rank on
+    the interpolation index ``q * (n - 1)``, half-up. 1 sample -> that
+    sample for every q; 2 samples -> lower for q < 0.5, upper from q >= 0.5."""
+    n = len(sorted_xs)
+    if n == 0:
+        raise ValueError("no samples")
+    idx = int(q * (n - 1) + 0.5)
+    return sorted_xs[min(n - 1, max(0, idx))]
+
+
+# fixed log-spaced millisecond bucket bounds (1-2-5 per decade): stable
+# across processes and scrapes, so Prometheus histograms aggregate cleanly
+# where the reservoir (exact but windowed) cannot
+HIST_BUCKETS_MS: tuple[float, ...] = (
+    1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000,
+)
+
+
 class Metrics:
-    """Process-local counters + latency histograms (lock-protected)."""
+    """Process-local counters + gauges + latency histograms (lock-protected).
+
+    Latencies keep BOTH a bounded reservoir (exact recent percentiles for
+    the JSON snapshot) and fixed log-spaced cumulative buckets (Prometheus
+    histogram exposition). Every registration records its kind so
+    ``collisions()`` can flag one name used as two different metric types.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._latencies: dict[str, list[float]] = {}
+        # name -> {"buckets": per-bound counts, "sum": float, "count": int}
+        self._hist: dict[str, dict] = {}
+        self._kinds: dict[str, str] = {}
+        self._collisions: set[tuple[str, str, str]] = set()
+
+    def _kind(self, name: str, kind: str) -> None:
+        prev = self._kinds.get(name)
+        if prev is None:
+            self._kinds[name] = kind
+        elif prev != kind:
+            self._collisions.add((name, prev, kind))
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
+            self._kind(name, "counter")
             self._counters[name] = self._counters.get(name, 0.0) + value
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
+            self._kind(name, "gauge")
             self._gauges[name] = float(value)
 
     # bounded reservoir per key: long-lived services must not grow (or sort)
@@ -59,18 +146,35 @@ class Metrics:
 
     def observe_ms(self, name: str, ms: float) -> None:
         with self._lock:
+            self._kind(name, "histogram")
             xs = self._latencies.setdefault(name, [])
             xs.append(ms)
             if len(xs) > self.MAX_SAMPLES:
                 del xs[: len(xs) // 2]  # amortized trim, keeps the recent half
+            h = self._hist.get(name)
+            if h is None:
+                h = self._hist[name] = {
+                    "buckets": [0] * len(HIST_BUCKETS_MS), "sum": 0.0, "count": 0,
+                }
+            for i, bound in enumerate(HIST_BUCKETS_MS):
+                if ms <= bound:
+                    h["buckets"][i] += 1
+                    break
+            h["sum"] += ms
+            h["count"] += 1
 
     def percentile_ms(self, name: str, q: float) -> float | None:
         with self._lock:
             xs = sorted(self._latencies.get(name, []))
         if not xs:
             return None
-        idx = min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))
-        return xs[idx]
+        return nearest_rank(xs, q)
+
+    def collisions(self) -> list[tuple[str, str, str]]:
+        """(name, first_kind, other_kind) for every name registered as two
+        different metric types — the runtime half of the collision lint."""
+        with self._lock:
+            return sorted(self._collisions)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -80,11 +184,81 @@ class Metrics:
                 s = sorted(xs)
                 out["latency_ms"][k] = {
                     "count": len(s),
-                    "p50": s[len(s) // 2],
-                    "p95": s[min(len(s) - 1, int(0.95 * (len(s) - 1) + 0.5))],
+                    "p50": nearest_rank(s, 0.50),
+                    "p95": nearest_rank(s, 0.95),
+                    "p99": nearest_rank(s, 0.99),
                     "max": s[-1],
                 }
         return out
+
+    def _prom_state(self) -> tuple[dict, dict, dict]:
+        """Consistent copies for exposition (one lock hold, no render
+        inside the lock)."""
+        with self._lock:
+            hist = {k: {"buckets": list(v["buckets"]), "sum": v["sum"],
+                        "count": v["count"]}
+                    for k, v in self._hist.items()}
+            return dict(self._counters), dict(self._gauges), hist
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(name: str) -> str:
+    """Dotted internal names -> valid Prometheus metric names."""
+    n = _PROM_BAD.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _fmt(v: float) -> str:
+    return repr(round(v, 6)) if isinstance(v, float) and v != int(v) else str(int(v))
+
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def prometheus_exposition(*metrics: "Metrics") -> str:
+    """Render one or more Metrics registries as Prometheus text exposition
+    (version 0.0.4). Counters get the conventional ``_total`` suffix,
+    latency keys become ``<name>_ms`` histograms with the fixed log-spaced
+    bucket bounds. On a name collision across registries the FIRST registry
+    wins (the service passes its tracer-local registry before the
+    process-global runtime one)."""
+    counters: dict[str, float] = {}
+    gauges: dict[str, float] = {}
+    hists: dict[str, dict] = {}
+    for m in metrics:
+        c, g, h = m._prom_state()
+        for k, v in c.items():
+            counters.setdefault(k, v)
+        for k, v in g.items():
+            gauges.setdefault(k, v)
+        for k, v in h.items():
+            hists.setdefault(k, v)
+
+    lines: list[str] = []
+    for k in sorted(counters):
+        n = prom_name(k) + "_total"
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt(counters[k])}")
+    for k in sorted(gauges):
+        n = prom_name(k)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(gauges[k])}")
+    for k in sorted(hists):
+        n = prom_name(k) + "_ms"
+        h = hists[k]
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for bound, cnt in zip(HIST_BUCKETS_MS, h["buckets"]):
+            cum += cnt
+            lines.append(f'{n}_bucket{{le="{_fmt(float(bound))}"}} {cum}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{n}_sum {_fmt(h['sum'])}")
+        lines.append(f"{n}_count {h['count']}")
+    return "\n".join(lines) + "\n"
 
 
 # Process-global registry: the serving runtime (engine/scheduler/interpreter)
@@ -97,54 +271,169 @@ def get_metrics() -> Metrics:
     return _GLOBAL_METRICS
 
 
-def make_metrics_handler(service: str, tracer: "Tracer"):
-    """aiohttp GET /metrics handler shared by every service: the tracer's
-    service-local snapshot next to the process-global runtime registry."""
+def make_metrics_handler(service: str, tracer: "Tracer", slo=None):
+    """aiohttp GET /metrics handler shared by every service. Content
+    negotiation: JSON (service-local snapshot next to the process-global
+    runtime registry, plus the SLO evaluation when a tracker is wired) by
+    default; Prometheus text exposition when the client asks for
+    ``text/plain`` or ``openmetrics`` — SLO gauges ride the global registry
+    (``utils.slo`` exports them there on every evaluation)."""
     from aiohttp import web
 
-    async def metrics_ep(_req) -> web.Response:
-        return web.json_response({
+    async def metrics_ep(req) -> web.Response:
+        if slo is not None:
+            slo_eval = slo.evaluate()  # also refreshes the slo.* gauges
+        accept = req.headers.get("Accept", "")
+        if "text/plain" in accept or "openmetrics" in accept:
+            return web.Response(
+                text=prometheus_exposition(tracer.metrics, get_metrics()),
+                headers={"Content-Type": PROM_CONTENT_TYPE},
+            )
+        body = {
             "service": service,
             "local": tracer.metrics.snapshot(),
             "runtime": get_metrics().snapshot(),
-        })
+        }
+        if slo is not None:
+            body["slo"] = slo_eval
+        return web.json_response(body)
 
     return metrics_ep
 
 
-class Tracer:
-    """Emits spans as one-line JSON to stderr and records into Metrics."""
+def make_trace_handler(service: str, tracer: "Tracer"):
+    """aiohttp ``GET /debug/trace/{trace_id}``: this service's completed
+    spans for one trace id, straight from the tracer's bounded ring. The
+    cross-service merge lives in ``tools/traceview.py``."""
+    from aiohttp import web
 
-    def __init__(self, service: str, metrics: Metrics | None = None, emit: bool = True):
+    async def trace_ep(req) -> web.Response:
+        trace_id = req.match_info["trace_id"]
+        return web.json_response({
+            "service": service,
+            "trace_id": trace_id,
+            "spans": tracer.spans_for(trace_id),
+        })
+
+    return trace_ep
+
+
+# Stage notes: a thread-local side channel for per-request decode stats.
+# The serving backends (EngineParser/BatchedEngineParser) know prefill/decode
+# split timings but not the request's trace id; the service handler knows the
+# trace id but not the split. The backend deposits notes on ITS thread during
+# parse; the handler (which ran the parse on that same worker thread) pops
+# them and attaches them to the request span — no API change on the parser
+# Protocol, no cross-thread races.
+_stage_notes = threading.local()
+
+
+def note_stage(key: str, value: float) -> None:
+    d = getattr(_stage_notes, "d", None)
+    if d is None:
+        d = _stage_notes.d = {}
+    d[key] = value
+
+
+def pop_stage_notes() -> dict:
+    d = getattr(_stage_notes, "d", None)
+    _stage_notes.d = {}
+    return d or {}
+
+
+class Tracer:
+    """Records spans into Metrics, a bounded per-trace ring, optionally a
+    JSONL sink (``TRACE_SINK=path``), and (``emit=True``) one-line JSON on
+    stderr."""
+
+    MAX_TRACES = 256  # distinct trace ids kept in the ring
+    MAX_SPANS_PER_TRACE = 512
+
+    def __init__(self, service: str, metrics: Metrics | None = None, emit: bool = True,
+                 sink_path: str | None = None):
         self.service = service
         self.metrics = metrics or Metrics()
         self.emit = emit
         self.spans: list[Span] = []
         self._lock = threading.Lock()
+        # LRU ring of completed spans keyed by trace id, for /debug/trace
+        self._ring: "OrderedDict[str, list[dict]]" = OrderedDict()
+        self._sink_path = sink_path if sink_path is not None \
+            else os.environ.get("TRACE_SINK") or None
+        # the sink handle is opened once and kept (an open+close per span
+        # would put a filesystem round trip on the hot path — several spans
+        # complete per utterance, some on the WS event loop thread)
+        self._sink_file = None
+        self._sink_lock = threading.Lock()
 
     @contextmanager
     def span(self, name: str, trace_id: str | None = None, **attrs):
-        sp = Span(name=name, trace_id=trace_id or new_trace_id(), start_s=time.perf_counter(), attrs=attrs)
+        _check_span_name(name)
+        sp = Span(name=name, trace_id=trace_id or new_trace_id(),
+                  start_s=time.perf_counter(), wall_start_s=time.time(),
+                  attrs=attrs)
         try:
             yield sp
         finally:
             sp.end_s = time.perf_counter()
-            with self._lock:
-                self.spans.append(sp)
-                if len(self.spans) > 10_000:
-                    del self.spans[:5_000]
-            self.metrics.observe_ms(f"{self.service}.{name}", sp.duration_ms)
-            if self.emit:
-                print(
-                    json.dumps(
-                        {
-                            "svc": self.service,
-                            "span": name,
-                            "trace": sp.trace_id,
-                            "ms": round(sp.duration_ms, 3),
-                            **{k: v for k, v in sp.attrs.items() if isinstance(v, (str, int, float, bool))},
-                        }
-                    ),
-                    file=sys.stderr,
-                    flush=True,
-                )
+            self._finish(sp)
+
+    def record_span(self, name: str, trace_id: str, start_s: float, end_s: float,
+                    **attrs) -> Span:
+        """Retroactively record a span from already-measured perf_counter
+        bounds (for stages whose trace id is only known after the fact,
+        e.g. the STT feed call that turned out to produce the final)."""
+        _check_span_name(name)
+        sp = Span(name=name, trace_id=trace_id, start_s=start_s, end_s=end_s,
+                  wall_start_s=time.time() - max(0.0, time.perf_counter() - start_s),
+                  attrs=attrs)
+        self._finish(sp)
+        return sp
+
+    def spans_for(self, trace_id: str) -> list[dict]:
+        with self._lock:
+            return list(self._ring.get(trace_id, ()))
+
+    def _finish(self, sp: Span) -> None:
+        d = {
+            "svc": self.service,
+            "span": sp.name,
+            "trace": sp.trace_id,
+            "ms": round(sp.duration_ms, 3),
+            "wall_start_s": round(sp.wall_start_s, 6),
+            "wall_end_s": round(sp.wall_start_s + sp.duration_ms / 1e3, 6),
+            **{k: v for k, v in sp.attrs.items()
+               if isinstance(v, (str, int, float, bool))},
+        }
+        with self._lock:
+            self.spans.append(sp)
+            if len(self.spans) > 10_000:
+                del self.spans[:5_000]
+            ring = self._ring.setdefault(sp.trace_id, [])
+            if len(ring) < self.MAX_SPANS_PER_TRACE:
+                ring.append(d)
+            self._ring.move_to_end(sp.trace_id)
+            while len(self._ring) > self.MAX_TRACES:
+                self._ring.popitem(last=False)
+        self.metrics.observe_ms(f"{self.service}.{sp.name}", sp.duration_ms)
+        if self._sink_path:
+            try:
+                with self._sink_lock:
+                    if self._sink_file is None:
+                        self._sink_file = open(self._sink_path, "a")
+                    self._sink_file.write(json.dumps(d) + "\n")
+                    self._sink_file.flush()
+            except OSError:
+                # a full disk or revoked path must never take the request
+                # path down with it; drop the sink write (retry with a
+                # fresh handle next span), keep serving
+                self.metrics.inc("tracing.sink_write_errors")
+                with self._sink_lock:
+                    if self._sink_file is not None:
+                        try:
+                            self._sink_file.close()
+                        except OSError:
+                            pass
+                        self._sink_file = None
+        if self.emit:
+            print(json.dumps(d), file=sys.stderr, flush=True)

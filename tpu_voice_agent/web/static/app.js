@@ -29,6 +29,9 @@ const $ = (id) => document.getElementById(id);
 const statusEl = $("status"), levelEl = $("level");
 const transcriptEl = $("transcript"), intentEl = $("intent"), resultsEl = $("results");
 const confirmBar = $("confirm-bar");
+const hudEl = $("hud"), hudTotal = $("hud-total"), hudBar = $("hud-bar"),
+  hudSplit = $("hud-split");
+const SLO_BUDGET_MS = 800;  // BASELINE voice->intent p50 target
 
 let ws = null, audio = null, pendingRisky = null, lastSend = 0;
 
@@ -56,6 +59,34 @@ function showPartial(text) {
 function showFinal(text) {
   if (partialLi) { partialLi.remove(); partialLi = null; }
   addLine("final", text);
+}
+
+/* ------------------------------------------------------------ latency HUD */
+
+function showLatencyBudget(m) {
+  // stage-split bar: STT-finalize / parse / execute share one 140 px strip
+  // proportionally; total colors red past the 800 ms budget
+  const st = m.stages || {};
+  const segs = [
+    ["stt", st.stt_finalize_ms || 0],
+    ["parse", st.parse_ms || 0],
+    ["exec", st.execute_ms || 0],
+  ].filter(([, ms]) => ms > 0);
+  const total = st.total_ms != null ? st.total_ms
+    : segs.reduce((a, [, ms]) => a + ms, 0);
+  hudBar.innerHTML = "";
+  for (const [cls, ms] of segs) {
+    const seg = document.createElement("span");
+    seg.className = `seg ${cls}`;
+    seg.style.width = `${(100 * ms / Math.max(1, total)).toFixed(1)}%`;
+    seg.title = `${cls} ${ms.toFixed(0)} ms`;
+    hudBar.appendChild(seg);
+  }
+  hudTotal.textContent = `${total.toFixed(0)} ms`;
+  hudTotal.className = `hud-total${total > SLO_BUDGET_MS ? " over" : ""}`;
+  hudSplit.textContent = segs.map(([cls, ms]) => `${cls} ${ms.toFixed(0)}`).join(" · ")
+    + (st.error ? " · error" : "") + (st.degraded ? " · degraded" : "");
+  hudEl.hidden = false;
 }
 
 /* ------------------------------------------------------------ results */
@@ -131,6 +162,7 @@ function connect() {
         addLine("warn", `${m.intents.length} action(s) need confirmation`);
         break;
       case "execution_result": showResults(m.data); break;
+      case "latency_budget": showLatencyBudget(m); break;
       case "execution_error": addLine("error", `execution: ${m.message}`); break;
       case "info": addLine("partial", m.message); break;
       case "warn": addLine("warn", m.message); break;
