@@ -1,0 +1,175 @@
+"""Speculative decoding bench (serve.spec): accept rate + tokens/step +
+decode latency vs the plain constrained greedy baseline.
+
+The workload is the intent-grammar serving shape: the rendered few-shot
+prompt (services.prompts.render_prompt — the same head the brain serves)
+over the golden utterances, decoded greedily under the grammar. Per
+drafter it measures:
+
+- ``spec_tokens_per_step_<d>``   — emitted tokens per target forward (the
+  step-reduction the subsystem exists for; baseline is exactly 1.0)
+- ``spec_accept_rate_<d>``       — accepted / drafted
+- ``spec_decode_p50_ms_<d>`` / ``_p99`` — wall latency vs baseline
+
+Drafters: ``fsm`` (grammar lookahead), ``prompt`` (n-gram lookup),
+``fsm,prompt`` (chain), and ``self`` — the draft model running the TARGET's
+own weights. Self-draft is the mechanism-validation row (its accept rate is
+~1.0 by construction, so tokens/step ≈ K+1); a deployment draws real
+speedup from a small distilled draft (SPEC_DRAFT_MODEL) where draft
+forwards are much cheaper than target forwards, which the in-tree tiny
+models cannot show honestly — the tokens/step column, not wall time, is
+the portable number.
+
+Writes ``bench_artifacts/BENCH_spec_<ts>.json`` with every row plus the
+``spec`` section (benches/common.snapshot_spec, merged into the combined
+run_all artifact like the SLO verdict).
+
+Knobs: BENCH_SPEC_K (default 4), BENCH_SPEC_UTTERANCES (default 6; --quick
+sets 3 via env), BENCH_SPEC_TOKENS (default 160).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log, percentile, snapshot_spec  # noqa: E402
+
+
+def _engine(spec=None, raw=None):
+    import jax
+
+    from tpu_voice_agent.serve import DecodeEngine
+
+    eng = DecodeEngine(preset="test-tiny", max_len=2048, batch_slots=1,
+                       prefill_buckets=(512, 1024, 2048),
+                       init_weights=raw is None, spec=spec)
+    if raw is not None:
+        eng.load_params(jax.device_put(raw))
+    return eng
+
+
+def main() -> None:
+    import jax
+
+    from tpu_voice_agent.evals.golden import GOLDEN_INTENT_CASES
+    from tpu_voice_agent.serve import DraftModelDrafter, SpecConfig, SpecDecoder
+    from tpu_voice_agent.services.prompts import render_prompt
+
+    k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    n_utt = int(os.environ.get("BENCH_SPEC_UTTERANCES", "6"))
+    max_tok = int(os.environ.get("BENCH_SPEC_TOKENS", "160"))
+
+    cases = GOLDEN_INTENT_CASES[:n_utt]
+    prompts = [render_prompt(c.text, c.context) for c in cases]
+    log(f"spec bench: {len(prompts)} rendered prompts, K={k}, "
+        f"max_new_tokens={max_tok}")
+
+    base = _engine()
+    raw = base.params
+
+    def run(eng, label):
+        # one warm generation per engine for compile, then the timed pass;
+        # spec counters are DELTA'd around the timed loop so the reported
+        # accept rate covers exactly the generations the latency/tokens
+        # rows cover (the warmup must not skew the artifact's verdict)
+        eng.generate(prompts[0], max_new_tokens=max_tok)
+        s0 = eng.spec.stats() if eng.spec is not None else None
+        lat, toks, fwds = [], 0, 0
+        t0 = time.perf_counter()
+        for p in prompts:
+            t1 = time.perf_counter()
+            r = eng.generate(p, max_new_tokens=max_tok)
+            lat.append((time.perf_counter() - t1) * 1e3)
+            toks += r.steps
+            fwds += r.forwards if r.forwards else r.steps
+        wall = time.perf_counter() - t0
+        log(f"{label}: {toks} tokens / {fwds} forwards in {wall:.1f}s")
+        stats = None
+        if s0 is not None:
+            s1 = eng.spec.stats()
+            drafted = s1["drafted"] - s0["drafted"]
+            accepted = s1["accepted"] - s0["accepted"]
+            steps = s1["verify_steps"] - s0["verify_steps"]
+            stats = {
+                "drafted": drafted,
+                "accepted": accepted,
+                "verify_steps": steps,
+                "accept_rate": accepted / drafted if drafted else 0.0,
+            }
+        return lat, toks, fwds, stats
+
+    rows: list[dict] = []
+
+    def row(metric, value, unit, vs=None):
+        emit(metric, value, unit, vs)
+        r = {"metric": metric, "value": round(value, 3), "unit": unit}
+        if vs is not None:
+            r["vs_baseline"] = round(vs, 3)
+        rows.append(r)
+
+    lat0, toks0, fwds0, _ = run(base, "baseline")
+    base_tps = toks0 / fwds0 if fwds0 else 1.0
+    row("spec_decode_p50_ms_baseline", percentile(lat0, 50), "ms")
+    row("spec_decode_p99_ms_baseline", percentile(lat0, 99), "ms")
+    row("spec_tokens_per_step_baseline", base_tps, "tokens/forward")
+
+    best_tps = 0.0
+    per_drafter: dict[str, dict] = {}
+    configs = [
+        ("fsm", SpecConfig(k=k, drafter="fsm"), None),
+        ("prompt", SpecConfig(k=k, drafter="prompt"), None),
+        ("fsm_prompt", SpecConfig(k=k, drafter="fsm,prompt"), None),
+        ("self", SpecConfig(k=k), "self"),
+    ]
+    for label, cfg, special in configs:
+        eng = _engine(spec=None if special else cfg, raw=raw)
+        if special == "self":
+            # mechanism validation: target drafts for itself — accept rate
+            # ~1.0 and tokens/step ~K+1 prove verify + rollback end to end
+            eng.spec = SpecDecoder(
+                eng, cfg, drafter=DraftModelDrafter(eng, cfg=eng.cfg,
+                                                    params=raw))
+        lat, toks, fwds, s = run(eng, f"spec:{label}")
+        tps = toks / fwds if fwds else 0.0
+        best_tps = max(best_tps, tps)
+        per_drafter[label] = {**s, "tokens_per_step": round(tps, 3)}
+        row(f"spec_tokens_per_step_{label}", tps, "tokens/forward",
+            tps / base_tps if base_tps else None)
+        row(f"spec_accept_rate_{label}", s["accept_rate"], "ratio")
+        row(f"spec_decode_p50_ms_{label}", percentile(lat, 50), "ms",
+            percentile(lat0, 50) / percentile(lat, 50))
+        row(f"spec_decode_p99_ms_{label}", percentile(lat, 99), "ms")
+
+    # headline: the best drafter's step reduction on this workload
+    row("spec_tokens_per_step", best_tps, "tokens/forward",
+        best_tps / base_tps if base_tps else None)
+
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art = art_dir / f"BENCH_spec_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_spec",
+        "ts": stamp,
+        "backend": jax.default_backend(),
+        "config": {"k": k, "utterances": len(prompts),
+                   "max_new_tokens": max_tok},
+        "rows": rows,
+        # per-drafter numbers are DELTA'd over each timed loop (the honest
+        # verdict); the process_cumulative snapshot blends every config +
+        # warmups and is kept only as the raw registry view
+        "spec": {"per_drafter": per_drafter,
+                 "tokens_per_step_best": round(best_tps, 3),
+                 "process_cumulative": snapshot_spec()},
+    }, indent=1))
+    log(f"artifact: {art}")
+
+
+if __name__ == "__main__":
+    main()

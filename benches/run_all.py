@@ -15,16 +15,21 @@ from __future__ import annotations
 
 import datetime
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
 
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
-           "bench_quality.py", "bench_faults.py"]
+           "bench_quality.py", "bench_faults.py", "bench_spec.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
-# the fault drill stays — it is service-level, no model, seconds on CPU)
-QUICK_BENCHES = ["bench_quality.py", "bench_faults.py"]
+# the fault drill stays — it is service-level, no model, seconds on CPU;
+# the spec bench stays at a reduced utterance/token budget — tiny model,
+# and the accept-rate verdict belongs in every quick artifact)
+QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py"]
+# env trims applied on --quick only when the operator has not pinned them
+QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -55,12 +60,17 @@ def main() -> None:
     failures = 0
     summary: dict = {"quick": quick, "benches": {}}
     pre_existing = set(art_dir.glob("BENCH_*.json"))
+    env = None
+    if quick:
+        env = dict(os.environ)
+        for k, v in QUICK_ENV.items():
+            env.setdefault(k, v)
     for name in (QUICK_BENCHES if quick else BENCHES):
         print(f"[run_all] {name}", file=sys.stderr, flush=True)
         try:
             proc = subprocess.run(
                 [sys.executable, str(here / name)], cwd=root,
-                capture_output=True, text=True, timeout=3600,
+                capture_output=True, text=True, timeout=3600, env=env,
             )
         except subprocess.TimeoutExpired as e:
             # count the timeout as this bench's failure and keep going —
@@ -91,7 +101,8 @@ def main() -> None:
                 continue
             if body.get("bench") == name.removesuffix(".py"):
                 entry["artifact"] = art.name
-                for key in ("slo", "stage_latency_ms", "runtime_gauges"):
+                for key in ("slo", "stage_latency_ms", "runtime_gauges",
+                            "spec"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

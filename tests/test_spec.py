@@ -1,0 +1,283 @@
+"""Speculative decoding (serve.spec): draft K, verify in one pass.
+
+The load-bearing property is DIFFERENTIAL: greedy speculative output must be
+token-identical to the plain constrained greedy path for EVERY drafter —
+accepted tokens are by construction the target's own masked greedy choices,
+so draft quality may only change the forward count, never the stream. The
+rollback/invalid-draft tests push adversarial proposals through the same
+assert.
+
+Runs CPU-only on the tiny preset (fast tier: shared f32 weights, small
+buckets, one verify-step compile shared across engines via the jit cache).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.models.llama import init_params
+from tpu_voice_agent.serve import DecodeEngine, GenerationResult, SpecConfig
+from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+from tpu_voice_agent.serve.spec import (
+    ChainDrafter,
+    Drafter,
+    DraftModelDrafter,
+    PromptLookupDrafter,
+    SpecDecoder,
+    spec_from_env,
+)
+
+PROMPTS = ["search for usb hubs", "scroll down"]
+MAXTOK = 64
+
+
+def _mk_engine(raw, spec=None, batch_slots=1):
+    eng = DecodeEngine(preset="test-tiny", max_len=512, prefill_buckets=(64,),
+                       batch_slots=batch_slots, init_weights=False, spec=spec)
+    eng.load_params(raw)
+    return eng
+
+
+@pytest.fixture(scope="module")
+def raw_params():
+    eng = DecodeEngine(preset="test-tiny", max_len=512, prefill_buckets=(64,),
+                       init_weights=False)
+    return init_params(eng.cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def baseline(raw_params):
+    eng = _mk_engine(raw_params)
+    return [eng.generate(p, max_new_tokens=MAXTOK) for p in PROMPTS]
+
+
+# ---------------------------------------------------------------- identity
+
+
+@pytest.mark.parametrize("drafter", ["fsm", "prompt", "fsm,prompt", "model"])
+def test_spec_greedy_token_identical(raw_params, baseline, drafter):
+    eng = _mk_engine(raw_params, spec=SpecConfig(k=4, drafter=drafter))
+    for p, ref in zip(PROMPTS, baseline):
+        res = eng.generate(p, max_new_tokens=MAXTOK)
+        assert res.token_ids == ref.token_ids, (drafter, res.text[:80])
+        assert res.finished == ref.finished
+        # accounting: steps counts ACCEPTED tokens, forwards verify steps
+        assert res.steps == len(res.token_ids)
+        assert 0 < res.forwards <= res.steps
+    assert eng.spec.stats()["verify_steps"] > 0
+
+
+def test_self_draft_accepts_everything(raw_params, baseline):
+    """Draft model == target model: every draft is the target's own greedy
+    choice, so the verify pass must accept all K per step — the strongest
+    end-to-end check of the accept logic and KV/pos rollback bookkeeping."""
+    eng = _mk_engine(raw_params)
+    eng.spec = SpecDecoder(
+        eng, SpecConfig(k=4),
+        drafter=DraftModelDrafter(eng, cfg=eng.cfg, params=raw_params))
+    res = eng.generate(PROMPTS[0], max_new_tokens=MAXTOK)
+    assert res.token_ids == baseline[0].token_ids
+    s = eng.spec.stats()
+    assert s["accept_rate"] == pytest.approx(1.0)
+    assert s["tokens_per_step"] > 2.0
+    assert res.forwards < res.steps / 2
+
+
+def test_batched_spec_matches_singles(raw_params, baseline):
+    eng = _mk_engine(raw_params, spec=SpecConfig(k=4, drafter="fsm,prompt"),
+                     batch_slots=2)
+    results = ContinuousBatcher(eng, chunk_steps=8,
+                                max_new_tokens=MAXTOK).generate_many(PROMPTS)
+    for ref, res in zip(baseline, results):
+        assert res.error is None
+        assert res.token_ids == ref.token_ids
+        assert eng.fsm.walk(res.token_ids) >= 0
+
+
+def test_spec_byte_budget_parity(raw_params):
+    """Truncation boundaries (the subtle part of multi-token accounting)
+    must land on the same token under speculation."""
+    a = _mk_engine(raw_params)
+    b = _mk_engine(raw_params, spec=SpecConfig(k=4, drafter="fsm,prompt"))
+    for budget in (16, 40):
+        ra = a.generate(PROMPTS[0], max_new_tokens=MAXTOK, byte_budget=budget)
+        rb = b.generate(PROMPTS[0], max_new_tokens=MAXTOK, byte_budget=budget)
+        assert ra.token_ids == rb.token_ids
+        assert ra.finished == rb.finished
+
+
+# ---------------------------------------------------------------- rollback
+
+
+class _WrongLegalDrafter(Drafter):
+    """Adversarial: proposes grammar-LEGAL tokens chosen to disagree with
+    the model (highest legal id) — every step exercises rejection rollback."""
+
+    name = "wrong"
+
+    def __init__(self, fsm):
+        self.fsm = fsm
+
+    def draft_one(self, ctx, state, k):
+        out, s = [], state
+        for _ in range(k):
+            if s < 0:
+                break
+            allowed = np.nonzero(self.fsm.allowed(s))[0]
+            if len(allowed) == 0:
+                break
+            t = int(allowed[-1])
+            out.append(t)
+            s = self.fsm.step(s, t)
+        return out
+
+
+class _DeadDrafter(Drafter):
+    """Adversarial: proposes tokens that are grammar-dead from EVERY state
+    (column class 0) — the FSM-invalid-draft case; nothing may be accepted
+    and the stream must not move off the plain path."""
+
+    name = "dead"
+
+    def __init__(self, fsm, k):
+        dead = np.nonzero(fsm.col_id == 0)[0]
+        assert len(dead) > 0, "toy vocab always has dead-everywhere ids"
+        self.toks = [int(dead[0])] * k
+
+    def draft_one(self, ctx, state, k):
+        return self.toks[:k]
+
+
+def test_rejection_rollback_keeps_stream(raw_params, baseline):
+    eng = _mk_engine(raw_params)
+    eng.spec = SpecDecoder(eng, SpecConfig(k=4),
+                           drafter=_WrongLegalDrafter(eng.fsm))
+    res = eng.generate(PROMPTS[0], max_new_tokens=MAXTOK)
+    assert res.token_ids == baseline[0].token_ids
+    s = eng.spec.stats()
+    assert s["drafted"] > 0
+    assert s["accepted"] < s["drafted"]  # rollback actually exercised
+
+
+def test_fsm_invalid_drafts_never_accepted(raw_params, baseline):
+    eng = _mk_engine(raw_params)
+    eng.spec = SpecDecoder(eng, SpecConfig(k=4),
+                           drafter=_DeadDrafter(eng.fsm, 4))
+    res = eng.generate(PROMPTS[0], max_new_tokens=MAXTOK)
+    assert res.token_ids == baseline[0].token_ids
+    s = eng.spec.stats()
+    assert s["drafted"] > 0
+    assert s["accepted"] == 0
+
+
+# ---------------------------------------------------------------- drafters
+
+
+def test_lookahead_chains_walk_the_fsm(raw_params):
+    eng = _mk_engine(raw_params)
+    fsm = eng.fsm
+    ff_tokens, ff_len = fsm.forced_tables(width=8)
+    hits = 0
+    for s in np.nonzero(ff_len > 0)[0][:40]:
+        chain = fsm.lookahead(int(s), 8)
+        assert chain == [int(t) for t in ff_tokens[s, : int(ff_len[s])]]
+        st = int(s)
+        for t in chain:
+            st = fsm.step(st, t)
+            assert st >= 0, "lookahead proposal left the grammar"
+        hits += 1
+    assert hits > 0
+    # free-choice / dead states draft nothing
+    assert fsm.lookahead(-1, 8) == []
+    free = np.nonzero(ff_len == 0)[0]
+    assert fsm.lookahead(int(free[0]), 8) == []
+
+
+def test_prompt_lookup_drafts_continuation():
+    d = PromptLookupDrafter(max_ngram=3)
+    ctx = [5, 1, 2, 3, 9, 8, 1, 2, 3]
+    assert d.draft_one(ctx, 0, 2) == [9, 8]  # trigram [1,2,3] recurs
+    assert d.draft_one([1, 2, 3], 0, 2) == []  # no earlier occurrence
+    # rightmost (most recent) occurrence wins
+    ctx2 = [7, 4, 1, 7, 6, 1, 7, 5, 1, 7]
+    assert d.draft_one(ctx2, 0, 1) == [5]
+
+
+def test_chain_drafter_first_hit_wins(raw_params):
+    eng = _mk_engine(raw_params)
+
+    class A(Drafter):
+        def draft_one(self, ctx, state, k):
+            return []
+
+    class B(Drafter):
+        def draft_one(self, ctx, state, k):
+            return [1, 2]
+
+    c = ChainDrafter([A(), B()])
+    toks, lens = c.draft_batch([[0, 1]], np.zeros(1, np.int32),
+                               np.ones(1, bool), 4)
+    assert lens[0] == 2 and list(toks[0, :2]) == [1, 2]
+
+
+# ---------------------------------------------------------------- gating
+
+
+def test_disabled_path_has_no_decoder(raw_params):
+    eng = _mk_engine(raw_params)
+    assert eng.spec is None  # decode_chunk/generate never branch
+
+
+def test_spec_from_env(monkeypatch):
+    monkeypatch.delenv("SPEC_ENABLE", raising=False)
+    assert spec_from_env() is None
+    monkeypatch.setenv("SPEC_ENABLE", "1")
+    monkeypatch.setenv("SPEC_K", "6")
+    monkeypatch.setenv("SPEC_DRAFTER", "fsm")
+    cfg = spec_from_env()
+    assert cfg is not None and cfg.k == 6 and cfg.drafter == "fsm"
+
+
+def test_spec_refused_on_non_dense_layout(raw_params):
+    from tpu_voice_agent.serve import PagedDecodeEngine
+
+    with pytest.raises(ValueError, match="dense"):
+        PagedDecodeEngine(preset="test-tiny", max_len=512,
+                          prefill_buckets=(64,), init_weights=False,
+                          spec=SpecConfig(k=4))
+
+
+def test_unknown_drafter_rejected(raw_params):
+    with pytest.raises(ValueError, match="SPEC_DRAFTER"):
+        _mk_engine(raw_params, spec=SpecConfig(k=4, drafter="nope"))
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_generation_result_zero_duration_guard():
+    r = GenerationResult(text="", token_ids=[1], prefill_ms=0.0,
+                         decode_ms=0.0, steps=1, finished=True)
+    assert r.tokens_per_s == 0.0
+    r2 = GenerationResult(text="", token_ids=[1], prefill_ms=0.0,
+                          decode_ms=-1.0, steps=1, finished=True)
+    assert r2.tokens_per_s == 0.0
+
+
+def test_spec_metrics_exported(raw_params):
+    from tpu_voice_agent.utils import get_metrics, prometheus_exposition
+
+    eng = _mk_engine(raw_params, spec=SpecConfig(k=4, drafter="fsm,prompt"))
+    eng.generate(PROMPTS[0], max_new_tokens=MAXTOK)
+    snap = get_metrics().snapshot()
+    for name in ("spec.drafted_tokens", "spec.accepted_tokens",
+                 "spec.verify_steps"):
+        assert snap["counters"].get(name, 0) > 0, name
+    for name in ("spec.accept_rate", "spec.tokens_per_step"):
+        assert name in snap["gauges"], name
+    assert snap["gauges"]["spec.tokens_per_step"] >= 1.0
+    text = prometheus_exposition(get_metrics())
+    assert "spec_accept_rate" in text
+    assert "spec_drafted_tokens_total" in text
+    assert get_metrics().collisions() == []

@@ -148,6 +148,9 @@ class TokenFSM:
         # kept for forced_tables(): byte-expanded transitions + piece trie
         self._trans_b = trans_b
         self._trie = trie
+        # lookahead(): canonical forced chain per state, computed on demand
+        self._lookahead_cache: dict[int, list[int]] = {}
+        self._forced_arr: tuple[np.ndarray, np.ndarray] | None = None
 
     # ------------------------------------------------------------ dense views
 
@@ -179,50 +182,88 @@ class TokenFSM:
 
     # ------------------------------------------------------------ fast-forward
 
+    def _forced_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(forced (S,) bool, fbyte (S,) int): a state is "forced" when the
+        byte DFA admits exactly one byte and is not accepting (accepting
+        adds the EOS choice); fbyte is that byte. Computed once."""
+        if self._forced_arr is None:
+            legal = self._trans_b >= 0  # (S, 256)
+            forced = (legal.sum(axis=1) == 1) & ~self.accepting
+            self._forced_arr = (forced, np.argmax(legal, axis=1))
+        return self._forced_arr
+
+    def _forced_run(self, state: int) -> list[int]:
+        """The unique forced byte path from ``state`` ([] when the state is
+        a free choice point / dead / accepting). Any grammar-legal
+        continuation must emit these bytes."""
+        forced, fbyte = self._forced_arrays()
+        run, st = [], state
+        while forced[st] and len(run) < 4096:
+            b = int(fbyte[st])
+            run.append(b)
+            st = int(self._trans_b[st, b])
+        return run
+
+    def _tile_run(self, run: list[int], width: int) -> list[int]:
+        """Greedy-longest canonical tokenization of a byte run over the
+        vocab trie (first id of a piece = canonical). THE one copy of the
+        canonical-tiling convention — forced_tables and lookahead must
+        stay bit-identical or draft acceptance quietly degrades."""
+        toks, i = [], 0
+        while i < len(run) and len(toks) < width:
+            node, best, j = self._trie, None, i
+            while j < len(run) and run[j] in node:
+                node = node[run[j]]
+                j += 1
+                if -1 in node:
+                    best = (j, node[-1][0])
+            if best is None:
+                break  # no piece tiles here; stop fast-forwarding
+            i = best[0]
+            toks.append(best[1])
+        return toks
+
     def forced_tables(self, width: int) -> tuple[np.ndarray, np.ndarray]:
         """(ff_tokens (S, width) int32, ff_len (S,) int32): per state, the
-        canonical tokenization of its forced byte run.
-
-        A state is "forced" when the byte DFA admits exactly one byte and
-        is not accepting (accepting adds the EOS choice). The run's bytes
-        are unique — any grammar-legal continuation must emit them — so the
-        decode loop may append them without consulting the model. The run
-        is tokenized greedily (longest piece first) over the vocab trie;
-        runs longer than ``width`` tokens continue next step because the
-        state after a truncated chain is itself forced. Chains never
-        contain EOS (runs stop before accepting states).
+        canonical tokenization (``_tile_run``) of its forced byte run
+        (``_forced_run``). Runs longer than ``width`` tokens continue next
+        step because the state after a truncated chain is itself forced.
+        Chains never contain EOS (runs stop before accepting states).
         """
         S = self.num_states
-        trans_b, trie = self._trans_b, self._trie
-        legal = trans_b >= 0  # (S, 256)
-        forced = (legal.sum(axis=1) == 1) & ~self.accepting
-        fbyte = np.argmax(legal, axis=1)
-
+        forced, _ = self._forced_arrays()
         ff_tokens = np.full((S, width), -1, dtype=np.int32)
         ff_len = np.zeros((S,), dtype=np.int32)
         for s in range(S):
             if not forced[s]:
                 continue
-            run, st = [], s
-            while forced[st] and len(run) < 4096:
-                b = int(fbyte[st])
-                run.append(b)
-                st = int(trans_b[st, b])
-            toks, i = [], 0
-            while i < len(run) and len(toks) < width:
-                node, best, j = trie, None, i
-                while j < len(run) and run[j] in node:
-                    node = node[run[j]]
-                    j += 1
-                    if -1 in node:
-                        best = (j, node[-1][0])  # first id = canonical
-                if best is None:
-                    break  # no piece tiles here; stop fast-forwarding
-                i = best[0]
-                toks.append(best[1])
+            toks = self._tile_run(self._forced_run(s), width)
             ff_tokens[s, : len(toks)] = toks
             ff_len[s] = len(toks)
         return ff_tokens, ff_len
+
+    def lookahead(self, state: int, width: int) -> list[int]:
+        """Draft tokens along the forced byte path from ``state`` (the
+        speculative-decoding host API; serve.spec FSMDrafter).
+
+        Unlike ``forced_tables`` — whose chains are *forced* onto the
+        stream without sampling — lookahead tokens are only PROPOSALS: the
+        verify pass checks them against the target model's greedy choice,
+        so the canonical (greedy-longest) tokenization here is a guess the
+        model is free to reject in favor of a different tiling of the same
+        bytes. Returns up to ``width`` token ids; [] when ``state`` is not
+        byte-forced (a free choice point) or is dead/accepting. Chains are
+        cached per state (full length) and sliced per call."""
+        if state < 0 or state >= self.num_states or width <= 0:
+            return []
+        chain = self._lookahead_cache.get(state)
+        if chain is None:
+            # tile the WHOLE forced run (bounded by the 4096-byte run cap),
+            # so the cache serves any draft width without silent truncation
+            run = self._forced_run(state)
+            chain = self._tile_run(run, len(run))
+            self._lookahead_cache[state] = chain
+        return chain[:width]
 
     # ------------------------------------------------------------ device tables
 

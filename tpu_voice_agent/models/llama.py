@@ -64,6 +64,10 @@ MAX_BLOCK_DECODE_T = 16
 
 PRESETS: dict[str, LlamaConfig] = {
     "test-tiny": LlamaConfig(dim=128, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=256, max_seq_len=256),
+    # speculative-decoding draft model (serve.spec): a fraction of even the
+    # test-tiny step cost, so K draft forwards stay cheap next to one
+    # target verify forward
+    "draft-tiny": LlamaConfig(dim=64, n_layers=1, n_heads=2, n_kv_heads=1, ffn_dim=128, max_seq_len=256),
     "tinyllama-1.1b": LlamaConfig(dim=2048, n_layers=22, n_heads=32, n_kv_heads=4, ffn_dim=5632),
     "llama3-8b": LlamaConfig(
         dim=4096, n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336, rope_theta=500_000.0, max_seq_len=8192

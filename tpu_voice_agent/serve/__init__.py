@@ -5,12 +5,24 @@ from .paged import BlockAllocator, PagedDecodeEngine
 from .planner import LongSessionPlanner, PlannerSession
 from .pp_engine import PPDecodeEngine
 from .scheduler import ContinuousBatcher
+from .spec import (
+    ChainDrafter,
+    DraftModelDrafter,
+    FSMDrafter,
+    PromptLookupDrafter,
+    SpecConfig,
+    SpecDecoder,
+    spec_from_env,
+)
 
 __all__ = [
     "BlockAllocator",
+    "ChainDrafter",
     "ColocatedServing",
     "ContinuousBatcher",
     "DecodeEngine",
+    "DraftModelDrafter",
+    "FSMDrafter",
     "GenerationResult",
     "GroundingEngine",
     "GroundingResult",
@@ -18,4 +30,8 @@ __all__ = [
     "PagedDecodeEngine",
     "PPDecodeEngine",
     "PlannerSession",
+    "PromptLookupDrafter",
+    "SpecConfig",
+    "SpecDecoder",
+    "spec_from_env",
 ]

@@ -41,14 +41,20 @@ class GenerationResult:
     token_ids: list[int]
     prefill_ms: float
     decode_ms: float
-    steps: int
+    steps: int  # EMITTED tokens — under multi-token stepping (grammar
+    # fast-forward, speculative decoding) this counts accepted output
+    # tokens, never verify/forward dispatches (those are `forwards`)
     finished: bool  # True only if EOS was reached (truncation => False)
     error: str | None = None  # per-request failure (e.g. prompt too long)
     forwards: int = 0  # decode forward dispatches (< steps under grammar
-    # fast-forward, where forced chains emit several tokens per forward)
+    # fast-forward / speculative decoding, where one forward emits several
+    # accepted tokens)
 
     @property
     def tokens_per_s(self) -> float:
+        # zero/negative-duration guard: a fully fast-forwarded or
+        # speculation-saturated generation can finish inside timer
+        # resolution — report 0 rather than raise/inf
         return self.steps / (self.decode_ms / 1e3) if self.decode_ms > 0 else 0.0
 
 
@@ -194,6 +200,38 @@ def prefill_row_with_prefix(
     }
 
 
+def chain_block(iw, cur, chain, k, active, pad_id, pos):
+    """Block tokens/positions for a (B, 1+W) chain step: ``[cur,
+    chain_0..k-1]`` with the tail duplicating the last valid (token,
+    position) — duplicate (token, position) scatter writes are idempotent
+    on the cache, so padding never scribbles junk over live KV. THE one
+    copy of this construction, shared by the grammar fast-forward loop and
+    the speculative verify step (serve.spec): returns (step_tok, blk_tok,
+    blk_pos)."""
+    ci = jnp.clip(iw - 1, 0, jnp.maximum(k[:, None] - 1, 0))
+    chain_tok = jnp.take_along_axis(chain, ci, axis=1)
+    step_tok = jnp.where(active, cur, pad_id)
+    blk_tok = jnp.where(iw == 0, step_tok[:, None],
+                        jnp.where(k[:, None] > 0, chain_tok, step_tok[:, None]))
+    write_pos = jnp.where(active, pos, 0)
+    blk_pos = write_pos[:, None] + jnp.minimum(iw, k[:, None])
+    return step_tok, blk_tok, blk_pos
+
+
+def chain_byte_cap(k, chain, cur_tok, nbytes, byte_len_table, byte_budget):
+    """Cap a chain length so its cumulative bytes still fit after
+    ``cur_tok``'s: the plain path overshoots the byte budget by at most
+    one token (stop is checked after the add), so chain/draft tokens may
+    only be taken while they still fit. The ff loop and the speculative
+    verify step MUST share this contract exactly — truncation boundaries
+    are part of the token-identity guarantee (tests/test_spec.py
+    byte-budget parity). Returns (capped k, per-token cumulative bytes)."""
+    chain_bytes = jnp.cumsum(
+        jnp.where(chain >= 0, byte_len_table[jnp.maximum(chain, 0)], 0), axis=1)
+    rem = (byte_budget - nbytes - byte_len_table[jnp.maximum(cur_tok, 0)])[:, None]
+    return jnp.minimum(k, jnp.sum(chain_bytes <= rem, axis=1)), chain_bytes
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained", "kernels",
@@ -311,30 +349,18 @@ def chunk_decode_loop(
         cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
         iw = jnp.arange(1 + W)[None, :]  # (1, 1+W) block index
         chain = tables.ff_tokens[state]  # (B, W); -1 pads
-        # chain length, capped so emission fits the token budget and the
-        # cache (writes land at pos .. pos+k <= max_len-1)
+        # chain length, capped so emission fits the token budget, the cache
+        # (writes land at pos .. pos+k <= max_len-1), and the byte budget
+        # (chain_byte_cap: the shared one-token-overshoot contract)
         k = jnp.minimum(jnp.minimum(tables.ff_len[state], left - 1),
                         max_len - 1 - pos)
-        # ...and the byte budget: the non-ff path overshoots by at most one
-        # token (stop is checked after the add), so the chain may only take
-        # tokens whose cumulative bytes still fit after cur's — otherwise a
-        # wide chain could blow past byte_budget by W tokens in one step
-        chain_bytes = jnp.cumsum(
-            jnp.where(chain >= 0, byte_len_table[jnp.maximum(chain, 0)], 0), axis=1)
-        rem = (byte_budget - nbytes - byte_len_table[cur])[:, None]
-        k = jnp.minimum(k, jnp.sum(chain_bytes <= rem, axis=1))
+        k, _ = chain_byte_cap(k, chain, cur, nbytes, byte_len_table,
+                              byte_budget)
         k = jnp.where(active, jnp.maximum(k, 0), 0)
 
-        # block tokens: [cur, chain_0..chain_{k-1}], tail duplicates the
-        # last valid token at the last valid position — duplicate (token,
-        # position) scatter writes are idempotent on the cache
-        ci = jnp.clip(iw - 1, 0, jnp.maximum(k[:, None] - 1, 0))
-        chain_tok = jnp.take_along_axis(chain, ci, axis=1)
-        step_tok = jnp.where(active, cur, pad_id)
-        blk_tok = jnp.where(iw == 0, step_tok[:, None],
-                            jnp.where(k[:, None] > 0, chain_tok, step_tok[:, None]))
-        write_pos = jnp.where(active, pos, 0)
-        blk_pos = write_pos[:, None] + jnp.minimum(iw, k[:, None])
+        # [cur, chain_0..chain_{k-1}] with idempotent duplicate-tail padding
+        step_tok, blk_tok, blk_pos = chain_block(iw, cur, chain, k, active,
+                                                 pad_id, pos)
 
         # emit cur + chain via the trash column
         valid = (iw <= k[:, None]) & active[:, None]
@@ -343,10 +369,11 @@ def chunk_decode_loop(
             jnp.where(valid, blk_tok, pad_id))
         emitted = jnp.where(active, 1 + k, 0)
         n = n + emitted
+        # taken chain bytes: inside chain_valid the block IS the chain
         chain_valid = (iw >= 1) & (iw <= k[:, None]) & active[:, None]
         nbytes = (nbytes + jnp.where(active, byte_len_table[cur], 0)
                   + jnp.sum(jnp.where(chain_valid,
-                                      byte_len_table[jnp.maximum(chain_tok, 0)], 0),
+                                      byte_len_table[jnp.maximum(blk_tok, 0)], 0),
                             axis=1))
         left = left - emitted
 
@@ -413,6 +440,10 @@ class DecodeEngine:
         # a (B, 1+W) forward whose attention runs the Pallas frontier-read
         # block kernel (ops.decode_block_attention) under kernels="pallas",
         # so the chain tokens ride the weight read nearly free at any B
+        spec=None,  # serve.spec.SpecConfig | None — speculative decoding
+        # (draft K + one-pass verify). None keeps the decode path
+        # byte-identical to pre-speculation; greedy constrained decode
+        # routes through SpecDecoder when set (spec supersedes ff there)
     ):
         if kernels == "auto":
             # on a mesh the kernels run per-shard under shard_map (batch
@@ -548,6 +579,14 @@ class DecodeEngine:
         # shared-prefix cache: token ids + their precomputed KV (L,1,P,nkv,hd)
         self.prefix_ids: list[int] = []
         self.prefix_kv: dict | None = None
+        # speculative decoding (serve.spec): built LAST — the decoder reads
+        # engine tables/cache geometry, and a draft-model drafter allocates
+        # its own KV against batch_slots/max_len
+        self.spec = None
+        if spec is not None and getattr(spec, "k", 0):
+            from .spec import SpecDecoder
+
+            self.spec = SpecDecoder(self, spec)
 
     # ------------------------------------------------------------ helpers
 
@@ -701,6 +740,11 @@ class DecodeEngine:
         ``_prefill_suffix`` / ``_prefill_full`` kernels) — the paths the
         equivalence tests hold token-identical."""
         self.release_slot(slot)  # a finished request may still own resources
+        if self.spec is not None:
+            # admission hook: the spec decoder keeps the host-side token
+            # context its drafters read (and the draft model prefills its
+            # own cache line for this slot)
+            self.spec.on_admit(slot, list(ids))
         n = len(ids)
         suffix = self._split_prefix(ids)
         if suffix is not None:
@@ -752,8 +796,16 @@ class DecodeEngine:
         configured the chunk takes (B, 1+W) grammar-chain steps — the
         round-3 single-request restriction is lifted by the frontier-read
         block-attention kernel (each row reads its own context, not the
-        cache capacity, even at batch width)."""
-        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, _ = chunk_decode_loop(
+        cache capacity, even at batch width). With speculation configured
+        (serve.spec) greedy chunks route through the SpecDecoder —
+        draft-K-verify-once steps, token-identical to this loop by
+        construction; non-greedy chunks keep the plain path (temperature
+        speculation would need rejection sampling)."""
+        if self.spec is not None and greedy:
+            return self.spec.decode_chunk(
+                cur, pos, fsm, active, nbytes, tokens_left, key,
+                temperature, byte_budget, chunk_steps)
+        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds = chunk_decode_loop(
             self.params, self.cfg, self.cache,
             cur, pos, fsm, active, nbytes, tokens_left,
             self.tables_ff if self.tables_ff is not None else self.tables,
@@ -764,11 +816,17 @@ class DecodeEngine:
             greedy=greedy, constrained=True, kernels=self.kernels,
             eos_id=self.eos_id, pad_id=self.pad_id, unroll=self.decode_unroll,
         )
+        # forward-dispatch count for the chunk (device scalar; the batcher
+        # folds it into its one combined readback): the denominator that
+        # keeps tokens-per-forward gauges truthful under multi-token steps
+        self._last_fwds = fwds
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
     def release_slot(self, slot: int) -> None:
         """A batch slot finished: dense cache rows are simply reused in
         place (the paged engine returns the slot's blocks to the pool)."""
+        if self.spec is not None:
+            self.spec.on_release(slot)
 
     def _prefill(self, prompt: str):
         if self.batch_slots != 1:
@@ -778,6 +836,25 @@ class DecodeEngine:
             )
         ids = self.tokenizer.encode(prompt, bos=True)
         return self.prefill_slot(ids, 0), len(ids)
+
+    def _admit_first_token(self, prompt: str, temperature: float,
+                           greedy: bool = True, constrained: bool = True):
+        """Single-request admission: prefill slot 0 + sample the first
+        token. THE one copy of the prologue shared by generate() and the
+        speculative path (prefill bucketing / first-token masking must
+        never diverge between them). Returns (tok0, fsm0, prompt_len,
+        prefill_ms) — prefill_ms is dispatch-side (no block), matching
+        generate()'s sync discipline."""
+        t0 = time.perf_counter()
+        last_logits, n = self._prefill(prompt)
+        fsm_state = jnp.full((1,), self.fsm.start, dtype=jnp.int32)
+        self._rng, k0 = jax.random.split(self._rng)
+        tok0, fsm0 = _first_token(
+            last_logits, fsm_state, self.tables, k0,
+            jnp.float32(temperature), greedy=greedy, constrained=constrained,
+            kernels=self.kernels, rules=self.rules, logit_mask=self.logit_mask,
+        )
+        return tok0, fsm0, n, (time.perf_counter() - t0) * 1e3
 
     def generate(
         self,
@@ -801,16 +878,13 @@ class DecodeEngine:
         # whole generate pays exactly ONE combined device_get at the end and
         # never blocks mid-flight. prefill_ms is therefore dispatch-side
         # (enqueue) time; the total latency is what's real.
-        t0 = time.perf_counter()
-        last_logits, n = self._prefill(prompt)
-        fsm_state = jnp.full((1,), self.fsm.start, dtype=jnp.int32)
-        self._rng, k0 = jax.random.split(self._rng)
-        tok0, fsm0 = _first_token(
-            last_logits, fsm_state, self.tables, k0,
-            jnp.float32(temperature), greedy=greedy, constrained=constrained,
-            kernels=self.kernels, rules=self.rules, logit_mask=self.logit_mask,
-        )
-        prefill_ms = (time.perf_counter() - t0) * 1e3
+        if (self.spec is not None and constrained and greedy
+                and not ignore_eos):
+            # speculative greedy path: host-driven draft/verify steps
+            # (token-identical to the loop below by construction)
+            return self._generate_spec(prompt, max_new_tokens, byte_budget)
+        tok0, fsm0, n, prefill_ms = self._admit_first_token(
+            prompt, temperature, greedy=greedy, constrained=constrained)
 
         t1 = time.perf_counter()
         self._rng, key = jax.random.split(self._rng)
@@ -851,6 +925,60 @@ class DecodeEngine:
             steps=count_h,
             finished=finished,
             forwards=int(fwds_h),
+        )
+
+    def _generate_spec(
+        self,
+        prompt: str,
+        max_new_tokens: int,
+        byte_budget: int,
+    ) -> GenerationResult:
+        """Single-request speculative greedy generation: the same admission
+        as generate() (_admit_first_token), then chunks of draft-K/
+        verify-once steps through the SpecDecoder (serve.spec). Each verify
+        step emits 1..K+1 accepted tokens; ``steps`` counts the tokens,
+        ``forwards`` the verify dispatches."""
+        tok0, fsm0, n, prefill_ms = self._admit_first_token(prompt, 0.0)
+
+        t1 = time.perf_counter()
+        cur = tok0
+        pos = jnp.full((1,), n, dtype=jnp.int32)
+        fsm = fsm0
+        active = tok0 != self.eos_id
+        nbytes = jnp.zeros((1,), jnp.int32)
+        left = jnp.full((1,), max_new_tokens, dtype=jnp.int32)
+        out_ids: list[int] = []
+        finished = False
+        forwards = 0
+        while True:
+            (out, n_c, eos, cur, pos, fsm, active, nbytes, left) = \
+                self.decode_chunk(cur, pos, fsm, active, nbytes, left, None,
+                                  0.0, byte_budget, chunk_steps=32,
+                                  greedy=True)
+            out_h, n_h, act_h, eos_h = jax.device_get((out, n_c, active, eos))
+            out_ids.extend(int(t) for t in np.asarray(out_h)[0, : int(n_h[0])])
+            finished = finished or bool(eos_h[0])
+            forwards += self.spec.last_chunk_forwards
+            if not bool(np.asarray(act_h)[0]):
+                break
+        decode_ms = (time.perf_counter() - t1) * 1e3
+
+        from ..utils import get_metrics
+
+        m = get_metrics()
+        m.inc("engine.requests")
+        m.inc("engine.tokens_generated", len(out_ids))
+        m.observe_ms("engine.prefill", prefill_ms)
+        m.observe_ms("engine.decode", decode_ms)
+
+        return GenerationResult(
+            text=self.tokenizer.decode(out_ids),
+            token_ids=out_ids,
+            prefill_ms=prefill_ms,
+            decode_ms=decode_ms,
+            steps=len(out_ids),
+            finished=finished,
+            forwards=forwards,
         )
 
     def generate_stepwise(

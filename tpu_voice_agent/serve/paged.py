@@ -291,10 +291,11 @@ def paged_chunk_decode_loop(
         active = active & ~stop
         return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
 
-    (k_pool, v_pool, cur, pos, state, active, eos, nbytes, left, out, n, _, _) = (
+    (k_pool, v_pool, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds) = (
         jax.lax.while_loop(cond, ff_body if use_ff else body, carry0)
     )
-    return out[:, : cap if use_ff else chunk_steps], n, eos, k_pool, v_pool, cur, pos, state, active, nbytes, left
+    return (out[:, : cap if use_ff else chunk_steps], n, eos, k_pool, v_pool,
+            cur, pos, state, active, nbytes, left, fwds)
 
 
 class PagedDecodeEngine(DecodeEngine):
@@ -526,7 +527,7 @@ class PagedDecodeEngine(DecodeEngine):
                     tokens_left = tokens_left.at[b].set(0)
                     continue
                 self._next_pos[b] = min(self._next_pos[b] + span, self.max_len)
-        out, n, eos, self.k_pool, self.v_pool, cur, pos, fsm, active, nbytes, left = (
+        out, n, eos, self.k_pool, self.v_pool, cur, pos, fsm, active, nbytes, left, fwds = (
             paged_chunk_decode_loop(
                 self.params, self.cfg, self.k_pool, self.v_pool, self.block_tables,
                 cur, pos, fsm, active, nbytes, tokens_left,
@@ -539,6 +540,10 @@ class PagedDecodeEngine(DecodeEngine):
                 eos_id=self.eos_id, pad_id=self.pad_id, max_len=self.max_len,
             )
         )
+        # forward-dispatch count for the scheduler's tokens-per-forward
+        # gauge (rides its combined readback) — without it the gauge is
+        # silently absent on the paged layout while ff multi-emits there too
+        self._last_fwds = fwds
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
     def release_slot(self, slot: int) -> None:

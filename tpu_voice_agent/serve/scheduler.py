@@ -209,10 +209,19 @@ class ContinuousBatcher:
             self.chunk_steps, self.greedy,
         )
         # one transfer for everything the host needs this chunk (a combined
-        # device_get is ONE tunnel round trip; separate gets pay one each)
-        out_h, n_h, act_h, eos_h, pos_h = (
+        # device_get is ONE tunnel round trip; separate gets pay one each).
+        # _last_fwds (engines that report it) rides the same transfer: the
+        # chunk's forward-dispatch count, the denominator that keeps
+        # tokens-per-forward truthful under multi-token steps (grammar
+        # fast-forward / speculative decoding emit several accepted tokens
+        # per forward — counting dispatches as tokens would inflate every
+        # throughput gauge)
+        fwds = getattr(eng, "_last_fwds", None)
+        out_h, n_h, act_h, eos_h, pos_h, fwds_h = (
             np.asarray(x)
-            for x in jax.device_get((out, n, self.active, eos, self.pos))
+            for x in jax.device_get(
+                (out, n, self.active, eos, self.pos,
+                 0 if fwds is None else fwds))
         )
         self._active_h = np.array(act_h)
         # paged engines clamp their block-growth targets to the actual
@@ -224,8 +233,16 @@ class ContinuousBatcher:
         from ..utils import get_metrics
 
         m = get_metrics()
+        # ACCEPTED/emitted tokens, never verify steps or forward dispatches:
+        # `n` is the per-row emitted count in every engine layout (plain,
+        # ff, speculative), so the tokens/s EMA below stays truthful when
+        # one forward emits several tokens
         m.inc("scheduler.tokens_generated", float(n_h.sum()))
         m.inc("scheduler.chunks")
+        if fwds is not None and fwds_h > 0:
+            m.inc("scheduler.forwards", float(fwds_h))
+            m.set_gauge("scheduler.tokens_per_forward",
+                        float(n_h.sum()) / float(fwds_h))
         # saturation gauges: the signals continuous batching is tuned by —
         # backlog (queue_depth), batch occupancy (slots used / total), KV
         # page pressure (paged engines), and rolling throughput
@@ -257,8 +274,13 @@ class ContinuousBatcher:
                     text=self.engine.tokenizer.decode(sl.token_ids),
                     token_ids=list(sl.token_ids),
                     prefill_ms=sl.prefill_ms,
-                    decode_ms=(time.perf_counter() - sl.start_s) * 1e3 - sl.prefill_ms,
-                    steps=len(sl.token_ids),
+                    # clamped: a request finishing inside timer resolution
+                    # (short answer riding one multi-token chunk) must not
+                    # report a negative duration
+                    decode_ms=max(
+                        0.0,
+                        (time.perf_counter() - sl.start_s) * 1e3 - sl.prefill_ms),
+                    steps=len(sl.token_ids),  # accepted tokens, not forwards
                     finished=bool(eos_h[b]),
                 )
                 m.inc("scheduler.requests_completed")

@@ -821,7 +821,11 @@ def make_parser_from_env() -> IntentParser:
     checkpoint's weights with its own tokenizer (the real replacement for
     the reference's LLM_BASE_URL/LLM_MODEL env, apps/brain/src/llm.ts:7-9).
     BRAIN_QUANT=int8 enables weight-only quantization for the loaded model.
-    BRAIN_BATCH=N (default 1) serves N continuous-batching slots."""
+    BRAIN_BATCH=N (default 1) serves N continuous-batching slots.
+    SPEC_ENABLE=1 turns on grammar-aware speculative decoding on the dense
+    engine layouts (SPEC_K / SPEC_DRAFTER / SPEC_DRAFT_MODEL — serve.spec);
+    the paged/pp layouts ignore it with a warning (their KV rollback story
+    does not exist yet) and greedy output stays token-identical either way."""
     import logging
 
     log = logging.getLogger("tpu_voice_agent.brain")
@@ -833,6 +837,9 @@ def make_parser_from_env() -> IntentParser:
     paged = os.environ.get("BRAIN_PAGED") == "1"
     quant = os.environ.get("BRAIN_QUANT") or None
     moe = "grouped" if os.environ.get("BRAIN_MOE") == "grouped" else None
+    from ..serve import spec_from_env
+
+    spec = spec_from_env()  # None unless SPEC_ENABLE=1
 
     def warn_unused(backend_name: str, **knobs) -> None:
         for name, val in knobs.items():
@@ -847,6 +854,7 @@ def make_parser_from_env() -> IntentParser:
         if paged:
             # classmethod polymorphism: from_hf builds cls(...), so the
             # paged engine loads checkpoints through the same loader
+            warn_unused("paged", SPEC_ENABLE=spec)
             pool = int(os.environ.get("BRAIN_POOL_BLOCKS", "0")) or None
             eng = PagedDecodeEngine.from_hf(
                 model_dir, quant=quant, batch_slots=max(slots, 1),
@@ -854,10 +862,11 @@ def make_parser_from_env() -> IntentParser:
             return _wrap_batched(eng)
         return _wrap_engine(DecodeEngine.from_hf(model_dir, quant=quant,
                                                  batch_slots=slots, fast_forward=ff,
-                                                 moe_impl=moe))
+                                                 moe_impl=moe, spec=spec))
     backend = os.environ.get("BRAIN_BACKEND", "rule")
     if backend == "rule":
-        warn_unused("rule", BRAIN_PAGED=paged, BRAIN_QUANT=quant, BRAIN_MOE=moe)
+        warn_unused("rule", BRAIN_PAGED=paged, BRAIN_QUANT=quant, BRAIN_MOE=moe,
+                    SPEC_ENABLE=spec)
         return RuleBasedParser()
     if backend.startswith("distilled"):
         # the in-tree trained intent checkpoint through the real constrained
@@ -874,7 +883,7 @@ def make_parser_from_env() -> IntentParser:
         if loaded is None:
             raise ValueError(f"no distilled intent checkpoint at {path} "
                              "(run python -m tpu_voice_agent.train.make_tiny_ckpts)")
-        return distill.intent_engine_from(*loaded)
+        return distill.intent_engine_from(*loaded, spec=spec)
     if backend.startswith("engine"):
         from ..serve import DecodeEngine, PagedDecodeEngine
 
@@ -892,12 +901,13 @@ def make_parser_from_env() -> IntentParser:
             # paged KV pool behind the batcher: HBM tracks live tokens, the
             # shared prompt prefix is stored once, BRAIN_POOL_BLOCKS sizes
             # the pool (default: dense worst case)
+            warn_unused("paged", SPEC_ENABLE=spec)
             pool = int(os.environ.get("BRAIN_POOL_BLOCKS", "0")) or None
             return _wrap_batched(PagedDecodeEngine(
                 preset=preset, cfg=cfg, batch_slots=max(slots, 1),
                 pool_blocks=pool, quant=quant, fast_forward=ff))
         return _wrap_engine(DecodeEngine(preset=preset, cfg=cfg, batch_slots=slots,
-                                         fast_forward=ff, quant=quant))
+                                         fast_forward=ff, quant=quant, spec=spec))
     if backend.startswith("pp"):
         # TP×PP pipelined engine (the 70B planner serving layout): layers
         # pipeline over pp, each stage tensor-parallel over tp.
@@ -907,7 +917,7 @@ def make_parser_from_env() -> IntentParser:
         from ..parallel.pipeline import pp_tp_mesh
         from ..serve import PPDecodeEngine
 
-        warn_unused("pp", BRAIN_PAGED=paged, BRAIN_MOE=moe)
+        warn_unused("pp", BRAIN_PAGED=paged, BRAIN_MOE=moe, SPEC_ENABLE=spec)
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
         ndev = len(jax.devices())
         pp = int(os.environ.get("BRAIN_PP", "0")) or min(2, ndev)
@@ -935,7 +945,7 @@ def make_parser_from_env() -> IntentParser:
         from ..train import distill
 
         warn_unused("planner-distilled", BRAIN_PAGED=paged, BRAIN_QUANT=quant,
-                    BRAIN_MOE=moe)
+                    BRAIN_MOE=moe, SPEC_ENABLE=spec)
         path = (backend.split(":", 1)[1] if ":" in backend
                 else os.path.join("checkpoints", distill.INTENT_CKPT))
         loaded = distill.load_ckpt_path(path, LlamaConfig)
@@ -961,7 +971,8 @@ def make_parser_from_env() -> IntentParser:
         from ..parallel.ring import sp_mesh
         from ..serve import LongSessionPlanner
 
-        warn_unused("planner", BRAIN_PAGED=paged, BRAIN_QUANT=quant, BRAIN_MOE=moe)
+        warn_unused("planner", BRAIN_PAGED=paged, BRAIN_QUANT=quant, BRAIN_MOE=moe,
+                    SPEC_ENABLE=spec)
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
         sp = int(os.environ.get("BRAIN_SP", "0")) or len(jax.devices())
         return PlannerParser(LongSessionPlanner(preset=preset, mesh=sp_mesh(sp)))

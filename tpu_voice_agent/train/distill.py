@@ -645,15 +645,18 @@ def train_intent_model(
     return cfg, params, stats
 
 
-def intent_engine_from(cfg, params, max_new_tokens: int = 300):
+def intent_engine_from(cfg, params, max_new_tokens: int = 300, spec=None):
     """Serving engine + parser over trained weights: the REAL constrained
     decode path (grammar FSM, prefix cache machinery) with the distilled
-    short prompt instead of the few-shot prefix."""
+    short prompt instead of the few-shot prefix. ``spec`` (serve.spec
+    SpecConfig) turns on speculative decoding for the distilled engine —
+    brain plumbs SPEC_ENABLE through here."""
     from ..serve import DecodeEngine
     from ..services.brain import EngineParser
 
     eng = DecodeEngine(cfg=replace(cfg, max_seq_len=512), max_len=512,
-                       prefill_buckets=(64, 128), init_weights=False)
+                       prefill_buckets=(64, 128), init_weights=False,
+                       spec=spec)
     eng.load_params(jax.device_put(params))
     return EngineParser(eng, max_new_tokens=max_new_tokens,
                         render=distilled_prompt)
