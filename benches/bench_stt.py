@@ -4,23 +4,156 @@ Measures per-chunk feed latency and the real-time factor of the streaming
 path (endpointer + bucketed encoder-decoder). The reference streams to
 Deepgram and has no on-device number to compare (SURVEY.md §6); the budget
 is real time: rtf < 1.0 means the chip keeps up with the mic.
+
+Multi-stream section (docs/PERF.md "Multi-stream STT batching"): N
+concurrent synthetic speech streams through BOTH serving planes — the
+per-connection baseline (shared engine, one lock, B=1 dispatches: what
+every WS connection got before the batcher) and the batched plane (one
+STTBatcher multiplexing all streams into (S, ...) decode dispatches).
+Reports per-chunk feed p50/p99, aggregate RTF (wall / PER-STREAM audio
+duration: all N streams run concurrently over one window, so RTF < 1.0
+means the plane keeps up with N live mics at once), aggregate throughput
+(total audio-seconds transcribed per wall-second), and the capacity
+headline: **max streams at RTF < 1.0** per plane. Snapshotted into a
+``BENCH_stt_<ts>.json`` artifact (merged by run_all.py, incl. --quick).
+
+Knobs: BENCH_STT_SECONDS (default 8; audio per stream), BENCH_STT_STREAMS
+(default "1,2,4,8"; --quick trims via env), BENCH_STT_SLOTS (default
+max(streams); the batcher's fixed decode width).
 """
 
 from __future__ import annotations
 
+import datetime
+import json
+import os
 import sys
+import threading
 import time
 from pathlib import Path
 
 import numpy as np
 
 sys.path.insert(0, str(Path(__file__).parent))
-from common import emit, log, on_tpu, percentile  # noqa: E402
+from common import _ROOT, emit, log, on_tpu, percentile  # noqa: E402
+
+SR = 16_000
+CHUNK_MS = 250
 
 
-def main(seconds: float = 8.0) -> None:
+def synth_speech(seconds: float, seed: int = 0) -> np.ndarray:
+    """Speech-like synthetic audio: modulated tone bursts with silence gaps
+    (drives endpointing — utterances open and close mid-stream)."""
+    rng = np.random.default_rng(seed)
+    t = np.arange(int(SR * seconds)) / SR
+    freq = 180.0 + 40.0 * (seed % 6)
+    return (0.2 * np.sin(2 * np.pi * freq * t)
+            * (np.sin(2 * np.pi * 1.5 * t + 0.7 * seed) > 0)
+            + 0.002 * rng.standard_normal(len(t))).astype(np.float32)
+
+
+def run_streams(make_stt, audios: list[np.ndarray], chunk: int, drain=None):
+    """Feed each stream's chunks back-to-back from its own thread (the WS
+    feed-executor shape). ``drain`` (the batcher's) runs INSIDE the timed
+    window: a throughput claim must include work still in flight, not just
+    audio accepted. Returns (wall_s, all per-chunk latencies ms)."""
+    stts = [make_stt() for _ in audios]
+    lats: list[list[float]] = [[] for _ in audios]
+
+    def worker(i: int) -> None:
+        stt, a = stts[i], audios[i]
+        # feed the WHOLE stream (a dropped tail chunk would inflate the
+        # audio-seconds/wall throughput the capacity verdict is built on)
+        for j in range(0, len(a), chunk):
+            s = time.perf_counter()
+            stt.feed(a[j:j + chunk])
+            lats[i].append((time.perf_counter() - s) * 1e3)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(audios))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if drain is not None:
+        drain()
+    wall = time.perf_counter() - t0
+    for stt in stts:
+        closer = getattr(stt, "close", None)
+        if closer is not None:
+            closer()
+    return wall, [x for per in lats for x in per]
+
+
+def multi_stream(engine, seconds: float, streams: list[int]) -> dict:
+    from tpu_voice_agent.audio.endpoint import EnergyEndpointer
+    from tpu_voice_agent.serve.stt import StreamingSTT
+    from tpu_voice_agent.serve.stt_batch import BatchedStreamingSTT, STTBatcher
+
+    chunk = int(SR * CHUNK_MS / 1000)
+    slots = int(os.environ.get("BENCH_STT_SLOTS", str(max(streams))))
+    lock = threading.Lock()
+
+    def make_endpointer():
+        return EnergyEndpointer(sample_rate=SR)
+
+    class Locked(StreamingSTT):
+        """The per-connection plane: every stream serializes through the
+        shared engine lock (services/voice.py's LockedStreaming)."""
+
+        def feed(self, samples):
+            with lock:
+                return super().feed(samples)
+
+    batcher = STTBatcher(engine, slots=slots)
+    try:
+        # warm the batched plane's fixed-width decode + a final encode
+        batcher.submit("final", 999_999, synth_speech(0.5, 9)).result(timeout=120)
+
+        verdict: dict = {"seconds": seconds, "streams": streams,
+                         "batch_slots": slots, "per_conn": {}, "batched": {}}
+        for n in streams:
+            audios = [synth_speech(seconds, seed=i) for i in range(n)]
+            for mode, make, drain in (
+                ("per_conn",
+                 lambda: Locked(engine, endpointer=make_endpointer()), None),
+                ("batched",
+                 lambda: BatchedStreamingSTT(engine, batcher,
+                                             endpointer=make_endpointer()),
+                 batcher.drain),
+            ):
+                wall, lat = run_streams(make, audios, chunk, drain=drain)
+                rtf = wall / seconds
+                verdict[mode][str(n)] = {
+                    "wall_s": round(wall, 3),
+                    "rtf": round(rtf, 3),
+                    "throughput_audio_s_per_s": round(n * seconds / wall, 3),
+                    "feed_p50_ms": round(percentile(lat, 50), 3),
+                    "feed_p99_ms": round(percentile(lat, 99), 3),
+                }
+                log(f"n={n} {mode}: rtf {rtf:.3f} "
+                    f"throughput {n * seconds / wall:.2f} audio-s/s "
+                    f"p99 {percentile(lat, 99):.1f}ms")
+    finally:
+        batcher.stop()
+
+    for mode in ("per_conn", "batched"):
+        ok = [n for n in streams if verdict[mode][str(n)]["rtf"] < 1.0]
+        verdict[f"capacity_streams_{mode}"] = max(ok) if ok else 0
+    # the ≥2x acceptance bar is read at 4+ concurrent streams
+    ratio_at = max((n for n in streams if n >= 4), default=max(streams))
+    per, bat = (verdict[m][str(ratio_at)]["throughput_audio_s_per_s"]
+                for m in ("per_conn", "batched"))
+    verdict["throughput_ratio"] = round(bat / per, 3) if per else None
+    verdict["throughput_ratio_streams"] = ratio_at
+    return verdict
+
+
+def main(seconds: float | None = None) -> None:
     from tpu_voice_agent.serve.stt import SpeechEngine, StreamingSTT
 
+    seconds = seconds if seconds is not None else float(
+        os.environ.get("BENCH_STT_SECONDS", "8"))
     tpu = on_tpu()
     preset = "whisper-large-v3" if tpu else "whisper-test"
     # 8 s of audio tops out at the 1000-frame bucket; don't compile 3000
@@ -29,13 +162,8 @@ def main(seconds: float = 8.0) -> None:
     stt = StreamingSTT(engine)
     log(f"preset={preset} buckets={buckets}")
 
-    sr, chunk_ms = 16_000, 250
-    chunk = int(sr * chunk_ms / 1000)
-    rng = np.random.default_rng(0)
-    t = np.arange(int(sr * seconds)) / sr
-    # speech-like: modulated tone bursts with silence gaps (drives endpointing)
-    audio = (0.2 * np.sin(2 * np.pi * 220 * t) * (np.sin(2 * np.pi * 1.5 * t) > 0)
-             + 0.002 * rng.standard_normal(len(t))).astype(np.float32)
+    chunk = int(SR * CHUNK_MS / 1000)
+    audio = synth_speech(seconds, seed=0)
 
     # warmup: compile every bucket's encoder+decoder program before timing
     # (steady-state is the metric; XLA compiles are once per process),
@@ -78,9 +206,52 @@ def main(seconds: float = 8.0) -> None:
     log(f"partial latency: first {first:.1f}ms last {last:.1f}ms over {n_blocks} blocks "
         f"(flat == incremental encoder works)")
 
-    emit("stt_chunk_p50", p50, "ms", vs_baseline=chunk_ms / max(p50, 1e-9))
-    emit("stt_realtime_factor", rtf, "x", vs_baseline=1.0 / max(rtf, 1e-9))
-    emit("stt_partial_latency_growth", last / max(first, 1e-9), "x_first_to_last")
+    rows: list[dict] = []
+
+    def row(metric, value, unit, vs_baseline=None):
+        emit(metric, value, unit, vs_baseline)
+        r = {"metric": metric, "value": round(value, 3), "unit": unit}
+        if vs_baseline is not None:
+            r["vs_baseline"] = round(vs_baseline, 3)
+        rows.append(r)
+
+    row("stt_chunk_p50", p50, "ms", vs_baseline=CHUNK_MS / max(p50, 1e-9))
+    row("stt_realtime_factor", rtf, "x", vs_baseline=1.0 / max(rtf, 1e-9))
+    row("stt_partial_latency_growth", last / max(first, 1e-9), "x_first_to_last")
+
+    # ------------------------------------------------------ multi-stream
+    streams = sorted({int(x) for x in os.environ.get(
+        "BENCH_STT_STREAMS", "1,2,4,8").split(",") if x.strip()})
+    verdict = multi_stream(engine, seconds, streams)
+    row("stt_capacity_streams_batched",
+        float(verdict["capacity_streams_batched"]), "streams")
+    row("stt_capacity_streams_per_conn",
+        float(verdict["capacity_streams_per_conn"]), "streams")
+    if verdict["throughput_ratio"] is not None:
+        # acceptance bar: batched >= 2x per-connection at 4+ streams
+        row("stt_multi_throughput_ratio", verdict["throughput_ratio"],
+            f"x_at_{verdict['throughput_ratio_streams']}_streams",
+            vs_baseline=verdict["throughput_ratio"] / 2.0)
+    top = str(max(streams))
+    row("stt_multi_feed_p99_batched",
+        verdict["batched"][top]["feed_p99_ms"], "ms")
+
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art = art_dir / f"BENCH_stt_{stamp}.json"
+    import jax
+
+    art.write_text(json.dumps({
+        "bench": "bench_stt",
+        "ts": stamp,
+        "backend": jax.default_backend(),
+        "config": {"preset": preset, "buckets": list(buckets),
+                   "chunk_ms": CHUNK_MS, "seconds": seconds},
+        "rows": rows,
+        "stt": verdict,
+    }, indent=1))
+    log(f"artifact: {art}")
 
 
 if __name__ == "__main__":

@@ -26,10 +26,14 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
 # the spec bench stays at a reduced utterance/token budget — tiny model,
-# and the accept-rate verdict belongs in every quick artifact)
-QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py"]
+# and the accept-rate verdict belongs in every quick artifact; the STT
+# bench stays at trimmed stream counts/seconds so the multi-stream
+# capacity number lands in every combined artifact)
+QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
+                 "bench_stt.py"]
 # env trims applied on --quick only when the operator has not pinned them
-QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96"}
+QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
+             "BENCH_STT_SECONDS": "4", "BENCH_STT_STREAMS": "1,4"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -102,7 +106,7 @@ def main() -> None:
             if body.get("bench") == name.removesuffix(".py"):
                 entry["artifact"] = art.name
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
-                            "spec"):
+                            "spec", "stt"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

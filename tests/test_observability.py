@@ -494,3 +494,22 @@ def test_metrics_name_collision_lint_catches_mismatch(tmp_path):
     cols = metrics_lint.find_collisions(reg)
     assert len(cols) == 1 and cols[0][0] == "svc.thing"
     assert set(cols[0][1]) == {"counter", "histogram"}
+
+
+def test_metrics_lint_pinned_stt_names_present():
+    """The multi-stream STT metric names have an external contract (bench
+    artifacts, OBSERVABILITY.md catalog): the lint pins name AND kind, so a
+    rename or kind flip fails tier-1 here."""
+    reg = metrics_lint.scan_source(ROOT / "tpu_voice_agent")
+    assert metrics_lint.check_pinned(reg) == []
+    for name in ("stt.feed_lag_s", "stt.buffered_audio_s",
+                 "stt.batch_occupancy", "stt.partials_coalesced",
+                 "stt.finals_batched"):
+        assert name in metrics_lint.PINNED
+
+
+def test_metrics_lint_pinned_catches_missing_and_wrong_kind():
+    reg = {"stt.feed_lag_s": {"counter": ["x.py:1"]}}  # wrong kind, rest absent
+    problems = metrics_lint.check_pinned(reg)
+    assert any("must be a gauge" in p for p in problems)
+    assert any("not registered anywhere" in p for p in problems)

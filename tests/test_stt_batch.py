@@ -1,0 +1,284 @@
+"""Multi-stream batched STT plane (serve/stt_batch.py): differential
+token-identity vs the B=1 per-connection path for every work kind, batcher
+priority/coalescing/shed units, the StreamingSTT-level event differential,
+feed_async, and the stream-gauge aggregation fix.
+
+Fast tier on purpose (unlike test_stt's compile-heavy module): the
+batched-vs-single identity contract is the acceptance bar of the batched
+plane and must gate every tier-1 run.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_voice_agent.audio.endpoint import EnergyEndpointer
+from tpu_voice_agent.models.whisper import init_self_cache, pad_cross_kv
+from tpu_voice_agent.serve.stt import SpeechEngine, StreamingSTT, _stt_decode_loop
+from tpu_voice_agent.serve.stt_batch import BatchedStreamingSTT, STTBatcher
+
+
+def tone(freq, dur_s, amp=0.3, sr=16_000):
+    t = np.arange(int(dur_s * sr)) / sr
+    return (amp * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return SpeechEngine(preset="whisper-test", frame_buckets=(50, 100, 200),
+                        max_new_tokens=16)
+
+
+@pytest.fixture()
+def batcher(engine):
+    b = STTBatcher(engine, slots=4)
+    yield b
+    b.stop()
+
+
+def test_batched_finals_token_identical_ragged_buckets(engine, batcher):
+    """Four finals spanning every bucket decoded in ONE batch must be
+    token-identical to engine.transcribe per slot (ragged enc lengths)."""
+    audios = [tone(300, 0.4), tone(440, 0.9), tone(520, 1.8), tone(260, 0.3)]
+    singles = [engine.transcribe(a).text for a in audios]
+    futs = [batcher.submit("final", 9000 + i, a) for i, a in enumerate(audios)]
+    assert [f.result(timeout=60).text for f in futs] == singles
+
+
+def test_batched_spec_final_token_identical(engine, batcher):
+    a = tone(410, 0.7)
+    res = batcher.submit("spec_final", 9100, a).result(timeout=60)
+    assert res.text == engine.transcribe(a).text
+
+
+def test_batched_partials_token_identical_and_slot_persistent(engine, batcher):
+    """Partials decode the pool slot's incremental cross-KV; identity vs a
+    per-connection IncrementalState fed the same audio, across TWO rounds
+    (the slot persists between ticks)."""
+    hop = engine.mel_cfg.hop
+    b1, b2 = tone(330, 1.0), tone(400, 1.5)
+    st1 = engine.incremental_feed(engine.incremental_init(len(b1) // hop), b1)
+    st2 = engine.incremental_feed(engine.incremental_init(len(b2) // hop), b2)
+    f1 = batcher.submit("partial", 9201, b1)
+    f2 = batcher.submit("partial", 9202, b2)
+    assert f1.result(timeout=60).text == engine.incremental_decode(st1).text
+    assert f2.result(timeout=60).text == engine.incremental_decode(st2).text
+    g1 = np.concatenate([b1, tone(350, 0.5)])
+    st1 = engine.incremental_feed(st1, g1)
+    assert (batcher.submit("partial", 9201, g1).result(timeout=60).text
+            == engine.incremental_decode(st1).text)
+
+
+def test_batched_partial_reanchor_matches_b1(engine, batcher):
+    """An utterance outgrowing the cross-KV budget re-anchors in the pool
+    slot exactly like the B=1 state (no silent freeze, same transcript)."""
+    hop = engine.mel_cfg.hop
+    b = tone(440, 1.0)
+    st = engine.incremental_feed(engine.incremental_init(len(b) // hop), b)
+    batcher.submit("partial", 9301, b).result(timeout=60)
+    g = np.concatenate([b, tone(380, 2.0)])  # >> 2 s budget
+    st = engine.incremental_feed(st, g)
+    assert (batcher.submit("partial", 9301, g).result(timeout=60).text
+            == engine.incremental_decode(st).text)
+
+
+def test_decode_loop_mid_batch_eos_and_ragged_budgets(engine):
+    """The batched loop with per-slot budgets: each row stops at its OWN
+    limit (mid-batch termination) and emits exactly what a B=1 loop with
+    the same budget emits."""
+    P = engine.cfg.enc_positions
+    audios = [tone(300, 0.4), tone(440, 0.9), tone(520, 1.2), tone(260, 0.6)]
+    kvs, masks = [], []
+    for a in audios:
+        kv, _, n_frames = engine._encode_window(a)
+        kvs.append(pad_cross_kv(kv, P))
+        # P-shaped masks (the batched plane's layout; padding is masked)
+        masks.append(jnp.arange(P)[None, :] < max(1, n_frames // 2))
+    ck = {"k": jnp.concatenate([kv["k"] for kv in kvs], axis=1),
+          "v": jnp.concatenate([kv["v"] for kv in kvs], axis=1)}
+    mask_b = jnp.concatenate(masks, axis=0)
+    budgets = np.array([3, 16, 1, 8], dtype=np.int32)
+    bos = jnp.broadcast_to(
+        jnp.asarray(list(engine.bos_ids), jnp.int32)[None, :], (4, 1))
+    out_b, n_b, _ = _stt_decode_loop(
+        engine.params, engine.cfg,
+        init_self_cache(engine.cfg, 4, dtype=engine._param_dtype),
+        ck, mask_b, bos, engine.suppress,
+        live=jnp.ones((4,), bool), max_new_each=jnp.asarray(budgets),
+        max_new=16, eos_id=engine.eos_id, pad_id=engine.pad_id,
+    )
+    out_b, n_b = np.asarray(out_b), np.asarray(n_b)
+    assert (n_b <= budgets).all()
+    assert n_b[2] <= 1 < n_b[1]  # ragged: row 2 parked while row 1 ran on
+    for i in range(4):
+        o1, n1, _ = _stt_decode_loop(
+            engine.params, engine.cfg,
+            init_self_cache(engine.cfg, 1, dtype=engine._param_dtype),
+            kvs[i], masks[i], bos[:1], engine.suppress,
+            max_new_each=jnp.asarray(budgets[i:i + 1]),
+            max_new=16, eos_id=engine.eos_id, pad_id=engine.pad_id,
+        )
+        assert np.array_equal(out_b[i, : n_b[i]],
+                              np.asarray(o1)[0, : int(np.asarray(n1)[0])])
+
+
+def test_batcher_priority_and_coalescing(engine):
+    """finals > spec_finals > partials; a newer partial for the same
+    utterance supersedes the queued stale one (resolved None + counted)."""
+    from tpu_voice_agent.utils import get_metrics
+
+    b = STTBatcher(engine, slots=2, autostart=False)
+    a = tone(300, 0.5)
+    c0 = get_metrics().snapshot()["counters"].get("stt.partials_coalesced", 0)
+    p1 = b.submit("partial", 1, a)
+    p2 = b.submit("partial", 1, tone(300, 0.6))  # supersedes p1
+    sp = b.submit("spec_final", 2, a)
+    fi = b.submit("final", 3, a)
+    assert p1.done() and p1.result() is None
+    assert get_metrics().snapshot()["counters"]["stt.partials_coalesced"] == c0 + 1
+    # width 2: the first tick takes [final, spec_final]; the partial waits
+    b.tick()
+    assert fi.done() and sp.done() and not p2.done()
+    assert fi.result().text == engine.transcribe(a).text
+    b.tick()
+    assert p2.done() and p2.result() is not None
+
+
+def test_batcher_sheds_partials_under_overload(engine):
+    """Admission control at submit (resilience convention): partials beyond
+    the slot pool or the bounded queue shed with stt.shed_overload; finals
+    are always admitted."""
+    from tpu_voice_agent.utils import get_metrics
+
+    # slot-pool exhaustion: one slot, four concurrent utterances — only the
+    # first partial gets a slot, the rest shed AT SUBMIT
+    b = STTBatcher(engine, slots=1, autostart=False)
+    a = tone(300, 0.3)
+    s0 = get_metrics().snapshot()["counters"].get("stt.shed_overload", 0)
+    futs = [b.submit("partial", 100 + i, a) for i in range(4)]
+    shed = [f for f in futs if f.done() and f.result() is None]
+    assert len(shed) == 3
+    assert get_metrics().snapshot()["counters"]["stt.shed_overload"] == s0 + 3
+    f = b.submit("final", 999, a)
+    assert not f.done()  # admitted despite the exhausted pool
+    while b.tick():
+        pass
+    assert f.result(timeout=5).text == engine.transcribe(a).text
+
+    # bounded queue: plenty of slots, but the pending cap sheds the second
+    # utterance's partial before it queues
+    b2 = STTBatcher(engine, slots=4, max_pending=1, autostart=False)
+    s1 = get_metrics().snapshot()["counters"].get("stt.shed_overload", 0)
+    q1 = b2.submit("partial", 201, a)
+    q2 = b2.submit("partial", 202, a)
+    assert not q1.done() and q2.done() and q2.result() is None
+    assert get_metrics().snapshot()["counters"]["stt.shed_overload"] == s1 + 1
+
+
+def test_batcher_slot_exhaustion_sheds_partial_not_final(engine):
+    """More concurrent utterances than pool slots: the un-slotted
+    utterance's partial sheds, its final still transcribes."""
+    b = STTBatcher(engine, slots=1, autostart=False)
+    a1, a2 = tone(320, 0.8), tone(430, 0.8)
+    f1 = b.submit("partial", 501, a1)
+    b.tick()
+    assert f1.result(timeout=5) is not None  # owns the only slot
+    f2 = b.submit("partial", 502, a2)
+    b.tick()
+    assert f2.result(timeout=5) is None  # no slot left: shed
+    fin = b.submit("final", 502, a2)
+    b.tick()
+    assert fin.result(timeout=5).text == engine.transcribe(a2).text
+    # releasing the slotted utterance frees the slot for the next one
+    b.release(501)
+    f3 = b.submit("partial", 503, a2)
+    b.tick()
+    assert f3.result(timeout=5) is not None
+
+
+def test_release_mid_flight_partial_never_leaks_the_slot(engine):
+    """Regression: an utterance closing while its partial is already in the
+    worker's batch must NOT re-acquire its slot (slots are reserved at
+    submit and freed by release; a worker-side re-alloc for a closed
+    utterance id could never be released again — a permanent leak)."""
+    b = STTBatcher(engine, slots=1, autostart=False)
+    a = tone(320, 0.8)
+    f = b.submit("partial", 601, a)
+    with b._wake:
+        batch = b._take_batch_locked()  # in flight: popped, not yet processed
+    b.release(601)  # endpoint closed the utterance meanwhile
+    b._process(batch)
+    assert f.result(timeout=5) is None  # dropped, not decoded
+    assert b.slot_of == {} and b.slot_state == [None]  # slot stayed free
+    f2 = b.submit("partial", 602, a)  # ...and is reusable
+    b.tick()
+    assert f2.result(timeout=5) is not None
+
+
+def test_batched_streaming_matches_base_events(engine, batcher):
+    """Differential e2e at the StreamingSTT level: the same chunk sequence
+    through the base (inline) and batched planes yields the same events —
+    async delivery may shift WHEN a partial/spec surfaces, but every text
+    is identical and the final matches exactly."""
+
+    def run(stt, batched):
+        events = []
+        chunks = [tone(300, 0.6)] + [np.zeros(16_000 * 60 // 1000, np.float32)] * 12
+        for c in chunks:
+            events += stt.feed(c)
+            if batched:
+                assert batcher.drain(timeout_s=30)  # deliveries land before the next feed
+        return events
+
+    base = StreamingSTT(
+        engine, partial_interval_s=0.2,
+        endpointer=EnergyEndpointer(trailing_silence_ms=300, min_speech_ms=100))
+    bat = BatchedStreamingSTT(
+        engine, batcher, partial_interval_s=0.2,
+        endpointer=EnergyEndpointer(trailing_silence_ms=300, min_speech_ms=100))
+    eb = run(base, batched=False)
+    eB = run(bat, batched=True)
+    assert sorted(eb) == sorted(eB)
+    assert [t for k, t in eb if k == "final"] == [t for k, t in eB if k == "final"]
+
+
+def test_batched_feed_async_delivers_identical_final(engine, batcher):
+    """feed_async awaits the final's future instead of blocking a thread;
+    the delivered final equals the base plane's."""
+    import asyncio
+
+    chunks = [tone(300, 0.6)] + [np.zeros(16_000 * 60 // 1000, np.float32)] * 12
+    base = StreamingSTT(
+        engine, partial_interval_s=60.0,
+        endpointer=EnergyEndpointer(trailing_silence_ms=300, min_speech_ms=100))
+    ref_finals = [t for c in chunks for k, t in base.feed(c) if k == "final"]
+
+    stt = BatchedStreamingSTT(
+        engine, batcher, partial_interval_s=60.0,
+        endpointer=EnergyEndpointer(trailing_silence_ms=300, min_speech_ms=100))
+
+    async def drive():
+        evs = []
+        for c in chunks:
+            evs += await stt.feed_async(c)
+        return evs
+
+    evs = asyncio.run(drive())
+    assert [t for k, t in evs if k == "final"] == ref_finals
+
+
+def test_stream_gauges_aggregate_across_instances(engine):
+    """The gauge-stomp fix: concurrent streams must not overwrite each
+    other — buffered seconds SUM across live instances (and lag is a max,
+    so one saturated stream keeps the alarm up)."""
+    from tpu_voice_agent.utils import get_metrics
+
+    s1 = StreamingSTT(engine, partial_interval_s=60.0)
+    s2 = StreamingSTT(engine, partial_interval_s=60.0)
+    s1.feed(tone(300, 0.5))
+    g1 = get_metrics().snapshot()["gauges"]["stt.buffered_audio_s"]
+    s2.feed(tone(400, 0.3))
+    g2 = get_metrics().snapshot()["gauges"]["stt.buffered_audio_s"]
+    # the second stream's feed ADDED its buffer to the aggregate instead of
+    # replacing the first stream's 0.5 s with its own 0.3 s
+    assert g2 >= g1 + 0.25

@@ -472,3 +472,108 @@ def test_speculation_commits_on_session_keyed_planner_brain(tmp_path):
     finally:
         for srv in (voice, executor, brain):
             srv.__exit__(None, None, None)
+
+
+# ------------------------------------------------------- multi-stream batched
+
+
+def test_stt_factory_env_gating_batched_vs_per_connection(monkeypatch):
+    """STT_BATCH_ENABLE unset -> the historical per-connection plane
+    (LockedStreaming); =1 -> every connection shares ONE engine and ONE
+    batcher sized by STT_BATCH_SLOTS."""
+    from tpu_voice_agent.serve.stt_batch import BatchedStreamingSTT
+    from tpu_voice_agent.services.voice import stt_factory_from_env
+
+    monkeypatch.setenv("VOICE_STT", "whisper:whisper-test")
+    monkeypatch.delenv("STT_BATCH_ENABLE", raising=False)
+    s = stt_factory_from_env()()
+    assert type(s).__name__ == "LockedStreaming"
+    assert not isinstance(s, BatchedStreamingSTT)
+
+    monkeypatch.setenv("STT_BATCH_ENABLE", "1")
+    monkeypatch.setenv("STT_BATCH_SLOTS", "2")
+    factory = stt_factory_from_env()
+    a, b = factory(), factory()
+    try:
+        assert isinstance(a, BatchedStreamingSTT) and isinstance(b, BatchedStreamingSTT)
+        assert a.batcher is b.batcher  # process-wide batcher
+        assert a.engine is b.engine  # process-wide engine
+        assert a.batcher.S == 2
+        assert a._utt != b._utt  # distinct utterance keys
+    finally:
+        a.batcher.stop()
+
+
+def test_batched_multiconnection_e2e_over_ws(tmp_path):
+    """Two real WS connections against a voice service running the batched
+    STT plane (real whisper-test engine, shared batcher): both stream
+    audio concurrently and both receive the SAME transcript_final a B=1
+    per-connection StreamingSTT produces for identical chunks."""
+    import threading
+
+    from tpu_voice_agent.audio.endpoint import EnergyEndpointer
+    from tpu_voice_agent.audio.mel import pcm16_to_float
+    from tpu_voice_agent.serve.stt import SpeechEngine, StreamingSTT
+    from tpu_voice_agent.serve.stt_batch import BatchedStreamingSTT, STTBatcher
+
+    engine = SpeechEngine(preset="whisper-test", frame_buckets=(50, 100, 200),
+                          max_new_tokens=16)
+    batcher = STTBatcher(engine, slots=4)
+
+    def make_endpointer():
+        return EnergyEndpointer(trailing_silence_ms=200, min_speech_ms=100)
+
+    def stt_factory():
+        return BatchedStreamingSTT(engine, batcher, endpointer=make_endpointer(),
+                                   early_close_ms=None)
+
+    # the audio both connections will stream: 0.6 s tone + trailing silence,
+    # in 100 ms PCM16 frames (quantized exactly like the wire format)
+    sr = 16_000
+    t = np.arange(int(0.6 * sr)) / sr
+    tone_f32 = (0.3 * np.sin(2 * np.pi * 300 * t)).astype(np.float32)
+    audio = np.concatenate([tone_f32, np.zeros(int(0.6 * sr), np.float32)])
+    pcm = (np.clip(audio, -1, 1) * 32767.0).astype("<i2").tobytes()
+    frames = [pcm[i:i + 3200] for i in range(0, len(pcm), 3200)]
+
+    # B=1 reference over the SAME quantized chunks (computed before the
+    # service boots so the engine isn't shared mid-flight)
+    ref = StreamingSTT(engine, endpointer=make_endpointer(), early_close_ms=None)
+    ref_finals = [txt for f in frames for k, txt in ref.feed(pcm16_to_float(f))
+                  if k == "final"]
+    if not ref_finals:
+        pytest.skip("random-weight engine transcribed this tone to empty text")
+
+    brain = AppServer(build_brain(RuleBasedParser())).__enter__()
+    manager = SessionManager(
+        page_factory=FakePage.demo,
+        artifacts_root=str(tmp_path / "art"),
+        uploads_dir=str(tmp_path / "up"),
+    )
+    executor = AppServer(build_executor(manager)).__enter__()
+    voice = AppServer(
+        build_voice(VoiceConfig(brain_url=brain.url, executor_url=executor.url,
+                                stt_factory=stt_factory))
+    ).__enter__()
+    try:
+        inbound = [("binary", f) for f in frames]
+        results: dict = {}
+
+        def one_conn(idx):
+            results[idx] = ws_session(voice.url, inbound, ["transcript_final"],
+                                      timeout_s=60)
+
+        threads = [threading.Thread(target=one_conn, args=(i,)) for i in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        for idx in range(2):
+            finals = [e["text"] for e in results[idx]
+                      if e["type"] == "transcript_final"]
+            assert finals, f"connection {idx} never got a final"
+            assert finals[0] == ref_finals[0]
+    finally:
+        for srv in (voice, executor, brain):
+            srv.__exit__(None, None, None)
+        batcher.stop()

@@ -29,6 +29,39 @@ _CALL = re.compile(
 _KIND = {"inc": "counter", "set_gauge": "gauge", "observe_ms": "histogram"}
 _PLACEHOLDER = re.compile(r"\{[^{}]*\}")
 
+# Names with an external contract (dashboards, bench artifacts, the
+# OBSERVABILITY.md catalog) pinned to their kind: the lint fails if one
+# disappears from the source or re-registers under another kind. The STT
+# saturation gauges are AGGREGATES across live streams (max lag, summed
+# buffered seconds — serve/stt.py _record_stream_gauges), not per-stream
+# values; a refactor that quietly turns them back into last-writer-wins
+# per-instance writes must at minimum keep the names alive here.
+PINNED: dict[str, str] = {
+    "stt.feed_lag_s": "gauge",
+    "stt.buffered_audio_s": "gauge",
+    "stt.batch_occupancy": "gauge",
+    "stt.batch_slots": "gauge",
+    "stt.queue_depth": "gauge",
+    "stt.partials_coalesced": "counter",
+    "stt.finals_batched": "counter",
+    "stt.batch_ticks": "counter",
+    "stt.shed_overload": "counter",
+}
+
+
+def check_pinned(reg: dict[str, dict[str, list[str]]]) -> list[str]:
+    """Pin violations: a PINNED name missing from the scan, or registered
+    under a different kind than its contract says."""
+    problems = []
+    for name, kind in sorted(PINNED.items()):
+        kinds = reg.get(name)
+        if kinds is None:
+            problems.append(f"pinned metric {name!r} ({kind}) not registered anywhere")
+        elif list(kinds) != [kind]:
+            problems.append(
+                f"pinned metric {name!r} must be a {kind}, found {sorted(kinds)}")
+    return problems
+
 
 def _normalize(name: str, is_fstring: bool) -> str:
     return _PLACEHOLDER.sub("*", name) if is_fstring else name
@@ -63,15 +96,19 @@ def main(argv: list[str] | None = None) -> int:
         pathlib.Path(__file__).resolve().parents[1] / "tpu_voice_agent"
     reg = scan_source(root)
     collisions = find_collisions(reg)
+    pin_problems = check_pinned(reg)
     print(f"[metrics-lint] {len(reg)} distinct metric names under {root}")
-    if not collisions:
-        print("[metrics-lint] ok — no name registered under more than one type")
+    if not collisions and not pin_problems:
+        print("[metrics-lint] ok — no name registered under more than one type; "
+              f"{len(PINNED)} pinned names present")
         return 0
     for name, kinds in collisions:
         print(f"[metrics-lint] COLLISION {name!r}:")
         for kind, sites in sorted(kinds.items()):
             for site in sites:
                 print(f"  {kind:<9} {site}")
+    for p in pin_problems:
+        print(f"[metrics-lint] PIN {p}")
     return 1
 
 

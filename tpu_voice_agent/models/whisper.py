@@ -232,6 +232,33 @@ def init_self_cache(cfg: WhisperConfig, batch: int, dtype=jnp.bfloat16) -> dict:
     return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
 
 
+def init_cross_kv_pool(cfg: WhisperConfig, slots: int, dtype=jnp.bfloat16) -> dict:
+    """S-slot cross-attention KV pool for multi-stream batched STT serving:
+    one shared (L, S, enc_positions, nh, hd) buffer whose slot axis doubles
+    as the batch axis of the batched decode. Each live utterance owns one
+    slot; per-slot validity is a host-side ``enc_len`` the decode turns into
+    an encoder mask (stale positions beyond a slot's enc_len are masked, so
+    slot reuse never needs a zeroing pass)."""
+    shape = (cfg.dec_layers, slots, cfg.enc_positions, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+
+
+def pad_cross_kv(cross_kv: dict, total: int) -> dict:
+    """Zero-pad cross-KV along the encoder-position axis to ``total`` so the
+    batched STT plane can mix ragged buckets in ONE fixed-shape decode
+    dispatch (padded positions are masked by enc_mask; a masked score of
+    -1e30 underflows exp() to exactly 0.0, so padding is numerically inert,
+    not approximate). The B=1 plane decodes at each bucket's own length —
+    a short utterance must not read the full window's KV per step."""
+    T = cross_kv["k"].shape[2]
+    if T == total:
+        return cross_kv
+    if T > total:
+        raise ValueError(f"cross-KV length {T} exceeds pad target {total}")
+    pad = [(0, 0), (0, 0), (0, total - T), (0, 0), (0, 0)]
+    return {"k": jnp.pad(cross_kv["k"], pad), "v": jnp.pad(cross_kv["v"], pad)}
+
+
 @partial(jax.jit, static_argnames=("cfg", "rules"))
 def compute_cross_kv(params: dict, cfg: WhisperConfig, enc_out: jax.Array, rules=None) -> dict:
     """Precompute per-layer cross-attention K/V from encoder output (one

@@ -1,0 +1,561 @@
+"""Multi-stream batched STT serving: one shared Whisper engine for ALL
+connections.
+
+The per-connection plane dispatches every encoder/decoder call at B=1 and
+serializes concurrent utterances through a lock, so STT capacity scales as
+1/N while the MXU idles between tiny matvecs. This module is the STT
+analog of the brain's ContinuousBatcher (the WhisperFlow / WhisperPipe
+multi-stream framing): connections submit transcription work items and get
+futures back; a single worker coalesces each tick's pending items — one
+encoder dispatch per item (B=1: bitwise identical to transcribe, see
+``_encode_finals``) feeding ONE fixed-width ``(S, ...)`` decode dispatch.
+The decode loop is ``max_new`` SEQUENTIAL forwards, so that is where
+multiplexing pays: one chain of decode dispatches reads the Whisper
+decoder weights once per step for ALL streams instead of once per stream.
+
+Design:
+
+- **Slotted cross-KV pool** (``models.whisper.init_cross_kv_pool``): each
+  live utterance's incremental encoder state occupies one slot of a shared
+  ``(L, S, enc_positions, nh, hd)`` buffer; per-slot validity is a
+  host-side ``enc_len`` that becomes the decode's per-slot encoder mask.
+- **Work kinds** mirror the streaming events: ``partial`` (incremental
+  blocks into the slot, decode over the slot), ``spec_final`` / ``final``
+  (full-window re-encode, padded to ``enc_positions`` to mix ragged
+  buckets in one dispatch). Token identity with the B=1 path holds per
+  slot: the same ``_encode_block`` produces the KV, the same
+  ``_stt_decode_loop`` decodes it, and padding is masked to exact zeros.
+  The contract is enforced DIFFERENTIALLY (tests/test_stt_batch.py, fast
+  tier, every work kind) rather than assumed: batched forwards are only
+  empirically row-stable per backend — the CPU harness holds today, and
+  the on-chip run must re-verify before the batched plane is trusted
+  there.
+- **Priority & coalescing**: finals > spec_finals > partials, FIFO within
+  a class; a newer partial (or speculative final) for the same utterance
+  supersedes a stale queued one — only the freshest buffer matters.
+  ``stt.partials_coalesced`` counts the partial supersessions (the
+  coalescing win; spec supersessions and final-purged partials are just
+  dropped).
+- **Admission/shed** follows utils/resilience.py conventions: best-effort
+  work is bounded, not queued without limit. Partials past the pending cap
+  or beyond the slot pool shed with ``stt.shed_overload`` (the queue IS
+  the tail latency); finals are never shed — they carry the utterance.
+
+``BatchedStreamingSTT`` is the per-connection wrapper: identical host-side
+state machine as StreamingSTT (endpointer, buffering, speculation
+staleness, adaptive early close — it IS StreamingSTT, with only the four
+transcription hooks overridden), but every transcription is a batcher
+future. ``feed()`` stays synchronous (blocking only on finals — bench and
+executor-thread callers); ``feed_async()`` awaits the final's future so
+the voice service's event loop never parks an executor thread on a
+transcription.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.whisper import init_cross_kv_pool, init_self_cache, pad_cross_kv
+from ..utils.tracing import get_metrics as _metrics
+from .stt import (
+    SpeechEngine,
+    StreamingSTT,
+    TranscribeResult,
+    _append_cross_kv,
+    _stt_decode_loop,
+)
+
+# work-class priority: the utterance-carrying finals first, then the
+# speculative finals hiding inside the endpoint window, then best-effort
+# partials
+_PRIORITY = {"final": 0, "spec_final": 1, "partial": 2}
+
+# process-wide utterance keys: every (connection, utterance) gets a fresh
+# one, so a stale future resolving after the utterance closed can never be
+# attributed to the next utterance
+_UTT_IDS = itertools.count(1)
+
+
+def _resolve(fut: Future, value) -> None:
+    """set_result guarded against an already-settled future: feed_async's
+    wait_for CANCELS the wrapped future on timeout, and an unguarded
+    set_result would raise InvalidStateError in the worker — failing every
+    other connection's future in the same batch."""
+    if not fut.done():
+        try:
+            fut.set_result(value)
+        except Exception:  # raced a concurrent cancel between done() and set
+            pass
+
+
+@dataclass
+class _Work:
+    kind: str  # "partial" | "spec_final" | "final"
+    utt: int  # utterance key (rotates per utterance, unique per process)
+    buf: np.ndarray  # utterance audio so far (host copy, caller-owned)
+    future: Future
+    seq: int  # FIFO tiebreak within a priority class
+
+
+@dataclass
+class _SlotState:
+    """Host-side incremental accounting for one pool slot — the fields of
+    serve.stt.IncrementalState minus the KV arrays (those live in the
+    shared pool)."""
+
+    utt: int
+    enc_len: int = 0
+    consumed_frames: int = 0
+    anchor_frames: int = 0
+
+
+class STTBatcher:
+    """Coalesces all connections' STT work onto one shared SpeechEngine.
+
+    Synchronous core (submit/tick); a daemon worker thread drives ticks
+    whenever work is pending. Thread-safe submit/release; pool state is
+    only ever touched by the worker (or by tick() in tests with
+    ``autostart=False``).
+    """
+
+    def __init__(self, engine: SpeechEngine, slots: int = 4,
+                 max_pending: int | None = None, autostart: bool = True):
+        if slots < 1:
+            raise ValueError("need at least one batch slot")
+        self.engine = engine
+        self.S = slots
+        self.pool = init_cross_kv_pool(engine.cfg, slots, engine._param_dtype)
+        self.slot_of: dict[int, int] = {}  # utt -> slot index
+        self.slot_state: list[_SlotState | None] = [None] * slots
+        # bounded best-effort queue (resilience convention: shed, don't
+        # queue unboundedly — a partial sitting behind S others is stale
+        # by the time it decodes anyway)
+        self.max_pending = max_pending if max_pending is not None else 4 * slots
+        self.queue: list[_Work] = []
+        self._wake = threading.Condition()
+        self._seq = 0
+        self._stop = False
+        self._busy = False
+        self.ticks = 0
+        # one blank decode row for dead slots (reused, never written)
+        L, nh, hd = engine.cfg.dec_layers, engine.cfg.n_heads, engine.cfg.head_dim
+        self._blank_row = jnp.zeros(
+            (L, 1, engine.cfg.enc_positions, nh, hd), engine._param_dtype)
+        _metrics().set_gauge("stt.batch_slots", float(slots))
+        self._thread: threading.Thread | None = None
+        if autostart:
+            self._thread = threading.Thread(
+                target=self._worker, name="stt-batcher", daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, kind: str, utt: int, buf: np.ndarray) -> Future:
+        """Enqueue one transcription work item; the future resolves to a
+        TranscribeResult (or None when the item was superseded / shed /
+        carried no complete block yet)."""
+        if kind not in _PRIORITY:
+            raise ValueError(f"unknown STT work kind {kind!r}")
+        fut: Future = Future()
+        with self._wake:
+            if kind != "final":
+                # a newer buffer for the same (kind, utterance) supersedes
+                # the queued one — decoding the stale prefix would waste a
+                # batch row on an answer nobody wants
+                for w in self.queue:
+                    if w.kind == kind and w.utt == utt:
+                        self.queue.remove(w)
+                        _resolve(w.future, None)
+                        if kind == "partial":
+                            _metrics().inc("stt.partials_coalesced")
+                        break
+            if kind == "partial":
+                # admission control AT SUBMIT, under the same lock release()
+                # runs under: bounded queue, and the slot is reserved here —
+                # never from the worker, so an utterance released while its
+                # partial is in flight can never re-acquire (and leak) a
+                # slot. Finals are always admitted — they carry the
+                # utterance and need no slot.
+                if len(self.queue) >= self.max_pending or (
+                        utt not in self.slot_of
+                        and self._alloc_slot_locked(utt, buf) is None):
+                    _metrics().inc("stt.shed_overload")
+                    _resolve(fut, None)
+                    return fut
+            if kind == "final":
+                # the utterance is closing: queued partials for it are moot
+                # (dropped, NOT counted as coalesced — nothing superseded
+                # them with a newer buffer, the utterance simply ended)
+                for w in list(self.queue):
+                    if w.kind == "partial" and w.utt == utt:
+                        self.queue.remove(w)
+                        _resolve(w.future, None)
+            self.queue.append(_Work(kind, utt, buf, fut, self._seq))
+            self._seq += 1
+            _metrics().set_gauge("stt.queue_depth", float(len(self.queue)))
+            self._wake.notify()
+        return fut
+
+    def release(self, utt: int) -> None:
+        """The utterance closed (final delivered / reset / disconnect):
+        free its pool slot and drop its queued best-effort work. Queued
+        finals/spec_finals survive — they carry their own audio."""
+        with self._wake:
+            s = self.slot_of.pop(utt, None)
+            if s is not None:
+                self.slot_state[s] = None
+            for w in list(self.queue):
+                if w.kind == "partial" and w.utt == utt:
+                    self.queue.remove(w)
+                    _resolve(w.future, None)
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Block until every queued item has been processed (benches and
+        shutdown hygiene — a throughput claim must include the work still
+        in flight). True when quiescent, False on timeout."""
+        deadline = time.perf_counter() + timeout_s
+        with self._wake:
+            while self.queue or self._busy:
+                if time.perf_counter() >= deadline:
+                    return False
+                self._wake.wait(timeout=0.02)
+        return True
+
+    def stop(self) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------ worker
+
+    def _worker(self) -> None:
+        while True:
+            with self._wake:
+                while not self.queue and not self._stop:
+                    self._wake.wait()
+                if self._stop:
+                    for w in self.queue:
+                        _resolve(w.future, None)
+                    self.queue.clear()
+                    return
+                batch = self._take_batch_locked()
+                self._busy = True
+            try:
+                self._process(batch)
+            except Exception as e:  # pragma: no cover - engine fault path
+                # per-batch isolation: a device fault fails this batch's
+                # futures, not the worker (the next tick gets a fresh try)
+                for w in batch:
+                    if not w.future.done():
+                        try:
+                            w.future.set_exception(e)
+                        except Exception:
+                            pass  # raced a concurrent cancel
+            finally:
+                with self._wake:
+                    self._busy = False
+                    self._wake.notify_all()
+
+    def tick(self) -> int:
+        """Process ONE batch synchronously (tests and manual driving with
+        ``autostart=False``). Returns the number of items taken."""
+        with self._wake:
+            batch = self._take_batch_locked()
+        if batch:
+            self._process(batch)
+        return len(batch)
+
+    def _take_batch_locked(self) -> list[_Work]:
+        self.queue.sort(key=lambda w: (_PRIORITY[w.kind], w.seq))
+        batch, self.queue = self.queue[: self.S], self.queue[self.S:]
+        _metrics().set_gauge("stt.queue_depth", float(len(self.queue)))
+        return batch
+
+    # ----------------------------------------------------------- process
+
+    def _alloc_slot_locked(self, utt: int, buf: np.ndarray) -> _SlotState | None:
+        """Reserve a pool slot for a new utterance (submit-side, caller
+        holds the lock). The anchor rule is SpeechEngine.anchor_for — the
+        same one incremental_init applies at the B=1 first partial."""
+        for s, st in enumerate(self.slot_state):
+            if st is None:
+                anchor = self.engine.anchor_for(len(buf) // self.engine.mel_cfg.hop)
+                st = _SlotState(utt, enc_len=0, consumed_frames=anchor,
+                                anchor_frames=anchor)
+                self.slot_state[s] = st
+                self.slot_of[utt] = s
+                return st
+        return None
+
+    def _feed_slot(self, s: int, st: _SlotState, buf: np.ndarray) -> None:
+        """SpeechEngine.incremental_feed, retargeted at pool slot ``s`` —
+        same block encoder, same anchor/re-anchor rules, so the slot's KV is
+        value-identical to a per-connection IncrementalState fed the same
+        audio. Re-anchoring just resets the host accounting: stale pool
+        positions beyond the new enc_len are masked, never read."""
+        eng = self.engine
+        hop = eng.mel_cfg.hop
+        step = eng.INC_STEP
+        total = len(buf) // hop
+        while total - st.consumed_frames >= step:
+            if st.enc_len + step // 2 > eng.cfg.enc_positions:
+                anchor = eng.anchor_for(total)  # same re-anchor rule as B=1
+                st.enc_len, st.consumed_frames, st.anchor_frames = 0, anchor, anchor
+                continue
+            new_k, new_v, keep = eng._encode_block(buf, st.anchor_frames,
+                                                   st.consumed_frames)
+            self.pool["k"], self.pool["v"] = _append_cross_kv(
+                self.pool["k"], self.pool["v"], new_k, new_v,
+                jnp.int32(st.enc_len), jnp.int32(s))
+            st.enc_len += keep
+            st.consumed_frames += step
+
+    def _encode_finals(self, works: list[_Work]) -> dict[int, tuple]:
+        """Full-window encode for final/spec_final items. Each item runs
+        through SpeechEngine._encode_window — ONE B=1 dispatch per item,
+        exactly transcribe's lowering. Deliberately NOT a (B, T) batched
+        encoder forward: batched encodes are not bitwise row-stable on
+        every backend (bf16 activations, shape-dependent gemm
+        partitioning), and token identity with the B=1 path is the
+        contract. The encode is one dispatch per item either way; the
+        batching win is the decode loop's max_new SEQUENTIAL dispatches,
+        which _process amortizes across all slots. Returns
+        work-id -> (cross_kv_row, valid_frames, n_frames)."""
+        eng = self.engine
+        out: dict[int, tuple] = {}
+        for w in works:
+            cross_kv, _, n_frames = eng._encode_window(w.buf)
+            row = pad_cross_kv(cross_kv, eng.cfg.enc_positions)
+            out[id(w)] = (row, max(1, n_frames // 2), n_frames)
+        return out
+
+    def _process(self, batch: list[_Work]) -> None:
+        eng = self.engine
+        finals = [w for w in batch if w.kind != "partial"]
+        partials = [w for w in batch if w.kind == "partial"]
+
+        # encode phase: incremental blocks into pool slots; full windows
+        # batched by bucket
+        rows: list[tuple[_Work, dict | int, int, int]] = []  # (w, src, valid, n_frames)
+        for w in partials:
+            with self._wake:
+                # slots are reserved at submit and freed by release(), both
+                # under this lock; the worker only LOOKS UP. A miss means
+                # the utterance closed while this item was in flight — drop
+                # it (never re-allocate: that would leak the slot forever,
+                # since the closed utterance's id can never release again).
+                s = self.slot_of.get(w.utt)
+                st = self.slot_state[s] if s is not None else None
+            if st is None or st.utt != w.utt:
+                _resolve(w.future, None)
+                continue
+            self._feed_slot(s, st, w.buf)
+            if st.enc_len <= 0:
+                # no complete block yet — same as the B=1 path emitting no
+                # partial before the first INC_STEP block lands
+                _resolve(w.future, None)
+                continue
+            rows.append((w, s, st.enc_len, st.consumed_frames))
+        # finals' encode timed apart from the partial feeds, and reported
+        # per item (the tick-level wall divided across the finals it
+        # covered) so per-utterance stage splits stay comparable to the
+        # B=1 plane's per-item encode_ms
+        t_enc = time.perf_counter()
+        enc_results = self._encode_finals(finals) if finals else {}
+        encode_ms = ((time.perf_counter() - t_enc) * 1e3 / len(finals)
+                     if finals else 0.0)
+        for w in finals:
+            row, valid, n_frames = enc_results[id(w)]
+            rows.append((w, row, valid, n_frames))
+
+        if not rows:
+            return
+        # decode phase: ONE (S, ...) dispatch over every live row
+        t1 = time.perf_counter()
+        ks, vs, valid_h = [], [], np.zeros((self.S,), np.int32)
+        for i, (w, src, valid, _) in enumerate(rows):
+            if isinstance(src, int):  # pool slot
+                ks.append(jax.lax.dynamic_slice_in_dim(self.pool["k"], src, 1, axis=1))
+                vs.append(jax.lax.dynamic_slice_in_dim(self.pool["v"], src, 1, axis=1))
+            else:
+                ks.append(src["k"])
+                vs.append(src["v"])
+            valid_h[i] = valid
+        while len(ks) < self.S:
+            ks.append(self._blank_row)
+            vs.append(self._blank_row)
+        cross_kv = {"k": jnp.concatenate(ks, axis=1), "v": jnp.concatenate(vs, axis=1)}
+        enc_mask = jnp.asarray(
+            np.arange(eng.cfg.enc_positions)[None, :] < valid_h[:, None])
+        live = jnp.asarray(np.arange(self.S) < len(rows))
+        cache = init_self_cache(eng.cfg, self.S, dtype=eng._param_dtype)
+        bos = jnp.broadcast_to(
+            jnp.asarray(list(eng.bos_ids), dtype=jnp.int32)[None, :],
+            (self.S, len(eng.bos_ids)))
+        out, n, _ = _stt_decode_loop(
+            eng.params, eng.cfg, cache, cross_kv, enc_mask, bos, eng.suppress,
+            live=live, max_new=eng.max_new_tokens, eos_id=eng.eos_id,
+            pad_id=eng.pad_id, attn_impl=eng.kernels,
+        )
+        out_h, n_h = jax.device_get((out, n))
+        out_h, n_h = np.asarray(out_h), np.asarray(n_h)
+        decode_ms = (time.perf_counter() - t1) * 1e3
+
+        m = _metrics()
+        self.ticks += 1
+        m.inc("stt.batch_ticks")
+        m.set_gauge("stt.batch_occupancy", len(rows) / self.S)
+        if finals:
+            m.inc("stt.finals_batched", float(len(finals)))
+        for i, (w, _, _, n_frames) in enumerate(rows):
+            ids = [int(t) for t in out_h[i, : int(n_h[i])]]
+            _resolve(w.future, TranscribeResult(
+                text=eng.tokenizer.decode(ids).strip(),
+                encode_ms=encode_ms if w.kind != "partial" else 0.0,
+                decode_ms=decode_ms,
+                n_frames=n_frames,
+            ))
+
+
+class BatchedStreamingSTT(StreamingSTT):
+    """StreamingSTT whose transcription hooks route through a shared
+    STTBatcher: identical host-side utterance state machine, but partials
+    and speculative finals are fire-and-forget futures (delivered by a
+    later feed once decoded — they never stall audio ingest) and finals
+    either block (`feed`, for thread callers) or are awaited
+    (`feed_async`, for the voice service's event loop)."""
+
+    def __init__(self, engine: SpeechEngine, batcher: STTBatcher,
+                 result_timeout_s: float = 30.0, **kw):
+        super().__init__(engine, **kw)
+        self.batcher = batcher
+        self.result_timeout_s = result_timeout_s
+        self._utt = next(_UTT_IDS)
+        self._ready: collections.deque = collections.deque()
+        self._spec_future: tuple[int, int, Future] | None = None
+        self._pending_final: tuple[Future | None, TranscribeResult | None] | None = None
+        self._defer_final = False
+
+    # ------------------------------------------------- hook overrides
+
+    def _start_speculation(self, spoken: int, events: list) -> None:
+        self._spec_final = None
+        self._spec_at_speech = spoken
+        fut = self.batcher.submit("spec_final", self._utt, self._buf.copy())
+        self._spec_future = (spoken, self._utt, fut)
+
+        def _cb(f, utt=self._utt, spoken=spoken):
+            try:
+                res = f.result()
+            except Exception:
+                res = None
+            self._ready.append(("spec", utt, spoken, res))
+
+        fut.add_done_callback(_cb)
+
+    def _emit_partial(self, events: list) -> None:
+        fut = self.batcher.submit("partial", self._utt, self._buf.copy())
+
+        def _cb(f, utt=self._utt):
+            try:
+                res = f.result()
+            except Exception:
+                res = None
+            self._ready.append(("partial", utt, res))
+
+        fut.add_done_callback(_cb)
+
+    def _drain_ready(self, events: list) -> None:
+        while self._ready:
+            item = self._ready.popleft()
+            if item[0] == "spec":
+                _, utt, spoken, res = item
+                if utt != self._utt or res is None:
+                    continue
+                if self._spec_at_speech != spoken:
+                    continue  # a newer speculation superseded this one
+                self._spec_final = res
+                # emit the hint only while the content is still frozen —
+                # resumed speech makes it useless to the consumer
+                if res.text and self.endpointer.total_speech_frames == spoken:
+                    events.append(("spec_final", res.text))
+            else:
+                _, utt, res = item
+                if utt == self._utt and res is not None and res.text:
+                    events.append(("partial", res.text))
+
+    def _final_result(self, fresh: bool, spoken: int) -> TranscribeResult | None:
+        fut: Future | None = None
+        res: TranscribeResult | None = None
+        if fresh:
+            res = self._spec_final  # exact, already delivered
+        else:
+            sf = self._spec_future
+            if sf is not None and sf[0] == spoken and sf[1] == self._utt:
+                fut = sf[2]  # in flight for exactly this frozen content
+            else:
+                fut = self.batcher.submit("final", self._utt, self._buf.copy())
+        self._spec_future = None
+        if self._defer_final:
+            self._pending_final = (fut, res)
+            return None
+        if fut is not None:
+            # engine faults / timeouts PROPAGATE (the worker set them as
+            # the future's exception): the base plane raises out of feed()
+            # and the voice handler surfaces a warn — swallowing here would
+            # make the utterance vanish without any signal. None only means
+            # the batcher was stopped mid-teardown.
+            res = fut.result(timeout=self.result_timeout_s)
+        return res if res is not None else TranscribeResult("", 0.0, 0.0, 0)
+
+    def _utterance_closed(self) -> None:
+        self.batcher.release(self._utt)
+        self._utt = next(_UTT_IDS)
+        self._spec_future = None
+
+    # ---------------------------------------------------- public surface
+
+    def reset(self) -> None:
+        super().reset()
+        self.batcher.release(self._utt)
+        self._utt = next(_UTT_IDS)
+        self._spec_future = None
+        self._pending_final = None
+        self._ready.clear()
+
+    def close(self) -> None:
+        """Connection teardown: free server-side state."""
+        self.batcher.release(self._utt)
+
+    async def feed_async(self, samples: np.ndarray) -> list[tuple[str, str]]:
+        """Event-loop-native feed: the host-side state machine runs inline
+        (cheap numpy), transcription futures are awaited — no executor
+        thread ever blocks on a model call."""
+        self._defer_final = True
+        try:
+            events = self.feed(samples)
+        finally:
+            self._defer_final = False
+        pending, self._pending_final = self._pending_final, None
+        if pending is not None:
+            fut, res = pending
+            if fut is not None:
+                # same contract as the sync path: failures propagate (the
+                # voice handler warns), they do not silently eat the final
+                res = await asyncio.wait_for(
+                    asyncio.wrap_future(fut), timeout=self.result_timeout_s)
+            if res is not None and res.text:
+                events.append(("final", res.text))
+        return events

@@ -114,6 +114,27 @@ def stt_factory_from_env():
         spec_ms = int(os.environ.get("VOICE_SPEC_SILENCE_MS", "120"))
         early_ms = float(os.environ.get("VOICE_EARLY_CLOSE_MS", "240"))
 
+        def make_endpointer():
+            return EnergyEndpointer(sample_rate=engine.mel_cfg.sample_rate,
+                                    spec_silence_ms=spec_ms)
+
+        # multi-stream batched serving plane (STT_BATCH_ENABLE=1): ONE
+        # process-wide engine + batcher multiplexes every connection's
+        # transcription work into batched dispatches (docs/PERF.md
+        # "Multi-stream STT batching"); STT_BATCH_SLOTS bounds concurrent
+        # decode width. Unset keeps the historical per-connection path
+        # (shared engine, one lock, B=1 dispatches) byte-identical.
+        if os.environ.get("STT_BATCH_ENABLE", "") == "1":
+            from ..serve.stt_batch import BatchedStreamingSTT, STTBatcher
+
+            slots = int(os.environ.get("STT_BATCH_SLOTS", "4"))
+            batcher = STTBatcher(engine, slots=slots)
+            return lambda: BatchedStreamingSTT(
+                engine, batcher,
+                endpointer=make_endpointer(),
+                early_close_ms=early_ms if early_ms > 0 else None,
+            )
+
         class LockedStreaming(StreamingSTT):
             def feed(self, samples):
                 with lock:
@@ -121,9 +142,7 @@ def stt_factory_from_env():
 
         return lambda: LockedStreaming(
             engine,
-            endpointer=EnergyEndpointer(
-                sample_rate=engine.mel_cfg.sample_rate,
-                spec_silence_ms=spec_ms),
+            endpointer=make_endpointer(),
             early_close_ms=early_ms if early_ms > 0 else None,
         )
     raise ValueError(f"unknown VOICE_STT {spec!r}")
@@ -532,8 +551,17 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                         t_feed0 = time.perf_counter()
                         try:
                             samples = pcm16_to_float(msg.data)
-                            # STT may run a model; keep the event loop responsive
-                            events = await loop.run_in_executor(None, state.stt.feed, samples)
+                            # batched STT plane: host-side feed runs inline
+                            # and transcriptions are awaited batcher futures
+                            # (no executor thread parks on a model call);
+                            # otherwise STT may run a model inline — keep
+                            # the event loop responsive via the executor
+                            afeed = getattr(state.stt, "feed_async", None)
+                            if afeed is not None:
+                                events = await afeed(samples)
+                            else:
+                                events = await loop.run_in_executor(
+                                    None, state.stt.feed, samples)
                         except Exception as e:
                             # a truncated PCM packet must not kill the session
                             await send(ws, "warn", message=f"bad audio frame: {e}")
@@ -624,6 +652,9 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                         break
             finally:
                 state.drop_spec()
+                closer = getattr(state.stt, "close", None)
+                if closer is not None:
+                    closer()  # batched plane: free the utterance's slot
         return ws
 
     async def index(_req: web.Request) -> web.FileResponse:
