@@ -1,0 +1,241 @@
+"""Radix KV session-cache bench (serve.radix): multi-turn prefill collapse.
+
+The workload is the session-aware brain's serving shape: S sessions of T
+turns each, where turn N's prompt is the literal turn N-1 prompt ids + the
+generated ids + a new user/assistant frame (services.brain
+SessionTranscripts). Measured per turn index, radix-warm engine vs the
+identical radix-off (cold) engine:
+
+- ``radix_turn<k>_prefill_ms_{cold,warm}`` — mean computed-prefill per turn
+- ``radix_turn2_prefill_speedup``          — cold/warm at turn 2 (the
+  acceptance bar: >= 3x — the turn-2 suffix collapses from the whole first
+  exchange to the new utterance)
+- ``radix_hit_rate`` / ``radix_cached_tokens_per_turn``
+- ``radix_evictions_tight_pool``           — eviction churn when the same
+  workload runs against a deliberately undersized pool (the LRU leaves
+  absorb the pressure; identity is the test suite's job, churn is ours)
+
+Outputs are asserted token-identical between the two engines while
+measuring — a wrong-but-fast radix plane must fail the bench, not win it.
+
+Writes ``bench_artifacts/BENCH_radix_<ts>.json`` with every row plus a
+``radix`` section merged into run_all's combined artifact.
+
+Knobs: BENCH_RADIX_SESSIONS (default 4), BENCH_RADIX_TURNS (default 4),
+BENCH_RADIX_TOKENS (default 48), BENCH_RADIX_BLOCK (default 64 — finer
+blocks match more of short per-turn deltas).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log  # noqa: E402
+
+
+def _sessions(n: int, turns: int, offset: int = 0) -> list[list[tuple[str, dict]]]:
+    """n distinct multi-turn sessions over the golden-utterance vocabulary
+    (texts vary per session so chains diverge past the static prefix;
+    ``offset`` keeps the compile-warmup sessions' texts disjoint from the
+    measured ones, so warm numbers are radix wins, not replay wins)."""
+    base = [
+        "search for {q}",
+        "open the second result and summarize it for me please",
+        "sort these by price from low to high",
+        "filter results under {n} dollars and extract the table",
+        "take a screenshot of this page",
+        "extract the product names and prices as a table",
+    ]
+    topics = ["wireless headphones", "4k monitors", "standing desks",
+              "mechanical keyboards", "usb microphones", "laptop stands",
+              "ergonomic chairs", "hiking boots", "garden tools",
+              "espresso machines"]
+    out = []
+    for s in range(n):
+        topic = topics[(s + offset) % len(topics)]
+        ctx: dict = {}
+        sess = []
+        for t in range(turns):
+            text = base[t % len(base)].format(q=topic, n=100 + 50 * s)
+            sess.append((text, dict(ctx)))
+            ctx["last_query"] = topic
+        out.append(sess)
+    return out
+
+
+def main() -> None:
+    from tpu_voice_agent.serve import PagedDecodeEngine
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+    from tpu_voice_agent.services.brain import (
+        SessionTranscripts,
+        install_prompt_prefix,
+    )
+    from tpu_voice_agent.services.prompts import render_prompt
+
+    n_sessions = int(os.environ.get("BENCH_RADIX_SESSIONS", "4"))
+    n_turns = int(os.environ.get("BENCH_RADIX_TURNS", "4"))
+    max_new = int(os.environ.get("BENCH_RADIX_TOKENS", "160"))
+    block = int(os.environ.get("BENCH_RADIX_BLOCK", "32"))
+    buckets = (128, 256, 512, 1024, 2048)
+
+    def mk(radix: bool, pool: int | None = None):
+        eng = PagedDecodeEngine(
+            preset="test-tiny", max_len=2048, batch_slots=2,
+            prefill_buckets=buckets, block_size=block,
+            radix_enable=radix, pool_blocks=pool)
+        install_prompt_prefix(eng)
+        return eng
+
+    log(f"radix bench: {n_sessions} sessions x {n_turns} turns, "
+        f"max_new={max_new}, block_size={block}")
+    cold_eng, warm_eng = mk(False), mk(True)
+    tok = cold_eng.tokenizer
+
+    import jax
+
+    def play(eng, sessions, record=None):
+        """Run every session through ``eng`` sequentially (turn N+1 depends
+        on turn N's output). With ``record``, each turn's admission is also
+        timed SYNCHRONOUSLY (prefill_slot + block_until_ready at the LIVE
+        tree state, best of 2 — the engine's own prefill_ms is dispatch-
+        side by design and hides device compute); record[k] collects
+        (prefill_ms, cached_tokens) per turn index."""
+        outs = []
+        for sess in sessions:
+            hist = None
+            sess_out = []
+            for k, (text, ctx) in enumerate(sess):
+                if hist is None:
+                    ids = tok.encode(render_prompt(text, ctx), bos=True)
+                else:
+                    user = SessionTranscripts.user_frame(text, ctx)
+                    ids = hist + tok.encode(
+                        f"\n<|user|>\n{user}\n<|assistant|>\n", bos=False)
+                if record is not None:
+                    # pipelined admission timing: K back-to-back
+                    # prefill_slot dispatches with ONE final sync — host
+                    # dispatch overlaps device compute exactly like the
+                    # scheduler's async admission path, so the number is
+                    # per-admission cost, not per-sync round-trip floor
+                    # (the engine's own prefill_ms is dispatch-side only
+                    # and hides device compute entirely). Best of 2 passes.
+                    K = 8
+                    best = float("inf")
+                    for _ in range(2):
+                        t0 = time.perf_counter()
+                        for _ in range(K):
+                            logits = eng.prefill_slot(ids, 0)
+                            eng.release_slot(0)  # no generated_ids: no insert
+                        jax.block_until_ready(logits)
+                        best = min(best,
+                                   (time.perf_counter() - t0) * 1e3 / K)
+                    record.setdefault(k, []).append(
+                        (best, int(getattr(eng, "_last_cached_tokens", 0))))
+                r = ContinuousBatcher(
+                    eng, chunk_steps=16,
+                    max_new_tokens=max_new).generate_many([ids])[0]
+                if r.error:
+                    log(f"request failed: {r.error}")
+                    sys.exit(1)
+                sess_out.append(r.token_ids)
+                hist = ids + r.token_ids
+            outs.append(sess_out)
+        return outs
+
+    # compile warmup: two throwaway sessions on each engine cover the
+    # prefill-bucket/gather shapes, so the timed pass measures work, not
+    # XLA — warmup topics are DISJOINT from the measured ones (offset), so
+    # measured warm turns win via radix session reuse, never via replaying
+    # an already-cached identical prompt
+    warm_sess = _sessions(2, n_turns, offset=8)
+    play(cold_eng, warm_sess)
+    play(warm_eng, warm_sess)
+
+    sessions = _sessions(n_sessions, n_turns)
+    cold_rec: dict[int, list] = {}
+    warm_rec: dict[int, list] = {}
+    t0 = time.perf_counter()
+    cold_out = play(cold_eng, sessions, cold_rec)
+    t_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm_out = play(warm_eng, sessions, warm_rec)
+    t_warm = time.perf_counter() - t0
+
+    # correctness gate: a wrong radix plane must not "win" the bench
+    if cold_out != warm_out:
+        log("TOKEN MISMATCH between radix-off and radix-on engines")
+        sys.exit(1)
+
+    rows = []
+
+    def row(metric, value, unit, vs=None):
+        emit(metric, value, unit, vs)
+        rows.append({"metric": metric, "value": round(value, 3), "unit": unit})
+
+    mean = lambda xs: sum(xs) / len(xs)
+    for k in range(n_turns):
+        c = mean([p for p, _ in cold_rec[k]])
+        w = mean([p for p, _ in warm_rec[k]])
+        row(f"radix_turn{k + 1}_prefill_ms_cold", c, "ms")
+        row(f"radix_turn{k + 1}_prefill_ms_warm", w, "ms")
+    c2 = mean([p for p, _ in cold_rec[1]])
+    w2 = mean([p for p, _ in warm_rec[1]])
+    row("radix_turn2_prefill_speedup", c2 / w2 if w2 > 0 else float("inf"), "x")
+    cold2p = mean([p for k in range(1, n_turns) for p, _ in cold_rec[k]])
+    warm2p = mean([p for k in range(1, n_turns) for p, _ in warm_rec[k]])
+    speedup = cold2p / warm2p if warm2p > 0 else float("inf")
+    # the acceptance bar: warm-turn (2+) computed prefill >= 3x cheaper —
+    # cold admissions re-prefill the whole accumulated exchange history
+    # past the static prefix, warm ones only the new utterance's frame
+    row("radix_turn2plus_prefill_speedup", speedup, "x", vs=speedup / 3.0)
+    cached = mean([c for k in range(1, n_turns) for _, c in warm_rec[k]])
+    row("radix_cached_tokens_per_warm_turn", cached, "tokens")
+    hit_rate = (sum(t.hits for t in warm_eng.radix)
+                / max(1, sum(t.lookups for t in warm_eng.radix)))
+    row("radix_hit_rate", hit_rate, "ratio")
+    row("radix_nodes", float(sum(t.nodes for t in warm_eng.radix)), "nodes")
+    row("radix_wall_cold_s", t_cold, "s")
+    row("radix_wall_warm_s", t_warm, "s")
+
+    # eviction churn under a deliberately undersized pool: prefix blocks +
+    # barely two live admissions — session chains must rotate through LRU
+    # eviction without failing a single request
+    need = -(-len(cold_eng.prefix_ids) // block)  # prefix full+tail blocks
+    tight = mk(True, pool=need + 8)
+    play(tight, _sessions(max(2, n_sessions // 2), min(3, n_turns)))
+    evictions = float(sum(t.evictions for t in tight.radix))
+    row("radix_evictions_tight_pool", evictions, "evictions")
+
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    art = art_dir / f"BENCH_radix_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_radix",
+        "config": {"sessions": n_sessions, "turns": n_turns,
+                   "max_new_tokens": max_new, "block_size": block},
+        "rows": rows,
+        "radix": {
+            "turn2plus_prefill_speedup": round(speedup, 3),
+            "turn2_prefill_speedup": round(c2 / w2 if w2 > 0 else 0.0, 3),
+            "hit_rate": round(hit_rate, 4),
+            "cached_tokens_per_warm_turn": round(cached, 1),
+            "evictions_tight_pool": evictions,
+            "nodes": sum(t.nodes for t in warm_eng.radix),
+            "token_identical": True,
+        },
+    }, indent=1))
+    log(f"artifact: {art}")
+    if speedup < 3.0:
+        log(f"FAIL: turn-2+ prefill speedup {speedup:.2f}x < 3x bar")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,16 +21,20 @@ import sys
 from pathlib import Path
 
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
-           "bench_quality.py", "bench_faults.py", "bench_spec.py"]
+           "bench_quality.py", "bench_faults.py", "bench_spec.py",
+           "bench_radix.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
 # the spec bench stays at a reduced utterance/token budget — tiny model,
 # and the accept-rate verdict belongs in every quick artifact; the STT
 # bench stays at trimmed stream counts/seconds so the multi-stream
-# capacity number lands in every combined artifact)
+# capacity number lands in every combined artifact; the radix bench runs
+# UNTRIMMED — the tiny model makes its full 4-session x 4-turn workload
+# ~30 s on CPU, and the turn-2+ prefill-collapse verdict is a mean over
+# warm turns whose margin a smaller sample would wobble across the bar)
 QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
-                 "bench_stt.py"]
+                 "bench_stt.py", "bench_radix.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_STT_SECONDS": "4", "BENCH_STT_STREAMS": "1,4"}
@@ -106,7 +110,7 @@ def main() -> None:
             if body.get("bench") == name.removesuffix(".py"):
                 entry["artifact"] = art.name
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
-                            "spec", "stt"):
+                            "spec", "stt", "radix"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -103,6 +103,37 @@ def test_parser_422_and_500_envelopes():
         assert r.status_code == 500 and r.json()["error"] == "llm_error"
 
 
+class _NotingParser:
+    """Engine-backend stand-in: deposits the decode split as stage notes
+    on the worker thread, like _result_to_response does."""
+
+    def parse(self, text, context):
+        from tpu_voice_agent.utils.tracing import note_stage
+
+        note_stage("prefill_ms", 12.5)
+        note_stage("decode_ms", 80.25)
+        note_stage("cached_tokens", 896)
+        return RuleBasedParser().parse(text, context)
+
+
+def test_decode_split_rides_response_headers():
+    """The prefill/decode/cached-tokens split reaches the caller as
+    x-* headers (the voice service folds them into the latency HUD's
+    stage breakdown); parsers without notes emit none."""
+    with AppServer(build_app(_NotingParser())) as srv:
+        r = httpx.post(srv.url + "/parse",
+                       json={"text": "search for ants", "context": {}})
+        assert r.status_code == 200
+        assert r.headers["x-prefill-ms"] == "12.5"
+        assert r.headers["x-decode-ms"] == "80.25"
+        assert r.headers["x-cached-tokens"] == "896"
+    with AppServer(build_app(RuleBasedParser())) as srv:
+        r = httpx.post(srv.url + "/parse",
+                       json={"text": "search for ants", "context": {}})
+        assert r.status_code == 200
+        assert "x-prefill-ms" not in r.headers
+
+
 def test_concurrent_parses_do_not_interleave(rule_server):
     """Racing requests share one parser; the serialization lock must keep
     each response self-consistent."""

@@ -37,6 +37,15 @@ _PLACEHOLDER = re.compile(r"\{[^{}]*\}")
 # values; a refactor that quietly turns them back into last-writer-wins
 # per-instance writes must at minimum keep the names alive here.
 PINNED: dict[str, str] = {
+    # radix KV reuse plane (serve/radix.py, docs/PERF.md "Session KV
+    # reuse"): hit_rate/nodes are scheduler-exported gauges, the counters
+    # increment at match/evict time; kv_blocks_shared is the dedup signal
+    # (blocks stored once, referenced by several owners)
+    "radix.hit_rate": "gauge",
+    "radix.cached_tokens": "counter",
+    "radix.evictions": "counter",
+    "radix.nodes": "gauge",
+    "paged.kv_blocks_shared": "gauge",
     "stt.feed_lag_s": "gauge",
     "stt.buffered_audio_s": "gauge",
     "stt.batch_occupancy": "gauge",

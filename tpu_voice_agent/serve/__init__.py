@@ -3,6 +3,7 @@ from .engine import DecodeEngine, GenerationResult
 from .grounding import GroundingEngine, GroundingResult
 from .paged import BlockAllocator, PagedDecodeEngine
 from .planner import LongSessionPlanner, PlannerSession
+from .radix import RadixCache
 from .pp_engine import PPDecodeEngine
 from .scheduler import ContinuousBatcher
 from .spec import (
@@ -30,6 +31,7 @@ __all__ = [
     "PagedDecodeEngine",
     "PPDecodeEngine",
     "PlannerSession",
+    "RadixCache",
     "PromptLookupDrafter",
     "SpecConfig",
     "SpecDecoder",

@@ -49,6 +49,10 @@ class GenerationResult:
     forwards: int = 0  # decode forward dispatches (< steps under grammar
     # fast-forward / speculative decoding, where one forward emits several
     # accepted tokens)
+    cached_tokens: int = 0  # prompt tokens served from cached KV at
+    # admission (static prefix cache or radix chain hit) — prefill_ms
+    # covers only the COMPUTED suffix, so the two together describe the
+    # admission honestly (conflating them was the old prefill_ms bug)
 
     @property
     def tokens_per_s(self) -> float:
@@ -756,15 +760,24 @@ class DecodeEngine:
             tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
             tokens[0, :m] = suffix
             positions = (P + np.arange(bucket, dtype=np.int32))[None, :]
+            t0 = time.perf_counter()
             logits = self._prefill_suffix(
                 jnp.asarray(tokens), jnp.asarray(positions), slot, P, bucket, n)
+            # the prefill split (scheduler/_result_to_response read it):
+            # compute ms covers ONLY the suffix forward dispatch — the
+            # cached prefix contributes tokens, not compute
+            self._last_prefill_compute_ms = (time.perf_counter() - t0) * 1e3
+            self._last_cached_tokens = P
             return logits[:, m - 1, :]
         bucket = self._bucket(n)
         tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
         tokens[0, :n] = ids
         positions = np.arange(bucket, dtype=np.int32)[None, :]
+        t0 = time.perf_counter()
         logits = self._prefill_full(
             jnp.asarray(tokens), jnp.asarray(positions), slot, bucket, n)
+        self._last_prefill_compute_ms = (time.perf_counter() - t0) * 1e3
+        self._last_cached_tokens = 0
         return logits[:, n - 1, :]
 
     def _prefill_suffix(self, tokens, positions, slot: int, P: int, bucket: int,
@@ -822,9 +835,11 @@ class DecodeEngine:
         self._last_fwds = fwds
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
-    def release_slot(self, slot: int) -> None:
+    def release_slot(self, slot: int, generated_ids: list[int] | None = None) -> None:
         """A batch slot finished: dense cache rows are simply reused in
-        place (the paged engine returns the slot's blocks to the pool)."""
+        place (the paged engine returns the slot's blocks to the pool —
+        and, with radix reuse on, adopts the prompt+generated chain the
+        scheduler passes via ``generated_ids`` into its tree first)."""
         if self.spec is not None:
             self.spec.on_release(slot)
 

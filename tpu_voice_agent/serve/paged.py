@@ -28,6 +28,8 @@ Single-request ``generate()`` stays on the dense engine.
 
 from __future__ import annotations
 
+import os
+import time
 from functools import partial
 
 import jax
@@ -37,6 +39,7 @@ import numpy as np
 from ..grammar.fsm import fsm_advance
 from ..models.llama import forward_paged
 from .engine import DecodeEngine, _mask_sample_advance
+from .radix import RadixCache
 
 
 class PoolExhausted(RuntimeError):
@@ -79,10 +82,29 @@ class BlockAllocator:
         return out
 
     def ref(self, blocks: list[int]) -> None:
+        # validate the WHOLE batch before touching any refcount: a bare
+        # KeyError mid-loop would name nothing AND leave the earlier
+        # blocks' counts bumped (sharing bugs — radix chains, prefix
+        # blocks — need the id and an all-or-nothing failure)
+        for b in blocks:
+            if b not in self._refs:
+                raise ValueError(
+                    f"ref of untracked block {b}: not allocated, or already "
+                    "fully freed (use-after-free)")
         for b in blocks:
             self._refs[b] += 1
 
     def free(self, blocks: list[int]) -> None:
+        # all-or-nothing like ref(): account for duplicates inside one call
+        # (freeing [b, b] is two decrements and must both be covered)
+        need: dict[int, int] = {}
+        for b in blocks:
+            need[b] = need.get(b, 0) + 1
+        for b, k in need.items():
+            if self._refs.get(b, 0) < k:
+                raise ValueError(
+                    f"double free of block {b}: no live refcount (freed more "
+                    "times than alloc'd + ref'd)")
         for b in blocks:
             r = self._refs[b] - 1
             if r == 0:
@@ -91,9 +113,27 @@ class BlockAllocator:
             else:
                 self._refs[b] = r
 
+    def refcount(self, block: int) -> int:
+        """Live refcount of one block (0 = untracked/free). Refcounts are
+        the single source of truth for sharing: the radix tree's eviction
+        may only free a block whose sole ref is the tree's own."""
+        return self._refs.get(block, 0)
+
+    def free_blocks(self, group: int = 0) -> int:
+        """How many blocks ``alloc`` could hand out from ``group`` now."""
+        return len(self._free[group])
+
     @property
     def blocks_in_use(self) -> int:
         return self.n_blocks - self.n_groups - sum(len(f) for f in self._free)
+
+    @property
+    def blocks_shared(self) -> int:
+        """Blocks with more than one live ref — KV physically stored once
+        but referenced by several owners (slots sharing a prefix chain,
+        the radix tree + a live slot). The dedup the paged+radix planes
+        exist to create; exported as ``paged.kv_blocks_shared``."""
+        return sum(1 for r in self._refs.values() if r > 1)
 
     @property
     def usable_blocks(self) -> int:
@@ -119,6 +159,7 @@ def record_pool_gauges(alloc: "BlockAllocator") -> None:
     m.set_gauge("paged.kv_blocks_used", float(alloc.blocks_in_use))
     m.set_gauge("paged.kv_blocks_total", float(alloc.usable_blocks))
     m.set_gauge("paged.kv_utilization", alloc.utilization)
+    m.set_gauge("paged.kv_blocks_shared", float(alloc.blocks_shared))
 
 
 @partial(jax.jit, donate_argnames=("k_pool", "v_pool"))
@@ -317,7 +358,8 @@ class PagedDecodeEngine(DecodeEngine):
     # worst-case footprint this engine exists to avoid
 
     def __init__(self, *args, block_size: int = 128, pool_blocks: int | None = None,
-                 **kw):
+                 radix_enable: bool | None = None,
+                 radix_max_nodes: int | None = None, **kw):
         super().__init__(*args, **kw)
         bs = block_size
         self.block_size = bs
@@ -356,6 +398,19 @@ class PagedDecodeEngine(DecodeEngine):
         # every dp shard that has slots attending to it)
         self._prefix_blocks: list[list[int]] = [[] for _ in range(self.dp)]
         self._prefix_tail: dict | None = None  # (L, R, nkv, hd) sub-block rest
+        # radix KV reuse (serve.radix): one tree per dp group, gated by
+        # RADIX_ENABLE — unset keeps the pre-radix paged path byte-identical
+        # (admission never consults a tree, release never inserts)
+        if radix_enable is None:
+            radix_enable = os.environ.get("RADIX_ENABLE") == "1"
+        if radix_max_nodes is None:
+            radix_max_nodes = int(os.environ.get("RADIX_MAX_NODES", "4096"))
+        self.radix: list[RadixCache] | None = (
+            [RadixCache(self.allocator, bs, group=g, max_nodes=radix_max_nodes)
+             for g in range(self.dp)] if radix_enable else None)
+        # host token ids of the request occupying each slot (radix insert
+        # at release needs prompt + generated ids; None when radix is off)
+        self._slot_ids: list[list[int] | None] = [None] * self.batch_slots
 
     def _group(self, slot: int) -> int:
         """dp group of a batch slot (slots shard over dp like the dense
@@ -366,6 +421,13 @@ class PagedDecodeEngine(DecodeEngine):
 
     def set_prompt_prefix(self, *sample_prompts: str) -> int:
         P = super().set_prompt_prefix(*sample_prompts)
+        if self.radix is not None:
+            # drop the whole tree BEFORE freeing the old prefix blocks: the
+            # tree holds its own ref on everything it adopted (pinned root
+            # chain included), and cached chains extending the OLD prefix
+            # can never match prompts rendered over the new one
+            for rc in self.radix:
+                rc.clear()
         for g in range(self.dp):
             if self._prefix_blocks[g]:
                 self.allocator.free(self._prefix_blocks[g])
@@ -388,6 +450,12 @@ class PagedDecodeEngine(DecodeEngine):
                 )
         if P % bs:
             self._prefix_tail = {"k": pk[:, full * bs:], "v": pv[:, full * bs:]}
+        if full and self.radix is not None:
+            # the static prefix becomes the tree's permanently-pinned root
+            # chain: session chains extend it, eviction can never take it
+            for g in range(self.dp):
+                self.radix[g].pin_root_chain(self.prefix_ids[: full * bs],
+                                             self._prefix_blocks[g])
         # the dense (L, 1, P, nkv, hd) prefix KV now lives in the pool (full
         # blocks per dp group) + self._prefix_tail (remainder); keeping the
         # dense copy would hold the prefix in HBM twice for the engine's
@@ -405,34 +473,60 @@ class PagedDecodeEngine(DecodeEngine):
         row[len(blocks):] = self._group(slot) * self.allocator.blocks_per_group
         self.block_tables = self.block_tables.at[slot].set(jnp.asarray(row))
 
+    def _alloc(self, k: int, group: int) -> list[int]:
+        """allocator.alloc with radix backpressure: when the pool is out,
+        evict LRU unreferenced radix leaves and retry once. Without a tree
+        (or with nothing evictable) PoolExhausted propagates — the
+        scheduler's per-request isolation handles it."""
+        try:
+            return self.allocator.alloc(k, group=group)
+        except PoolExhausted:
+            if self.radix is None:
+                raise
+            need = k - self.allocator.free_blocks(group)
+            if self.radix[group].evict(need) < need:
+                raise
+            return self.allocator.alloc(k, group=group)
+
     def _prefill_suffix(self, tokens, positions, slot: int, P: int, bucket: int,
                         n: int):
         """Layout kernel (the decision tree lives in DecodeEngine.
-        prefill_slot): ref the group's shared prefix blocks, allocate the
-        suffix's own, scatter the sub-block prefix tail, then run the
-        suffix-only forward gathering just the covered blocks."""
+        prefill_slot): the static-prefix special case of ``_prefill_chain``
+        — the chain is the group's pinned prefix full blocks, the dense
+        tail its sub-block remainder KV."""
         bs = self.block_size
         g = self._group(slot)
-        full = P // bs
-        shared = self._prefix_blocks[g][:full]
+        shared = self._prefix_blocks[g][: P // bs]
         self.allocator.ref(shared)
+        return self._prefill_chain(tokens, positions, slot, list(shared), P,
+                                   bucket, n, tail=self._prefix_tail)
+
+    def _prefill_chain(self, tokens, positions, slot: int, chain: list[int],
+                       P: int, bucket: int, n: int, tail: dict | None = None):
+        """Generalized chain admission (static prefix AND radix hits):
+        ``chain`` blocks — already ref'd FOR THIS SLOT — cover positions
+        [0, len(chain)*bs) read-only; ``tail`` optionally supplies dense KV
+        for [len(chain)*bs, P); the (1, bucket) suffix forward computes
+        [P, n). New tokens only ever land in the freshly allocated owned
+        blocks (copy-on-write: suffix writes start at P >= len(chain)*bs)."""
+        bs = self.block_size
+        full = len(chain)
         n_owned = -(-(P + bucket) // bs) - full
         try:
-            owned = self.allocator.alloc(n_owned, group=g)
+            owned = self._alloc(n_owned, self._group(slot))
         except PoolExhausted:
-            self.allocator.free(shared)  # don't leak the prefix refs
+            self.allocator.free(chain)  # don't leak the chain refs
             raise
-        self._slot_shared[slot], self._slot_owned[slot] = list(shared), owned
-        self._set_table_row(slot, shared + owned)
+        self._slot_shared[slot], self._slot_owned[slot] = list(chain), owned
+        self._set_table_row(slot, list(chain) + owned)
         self._covered[slot] = (full + n_owned) * bs
-        if self._prefix_tail is not None:
-            # sub-block prefix remainder goes into the slot's first
+        if tail is not None:
+            # sub-block chain remainder goes into the slot's first
             # owned block (shared blocks stay read-only)
             R = P - full * bs
             dst = jnp.asarray(owned[0] * bs + np.arange(R, dtype=np.int32))
             self.k_pool, self.v_pool = _scatter_blocks(
-                self.k_pool, self.v_pool,
-                self._prefix_tail["k"], self._prefix_tail["v"], dst,
+                self.k_pool, self.v_pool, tail["k"], tail["v"], dst,
             )
         # gather only the COVERED blocks, bucketed to a power of two so
         # compile count stays log-bounded (gathering the whole table width
@@ -441,6 +535,16 @@ class PagedDecodeEngine(DecodeEngine):
         gb = 1
         while gb < need:
             gb *= 2
+        if self.radix is not None and gb >= 4 and need <= gb * 3 // 4:
+            # half-octave refinement: the pow2 overshoot doubles the
+            # per-layer gather at the worst point, and the gather is the
+            # dominant shared cost of a warm radix admission (the suffix
+            # itself is tiny). 3/4 of the next octave keeps the compile
+            # count log-bounded (two buckets per octave) while capping
+            # overshoot at 33%. Gated on radix: RADIX_ENABLE unset must
+            # keep the pre-radix gather shapes (and therefore programs)
+            # byte-identical.
+            gb = gb * 3 // 4
         gb = min(gb, self.max_blocks)
         self._next_pos[slot] = n
         logits, self.k_pool, self.v_pool = forward_paged(
@@ -451,9 +555,60 @@ class PagedDecodeEngine(DecodeEngine):
         )
         return logits
 
+    def prefill_slot(self, ids: list[int], slot: int):
+        """Radix-aware admission: consult the group's tree for the longest
+        cached block chain before falling back to the static-prefix /
+        full-prefill decision tree. RADIX_ENABLE unset (``self.radix is
+        None``) takes the parent path untouched."""
+        if self.radix is None:
+            return super().prefill_slot(ids, slot)
+        self.release_slot(slot)
+        ids = list(ids)
+        g = self._group(slot)
+        chain, matched = self.radix[g].match(ids)
+        bucket = None
+        P, tail = matched, None
+        if matched:
+            P0 = len(self.prefix_ids)
+            if (self._prefix_tail is not None and P0 > matched
+                    and len(ids) > P0
+                    and chain == self._prefix_blocks[g][: len(chain)]
+                    and ids[:P0] == self.prefix_ids):
+                # the match stopped exactly at the pinned root chain and the
+                # prompt extends the full static prefix: keep the sub-block
+                # tail scatter (byte-for-byte the _prefill_suffix layout)
+                # instead of recomputing the P % block_size remainder
+                P, tail = P0, self._prefix_tail
+            suffix = ids[P:]
+            bucket = self._suffix_bucket(len(suffix), self.max_len - P)
+            if bucket is None:
+                # no suffix bucket fits: release the chain refs and take
+                # the full-prompt path (which buckets independently)
+                self.allocator.free(chain)
+                matched = 0
+        if not matched:
+            logits = super().prefill_slot(ids, slot)
+            self._slot_ids[slot] = ids
+            return logits
+        # the hit is accounted only HERE — a bucket fallback above must not
+        # show up as served-from-cache in the radix gauges
+        self.radix[g].record_hit(P)
+        m = len(suffix)
+        tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
+        tokens[0, :m] = suffix
+        positions = (P + np.arange(bucket, dtype=np.int32))[None, :]
+        t0 = time.perf_counter()
+        logits = self._prefill_chain(
+            jnp.asarray(tokens), jnp.asarray(positions), slot, chain, P,
+            bucket, len(ids), tail=tail)
+        self._last_prefill_compute_ms = (time.perf_counter() - t0) * 1e3
+        self._last_cached_tokens = P
+        self._slot_ids[slot] = ids
+        return logits[:, m - 1, :]
+
     def _prefill_full(self, tokens, positions, slot: int, bucket: int, n: int):
         bs = self.block_size
-        owned = self.allocator.alloc(-(-bucket // bs), group=self._group(slot))
+        owned = self._alloc(-(-bucket // bs), self._group(slot))
         self._slot_shared[slot], self._slot_owned[slot] = [], owned
         self._set_table_row(slot, owned)
         self._covered[slot] = len(owned) * bs
@@ -486,8 +641,8 @@ class PagedDecodeEngine(DecodeEngine):
         upto = min(upto, self.max_len)
         if upto <= self._covered[slot]:
             return
-        extra = self.allocator.alloc(
-            -(-(upto - self._covered[slot]) // bs), group=self._group(slot))
+        extra = self._alloc(
+            -(-(upto - self._covered[slot]) // bs), self._group(slot))
         self._slot_owned[slot].extend(extra)
         self._set_table_row(slot, self._slot_shared[slot] + self._slot_owned[slot])
         self._covered[slot] += len(extra) * bs
@@ -546,14 +701,23 @@ class PagedDecodeEngine(DecodeEngine):
         self._last_fwds = fwds
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
-    def release_slot(self, slot: int) -> None:
+    def release_slot(self, slot: int, generated_ids: list[int] | None = None) -> None:
         if self._slot_owned[slot] or self._slot_shared[slot]:
+            if (self.radix is not None and generated_ids is not None
+                    and self._slot_ids[slot] is not None):
+                # insert the finished request's prompt+generated chain back
+                # into the tree BEFORE freeing the slot's refs: adopted
+                # blocks gain the tree's own ref and survive the free below
+                ids = self._slot_ids[slot] + [int(t) for t in generated_ids]
+                blocks = self._slot_shared[slot] + self._slot_owned[slot]
+                self.radix[self._group(slot)].insert(ids, blocks)
             self.allocator.free(self._slot_owned[slot])
             self.allocator.free(self._slot_shared[slot])
             self._slot_owned[slot] = []
             self._slot_shared[slot] = []
             self._covered[slot] = 0
             self._next_pos[slot] = 0
+        self._slot_ids[slot] = None
 
     # the dense single-request path doesn't exist here; the batcher is the
     # serving surface (generate_many / services with BRAIN_BATCH)

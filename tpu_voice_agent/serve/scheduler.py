@@ -41,8 +41,11 @@ class _Slot:
     request_id: int = -1
     token_ids: list = field(default_factory=list)
     start_s: float = 0.0
-    prefill_ms: float = 0.0
+    prefill_ms: float = 0.0  # COMPUTED prefill only (cached KV costs tokens
+    # of bookkeeping, not forward time — the split the HUD renders)
     prompt_len: int = 0
+    cached_tokens: int = 0  # prompt tokens served from cached KV (static
+    # prefix / radix chain) at admission
     eos: bool = False
 
 
@@ -109,7 +112,13 @@ class ContinuousBatcher:
         for b in range(self.B):
             self.engine.release_slot(b)
 
-    def submit(self, prompt: str) -> int:
+    def submit(self, prompt) -> int:
+        """Queue one request. ``prompt`` is a string, or a pre-tokenized
+        ``list[int]`` — the session-aware brain path builds turn N's ids as
+        the literal turn N-1 ids + generated ids + new-frame ids, so the
+        radix match sees a STRICT token extension (re-encoding generated
+        text is not id-stable: grammar-constrained decoding may emit
+        non-canonical BPE pieces)."""
         rid = self._next_id
         self._next_id += 1
         self._enqueued_at[rid] = time.perf_counter()
@@ -129,7 +138,8 @@ class ContinuousBatcher:
         when the prompt starts with it."""
         eng = self.engine
         t0 = time.perf_counter()
-        ids = eng.tokenizer.encode(prompt, bos=True)
+        ids = (eng.tokenizer.encode(prompt, bos=True)
+               if isinstance(prompt, str) else [int(t) for t in prompt])
         n = len(ids)
         last_logits = eng.prefill_slot(ids, slot)
         self._rng, k = jax.random.split(self._rng)
@@ -151,7 +161,12 @@ class ContinuousBatcher:
         sl.token_ids = []
         sl.start_s = t0
         sl.prompt_len = n
-        sl.prefill_ms = (time.perf_counter() - t0) * 1e3
+        # prefill_ms = COMPUTED suffix dispatch only (the old wall-clock
+        # number conflated cached-prefix bookkeeping with real forward
+        # time); cached_tokens carries the part the cache absorbed
+        _pf = getattr(eng, "_last_prefill_compute_ms", None)
+        sl.prefill_ms = _pf if _pf is not None else (time.perf_counter() - t0) * 1e3
+        sl.cached_tokens = int(getattr(eng, "_last_cached_tokens", 0))
         sl.eos = False
         # TTFT: ENQUEUE through the first sampled token — queue wait
         # included, because that is the component that degrades when all
@@ -261,6 +276,11 @@ class ContinuousBatcher:
             from .paged import record_pool_gauges
 
             record_pool_gauges(alloc)
+        radix = getattr(eng, "radix", None)
+        if radix is not None:
+            from .radix import record_radix_gauges
+
+            record_radix_gauges(radix)
 
         for b in range(self.B):
             sl = self.slots[b]
@@ -282,12 +302,16 @@ class ContinuousBatcher:
                         (time.perf_counter() - sl.start_s) * 1e3 - sl.prefill_ms),
                     steps=len(sl.token_ids),  # accepted tokens, not forwards
                     finished=bool(eos_h[b]),
+                    cached_tokens=sl.cached_tokens,
                 )
                 m.inc("scheduler.requests_completed")
                 m.observe_ms("scheduler.request_total",
                              (time.perf_counter() - sl.start_s) * 1e3)
                 self.slots[b] = _Slot()
-                self.engine.release_slot(b)  # paged engines free the blocks
+                # paged engines free the blocks; with radix reuse on, the
+                # generated ids let release insert the prompt+generated
+                # chain back into the tree first
+                self.engine.release_slot(b, generated_ids=sl.token_ids)
 
     # ------------------------------------------------------------ drain
 

@@ -57,11 +57,14 @@ def _result_to_response(res) -> ParseResponse:
     """GenerationResult -> ParseResponse with the reference error mapping.
     Deposits the prefill/decode split as stage notes on the calling thread
     so the /parse span (and therefore the trace waterfall) carries the
-    decode decomposition, not just the total."""
+    decode decomposition, not just the total. prefill_ms is COMPUTED
+    prefill only; cached_tokens says how much KV the prefix/radix cache
+    absorbed (the split the web HUD renders)."""
     from ..utils.tracing import note_stage
 
     note_stage("prefill_ms", round(res.prefill_ms, 3))
     note_stage("decode_ms", round(res.decode_ms, 3))
+    note_stage("cached_tokens", int(getattr(res, "cached_tokens", 0)))
     if res.error:
         raise ParserError("llm_error", res.error)
     if not res.finished:
@@ -113,6 +116,70 @@ class EngineParser:
         return _result_to_response(res)
 
 
+class SessionTranscripts:
+    """Deterministic multi-turn prompt rendering for the radix KV plane.
+
+    Turn N's prompt is built in TOKEN-ID space: the literal turn N-1 prompt
+    ids + the ids the model actually generated + one freshly encoded
+    ``<|user|>``/``<|assistant|>`` frame — a STRICT token extension of what
+    the engine already decoded, which the radix tree (serve.radix) turns
+    into an O(new utterance) admission. Id space, not text space, because
+    re-encoding generated text is not id-stable: grammar-constrained
+    decoding may emit non-canonical BPE pieces, and one divergent id would
+    cap every later turn's match at the first turn's prompt. Host-side ids
+    only; the KV lives in the engine's paged pool — an evicted chain just
+    re-prefills, nothing here has to be invalidated.
+
+    Turn 1 renders through ``render_prompt`` unchanged (a session's first
+    request is byte-identical to the stateless path); later frames
+    serialize the user payload with SORTED keys (deterministic rendering:
+    the same (text, context) must always produce the same bytes, or turn
+    N's prompt would silently stop extending turn N-1's).
+    """
+
+    def __init__(self, tokenizer, max_sessions: int | None = None):
+        from collections import OrderedDict
+
+        self.tokenizer = tokenizer
+        self.max_sessions = max_sessions if max_sessions is not None else int(
+            os.environ.get("RADIX_SESSIONS", "256"))
+        self._hist: "OrderedDict[str, list[int]]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def user_frame(text: str, context: dict) -> str:
+        return json.dumps({"text": text, "context": context},
+                          separators=(",", ":"), sort_keys=True)
+
+    def prompt_for(self, session_id: str, text: str, context: dict):
+        """This turn's prompt: a fresh stateless render (str) for turn 1,
+        or the transcript ids + the new frame's ids (list[int]) — the
+        batcher accepts both."""
+        with self._lock:
+            hist = self._hist.get(session_id)
+            if hist is not None:
+                self._hist.move_to_end(session_id)
+                hist = list(hist)
+        if hist is None:
+            return render_prompt(text, context)
+        frame = f"\n<|user|>\n{self.user_frame(text, context)}\n<|assistant|>\n"
+        return hist + self.tokenizer.encode(frame, bos=False)
+
+    def record(self, session_id: str, prompt, generated_ids: list[int]) -> None:
+        """Commit a finished turn: the next prompt extends prompt+output."""
+        ids = (self.tokenizer.encode(prompt, bos=True)
+               if isinstance(prompt, str) else list(prompt))
+        with self._lock:
+            self._hist[session_id] = ids + [int(t) for t in generated_ids]
+            self._hist.move_to_end(session_id)
+            while len(self._hist) > self.max_sessions:
+                self._hist.popitem(last=False)
+
+    def forget(self, session_id: str) -> None:
+        with self._lock:
+            self._hist.pop(session_id, None)
+
+
 class BatchedEngineParser:
     """Continuous-batched grammar-constrained decode behind /parse.
 
@@ -121,30 +188,56 @@ class BatchedEngineParser:
     for the reference voice/brain stack's Node event-loop concurrency
     (apps/voice/src/server.ts:97). Each request's future resolves when its
     slot finishes; admission happens at chunk boundaries.
+
+    ``session_aware=True`` (the radix KV plane, RADIX_ENABLE=1 +
+    BRAIN_PAGED=1) keeps a per-session transcript so turn N's prompt is a
+    strict token extension of turn N-1's — the engine's radix tree then
+    admits returning sessions with O(new utterance) prefill. Speculative
+    turns run two-phase like the planner's: the provisional turn decodes
+    normally but the transcript only advances when the matching final
+    COMMITS it (returning the cached plan, zero decode); a superseded
+    speculation just never gets recorded — there is no KV to roll back,
+    the radix tree keeps whatever chains were decoded as reusable cache.
     """
 
     concurrent_safe = True  # build_app skips the serialization lock
 
     def __init__(self, engine, chunk_steps: int = 16, max_new_tokens: int = 512,
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 120.0, session_aware: bool = False):
         from ..serve import ColocatedServing, ContinuousBatcher
 
         self.engine = engine
+        self.max_new_tokens = max_new_tokens
         self.batcher = ContinuousBatcher(
             engine, chunk_steps=chunk_steps, max_new_tokens=max_new_tokens
         )
         self.runtime = ColocatedServing(None, self.batcher)
         self.timeout_s = timeout_s
+        # session-keyed surface only when asked: wants_session makes
+        # build_app thread session_id/speculative through; stateless mode
+        # keeps the exact pre-radix parse(text, context) contract
+        self.wants_session = session_aware
+        self.supports_speculation = True
+        self.transcripts = (SessionTranscripts(engine.tokenizer)
+                            if session_aware else None)
+        # sid -> two-phase spec turn; LRU-capped like the transcripts — a
+        # session that speculates and then disconnects must not leak its
+        # pending plan (prompt ids + response) forever
+        from collections import OrderedDict
+
+        self._pending: "OrderedDict[str, dict]" = OrderedDict()
+        self._pending_cap = (self.transcripts.max_sessions
+                             if self.transcripts is not None else 64)
+        self._plock = threading.Lock()
         self.runtime.start()
         # liveness watchdog: a dead serving loop restarts with inflight
         # futures failed fast instead of silently queueing forever
         self.runtime.start_watchdog()
 
-    def parse(self, text: str, context: dict) -> ParseResponse:
-        prompt = render_prompt(text, context)
+    def _decode(self, prompt: str):
         fut = self.runtime.submit_parse(prompt)
         try:
-            res = fut.result(timeout=self.timeout_s)
+            return fut.result(timeout=self.timeout_s)
         except TimeoutError as e:
             # dequeue the abandoned request so overload can't pile up work
             # nobody will read (pending entries are dropped immediately; a
@@ -153,7 +246,60 @@ class BatchedEngineParser:
             raise ParserError("llm_error", "batched decode timed out") from e
         except Exception as e:
             raise ParserError("llm_error", str(e)) from e
-        return _result_to_response(res)
+
+    def parse(self, text: str, context: dict, session_id: str | None = None,
+              speculative: bool = False) -> ParseResponse:
+        if self.transcripts is None or not session_id:
+            return _result_to_response(self._decode(render_prompt(text, context)))
+        user = SessionTranscripts.user_frame(text, context)
+        with self._plock:
+            pend = self._pending.pop(session_id, None)
+        if pend is not None and not speculative and pend["user"] == user:
+            # commit: the speculative turn IS this turn — advance the
+            # transcript and deliver the cached plan without decoding
+            from ..utils import get_metrics
+            from ..utils.tracing import note_stage
+
+            self.transcripts.record(session_id, pend["prompt"], pend["gen"])
+            get_metrics().inc("brain.session_spec_commits")
+            for k, v in pend["notes"].items():
+                note_stage(k, v)
+            return pend["resp"]
+        # superseded speculation: nothing to roll back — the transcript
+        # never advanced, and the decoded chain stays in the radix tree as
+        # plain reusable cache
+        prompt = self.transcripts.prompt_for(session_id, text, context)
+        if self._too_long(prompt):
+            # transcript outgrew the prefill/decode budget: cold-start the
+            # session (the reference rolls its context dict forever; we
+            # bound model context by the engine's real capacity)
+            self.transcripts.forget(session_id)
+            prompt = self.transcripts.prompt_for(session_id, text, context)
+        res = self._decode(prompt)
+        resp = _result_to_response(res)  # raises on truncation: transcript
+        # stays at the last committed turn (the session survives)
+        if speculative:
+            from ..utils.tracing import peek_stage_notes
+
+            with self._plock:
+                self._pending[session_id] = {
+                    "user": user, "resp": resp, "prompt": prompt,
+                    "gen": list(res.token_ids), "notes": dict(peek_stage_notes())}
+                self._pending.move_to_end(session_id)
+                while len(self._pending) > self._pending_cap:
+                    self._pending.popitem(last=False)
+        else:
+            self.transcripts.record(session_id, prompt, res.token_ids)
+        return resp
+
+    def _too_long(self, prompt) -> bool:
+        """Token-length guard: the prompt must fit a prefill bucket AND
+        leave the decode budget's headroom before max_len."""
+        eng = self.engine
+        limit = min(eng.prefill_buckets[-1], eng.max_len - self.max_new_tokens)
+        n = (len(eng.tokenizer.encode(prompt, bos=True))
+             if isinstance(prompt, str) else len(prompt))
+        return n > limit
 
     def healthy(self) -> bool:
         return self.runtime.healthy()
@@ -774,6 +920,16 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         finally:
             admission.release()
         ok_headers = {"x-trace-id": trace_id}
+        # the decode split as response headers: the voice service folds them
+        # into the utterance's latency_budget stages so the web HUD can show
+        # computed-prefill / decode / cache-absorbed-tokens, not just a flat
+        # parse_ms (engine backends deposit these as stage notes; rule-based
+        # and planner parses simply have none)
+        for note, header in (("prefill_ms", "x-prefill-ms"),
+                             ("decode_ms", "x-decode-ms"),
+                             ("cached_tokens", "x-cached-tokens")):
+            if note in notes:
+                ok_headers[header] = str(notes[note])
         # (speculative implies spec_ok here — the 409 gate already fired)
         if preq.speculative and wants_session and preq.session_id:
             # this turn is PENDING on the session (two-phase): the caller
@@ -796,11 +952,17 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
 
 def _wrap_batched(engine) -> "BatchedEngineParser":
     """ONE place reading the batched-serving env contract (BRAIN_PREFIX /
-    BRAIN_CHUNK) for every engine flavor put behind the batcher."""
+    BRAIN_CHUNK) for every engine flavor put behind the batcher. An engine
+    carrying a radix tree (PagedDecodeEngine under RADIX_ENABLE=1) gets the
+    session-aware transcript rendering — multi-turn prompts become strict
+    token extensions, which is what the tree matches on. Dense engines stay
+    stateless: without block-level reuse, an extended transcript would only
+    LENGTHEN their per-request suffix prefill."""
     if os.environ.get("BRAIN_PREFIX", "1") != "0":
         install_prompt_prefix(engine)
     return BatchedEngineParser(engine,
-                               chunk_steps=int(os.environ.get("BRAIN_CHUNK", "16")))
+                               chunk_steps=int(os.environ.get("BRAIN_CHUNK", "16")),
+                               session_aware=getattr(engine, "radix", None) is not None)
 
 
 def _wrap_engine(engine) -> IntentParser:
@@ -822,6 +984,12 @@ def make_parser_from_env() -> IntentParser:
     the reference's LLM_BASE_URL/LLM_MODEL env, apps/brain/src/llm.ts:7-9).
     BRAIN_QUANT=int8 enables weight-only quantization for the loaded model.
     BRAIN_BATCH=N (default 1) serves N continuous-batching slots.
+    RADIX_ENABLE=1 (paged engines only, read at engine construction) turns
+    on the radix KV session cache (serve.radix): the batched parser goes
+    session-aware — multi-turn prompts become strict token extensions that
+    the tree admits with O(new utterance) prefill. RADIX_MAX_NODES caps the
+    tree, RADIX_SESSIONS the host transcript LRU (docs/PERF.md "Session KV
+    reuse"). Unset keeps the stateless path byte-identical.
     SPEC_ENABLE=1 turns on grammar-aware speculative decoding on the dense
     engine layouts (SPEC_K / SPEC_DRAFTER / SPEC_DRAFT_MODEL — serve.spec);
     the paged/pp layouts ignore it with a warning (their KV rollback story

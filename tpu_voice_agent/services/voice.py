@@ -430,6 +430,20 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         # voice->intent is decided HERE: the stage split below feeds the SLO
         # tracker and the latency_budget event the web HUD renders
         state.stages["parse_ms"] = round((time.perf_counter() - t_final0) * 1e3, 3)
+        if not degraded:
+            # the brain's decode split rides back as response headers:
+            # computed prefill / decode ms and the prompt tokens the KV
+            # cache (static prefix or radix session chain) absorbed —
+            # rendered by the HUD's stage breakdown under parse
+            for header, key in (("x-prefill-ms", "parse_prefill_ms"),
+                                ("x-decode-ms", "parse_decode_ms"),
+                                ("x-cached-tokens", "cached_tokens")):
+                v = r.headers.get(header)
+                if v is not None:
+                    try:
+                        state.stages[key] = float(v)
+                    except ValueError:
+                        pass
         if degraded:
             state.stages["degraded"] = True
         slo.record(state.stages.get("stt_finalize_ms", 0.0) + state.stages["parse_ms"],

@@ -341,6 +341,13 @@ def pop_stage_notes() -> dict:
     return d or {}
 
 
+def peek_stage_notes() -> dict:
+    """Read the current thread's notes without clearing them (a two-phase
+    speculative turn snapshots its split so the commit — served on a
+    different thread, with zero decode — can replay it)."""
+    return dict(getattr(_stage_notes, "d", None) or {})
+
+
 class Tracer:
     """Records spans into Metrics, a bounded per-trace ring, optionally a
     JSONL sink (``TRACE_SINK=path``), and (``emit=True``) one-line JSON on

@@ -84,7 +84,15 @@ function showLatencyBudget(m) {
   }
   hudTotal.textContent = `${total.toFixed(0)} ms`;
   hudTotal.className = `hud-total${total > SLO_BUDGET_MS ? " over" : ""}`;
+  // parse sub-split: computed prefill vs decode, plus the prompt tokens
+  // the brain's KV cache (static prefix / radix session chain) absorbed —
+  // the cache's win shows up as tokens-without-prefill-time
+  const sub = [];
+  if (st.parse_prefill_ms != null) sub.push(`prefill ${st.parse_prefill_ms.toFixed(0)}`);
+  if (st.parse_decode_ms != null) sub.push(`decode ${st.parse_decode_ms.toFixed(0)}`);
+  if (st.cached_tokens) sub.push(`${st.cached_tokens.toFixed(0)} tok cached`);
   hudSplit.textContent = segs.map(([cls, ms]) => `${cls} ${ms.toFixed(0)}`).join(" · ")
+    + (sub.length ? ` (${sub.join(", ")})` : "")
     + (st.error ? " · error" : "") + (st.degraded ? " · degraded" : "");
   hudEl.hidden = false;
 }
