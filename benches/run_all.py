@@ -22,7 +22,7 @@ from pathlib import Path
 
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_quality.py", "bench_faults.py", "bench_spec.py",
-           "bench_radix.py"]
+           "bench_radix.py", "bench_swarm.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -33,11 +33,15 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # UNTRIMMED — the tiny model makes its full 4-session x 4-turn workload
 # ~30 s on CPU, and the turn-2+ prefill-collapse verdict is a mean over
 # warm turns whose margin a smaller sample would wobble across the bar)
+# the swarm bench stays on --quick too — it is the capacity regression
+# gate, service-level with no model, and the quick trims cap its binary
+# search at tiny N (seconds on CPU)
 QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
-                 "bench_stt.py", "bench_radix.py"]
+                 "bench_stt.py", "bench_radix.py", "bench_swarm.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
-             "BENCH_STT_SECONDS": "4", "BENCH_STT_STREAMS": "1,4"}
+             "BENCH_STT_SECONDS": "4", "BENCH_STT_STREAMS": "1,4",
+             "BENCH_SWARM_MAX_N": "8", "BENCH_SWARM_UTTERANCES": "3"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -110,7 +114,7 @@ def main() -> None:
             if body.get("bench") == name.removesuffix(".py"):
                 entry["artifact"] = art.name
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
-                            "spec", "stt", "radix"):
+                            "spec", "stt", "radix", "swarm"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -15,11 +15,20 @@ import re
 import subprocess
 import sys
 
+import threading
+
 import aiohttp
 import numpy as np
 import pytest
 
-from tpu_voice_agent.utils import Metrics, SLOTracker, Tracer, get_metrics
+from tpu_voice_agent.utils import (
+    FlightRecorder,
+    Metrics,
+    SLOTracker,
+    Tracer,
+    get_flight_recorder,
+    get_metrics,
+)
 from tpu_voice_agent.utils.tracing import (
     HIST_BUCKETS_MS,
     nearest_rank,
@@ -209,6 +218,197 @@ def test_slo_p99_guard():
     for _ in range(5):
         s.record(5000.0)  # a thin slow tail
     assert s.state() == "violated"
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_buffers_freezes_and_rearms():
+    rec = FlightRecorder(max_traces=4, max_snapshots=8, snapshot_interval_s=999)
+    for i in range(10):  # 10 traces through a 4-trace ring
+        rec.observe_span({"svc": "t", "span": "s", "trace": f"tr{i}", "ms": 1.0,
+                          "wall_start_s": float(i), "wall_end_s": float(i) + 0.1})
+    st = rec.state("svc")
+    assert st["frozen"] is False and st["traces_buffered"] == 4
+    assert st["service"] == "svc"
+    assert rec.trigger("slo.test.violated", detail="p50 blown") is True
+    dump = rec.frozen_dump()
+    assert dump["reason"] == "slo.test.violated" and dump["detail"] == "p50 blown"
+    assert [t["trace_id"] for t in dump["traces"]] == ["tr6", "tr7", "tr8", "tr9"]
+    assert dump["metric_snapshots"], "trigger snapshots the knee itself"
+    # first freeze wins; the dump is immutable under later spans/triggers
+    assert rec.trigger("breaker.x.open") is False
+    rec.observe_span({"svc": "t", "span": "s", "trace": "later", "ms": 1.0})
+    assert rec.frozen_dump()["reason"] == "slo.test.violated"
+    assert len(rec.frozen_dump()["traces"]) == 4
+    rec.rearm()
+    assert rec.state()["frozen"] is False
+    assert rec.trigger("second.incident") is True
+
+
+def test_breaker_trip_freezes_global_flight_recorder():
+    from tpu_voice_agent.utils.resilience import CircuitBreaker
+
+    rec = get_flight_recorder()
+    rec.rearm()
+    try:
+        b = CircuitBreaker("flighttestdep", failure_threshold=1,
+                           reset_after_s=60.0)
+        b.record_failure()  # threshold 1: first failure trips -> open
+        dump = rec.frozen_dump()
+        assert dump is not None
+        assert dump["reason"] == "breaker.flighttestdep.open"
+    finally:
+        rec.rearm()
+
+
+def test_slo_violation_freezes_global_flight_recorder():
+    clock = {"t": 0.0}
+    rec = get_flight_recorder()
+    rec.rearm()
+    try:
+        s = SLOTracker("flightslo", window_s=60.0, target_p50_ms=1.0,
+                       min_samples=2, clock=lambda: clock["t"])
+        for _ in range(5):
+            s.record(100.0)
+        assert s.state() == "violated"
+        dump = rec.frozen_dump()
+        assert dump is not None and dump["reason"] == "slo.flightslo.violated"
+        assert "p50_ms" in (dump["detail"] or "")
+    finally:
+        rec.rearm()
+
+
+def test_passive_slo_tracker_never_mutates_the_system():
+    """A measurement-side tracker (the swarm's client verdict) must score
+    without side effects: no flight freeze, no slo.* gauge export."""
+    rec = get_flight_recorder()
+    rec.rearm()
+    try:
+        s = SLOTracker("passiveprobe", window_s=60.0, target_p50_ms=1.0,
+                       min_samples=2, passive=True)
+        for _ in range(5):
+            s.record(100.0)
+        assert s.state() == "violated"
+        assert rec.frozen_dump() is None
+        assert "slo.passiveprobe.state" not in get_metrics().snapshot()["gauges"]
+    finally:
+        rec.rearm()
+
+
+def test_flight_sink_writes_dump_on_freeze(tmp_path, monkeypatch):
+    monkeypatch.setenv("FLIGHT_SINK", str(tmp_path / "fl"))
+    rec = FlightRecorder(max_traces=4, snapshot_interval_s=999)
+    rec.observe_span({"svc": "t", "span": "s", "trace": "tr", "ms": 1.0})
+    assert rec.trigger("slo.sink.violated")
+    files = list(tmp_path.glob("fl_slo.sink.violated_*.json"))
+    assert len(files) == 1
+    body = json.loads(files[0].read_text())
+    assert body["frozen"] and body["traces"][0]["trace_id"] == "tr"
+
+
+# ------------------------------------ concurrent writers (the race hammer)
+
+
+def test_slo_tracker_concurrent_record_and_eval_loses_nothing():
+    """8 threads hammer record() while 2 more hammer evaluate(): no lost
+    samples (the window is huge and under MAX_SAMPLES), no exceptions, and
+    the percentile verdict is stable — p50 must be one of the recorded
+    values, identical across back-to-back evaluations."""
+    s = SLOTracker("hammer", window_s=86_400.0, target_p50_ms=10_000.0,
+                   min_samples=5)
+    n_threads, per_thread = 8, 400  # 3200 < MAX_SAMPLES
+    stop = threading.Event()
+    errors: list[Exception] = []
+
+    def writer(t):
+        try:
+            for i in range(per_thread):
+                s.record(1.0 + (i % 7), ok=True)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                ev = s.evaluate()
+                assert ev["state"] in ("ok", "at_risk", "violated")
+                if ev["p50_ms"] is not None:
+                    assert 1.0 <= ev["p50_ms"] <= 8.0
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    writers = [threading.Thread(target=writer, args=(t,)) for t in range(n_threads)]
+    for th in readers + writers:
+        th.start()
+    for th in writers:
+        th.join(timeout=60)
+        assert not th.is_alive(), "writer hung"
+    stop.set()
+    for th in readers:
+        th.join(timeout=60)
+        assert not th.is_alive(), "reader hung"
+    assert not errors, errors[0]
+    ev1, ev2 = s.evaluate(), s.evaluate()
+    assert ev1["samples"] == n_threads * per_thread, "lost SLO samples"
+    assert ev1["errors"] == 0
+    assert ev1["p50_ms"] == ev2["p50_ms"] and ev1["p99_ms"] == ev2["p99_ms"]
+
+
+def test_trace_and_flight_rings_bounded_under_concurrent_writers():
+    """Many threads complete spans with mostly-unique trace ids (the
+    abandoned-trace shape: one span, never finished into an utterance):
+    nothing is lost from the metrics, and neither the tracer ring nor the
+    flight ring grows past its cap. A freeze racing the writers snapshots a
+    consistent dump that later writes never mutate."""
+    t = Tracer("hammer", emit=False)
+    rec = FlightRecorder(max_traces=16, max_snapshots=8,
+                         snapshot_interval_s=0.01)
+    n_threads, per_thread = 8, 250  # 2000 spans < reservoir cap
+    barrier = threading.Barrier(n_threads + 1)
+    errors: list[Exception] = []
+
+    def worker(w):
+        try:
+            barrier.wait(timeout=30)
+            for i in range(per_thread):
+                with t.span("s", trace_id=f"w{w}i{i}"):
+                    pass
+                rec.observe_span({"svc": "hammer", "span": "s",
+                                  "trace": f"w{w}i{i}", "ms": 0.1})
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    frozen_sizes: list[int] = []
+
+    def freezer():
+        try:
+            barrier.wait(timeout=30)
+            rec.trigger("hammer.freeze")
+            frozen_sizes.append(len(rec.frozen_dump()["traces"]))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(n_threads)]
+    threads.append(threading.Thread(target=freezer))
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+        assert not th.is_alive(), "hammer thread hung"
+    assert not errors, errors[0]
+    # no lost spans: the histogram counted every completion
+    assert t.metrics.snapshot()["latency_ms"]["hammer.s"]["count"] \
+        == n_threads * per_thread
+    # the tracer ring stayed LRU-bounded despite n_threads*per_thread ids
+    assert len(t._ring) <= t.MAX_TRACES
+    # the flight ring never outgrew its cap, frozen or live
+    assert len(rec._traces) <= rec.max_traces
+    assert frozen_sizes and frozen_sizes[0] <= rec.max_traces
+    # the frozen dump did not grow after the freeze
+    assert len(rec.frozen_dump()["traces"]) == frozen_sizes[0]
+    assert len(rec.frozen_dump()["metric_snapshots"]) <= rec.max_snapshots
 
 
 # ------------------------------------------------- scheduler saturation
@@ -506,6 +706,17 @@ def test_metrics_lint_pinned_stt_names_present():
                  "stt.batch_occupancy", "stt.partials_coalesced",
                  "stt.finals_batched"):
         assert name in metrics_lint.PINNED
+    # the capacity-observatory contract: the flight recorder's metrics, the
+    # aborted-utterance error accounting, the live-session gauge, and the
+    # saturation gauges the swarm's attribution keys on
+    for name, kind in (("flight.freezes", "counter"),
+                       ("flight.traces_buffered", "gauge"),
+                       ("flight.snapshots_buffered", "gauge"),
+                       ("voice.utterances_aborted", "counter"),
+                       ("voice.live_sessions", "gauge"),
+                       ("scheduler.batch_occupancy", "gauge"),
+                       ("paged.kv_utilization", "gauge")):
+        assert metrics_lint.PINNED.get(name) == kind, name
 
 
 def test_metrics_lint_pinned_catches_missing_and_wrong_kind():

@@ -55,6 +55,21 @@ PINNED: dict[str, str] = {
     "stt.finals_batched": "counter",
     "stt.batch_ticks": "counter",
     "stt.shed_overload": "counter",
+    # capacity observatory (tools/swarm.py, benches/bench_swarm.py,
+    # docs/OBSERVABILITY.md "Capacity"): the flight recorder's freeze
+    # counter and ring occupancy, the aborted-utterance error accounting
+    # (a WS teardown mid-utterance must burn SLO error budget, not vanish),
+    # and the live-session gauge the HUD's headroom display reads. The
+    # saturation gauges the swarm's attribution keys on are pinned too —
+    # renaming one silently blinds the first-saturated verdict.
+    "flight.freezes": "counter",
+    "flight.traces_buffered": "gauge",
+    "flight.snapshots_buffered": "gauge",
+    "voice.utterances_aborted": "counter",
+    "voice.live_sessions": "gauge",
+    "scheduler.batch_occupancy": "gauge",
+    "scheduler.queue_depth": "gauge",
+    "paged.kv_utilization": "gauge",
 }
 
 

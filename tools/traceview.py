@@ -12,12 +12,17 @@ waterfall the trace ids were built for:
 Usage:
     python tools/traceview.py TRACE_ID [--voice URL] [--brain URL]
         [--executor URL] [--json] [--width N]
+    python tools/traceview.py --flight DUMP [--json] [--width N] [--last K]
     python tools/traceview.py --self-test
 
 ``--json`` prints the merged spans + derived stage splits as JSON instead
-of the text gantt. ``--self-test`` runs the merge/derive/render pipeline on
-synthetic spans (no services needed) — wired into tier-1 via
-tests/test_observability.py.
+of the text gantt. ``--flight`` renders a frozen flight-recorder dump (the
+JSON body of ``GET /debug/flightrecorder`` saved to a file, or a
+``FLIGHT_SINK`` artifact): the freeze header, the last metric snapshot's
+saturation gauges, and one gantt per retained utterance trace — the
+overload autopsy straight from the incident. ``--self-test`` runs the
+merge/derive/render pipeline on synthetic spans (no services needed) —
+wired into tier-1 via tests/test_observability.py.
 
 Zero dependencies beyond the stdlib: this must work from an operator shell
 with nothing installed.
@@ -130,6 +135,63 @@ def waterfall(trace_id: str, urls: dict[str, str], timeout_s: float = 5.0) -> di
     return {"trace_id": trace_id, "spans": spans, "stages": derive_stages(spans)}
 
 
+# ------------------------------------------------------------- flight dump
+
+
+# the saturation gauges worth a line in the autopsy header (the swarm's
+# attribution reads the same names; tools/swarm.py RESOURCE_FRACTIONS)
+_FLIGHT_GAUGES = (
+    "scheduler.batch_occupancy", "scheduler.queue_depth",
+    "paged.kv_utilization", "stt.batch_occupancy", "stt.queue_depth",
+    "resilience.brain.inflight", "resilience.executor.inflight",
+    "resilience.brain.breaker_state", "resilience.executor.breaker_state",
+    "voice.live_sessions",
+)
+
+
+def render_flight(dump: dict, width: int = 64, last: int = 0) -> str:
+    """Text rendering of one frozen flight-recorder dump: freeze header,
+    the final metric snapshot's saturation gauges, then a gantt per
+    retained trace (newest last, ``last`` > 0 trims to the most recent K)."""
+    if not dump.get("frozen"):
+        return "(flight recorder not frozen — nothing to render)"
+    lines = [
+        f"flight recorder frozen: {dump.get('reason')} "
+        f"at {dump.get('frozen_at_s')}"
+        + (f" ({dump['detail']})" if dump.get("detail") else ""),
+    ]
+    snaps = dump.get("metric_snapshots") or []
+    if snaps:
+        g = snaps[-1].get("gauges", {})
+        sat = [f"{k}={g[k]:g}" for k in _FLIGHT_GAUGES if k in g]
+        lines.append(f"last snapshot ({len(snaps)} retained): "
+                     + (" ".join(sat) if sat else "(no saturation gauges)"))
+    traces = dump.get("traces") or []
+    shown = traces[-last:] if last > 0 else traces
+    lines.append(f"{len(traces)} trace(s) retained"
+                 + (f", showing last {len(shown)}" if len(shown) < len(traces)
+                    else "") + ":")
+    for tr in shown:
+        lines.append("")
+        lines.append(f"-- trace {tr.get('trace_id')}")
+        lines.append(render_gantt(tr.get("spans") or [], width=width))
+    return "\n".join(lines)
+
+
+def flight_main(path: str, as_json: bool, width: int, last: int) -> int:
+    try:
+        with open(path) as f:
+            dump = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"[traceview] cannot read flight dump {path}: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(dump, indent=1))
+    else:
+        print(render_flight(dump, width=width, last=last))
+    return 0 if dump.get("frozen") else 2
+
+
 # ------------------------------------------------------------- self-test
 
 
@@ -172,6 +234,17 @@ def self_test() -> int:
     assert gantt.count("\n") == len(spans), "one gantt row per span + window"
     assert "brain.parse" in gantt and "█" in gantt
     assert render_gantt([]) == "(no spans)"
+    # flight-dump rendering: header + saturation line + one gantt per trace
+    dump = {"frozen": True, "reason": "slo.voice.violated", "frozen_at_s": 1.0,
+            "metric_snapshots": [
+                {"t_s": 1.0, "gauges": {"scheduler.batch_occupancy": 1.0,
+                                        "voice.live_sessions": 7}}],
+            "traces": [{"trace_id": "selftest01", "spans": spans}]}
+    ftxt = render_flight(dump)
+    assert "slo.voice.violated" in ftxt and "selftest01" in ftxt and "█" in ftxt
+    assert "scheduler.batch_occupancy=1" in ftxt
+    assert render_flight({"frozen": False}).startswith(
+        "(flight recorder not frozen")
     print(gantt)
     print("traceview self-test ok")
     return 0
@@ -186,13 +259,19 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--executor", default=DEFAULT_URLS["executor"])
     ap.add_argument("--json", action="store_true", help="JSON instead of gantt")
     ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--flight", metavar="DUMP",
+                    help="render a frozen flight-recorder dump file")
+    ap.add_argument("--last", type=int, default=0,
+                    help="with --flight: only the most recent K traces")
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args(argv)
 
     if args.self_test:
         return self_test()
+    if args.flight:
+        return flight_main(args.flight, args.json, args.width, args.last)
     if not args.trace_id:
-        ap.error("TRACE_ID required (or --self-test)")
+        ap.error("TRACE_ID required (or --flight, or --self-test)")
     out = waterfall(args.trace_id,
                     {"voice": args.voice, "brain": args.brain,
                      "executor": args.executor})

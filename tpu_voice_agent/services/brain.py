@@ -942,10 +942,15 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
 
 
     app.router.add_get("/health", health)
-    from ..utils.tracing import make_metrics_handler, make_trace_handler
+    from ..utils.tracing import (
+        make_flightrecorder_handler,
+        make_metrics_handler,
+        make_trace_handler,
+    )
 
     app.router.add_get("/metrics", make_metrics_handler("brain", tracer, slo=slo))
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("brain", tracer))
+    app.router.add_get("/debug/flightrecorder", make_flightrecorder_handler("brain"))
     app.router.add_post("/parse", parse)
     return app
 

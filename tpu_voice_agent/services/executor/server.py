@@ -198,10 +198,16 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
 
 
     app.router.add_get("/health", health)
-    from ...utils.tracing import make_metrics_handler, make_trace_handler
+    from ...utils.tracing import (
+        make_flightrecorder_handler,
+        make_metrics_handler,
+        make_trace_handler,
+    )
 
     app.router.add_get("/metrics", make_metrics_handler("executor", tracer, slo=slo))
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("executor", tracer))
+    app.router.add_get("/debug/flightrecorder",
+                       make_flightrecorder_handler("executor"))
     app.router.add_post("/execute", execute)
     app.router.add_post("/uploads", uploads)
     app.router.add_post("/close", close)

@@ -168,6 +168,13 @@ class ClientState:
         # stages = the split dict accumulated capture -> final -> parse
         self.utt_t0: float | None = None
         self.stages: dict = {}
+        # perf_counter at the start of any utterance whose SLO sample has
+        # not been recorded yet (speech onset OR typed command); cleared
+        # wherever slo.record runs. A connection torn down while this is
+        # set aborted an utterance mid-flight — that must cost SLO error
+        # budget, not silently vanish (swarm churn would otherwise inflate
+        # the capacity verdict)
+        self.slo_open_t0: float | None = None
         # trace id of the utterance whose risky plan awaits confirmation:
         # the user's confirm click arrives AFTER later audio frames have
         # rotated trace_id, and the confirmed execution belongs to the
@@ -227,6 +234,12 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     # the north-star SLO: voice->intent (end-of-speech processing cost —
     # STT finalize + parse; the speaker's own talking time is not latency)
     slo = SLOTracker("voice")
+    # live WS session count + the measured capacity ceiling (the swarm
+    # bench's max-sessions-at-SLO number, operator-pinned): the web HUD
+    # renders occupancy/headroom from /health
+    live_sessions = {"n": 0}
+    capacity_sessions = int(os.environ.get("VOICE_CAPACITY_SESSIONS", "0"))
+    get_metrics().set_gauge("voice.live_sessions", 0)
 
     async def health(_req: web.Request) -> web.Response:
         breakers = {"brain": brain_breaker.state, "executor": exec_breaker.state}
@@ -236,6 +249,8 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             "ok": status == "ok", "status": status, "service": "voice",
             "breakers": breakers,
             "slo": slo.state(),
+            "sessions": live_sessions["n"],
+            "capacity_sessions": capacity_sessions,
         })
 
     async def send(ws: web.WebSocketResponse, type_: str, **payload) -> None:
@@ -448,6 +463,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             state.stages["degraded"] = True
         slo.record(state.stages.get("stt_finalize_ms", 0.0) + state.stages["parse_ms"],
                    ok=True)
+        state.slo_open_t0 = None
 
         tag = {"degraded": True} if degraded else {}
         await send(ws, "intent", data=parsed.model_dump(), **tag)
@@ -487,6 +503,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         state.stages["error"] = True
         slo.record(state.stages.get("stt_finalize_ms", 0.0) + state.stages["parse_ms"],
                    ok=False)
+        state.slo_open_t0 = None
         await emit_budget(ws, state)
 
     async def execute_and_report(ws, state: ClientState, intents: list[Intent], http,
@@ -545,7 +562,26 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         ws = web.WebSocketResponse(max_msg_size=8 * 1024 * 1024)
         await ws.prepare(req)
         state = ClientState(cfg.stt_factory())
+        live_sessions["n"] += 1
+        get_metrics().set_gauge("voice.live_sessions", live_sessions["n"])
+        try:
+            return await _stream_session(ws, state)
+        finally:
+            live_sessions["n"] = max(0, live_sessions["n"] - 1)
+            get_metrics().set_gauge("voice.live_sessions", live_sessions["n"])
+            if state.slo_open_t0 is not None:
+                # client disconnected mid-utterance (speech started or a
+                # final was being parsed, but no SLO sample ever landed):
+                # an aborted utterance is an error sample — the latency is
+                # the wall the speaker waited for nothing. Without this,
+                # swarm/churn-induced teardown vanishes from slo.voice.*
+                # and silently inflates capacity verdicts.
+                slo.record((time.perf_counter() - state.slo_open_t0) * 1e3,
+                           ok=False)
+                state.slo_open_t0 = None
+                get_metrics().inc("voice.utterances_aborted")
 
+    async def _stream_session(ws, state: ClientState) -> web.WebSocketResponse:
         from ..serve.stt import NullSTT
 
         if isinstance(state.stt, NullSTT):
@@ -593,6 +629,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                             ep = getattr(state.stt, "endpointer", None)
                             if ep is None or ep.in_speech or events:
                                 state.utt_t0 = t_feed0
+                                state.slo_open_t0 = t_feed0
                                 state.trace_id = new_trace_id()
                                 state.stages = {}
                         for kind, text in events:
@@ -638,6 +675,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                                 state.trace_id = new_trace_id()
                                 state.stages = {}
                                 state.utt_t0 = None
+                                state.slo_open_t0 = time.perf_counter()
                                 await send(ws, "transcript_final", text=text)
                                 await handle_final(ws, state, text, http)
                         elif ctype == "confirm_execute":
@@ -658,6 +696,11 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                         elif ctype == "reset":
                             state.stt.reset()
                             state.context = {}
+                            # a client-initiated reset cleanly CANCELS any
+                            # armed utterance — it must not be scored as an
+                            # aborted-mid-flight error at teardown
+                            state.utt_t0 = None
+                            state.slo_open_t0 = None
                             state.drop_spec()
                             await send(ws, "info", message="state reset")
                         else:
@@ -678,10 +721,15 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
 
 
     app.router.add_get("/health", health)
-    from ..utils.tracing import make_metrics_handler, make_trace_handler
+    from ..utils.tracing import (
+        make_flightrecorder_handler,
+        make_metrics_handler,
+        make_trace_handler,
+    )
 
     app.router.add_get("/metrics", make_metrics_handler("voice", tracer, slo=slo))
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("voice", tracer))
+    app.router.add_get("/debug/flightrecorder", make_flightrecorder_handler("voice"))
     app.router.add_get("/stream", stream)
     app.router.add_get("/", index)
     from ..web import static_dir as _sd

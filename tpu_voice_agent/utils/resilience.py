@@ -202,13 +202,27 @@ class CircuitBreaker:
             self._gauge(0)
 
     def record_failure(self) -> None:
+        tripped = False
         with self._lock:
             if self._state == "half_open":
                 self._trip()  # the probe failed: straight back to open
-                return
-            self._failures += 1
-            if self._state == "closed" and self._failures >= self.failure_threshold:
-                self._trip()
+                tripped = True
+            else:
+                self._failures += 1
+                if self._state == "closed" and self._failures >= self.failure_threshold:
+                    self._trip()
+                    tripped = True
+        if tripped:
+            # a breaker opening IS an overload/outage incident: freeze the
+            # flight recorder (first incident wins; idempotent while
+            # frozen). OUTSIDE the breaker lock — the freeze serializes the
+            # trace ring and may write FLIGHT_SINK to disk, and every
+            # allow()/record_* on this breaker would block behind it at the
+            # exact moment of overload (same discipline as SLOTracker's
+            # outside-the-lock auto-eval).
+            from .tracing import get_flight_recorder
+
+            get_flight_recorder().trigger(f"breaker.{self.name}.open")
 
     def _trip(self) -> None:
         self._state = "open"

@@ -26,9 +26,14 @@ import threading
 import time
 from collections import deque
 
-from .tracing import get_metrics, nearest_rank
+from .tracing import get_flight_recorder, get_metrics, nearest_rank
 
 STATES = ("ok", "at_risk", "violated")
+
+# how often record() re-evaluates the window on its own (seconds): the
+# flight recorder must see the ok->violated transition from the sample
+# stream itself, not only when an operator happens to poll /health
+AUTO_EVAL_S = 1.0
 
 
 class SLOTracker:
@@ -40,6 +45,12 @@ class SLOTracker:
     ``SLO_ERROR_RATE`` (0.05), ``SLO_AT_RISK_FRACTION`` (0.8),
     ``SLO_MIN_SAMPLES`` (5 — below it the verdict stays ``ok``: two slow
     warmup requests must not page anyone).
+
+    ``passive=True`` makes the tracker a pure evaluator: no ``slo.*``
+    gauge export, no flight-recorder trigger. Measurement-side trackers
+    (the swarm's client verdict) score the system under test and must not
+    mutate it — freezing the shared flight recorder from the scoring loop
+    would shadow the genuine server-side incident.
     """
 
     MAX_SAMPLES = 8192  # hard cap independent of window (memory bound)
@@ -50,9 +61,10 @@ class SLOTracker:
                  error_rate_target: float | None = None,
                  at_risk_fraction: float | None = None,
                  min_samples: int | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, passive: bool = False):
         env = os.environ.get
         self.name = name
+        self.passive = passive
         self.window_s = window_s if window_s is not None \
             else float(env("SLO_WINDOW_S", "300"))
         self.target_p50_ms = target_p50_ms if target_p50_ms is not None \
@@ -68,10 +80,19 @@ class SLOTracker:
         self._clock = clock
         self._lock = threading.Lock()
         self._samples: deque[tuple[float, float, bool]] = deque(maxlen=self.MAX_SAMPLES)
+        self._last_state = "ok"
+        self._last_auto_eval = 0.0
 
     def record(self, latency_ms: float, ok: bool = True) -> None:
         with self._lock:
             self._samples.append((self._clock(), float(latency_ms), bool(ok)))
+            due = self._clock() - self._last_auto_eval >= AUTO_EVAL_S
+            if due:
+                self._last_auto_eval = self._clock()
+        if due:
+            # outside the lock: evaluate() re-acquires it and may trigger
+            # the flight recorder on an ok->violated transition
+            self.evaluate()
 
     def _windowed(self) -> list[tuple[float, float, bool]]:
         cutoff = self._clock() - self.window_s
@@ -112,14 +133,24 @@ class SLOTracker:
                     reasons.append(f"{label} {value:.3g} > "
                                    f"{self.at_risk_fraction:.0%} of target {target:.3g}")
 
-        m = get_metrics()
-        m.set_gauge(f"slo.{self.name}.state", float(STATES.index(state)))
-        m.set_gauge(f"slo.{self.name}.window_samples", float(n))
-        m.set_gauge(f"slo.{self.name}.error_rate", error_rate)
-        if p50 is not None:
-            m.set_gauge(f"slo.{self.name}.p50_ms", p50)
-        if p99 is not None:
-            m.set_gauge(f"slo.{self.name}.p99_ms", p99)
+        if not self.passive:
+            # the ok/at_risk -> violated edge is the overload incident:
+            # freeze the flight recorder so the autopsy (last K utterance
+            # traces + the gauge timeline) comes from the onset, not a
+            # re-run
+            prev, self._last_state = self._last_state, state
+            if state == "violated" and prev != "violated":
+                get_flight_recorder().trigger(
+                    f"slo.{self.name}.violated", detail="; ".join(reasons))
+
+            m = get_metrics()
+            m.set_gauge(f"slo.{self.name}.state", float(STATES.index(state)))
+            m.set_gauge(f"slo.{self.name}.window_samples", float(n))
+            m.set_gauge(f"slo.{self.name}.error_rate", error_rate)
+            if p50 is not None:
+                m.set_gauge(f"slo.{self.name}.p50_ms", p50)
+            if p99 is not None:
+                m.set_gauge(f"slo.{self.name}.p99_ms", p99)
 
         return {
             "name": self.name,

@@ -170,6 +170,14 @@ class Metrics:
             return None
         return nearest_rank(xs, q)
 
+    def gauges(self) -> dict[str, float]:
+        """Cheap gauge-only copy (one lock hold, no reservoir sorting):
+        the flight recorder's periodic snapshot runs on whatever request
+        thread happened to close a span, so it must not pay the full
+        ``snapshot()`` percentile path."""
+        with self._lock:
+            return dict(self._gauges)
+
     def collisions(self) -> list[tuple[str, str, str]]:
         """(name, first_kind, other_kind) for every name registered as two
         different metric types — the runtime half of the collision lint."""
@@ -271,6 +279,168 @@ def get_metrics() -> Metrics:
     return _GLOBAL_METRICS
 
 
+class FlightRecorder:
+    """Overload flight recorder: a bounded always-on ring of the last K
+    complete utterance traces plus periodic metric snapshots, frozen into an
+    immutable dump the moment the process detects overload — an SLO
+    transition to ``violated`` (utils.slo) or a circuit breaker opening
+    (utils.resilience). Overload autopsies then come from the incident
+    itself (``GET /debug/flightrecorder``), not from a re-run that may never
+    reproduce the knee.
+
+    Feeding is passive: every Tracer in the process deposits completed spans
+    here (``observe_span``), which also takes a metrics-gauge snapshot when
+    ``FLIGHT_SNAPSHOT_S`` (default 1.0) has elapsed since the last one — no
+    dedicated thread, no cost when the process is idle. Both rings are LRU
+    ring buffers (``FLIGHT_TRACES``/``FLIGHT_SNAPSHOTS``), so abandoned
+    traces (a span or two, never finished) age out instead of growing the
+    ring. The FIRST trigger wins — later triggers while frozen only count —
+    so the dump describes the *onset* of the incident; ``rearm()`` clears it
+    for the next one. ``FLIGHT_SINK=<path prefix>`` additionally writes the
+    dump as JSON on freeze (``<prefix>_<reason>_<unix_ts>.json``)."""
+
+    def __init__(self, max_traces: int | None = None,
+                 max_snapshots: int | None = None,
+                 snapshot_interval_s: float | None = None):
+        env = os.environ.get
+        self.max_traces = max_traces if max_traces is not None \
+            else int(env("FLIGHT_TRACES", "32"))
+        self.max_snapshots = max_snapshots if max_snapshots is not None \
+            else int(env("FLIGHT_SNAPSHOTS", "120"))
+        self.snapshot_interval_s = snapshot_interval_s if snapshot_interval_s is not None \
+            else float(env("FLIGHT_SNAPSHOT_S", "1.0"))
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, list[dict]]" = OrderedDict()
+        self._snapshots: list[dict] = []
+        self._last_snapshot_s = 0.0
+        self._frozen: dict | None = None
+
+    # ------------------------------------------------------------- feeding
+
+    def observe_span(self, span_dict: dict) -> None:
+        """Deposit one completed span (Tracer._finish calls this for every
+        span in the process). Cheap append under the lock; a periodic gauge
+        snapshot piggybacks on the span stream."""
+        trace_id = span_dict.get("trace")
+        if not trace_id:
+            return
+        with self._lock:
+            ring = self._traces.setdefault(trace_id, [])
+            if len(ring) < Tracer.MAX_SPANS_PER_TRACE:
+                ring.append(span_dict)
+            self._traces.move_to_end(trace_id)
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+            due = (time.time() - self._last_snapshot_s) >= self.snapshot_interval_s
+            if due:
+                self._last_snapshot_s = time.time()
+        if due:
+            self.snapshot_metrics()
+
+    def snapshot_metrics(self) -> None:
+        """Append one timestamped gauge snapshot to the bounded ring (the
+        saturation timeline the dump's attribution is read from). Gauges
+        only — this runs inline on span-closing threads, so it must stay a
+        dict copy, not the full percentile-sorting snapshot()."""
+        entry = {"t_s": round(time.time(), 3), "gauges": get_metrics().gauges()}
+        with self._lock:
+            self._snapshots.append(entry)
+            if len(self._snapshots) > self.max_snapshots:
+                del self._snapshots[: len(self._snapshots) - self.max_snapshots]
+        m = get_metrics()
+        m.set_gauge("flight.traces_buffered", float(len(self._traces)))
+        m.set_gauge("flight.snapshots_buffered", float(len(self._snapshots)))
+
+    # ------------------------------------------------------------ freezing
+
+    def trigger(self, reason: str, detail: str | None = None) -> bool:
+        """Freeze the current rings under ``reason``. Idempotent while
+        frozen (first incident wins); returns True when this call froze."""
+        self.snapshot_metrics()  # the knee itself belongs in the timeline
+        with self._lock:
+            if self._frozen is not None:
+                return False
+            self._frozen = {
+                "frozen": True,
+                "reason": reason,
+                "detail": detail,
+                "frozen_at_s": round(time.time(), 3),
+                "traces": [{"trace_id": tid, "spans": list(spans)}
+                           for tid, spans in self._traces.items()],
+                "metric_snapshots": list(self._snapshots),
+                "config": {"max_traces": self.max_traces,
+                           "max_snapshots": self.max_snapshots,
+                           "snapshot_interval_s": self.snapshot_interval_s},
+            }
+            dump = self._frozen
+        get_metrics().inc("flight.freezes")
+        log_event("flight", "frozen", reason=reason, detail=detail,
+                  traces=len(dump["traces"]), snapshots=len(dump["metric_snapshots"]))
+        sink = os.environ.get("FLIGHT_SINK")
+        if sink:
+            try:
+                safe = re.sub(r"[^A-Za-z0-9_.-]", "_", reason)
+                path = f"{sink}_{safe}_{int(dump['frozen_at_s'])}.json"
+                with open(path, "w") as f:
+                    json.dump(dump, f)
+            except OSError:
+                # a full disk must not take the overload path down with it
+                get_metrics().inc("flight.sink_write_errors")
+        return True
+
+    def rearm(self) -> None:
+        """Discard the frozen dump; the recorder goes back to armed."""
+        with self._lock:
+            self._frozen = None
+
+    # ------------------------------------------------------------- reading
+
+    def frozen_dump(self) -> dict | None:
+        with self._lock:
+            return self._frozen
+
+    def state(self, service: str | None = None) -> dict:
+        """The /debug/flightrecorder body: the frozen dump when an incident
+        froze one, else the armed live counts."""
+        with self._lock:
+            if self._frozen is not None:
+                body = dict(self._frozen)
+            else:
+                body = {"frozen": False, "armed": True,
+                        "traces_buffered": len(self._traces),
+                        "snapshots_buffered": len(self._snapshots)}
+        if service is not None:
+            body["service"] = service
+        return body
+
+
+# Process-global flight recorder, mirroring the metrics registry: the SLO
+# trackers and circuit breakers trigger it without any constructor plumbing,
+# and every Tracer in the process feeds it.
+_GLOBAL_FLIGHT = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _GLOBAL_FLIGHT
+
+
+def make_flightrecorder_handler(service: str):
+    """aiohttp ``GET /debug/flightrecorder``: the frozen overload dump (or
+    the armed live state). ``?rearm=1`` clears a frozen dump AFTER returning
+    it, so retrieval-and-rearm is one operator roundtrip."""
+    from aiohttp import web
+
+    async def flight_ep(req) -> web.Response:
+        rec = get_flight_recorder()
+        body = rec.state(service)
+        if req.query.get("rearm") == "1":
+            rec.rearm()
+            body["rearmed"] = True
+        return web.json_response(body)
+
+    return flight_ep
+
+
 def make_metrics_handler(service: str, tracer: "Tracer", slo=None):
     """aiohttp GET /metrics handler shared by every service. Content
     negotiation: JSON (service-local snapshot next to the process-global
@@ -281,6 +451,16 @@ def make_metrics_handler(service: str, tracer: "Tracer", slo=None):
     from aiohttp import web
 
     async def metrics_ep(req) -> web.Response:
+        if req.query.get("gauges") == "1":
+            # cheap high-frequency poll mode (the swarm's saturation
+            # sampler hits this at ~3 Hz per service): gauge dict copies
+            # only — no slo.evaluate(), no percentile-sorting snapshots —
+            # so the measurement does not load the system under test
+            return web.json_response({
+                "service": service,
+                "local": {"gauges": tracer.metrics.gauges()},
+                "runtime": {"gauges": get_metrics().gauges()},
+            })
         if slo is not None:
             slo_eval = slo.evaluate()  # also refreshes the slo.* gauges
         accept = req.headers.get("Accept", "")
@@ -423,6 +603,10 @@ class Tracer:
             while len(self._ring) > self.MAX_TRACES:
                 self._ring.popitem(last=False)
         self.metrics.observe_ms(f"{self.service}.{sp.name}", sp.duration_ms)
+        # every completed span also lands in the process-global flight
+        # recorder's bounded ring, so an overload freeze captures the last K
+        # utterances' waterfalls without any per-service wiring
+        _GLOBAL_FLIGHT.observe_span(d)
         if self._sink_path:
             try:
                 with self._sink_lock:

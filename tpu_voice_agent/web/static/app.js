@@ -31,7 +31,9 @@ const transcriptEl = $("transcript"), intentEl = $("intent"), resultsEl = $("res
 const confirmBar = $("confirm-bar");
 const hudEl = $("hud"), hudTotal = $("hud-total"), hudBar = $("hud-bar"),
   hudSplit = $("hud-split");
+const capacityEl = $("capacity"), capacityText = $("capacity-text");
 const SLO_BUDGET_MS = 800;  // BASELINE voice->intent p50 target
+const HEALTH_POLL_MS = 5000;
 
 let ws = null, audio = null, pendingRisky = null, lastSend = 0;
 
@@ -96,6 +98,35 @@ function showLatencyBudget(m) {
     + (st.error ? " · error" : "") + (st.degraded ? " · degraded" : "");
   hudEl.hidden = false;
 }
+
+/* ------------------------------------------------------------ capacity HUD */
+
+/* live session count vs the measured max-sessions-at-SLO ceiling
+ * (benches/bench_swarm.py; the operator pins it via
+ * VOICE_CAPACITY_SESSIONS). Polled from /health next to the SLO verdict so
+ * "how close to full is this box" is a glance, not a dashboard hunt. */
+async function pollHealth() {
+  try {
+    const r = await fetch("/health");
+    if (!r.ok) return;
+    const h = await r.json();
+    const n = h.sessions, cap = h.capacity_sessions;
+    if (n == null) return;
+    let text = `${n} session${n === 1 ? "" : "s"}`;
+    let over = false;
+    if (cap > 0) {
+      const headroom = cap - n;
+      text += ` / ${cap} (${headroom} headroom)`;
+      over = headroom <= 0;
+    }
+    if (h.slo && h.slo !== "ok") { text += ` · slo ${h.slo}`; over = true; }
+    capacityText.textContent = text;
+    capacityText.className = `hud-split${over ? " over" : ""}`;
+    capacityEl.hidden = false;
+  } catch { /* a dead poll must not spam the console */ }
+}
+setInterval(pollHealth, HEALTH_POLL_MS);
+pollHealth();
 
 /* ------------------------------------------------------------ results */
 
