@@ -104,4 +104,5 @@ def snapshot_observability(service_url: str, timeout_s: float = 5.0) -> dict:
         "slo": m.get("slo"),
         "stage_latency_ms": m.get("local", {}).get("latency_ms", {}),
         "runtime_gauges": m.get("runtime", {}).get("gauges", {}),
+        "runtime_counters": m.get("runtime", {}).get("counters", {}),
     }

@@ -22,7 +22,7 @@ from pathlib import Path
 
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_quality.py", "bench_faults.py", "bench_spec.py",
-           "bench_radix.py", "bench_swarm.py"]
+           "bench_radix.py", "bench_swarm.py", "bench_chaos.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -35,13 +35,17 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # warm turns whose margin a smaller sample would wobble across the bar)
 # the swarm bench stays on --quick too — it is the capacity regression
 # gate, service-level with no model, and the quick trims cap its binary
-# search at tiny N (seconds on CPU)
+# search at tiny N (seconds on CPU); the chaos bench stays as well — it is
+# the fault-containment regression gate (tiny engine, trimmed search) and
+# a PR that breaks quarantine/cancellation must fail the quick table too
 QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
-                 "bench_stt.py", "bench_radix.py", "bench_swarm.py"]
+                 "bench_stt.py", "bench_radix.py", "bench_swarm.py",
+                 "bench_chaos.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_STT_SECONDS": "4", "BENCH_STT_STREAMS": "1,4",
-             "BENCH_SWARM_MAX_N": "8", "BENCH_SWARM_UTTERANCES": "3"}
+             "BENCH_SWARM_MAX_N": "8", "BENCH_SWARM_UTTERANCES": "3",
+             "BENCH_CHAOS_MAX_N": "4", "BENCH_CHAOS_UTTERANCES": "2"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -114,7 +118,7 @@ def main() -> None:
             if body.get("bench") == name.removesuffix(".py"):
                 entry["artifact"] = art.name
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
-                            "spec", "stt", "radix", "swarm"):
+                            "spec", "stt", "radix", "swarm", "chaos"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -32,7 +32,15 @@ class AppServer:
         asyncio.set_event_loop(self._loop)
 
         async def start():
-            runner = web.AppRunner(self.app)
+            # services that propagate client disconnects into in-flight
+            # work (brain/voice mid-decode cancellation, ISSUE 7) set this
+            # app flag; aiohttp >= 3.9 made handler cancellation opt-in
+            from tpu_voice_agent.services import HANDLER_CANCELLATION
+
+            runner = web.AppRunner(
+                self.app,
+                handler_cancellation=bool(
+                    self.app.get(HANDLER_CANCELLATION, False)))
             await runner.setup()
             site = web.TCPSite(runner, "127.0.0.1", 0)
             await site.start()

@@ -1,0 +1,377 @@
+"""Fault containment inside the inference plane (ISSUE 7) — FAST tier.
+
+The non-negotiable contract, drilled differentially like PR 3/4/5's
+identity tests: for EVERY injected fault (NaN logits, prefill exception,
+dead FSM state, mid-decode cancellation, expired deadline) the poisoned
+request fails alone with a typed error, its KV blocks return to the pool,
+its chain NEVER enters the radix tree, and batch-mates' outputs are
+TOKEN-IDENTICAL to an undisturbed run. Plus: the repeat-offender
+quarantine, the deterministic chaos layer itself, the stalled-step
+watchdog's warm restart, and a 200-request mixed ok/poisoned/cancelled
+pool-accounting fuzz that must leak zero blocks.
+"""
+
+import random
+import time
+
+import pytest
+
+from tpu_voice_agent.serve import DecodeEngine, PagedDecodeEngine
+from tpu_voice_agent.serve.colocate import ColocatedServing
+from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+from tpu_voice_agent.services.brain import install_prompt_prefix
+from tpu_voice_agent.utils import chaos, get_metrics
+from tpu_voice_agent.utils.chaos import Chaos, ChaosError
+from tpu_voice_agent.utils.resilience import Deadline
+
+BUCKETS = (128, 256, 512, 1024, 2048)
+PROMPTS = [
+    "search for laptops under 1000",
+    "upload my resume and submit",
+    "take a screenshot of this page",
+]
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _counter(name: str) -> float:
+    return get_metrics().snapshot()["counters"].get(name, 0.0)
+
+
+# ---------------------------------------------------------------- chaos unit
+
+
+def test_chaos_off_by_default(monkeypatch):
+    monkeypatch.delenv("CHAOS_FAULTS", raising=False)
+    chaos.reset()
+    assert not chaos.get_chaos().enabled
+    assert not chaos.chaos_fire("nan_logits")
+
+
+def test_chaos_deterministic_and_seeded():
+    a = Chaos("nan_logits:0.3", seed=5)
+    b = Chaos("nan_logits:0.3", seed=5)
+    seq_a = [a.fire("nan_logits") for _ in range(64)]
+    seq_b = [b.fire("nan_logits") for _ in range(64)]
+    assert seq_a == seq_b, "same spec+seed must replay identically"
+    assert any(seq_a) and not all(seq_a)
+    c = Chaos("nan_logits:0.3", seed=6)
+    assert [c.fire("nan_logits") for _ in range(64)] != seq_a
+
+
+def test_chaos_nth_fires_exactly_once():
+    c = Chaos("alloc_fail@3")
+    assert [c.fire("alloc_fail") for _ in range(6)] == [
+        False, False, True, False, False, False]
+
+
+def test_chaos_unknown_point_rejected():
+    with pytest.raises(ValueError, match="unknown chaos point"):
+        Chaos("tyop_fault:0.5")
+
+
+# ------------------------------------------------------------- shared engine
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=3,
+                          prefill_buckets=BUCKETS, radix_enable=False)
+    install_prompt_prefix(e)
+    return e
+
+
+@pytest.fixture(scope="module")
+def clean(eng):
+    """The undisturbed reference run every fault drill diffs against."""
+    return ContinuousBatcher(eng, chunk_steps=8,
+                             max_new_tokens=48).generate_many(PROMPTS)
+
+
+def _run_with_fault(eng, spec: str):
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=48)
+    chaos.configure(spec)
+    try:
+        return b, b.generate_many(PROMPTS)
+    finally:
+        chaos.reset()
+
+
+def _assert_contained(eng, clean, res, victim: int, err_prefix: str):
+    """The containment contract: victim fails typed, batch-mates are
+    token-identical, no pool blocks leak past the resident prefix."""
+    assert res[victim].error is not None and \
+        res[victim].error.startswith(err_prefix), res[victim].error
+    for i in range(len(clean)):
+        if i != victim:
+            assert res[i].error is None, res[i].error
+            assert res[i].token_ids == clean[i].token_ids, \
+                f"batch-mate {i} diverged from the undisturbed run"
+    assert eng.allocator.blocks_in_use == len(eng._prefix_blocks[0]), \
+        "poisoned/cancelled request leaked pool blocks"
+
+
+# ------------------------------------------------- differential isolation
+
+
+def test_nan_logits_quarantines_slot_batch_mates_identical(eng, clean):
+    before = _counter("scheduler.slots_quarantined")
+    b, res = _run_with_fault(eng, "nan_logits@2")  # 2nd admission poisoned
+    _assert_contained(eng, clean, res, victim=1, err_prefix="poisoned: non-finite")
+    assert _counter("scheduler.slots_quarantined") == before + 1
+    assert b.quarantined() == []  # one offense < QUARANTINE_AFTER
+
+
+def test_dead_fsm_state_quarantines_slot(eng, clean):
+    _, res = _run_with_fault(eng, "dead_fsm@2")
+    _assert_contained(eng, clean, res, victim=1,
+                      err_prefix="poisoned: grammar dead state")
+
+
+def test_prefill_exception_fails_alone(eng, clean):
+    before = _counter("scheduler.prefill_faults")
+    _, res = _run_with_fault(eng, "prefill_exc@2")
+    _assert_contained(eng, clean, res, victim=1, err_prefix="chaos: injected")
+    assert _counter("scheduler.prefill_faults") == before + 1
+
+
+def test_mid_decode_cancel_releases_slot_batch_mates_identical(eng, clean):
+    before = _counter("scheduler.cancelled")
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=48)
+    rids = [b.submit(p) for p in PROMPTS]
+    b.step()  # all three admitted and one chunk in
+    assert b.cancel(rids[1], "test disconnect")
+    b.run_until_done()
+    res = [b.results.pop(r) for r in rids]
+    _assert_contained(eng, clean, res, victim=1, err_prefix="cancelled:")
+    assert _counter("scheduler.cancelled") == before + 1
+
+
+def test_deadline_sheds_at_dequeue_and_cancels_mid_decode(eng):
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=48)
+    before_shed = _counter("scheduler.shed_expired")
+    # expired before dequeue: never occupies a slot
+    rid_dead = b.submit(PROMPTS[0], deadline=Deadline.after(0.0))
+    # expires mid-decode: admitted, then evicted at a chunk boundary
+    rid_mid = b.submit(PROMPTS[1], deadline=Deadline.after(0.25))
+    rid_ok = b.submit(PROMPTS[2])
+    b.step()
+    time.sleep(0.3)
+    b.run_until_done()
+    assert b.results.pop(rid_dead).error.startswith("shed: deadline expired")
+    assert _counter("scheduler.shed_expired") == before_shed + 1
+    mid = b.results.pop(rid_mid)
+    assert mid.error is not None and mid.error.startswith("cancelled: deadline")
+    assert b.results.pop(rid_ok).error is None
+    assert eng.allocator.blocks_in_use == len(eng._prefix_blocks[0])
+
+
+def test_repeat_offender_quarantined_and_surfaced(eng):
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=48)
+    for _ in range(2):  # QUARANTINE_AFTER default
+        chaos.configure("nan_logits@1")
+        r = b.generate_many([PROMPTS[0]])[0]
+        chaos.reset()
+        assert r.error.startswith("poisoned:")
+    before = _counter("scheduler.quarantine_rejected")
+    r = b.generate_many([PROMPTS[0]])[0]  # no chaos armed — refused at submit
+    assert r.error.startswith("quarantined:"), r.error
+    assert _counter("scheduler.quarantine_rejected") == before + 1
+    q = b.quarantined()
+    assert q and q[0]["count"] == 2 and q[0]["rejected"] == 1
+    assert PROMPTS[0][:20] in q[0]["preview"]
+    # a different prompt still serves (quarantine is per-fingerprint)
+    assert b.generate_many([PROMPTS[2]])[0].error is None
+
+
+# ------------------------------------------------------------ radix hygiene
+
+
+@pytest.fixture(scope="module")
+def eng_radix():
+    e = PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=2,
+                          prefill_buckets=BUCKETS, radix_enable=True)
+    install_prompt_prefix(e)
+    return e
+
+
+def test_poisoned_chain_never_enters_radix(eng_radix):
+    b = ContinuousBatcher(eng_radix, chunk_steps=8, max_new_tokens=48)
+    assert b.generate_many([PROMPTS[0]])[0].error is None
+    inserts = sum(t.inserts for t in eng_radix.radix)
+    nodes = sum(t.nodes for t in eng_radix.radix)
+    chaos.configure("nan_logits@1")
+    r = b.generate_many([PROMPTS[1]])[0]
+    chaos.reset()
+    assert r.error.startswith("poisoned:")
+    assert sum(t.inserts for t in eng_radix.radix) == inserts, \
+        "a poisoned generation must never become a warm prefix"
+    assert sum(t.nodes for t in eng_radix.radix) == nodes
+
+
+def test_cancelled_chain_never_enters_radix(eng_radix):
+    b = ContinuousBatcher(eng_radix, chunk_steps=8, max_new_tokens=48)
+    inserts = sum(t.inserts for t in eng_radix.radix)
+    rid = b.submit(PROMPTS[2])
+    b.step()
+    b.cancel(rid, "gone")
+    b.run_until_done()
+    assert b.results.pop(rid).error.startswith("cancelled:")
+    assert sum(t.inserts for t in eng_radix.radix) == inserts
+
+
+# ----------------------------------------------------------- warm restart
+
+
+def test_warm_restart_keeps_prefix_and_token_identity():
+    from tpu_voice_agent.services.prompts import render_prompt
+
+    e = PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=2,
+                          prefill_buckets=BUCKETS, radix_enable=True)
+    install_prompt_prefix(e)
+    prompt = render_prompt(PROMPTS[0], {})  # starts with the cached prefix
+    b = ContinuousBatcher(e, chunk_steps=8, max_new_tokens=48)
+    r1 = b.generate_many([prompt])[0]
+    assert r1.error is None and r1.cached_tokens > 0  # prefix served warm
+    b.reset()
+    e.warm_restart()
+    # only the re-reserved prefix survives; the tree is pinned-root-only
+    assert e.allocator.blocks_in_use == len(e._prefix_blocks[0])
+    assert all(t.nodes == len(e._prefix_blocks[0]) for t in e.radix)
+    r2 = ContinuousBatcher(e, chunk_steps=8,
+                           max_new_tokens=48).generate_many([prompt])[0]
+    assert r2.error is None
+    assert r2.token_ids == r1.token_ids, \
+        "post-restart decode diverged: prefix KV was not preserved"
+    assert r2.cached_tokens == r1.cached_tokens
+
+
+def test_stall_watchdog_warm_restarts_and_fails_inflight_fast(monkeypatch):
+    monkeypatch.setenv("CHAOS_STALL_S", "2.0")
+    e = DecodeEngine(preset="test-tiny", max_len=1024, batch_slots=2,
+                     prefill_buckets=(128, 256, 512))
+    b = ContinuousBatcher(e, chunk_steps=8, max_new_tokens=24)
+    # pre-warm the compiled programs: a first-compile (seconds on CPU) must
+    # not read as a stall to the tight drill threshold below
+    assert b.generate_many([PROMPTS[1]])[0].token_ids
+    co = ColocatedServing(None, b)
+    before = _counter("engine.restarts")
+    chaos.configure("stall_step@1")
+    co.start()
+    co.start_watchdog(interval_s=0.05, stall_s=0.5)
+    try:
+        fut = co.submit_parse(PROMPTS[0])
+        with pytest.raises(RuntimeError, match="stalled"):
+            fut.result(timeout=10)  # failed FAST, not after the stall ends
+        assert _counter("engine.restarts") == before + 1
+        chaos.reset()
+        # the replacement loop serves on the warm-restarted engine
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not co.healthy():
+            time.sleep(0.01)
+        assert co.healthy()
+        res = co.submit_parse(PROMPTS[1]).result(timeout=30)
+        assert res.error is None and res.token_ids
+    finally:
+        co.stop()
+
+
+# -------------------------------------------------- disconnect cancellation
+
+
+def test_client_disconnect_cancels_in_flight_decode():
+    """The full chain: TCP client vanishes mid-/parse -> aiohttp cancels
+    the handler (opt-in flag) -> RequestContext fires the registered
+    canceller -> colocate tombstones -> scheduler evicts the slot at the
+    next chunk boundary, releasing blocks instead of decoding the full
+    budget for a dead socket."""
+    import socket
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import BatchedEngineParser, build_app
+
+    e = PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=2,
+                          prefill_buckets=BUCKETS, radix_enable=False)
+    install_prompt_prefix(e)
+    parser = BatchedEngineParser(e, chunk_steps=4, max_new_tokens=512)
+    srv = AppServer(build_app(parser)).__enter__()
+    try:
+        before = _counter("scheduler.cancelled")
+        body = json_bytes = (
+            b'{"text": "search for mechanical keyboards", "context": {}}')
+        req = (b"POST /parse HTTP/1.1\r\nHost: 127.0.0.1\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+               + json_bytes)
+        before_chunks = _counter("scheduler.chunks")
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(req)
+        # close the moment decode is demonstrably in flight (first chunk
+        # dispatched) — a fixed sleep races a warm-cache decode of the
+        # whole 512-token budget
+        start_wait = time.monotonic() + 15
+        while time.monotonic() < start_wait and \
+                _counter("scheduler.chunks") == before_chunks:
+            time.sleep(0.01)
+        assert _counter("scheduler.chunks") > before_chunks, "decode never started"
+        s.close()  # client gone — no response will ever be read
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                _counter("scheduler.cancelled") == before:
+            time.sleep(0.05)
+        assert _counter("scheduler.cancelled") == before + 1, \
+            "disconnect did not cancel the in-flight decode"
+        assert e.allocator.blocks_in_use == len(e._prefix_blocks[0])
+    finally:
+        srv.__exit__(None, None, None)
+        parser.close()
+
+
+# -------------------------------------------------------------- pool fuzz
+
+
+def test_pool_accounting_fuzz_zero_leaks_after_200_mixed_requests():
+    """ISSUE 7 satellite: 200 mixed ok/poisoned/cancelled/expired requests
+    under probabilistic chaos — every terminal path must return its blocks;
+    the pool ends exactly at the resident prefix."""
+    e = PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=3,
+                          prefill_buckets=BUCKETS, radix_enable=False)
+    install_prompt_prefix(e)
+    b = ContinuousBatcher(e, chunk_steps=4, max_new_tokens=4)
+    rng = random.Random(11)
+    chaos.configure("nan_logits:0.15,prefill_exc:0.1,alloc_fail:0.05", seed=11)
+    try:
+        outcomes = {"ok": 0, "error": 0}
+        submitted = 0
+        while submitted < 200:
+            wave = []
+            for _ in range(rng.randint(2, 6)):
+                # unique suffix: quarantine is per-fingerprint and must not
+                # kick in for distinct prompts
+                p = f"{PROMPTS[submitted % 3]} v{submitted}"
+                dl = Deadline.after(0.0) if rng.random() < 0.1 else None
+                wave.append(b.submit(p, deadline=dl))
+                submitted += 1
+            b.step()
+            if wave and rng.random() < 0.3:
+                b.cancel(wave[rng.randrange(len(wave))], "fuzz")
+            b.run_until_done()
+            for rid in wave:
+                r = b.results.pop(rid)
+                outcomes["ok" if r.error is None else "error"] += 1
+    finally:
+        chaos.reset()
+    assert sum(outcomes.values()) == 200
+    assert outcomes["ok"] > 0 and outcomes["error"] > 0, \
+        f"fuzz must exercise both paths, got {outcomes}"
+    assert e.allocator.blocks_in_use == len(e._prefix_blocks[0]), \
+        f"leaked blocks: {e.allocator.blocks_in_use} in use, " \
+        f"prefix is {len(e._prefix_blocks[0])}"
+    # refcount hygiene: the resident prefix blocks are exactly once-owned
+    for blk in e._prefix_blocks[0]:
+        assert e.allocator.refcount(blk) == 1

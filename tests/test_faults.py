@@ -414,10 +414,16 @@ class _DeadableBatcher:
         self.die = False
         self._n = 0
 
-    def submit(self, prompt: str) -> int:
+    def submit(self, prompt: str, deadline=None) -> int:
         rid, self._n = self._n, self._n + 1
         self.pending.append((rid, prompt))
         return rid
+
+    def cancel(self, rid: int, reason: str = "client gone") -> bool:
+        live = [(r, p) for (r, p) in self.pending if r != rid]
+        found = len(live) != len(self.pending)
+        self.pending = live
+        return found
 
     def step(self) -> None:
         if self.die:

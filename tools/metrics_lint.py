@@ -70,6 +70,17 @@ PINNED: dict[str, str] = {
     "scheduler.batch_occupancy": "gauge",
     "scheduler.queue_depth": "gauge",
     "paged.kv_utilization": "gauge",
+    # fault containment (ISSUE 7, utils/chaos.py + serve/scheduler.py +
+    # serve/colocate.py, docs/RESILIENCE.md "Fault containment"): the
+    # chaos drill's injected-fault count, the quarantine/cancellation/
+    # queue-expiry eviction counters bench_chaos gates on, and the
+    # watchdog's warm-restart counter — renaming any of these silently
+    # blinds the chaos bench's containment verdict
+    "chaos.injected": "counter",
+    "scheduler.slots_quarantined": "counter",
+    "scheduler.cancelled": "counter",
+    "scheduler.shed_expired": "counter",
+    "engine.restarts": "counter",
 }
 
 

@@ -46,6 +46,13 @@ Usage (against a running stack; benches/bench_swarm.py boots one for you):
 The audio scenarios assume the swarm stack's ``ScriptedSTT`` cadence
 (a final every ``--frames-per-final`` frames); against a real-STT stack
 prefer the typed scenarios or feed real speech.
+
+Chaos mode: the deterministic fault layer (``tpu_voice_agent.utils.chaos``)
+is armed IN the services, not in this client — launch the stack with
+``CHAOS_FAULTS="nan_logits:0.05,prefill_exc:0.05,..."`` (and optionally
+``CHAOS_SEED``) or pass ``chaos_spec=`` to ``build_local_stack`` for the
+in-process harness. ``benches/bench_chaos.py`` runs exactly that drill:
+capacity-at-SLO with 5% injected faults vs clean, same swarm, same SLO.
 """
 
 from __future__ import annotations
@@ -308,7 +315,9 @@ class EventLog:
         for i, t0 in enumerate(t0s):
             if i < len(terms):
                 idx, ev = terms[i]
-                lat = (self.arrived[idx] - t0) * 1e3
+                # clamped at 0: keepalive frames can realign a scripted
+                # endpoint so a final lands just before its nominal t0
+                lat = max(0.0, (self.arrived[idx] - t0) * 1e3)
                 stages = budgets[i]["stages"] if i < len(budgets) else {}
                 ok = ev["type"] == "intent" and not bool(stages.get("error"))
                 utts.append(Utt(scenario, lat, ok, stages))
@@ -347,7 +356,15 @@ async def _typed_round(ws, scenario: str, texts: list[str], think_s: float,
 async def _audio_round(ws, scenario: str, n_utts: int, frames_per_final: int,
                        frame_s: float, think_s: float, timeout_s: float) -> list[Utt]:
     """Feed silence frames until the stack's ScriptedSTT endpoints; paced
-    (frame_s > 0) sleeps between frames like a live mic, unpaced firehoses."""
+    (frame_s > 0) sleeps between frames like a live mic, unpaced firehoses.
+
+    Like a live mic, the client KEEPS streaming if the endpoint doesn't
+    fire: after a generous quiet window it feeds another silence frame.
+    Without this, a single lost frame (network, or the chaos drill's
+    ``drop_frame``) would wedge the frame-counted ScriptedSTT one short of
+    its final forever — a harness artifact; in the real pipeline frame
+    loss costs one frame of latency, and that is what capacity probes
+    should measure."""
     log = EventLog()
     t0s: list[float] = []
     for _ in range(n_utts):
@@ -358,8 +375,14 @@ async def _audio_round(ws, scenario: str, n_utts: int, frames_per_final: int,
         # latency clock starts at the endpoint-triggering frame
         t0s.append(time.monotonic())
         want = len(t0s)
-        await log.wait(ws, lambda lg, w=want: lg.terminals() >= w
-                       and lg.count("latency_budget") >= w, timeout_s)
+        done = (lambda lg, w=want: lg.terminals() >= w
+                and lg.count("latency_budget") >= w)
+        end = time.monotonic() + timeout_s
+        while True:
+            left = end - time.monotonic()
+            if left <= 0 or await log.wait(ws, done, min(5.0, max(left, 0.1))):
+                break
+            await ws.send_bytes(SILENCE_FRAME)  # the mic never stops
         if think_s:
             await asyncio.sleep(think_s)
     return log.mine(scenario, t0s)
@@ -631,11 +654,17 @@ def binary_search_capacity(voice_url: str, *, max_n: int = 32,
 
 def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
                       exec_inflight: int = 8, frames_per_final: int = 4,
-                      parser=None):
+                      parser=None, chaos_spec: str | None = None,
+                      chaos_seed: int = 0, parse_timeout_s: float = 10.0):
     """voice + brain + executor on real sockets, wired for swarm runs:
     rule-based brain (or the given parser), fake-page executor, ScriptedSTT
-    audio path. Returns (urls dict, servers list) — callers __exit__ the
-    servers. Shared by benches/bench_swarm.py and tests/test_swarm.py."""
+    audio path. ``chaos_spec`` arms the in-process deterministic fault
+    layer (tpu_voice_agent.utils.chaos — NaN logits, prefill exceptions,
+    alloc failures, stalled steps, dropped WS frames) so the SAME swarm
+    that measures clean capacity drills the fault-containment claims;
+    None leaves chaos at its env-derived default (off). Returns (urls
+    dict, servers list) — callers __exit__ the servers. Shared by
+    benches/bench_swarm.py, benches/bench_chaos.py and tests."""
     import os
 
     from tests.http_helper import AppServer
@@ -646,6 +675,10 @@ def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
     from tpu_voice_agent.services.executor.page import FakePage
     from tpu_voice_agent.services.voice import VoiceConfig
     from tpu_voice_agent.services.voice import build_app as build_voice
+    from tpu_voice_agent.utils import chaos as chaos_mod
+
+    if chaos_spec is not None:
+        chaos_mod.configure(chaos_spec, seed=chaos_seed)
 
     brain = AppServer(build_brain(parser or RuleBasedParser(),
                                   max_inflight=brain_inflight)).__enter__()
@@ -657,7 +690,7 @@ def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
     voice = AppServer(build_voice(VoiceConfig(
         brain_url=brain.url, executor_url=executor.url,
         stt_factory=lambda: ScriptedSTT(frames_per_final=frames_per_final),
-        parse_timeout_s=10.0, retry_attempts=2,
+        parse_timeout_s=parse_timeout_s, retry_attempts=2,
     ))).__enter__()
     urls = {"voice": voice.url, "brain": brain.url, "executor": executor.url}
     return urls, [voice, executor, brain]

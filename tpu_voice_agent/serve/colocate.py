@@ -75,6 +75,10 @@ class ColocatedServing:
         self._thread: threading.Thread | None = None
         self._watchdog: threading.Thread | None = None
         self._stop = False
+        # stalled-step detection: set under the lock when the worker enters
+        # batcher.step(), cleared when it returns; the watchdog compares
+        # against ENGINE_STALL_S to detect a wedged dispatch
+        self._step_t0: float | None = None
 
     # ------------------------------------------------------------ submit
 
@@ -88,11 +92,20 @@ class ColocatedServing:
             self._work.notify()
         return fut
 
-    def submit_parse(self, prompt: str) -> "Future[GenerationResult]":
+    def submit_parse(self, prompt: str, deadline=None) -> "Future[GenerationResult]":
+        """``deadline`` (utils.resilience.Deadline, optional) rides into the
+        batcher: expired-in-queue requests shed at dequeue and in-flight
+        ones cancel at chunk boundaries (the x-deadline-ms propagation now
+        reaches INSIDE the inference plane, not just the HTTP seams)."""
         fut: Future = Future()
         with self._work:
-            rid = self.batcher.submit(prompt)
+            rid = self.batcher.submit(prompt, deadline=deadline)
             fut.request_id = rid  # lets abandon_parse find the request again
+            if rid in self.batcher.results:
+                # refused at submit (quarantined prompt): resolve now — no
+                # decode step will ever run to harvest it
+                self._set_future(fut, value=self.batcher.results.pop(rid))
+                return fut
             self._parse_futs[rid] = fut
             self.stats.max_parse_inflight = max(
                 self.stats.max_parse_inflight, len(self._parse_futs)
@@ -101,20 +114,26 @@ class ColocatedServing:
         return fut
 
     def abandon_parse(self, fut: Future) -> None:
-        """Give up on a submitted parse (caller timed out): drop its future
-        and tombstone the request id, so overload does not accumulate work
-        nobody will read. The tombstone is applied by step() on the WORKER
-        thread — the only thread that touches batcher.pending — so the
-        dequeue cannot race the worker's own pending.pop(0). A request
-        already decoding in a slot runs to its (bounded) finish; its
-        orphaned result is purged at harvest."""
+        """Give up on a submitted parse (caller timed out or disconnected):
+        drop its future and tombstone the request id, so overload does not
+        accumulate work nobody will read. The tombstone is applied by
+        step() on the WORKER thread — the only thread that touches batcher
+        state — via ``batcher.cancel``: a queued request is dropped, and a
+        request already DECODING is evicted at the next chunk boundary,
+        releasing its slot and KV blocks instead of burning steps for a
+        dead socket (mid-decode cancellation, ISSUE 7)."""
         rid = getattr(fut, "request_id", None)
         if rid is None:
             return
         with self._lock:
             self._parse_futs.pop(rid, None)
             self._abandoned.add(rid)
+            self._work.notify()  # an idle worker must wake to apply it
         fut.cancel()
+
+    # cancel-on-disconnect is the same mechanics as a timeout abandon; the
+    # name is the API contract the brain's request-cancellation hook uses
+    cancel_parse = abandon_parse
 
     # ------------------------------------------------------------ core
 
@@ -131,17 +150,21 @@ class ColocatedServing:
         with self._lock:
             stt_jobs = list(self._stt_q)
             self._stt_q.clear()
+            tombs: set[int] = set()
             if self._abandoned:
-                # filter under the lock: submit_parse appends to pending from
-                # caller threads (same lock), and this runs on the worker
-                # thread so it cannot race the worker's own pending.pop(0)
                 tombs, self._abandoned = self._abandoned, set()
-                self.batcher.pending = [
-                    (r, p) for (r, p) in self.batcher.pending if r not in tombs
-                ]
             # pre-drain depths: what a scrape should see as backlog
             get_metrics().set_gauge("colocate.stt_queue", len(stt_jobs))
             get_metrics().set_gauge("colocate.parse_inflight", len(self._parse_futs))
+        # apply cancellations OUTSIDE the lock but ON the worker thread —
+        # the only thread that touches batcher state, so this cannot race
+        # the worker's own pending.pop(0) or chunk dispatch. cancel() drops
+        # queued requests and evicts mid-decode ones at the chunk boundary.
+        for rid in tombs:
+            self.batcher.cancel(rid)
+            # nobody is waiting for a tombstoned result: purge immediately
+            # (harvest's orphan sweep only runs when decode work exists)
+            self.batcher.results.pop(rid, None)
         did = False
 
         for audio, fut in stt_jobs:  # priority lane
@@ -161,6 +184,8 @@ class ColocatedServing:
 
         if self._has_decode_work():
             t0 = time.perf_counter()
+            with self._lock:
+                self._step_t0 = t0  # stall watchdog arms on this
             try:
                 self.batcher.step()
             except Exception as e:
@@ -170,6 +195,15 @@ class ColocatedServing:
                 self.stats.errors += 1
                 self._fail_inflight(e)
                 return True
+            finally:
+                with self._lock:
+                    # an abandoned (stall-restarted) worker waking here must
+                    # not clear the REPLACEMENT worker's armed timestamp —
+                    # that would silently blind the watchdog to a second
+                    # stall. Only the live worker disarms.
+                    if (self._thread is None
+                            or threading.current_thread() is self._thread):
+                        self._step_t0 = None
             with self._lock:
                 self.stats.decode_busy_ms += (time.perf_counter() - t0) * 1e3
                 self.stats.decode_chunks += 1
@@ -241,25 +275,65 @@ class ColocatedServing:
         self._thread = threading.Thread(target=self._loop, name="colocate", daemon=True)
         self._thread.start()
 
-    def start_watchdog(self, interval_s: float = 0.5) -> None:
-        """Arm a liveness watchdog over the worker thread.
+    def start_watchdog(self, interval_s: float = 0.5,
+                       stall_s: float | None = None) -> None:
+        """Arm a liveness + stall watchdog over the worker thread.
 
         ``_loop`` survives ordinary exceptions itself, but a thread can
         still die outright (BaseException escape, interpreter-level kill,
-        a bug in the survival path). Without the watchdog that is a silent
-        outage: submits queue forever and only /health notices. The
-        watchdog detects the dead worker, fails every inflight future fast
-        (callers see an error now, not a timeout later), resets the batcher
-        (its slot/cache state is suspect mid-chunk), and starts a fresh
-        serving loop."""
+        a bug in the survival path) — and a thread can also WEDGE inside a
+        decode step (host-side convoy, a hung dispatch) without dying,
+        which is a worse outage: /health keeps reporting a live worker
+        while every future waits forever. The watchdog covers both:
+
+        - dead worker: fail every inflight future fast, reset the suspect
+          batcher, start a fresh loop (``colocate.worker_restarts``)
+        - stalled step (no progress for ``stall_s``, default
+          ``ENGINE_STALL_S``=30): fail inflights fast, WARM-RESTART the
+          engine (``engine.warm_restart()`` — fresh mutable decode state,
+          same loaded weights and compiled programs), reset the batcher
+          (which bumps its epoch so the stuck step discards its commit if
+          it ever wakes), start a fresh loop, and freeze a flight-recorder
+          dump (``engine.restarts``). The abandoned thread exits at its
+          next loop check — a genuinely hung device call may never wake,
+          which is exactly why the replacement loop must not wait for it.
+        """
         if self._watchdog is not None:
             return
+        if stall_s is None:
+            import os
+
+            stall_s = float(os.environ.get("ENGINE_STALL_S", "30"))
+        # restart counter exists from arming (scrape-visible at zero, like
+        # the breaker gauges): 'no series' and 'no restarts' must differ
+        from ..utils import get_metrics
+
+        get_metrics().inc("engine.restarts", 0.0)
         self._watchdog = threading.Thread(
-            target=self._watch, args=(interval_s,), name="colocate-watchdog",
-            daemon=True)
+            target=self._watch, args=(interval_s, stall_s),
+            name="colocate-watchdog", daemon=True)
         self._watchdog.start()
 
-    def _watch(self, interval_s: float) -> None:
+    def _restart_worker(self, exc: RuntimeError,
+                        reset_batcher: bool = True) -> None:
+        """Shared dead/stalled recovery: fail both lanes fast, reset the
+        batcher (unless the caller already did, interleaved with a warm
+        restart), spin up a fresh serving loop."""
+        with self._lock:
+            stt_jobs, self._stt_q[:] = list(self._stt_q), []
+        for _, fut in stt_jobs:
+            self._set_future(fut, exc=exc)
+        if reset_batcher:
+            self._fail_inflight(exc)  # also resets the suspect batcher (+epoch)
+        with self._work:
+            if self._stop:
+                return
+            self._step_t0 = None
+            self._thread = threading.Thread(
+                target=self._loop, name="colocate", daemon=True)
+            self._thread.start()
+
+    def _watch(self, interval_s: float, stall_s: float = 30.0) -> None:
         import logging
 
         from ..utils import get_metrics
@@ -270,25 +344,43 @@ class ColocatedServing:
                 if self._stop:
                     return
                 dead = self._thread is not None and not self._thread.is_alive()
+                t0 = self._step_t0
+                stalled = (not dead and t0 is not None
+                           and time.perf_counter() - t0 >= stall_s)
             if dead:
                 log.error("colocate worker died; failing inflight work and "
                           "restarting the serving loop")
                 get_metrics().inc("colocate.worker_restarts")
                 self.stats.restarts += 1
-                exc = RuntimeError("serving worker died; work failed fast on restart")
-                # fail BOTH lanes: a queued STT job would otherwise wait on
-                # a loop that no longer exists
+                self._restart_worker(RuntimeError(
+                    "serving worker died; work failed fast on restart"))
+            elif stalled:
+                log.error("decode step stalled >%.1fs; failing inflight work "
+                          "and warm-restarting the engine", stall_s)
+                get_metrics().inc("engine.restarts")
+                self.stats.restarts += 1
+                from ..utils.tracing import get_flight_recorder
+
+                get_flight_recorder().trigger("engine.stall",
+                                              detail=f"step stalled >{stall_s}s")
+                # ordering: epoch fence up (batcher.reset) BEFORE the warm
+                # restart, both before the fresh loop spawns — the wedged
+                # thread is abandoned, and if it ever wakes its step
+                # discards rather than commits (epoch mismatch) and
+                # _loop's identity check exits it.
+                wr = getattr(self.batcher.engine, "warm_restart", None)
+                exc = RuntimeError(
+                    "decode step stalled; engine warm-restarted, "
+                    "work failed fast")
                 with self._lock:
-                    stt_jobs, self._stt_q[:] = list(self._stt_q), []
-                for _, fut in stt_jobs:
+                    futs = list(self._parse_futs.values())
+                    self._parse_futs.clear()
+                    self.batcher.reset()  # epoch fence up BEFORE restart
+                    if wr is not None:
+                        wr()
+                for fut in futs:
                     self._set_future(fut, exc=exc)
-                self._fail_inflight(exc)  # also resets the suspect batcher
-                with self._work:
-                    if self._stop:
-                        return
-                    self._thread = threading.Thread(
-                        target=self._loop, name="colocate", daemon=True)
-                    self._thread.start()
+                self._restart_worker(exc, reset_batcher=False)
             time.sleep(interval_s)
 
     def stop(self) -> None:
@@ -312,6 +404,13 @@ class ColocatedServing:
 
         log = logging.getLogger("tpu_voice_agent.colocate")
         while True:
+            with self._work:
+                # a stall-watchdog restart replaced this loop while it was
+                # wedged inside a step: the impostor must exit, never touch
+                # the (warm-restarted) batcher again
+                if self._thread is not None and \
+                        threading.current_thread() is not self._thread:
+                    return
             try:
                 did = self.step()
             except Exception:
@@ -322,6 +421,9 @@ class ColocatedServing:
                 did = False
             with self._work:
                 if self._stop:
+                    return
+                if self._thread is not None and \
+                        threading.current_thread() is not self._thread:
                     return
                 if not did and not self._stt_q and not self._has_decode_work():
                     self._work.wait(timeout=0.05)

@@ -99,6 +99,25 @@ def _mask_sample_advance(logits, fsm_state, tables: DeviceFSM, key, temperature,
     return tok, fsm_state
 
 
+def _poison_gate(raw, state, state_next, active, poison, constrained: bool):
+    """THE one copy of the per-row fault check, shared by the dense AND
+    paged chunk loops (plain + ff bodies — jit-inlined at every call site):
+    non-finite raw logits (pre-mask — the grammar mask writes -inf on
+    purpose) and dead FSM transitions (entry state or post-advance state
+    below zero; only meaningful under constrained decoding). Returns
+    (ok, poison): ``ok`` is active minus this step's poisoned rows —
+    poisoned rows must NOT commit the faulty sample, so batch-mates'
+    carries stay untouched. Poison codes: 1 = NaN/inf, 2 = dead FSM
+    (sticky via max across steps)."""
+    nanp = active & ~jnp.all(jnp.isfinite(raw), axis=-1)
+    if constrained:
+        deadp = active & ~nanp & ((state < 0) | (state_next < 0))
+    else:
+        deadp = jnp.zeros_like(active)
+    poison = jnp.maximum(poison, jnp.where(nanp, 1, jnp.where(deadp, 2, 0)))
+    return active & ~(nanp | deadp), poison
+
+
 @partial(jax.jit, static_argnames=("cfg", "rules", "greedy", "constrained", "kernels"))
 def _decode_step(
     params,
@@ -259,6 +278,9 @@ def chunk_decode_loop(
     byte_budget: jax.Array,  # scalar int32
     rules=None,
     logit_mask=None,  # (V,) bool; False = unsampleable (padded-vocab ids)
+    nan_inject=None,  # (B,) bool or None — chaos drill: overwrite flagged
+    # rows' logits with NaN so the poison guard's containment is testable.
+    # None (production) keeps the traced program identical to pre-chaos.
     chunk_steps: int = 32,
     greedy: bool = True,
     constrained: bool = True,
@@ -295,9 +317,15 @@ def chunk_decode_loop(
     the cache at capacity and is acceptable only off-TPU.
 
     Returns (emitted (B, <=chunk_steps*(1+W)), counts, eos_flags, cache,
-    cur, pos, fsm_state, active, nbytes, tokens_left). eos is True only for
-    rows that sampled EOS (clean finish) -- budget/length truncation leaves
-    it False.
+    cur, pos, fsm_state, active, nbytes, tokens_left, fwds, poison). eos is
+    True only for rows that sampled EOS (clean finish) -- budget/length
+    truncation leaves it False. ``poison`` is the per-row fault code the
+    scheduler's quarantine keys on: 0 healthy, 1 non-finite logits (NaN/inf
+    out of the forward), 2 grammar dead state (the FSM has no legal
+    continuation — unreachable under healthy constrained decoding, reached
+    by corrupt state or injection). A poisoned row deactivates WITHOUT
+    committing the faulty sample, so batch-mates' carries (and therefore
+    their tokens) are untouched — per-request containment at the loop level.
     """
     B = cur.shape[0]
     if max_len is None:
@@ -311,14 +339,15 @@ def chunk_decode_loop(
     eos0 = (~active) & (cur == eos_id)
 
     carry0 = (cache, cur, pos, fsm_state, active, eos0, nbytes, tokens_left, out,
-              jnp.zeros((B,), jnp.int32), key, jnp.zeros((), jnp.int32))
+              jnp.zeros((B,), jnp.int32), key, jnp.zeros((), jnp.int32),
+              jnp.zeros((B,), jnp.int32))
 
     def cond(c):
         active, step = c[4], c[11]
         return jnp.logical_and(step < chunk_steps, jnp.any(active))
 
     def body(c):
-        cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
+        cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step, poison = c
         # record current token for active rows
         out = out.at[jnp.arange(B), jnp.minimum(n, cap - 1)].set(
             jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, cap - 1)])
@@ -335,22 +364,37 @@ def chunk_decode_loop(
         else:
             logits, cache = forward(params, cfg, step_tok[:, None], write_pos[:, None],
                                     cache, rules, attn_impl=kernels, unroll=unroll)
+        raw = logits[:, 0, :]
+        if nan_inject is not None:
+            raw = jnp.where(nan_inject[:, None] & active[:, None],
+                            jnp.float32(jnp.nan), raw)
         key, k = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
-            logits[:, 0, :], state, tables, k, temperature, greedy,
+            raw, state, tables, k, temperature, greedy,
             constrained, kernels, rules, logit_mask
         )
-        state = jnp.where(active, state_next, state)
-        cur = jnp.where(active, nxt, cur)
-        pos = jnp.where(active, pos + 1, pos)
+        # fault fence: a poisoned row deactivates WITHOUT committing the
+        # faulty sample; healthy rows commit exactly as before (ok==active)
+        ok, poison = _poison_gate(raw, state, state_next, active, poison,
+                                  constrained)
+        state = jnp.where(ok, state_next, state)
+        cur = jnp.where(ok, nxt, cur)
+        pos = jnp.where(ok, pos + 1, pos)
 
-        eos = eos | (active & (cur == eos_id))
+        eos = eos | (ok & (cur == eos_id))
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_len - 1) | (left <= 0)
-        active = active & ~stop
-        return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
+        active = ok & ~stop
+        return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key,
+                step + 1, poison)
 
     def ff_body(c):
-        cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
+        cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step, poison = c
+        # dead-at-entry rows must not fast-forward: ff_tokens[state] with a
+        # negative state wraps to an arbitrary chain — fence them out of
+        # this step's emission entirely (their result is discarded anyway)
+        dead_in = active & (state < 0)
+        active = active & ~dead_in
+        poison = jnp.maximum(poison, jnp.where(dead_in, 2, 0))
         iw = jnp.arange(1 + W)[None, :]  # (1, 1+W) block index
         chain = tables.ff_tokens[state]  # (B, W); -1 pads
         # chain length, capped so emission fits the token budget, the cache
@@ -396,24 +440,31 @@ def chunk_decode_loop(
             logits, cache = forward(params, cfg, blk_tok, blk_pos, cache, rules,
                                     attn_impl=kernels, unroll=unroll)
         logits_k = jnp.take_along_axis(logits, k[:, None, None], axis=1)[:, 0, :]
+        if nan_inject is not None:
+            logits_k = jnp.where(nan_inject[:, None] & active[:, None],
+                                 jnp.float32(jnp.nan), logits_k)
         key, kk = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
             logits_k, s_end, tables, kk, temperature, greedy,
             constrained, kernels, rules, logit_mask
         )
-        state = jnp.where(active, state_next, state)
-        cur = jnp.where(active, nxt, cur)
-        pos = jnp.where(active, pos + 1 + k, pos)
+        ok, poison = _poison_gate(logits_k, s_end, state_next, active,
+                                  poison, constrained)
+        state = jnp.where(ok, state_next, state)
+        cur = jnp.where(ok, nxt, cur)
+        pos = jnp.where(ok, pos + 1 + k, pos)
 
-        eos = eos | (active & (cur == eos_id))
+        eos = eos | (ok & (cur == eos_id))
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_len - 1) | (left <= 0)
-        active = active & ~stop
-        return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
+        active = ok & ~stop
+        return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key,
+                step + 1, poison)
 
-    (cache, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds) = (
+    (cache, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds, poison) = (
         jax.lax.while_loop(cond, ff_body if use_ff else body, carry0)
     )
-    return out[:, :cap], n, eos, cache, cur, pos, state, active, nbytes, left, fwds
+    return (out[:, :cap], n, eos, cache, cur, pos, state, active, nbytes, left,
+            fwds, poison)
 
 
 class DecodeEngine:
@@ -743,6 +794,13 @@ class DecodeEngine:
         every engine layout (dense / paged / pp override only the
         ``_prefill_suffix`` / ``_prefill_full`` kernels) — the paths the
         equivalence tests hold token-identical."""
+        from ..utils.chaos import ChaosError, chaos_fire
+
+        if chaos_fire("prefill_exc"):
+            # drill for the scheduler's per-request admission fence: fires
+            # BEFORE any engine state is touched, like a real tokenizer/
+            # shape fault at the top of admission
+            raise ChaosError("chaos: injected prefill exception")
         self.release_slot(slot)  # a finished request may still own resources
         if self.spec is not None:
             # admission hook: the spec decoder keeps the host-side token
@@ -815,33 +873,71 @@ class DecodeEngine:
         construction; non-greedy chunks keep the plain path (temperature
         speculation would need rejection sampling)."""
         if self.spec is not None and greedy:
+            self._last_poison = None  # spec path carries no poison signal
             return self.spec.decode_chunk(
                 cur, pos, fsm, active, nbytes, tokens_left, key,
                 temperature, byte_budget, chunk_steps)
-        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds = chunk_decode_loop(
-            self.params, self.cfg, self.cache,
-            cur, pos, fsm, active, nbytes, tokens_left,
-            self.tables_ff if self.tables_ff is not None else self.tables,
-            self.byte_len_table,
-            key, jnp.float32(temperature), jnp.int32(byte_budget),
-            rules=self.rules, logit_mask=self.logit_mask,
-            chunk_steps=chunk_steps,
-            greedy=greedy, constrained=True, kernels=self.kernels,
-            eos_id=self.eos_id, pad_id=self.pad_id, unroll=self.decode_unroll,
+        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds, pois = (
+            chunk_decode_loop(
+                self.params, self.cfg, self.cache,
+                cur, pos, fsm, active, nbytes, tokens_left,
+                self.tables_ff if self.tables_ff is not None else self.tables,
+                self.byte_len_table,
+                key, jnp.float32(temperature), jnp.int32(byte_budget),
+                rules=self.rules, logit_mask=self.logit_mask,
+                nan_inject=self._take_nan_inject(),
+                chunk_steps=chunk_steps,
+                greedy=greedy, constrained=True, kernels=self.kernels,
+                eos_id=self.eos_id, pad_id=self.pad_id, unroll=self.decode_unroll,
+            )
         )
         # forward-dispatch count for the chunk (device scalar; the batcher
         # folds it into its one combined readback): the denominator that
-        # keeps tokens-per-forward gauges truthful under multi-token steps
+        # keeps tokens-per-forward gauges truthful under multi-token steps.
+        # _last_poison rides the same transfer: per-row fault codes the
+        # scheduler's quarantine evicts on (0 ok / 1 NaN / 2 dead FSM)
         self._last_fwds = fwds
+        self._last_poison = pois
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
-    def release_slot(self, slot: int, generated_ids: list[int] | None = None) -> None:
+    def _take_nan_inject(self):
+        """Consume the one-shot chaos NaN mask (scheduler sets it per
+        admission under an active drill; None in production — and None
+        keeps the traced loop byte-identical)."""
+        ni = getattr(self, "_nan_inject", None)
+        if ni is None:
+            return None
+        self._nan_inject = None
+        return jnp.asarray(np.asarray(ni, dtype=bool))
+
+    def release_slot(self, slot: int, generated_ids: list[int] | None = None,
+                     ok: bool = True) -> None:
         """A batch slot finished: dense cache rows are simply reused in
         place (the paged engine returns the slot's blocks to the pool —
         and, with radix reuse on, adopts the prompt+generated chain the
-        scheduler passes via ``generated_ids`` into its tree first)."""
+        scheduler passes via ``generated_ids`` into its tree first).
+        ``ok=False`` marks an errored/cancelled request: resources are
+        still freed, but layout subclasses must never cache its chain."""
         if self.spec is not None:
             self.spec.on_release(slot)
+
+    def warm_restart(self) -> None:
+        """Rebuild device decode state after a wedged/corrupt step, REUSING
+        the loaded weights (a cold process restart re-pays checkpoint load
+        and every jit compile; the params and compiled programs are the
+        expensive part and are not suspect — the mutable decode state is).
+        Dense layout: a fresh KV cache; the shared-prefix KV survives (it
+        lives outside the batch cache). The caller (colocate watchdog)
+        owns failing inflight work and resetting the batcher."""
+        if self._alloc_dense_cache:
+            if self.mesh is not None:
+                kv_sh = kv_cache_shardings(self.mesh, self.cfg.n_kv_heads)
+                self.cache = jax.jit(
+                    partial(init_kv_cache, self.cfg, self.batch_slots, self.max_len),
+                    out_shardings=kv_sh)()
+            else:
+                self.cache = init_kv_cache(self.cfg, self.batch_slots, self.max_len)
+        self._nan_inject = None
 
     def _prefill(self, prompt: str):
         if self.batch_slots != 1:
@@ -918,11 +1014,13 @@ class DecodeEngine:
             eos_id=-1 if ignore_eos else self.eos_id,
             pad_id=self.pad_id, unroll=self.decode_unroll,
         )
-        buf_h, count_h_a, eos_h, fwds_h = jax.device_get((buf, count, eos, rest[-1]))
+        buf_h, count_h_a, eos_h, fwds_h, pois_h = jax.device_get(
+            (buf, count, eos, rest[-2], rest[-1]))
         count_h = int(count_h_a[0])
         out_ids = [int(t) for t in np.asarray(buf_h)[0, :count_h]]
         finished = bool(eos_h[0])
         decode_ms = (time.perf_counter() - t1) * 1e3
+        pois = int(np.asarray(pois_h)[0])
 
         from ..utils import get_metrics
 
@@ -939,6 +1037,12 @@ class DecodeEngine:
             decode_ms=decode_ms,
             steps=count_h,
             finished=finished,
+            # a poisoned single-request generation surfaces the typed error
+            # instead of masquerading as truncation (the batched path's
+            # quarantine does the same through the scheduler)
+            error=(None if pois == 0 else
+                   "poisoned: " + ("non-finite logits" if pois == 1
+                                   else "grammar dead state")),
             forwards=int(fwds_h),
         )
 

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..grammar.fsm import fsm_advance
 from ..models.llama import forward_paged
-from .engine import DecodeEngine, _mask_sample_advance
+from .engine import DecodeEngine, _mask_sample_advance, _poison_gate
 from .radix import RadixCache
 
 
@@ -70,6 +70,13 @@ class BlockAllocator:
         self._refs: dict[int, int] = {}
 
     def alloc(self, k: int, group: int = 0) -> list[int]:
+        from ..utils.chaos import chaos_fire
+
+        if chaos_fire("alloc_fail"):
+            # drill for the pool-pressure degradation ladder: same type a
+            # genuinely exhausted pool raises, so eviction/retry/shed paths
+            # are exercised end to end
+            raise PoolExhausted("chaos: injected allocation failure")
         free = self._free[group]
         if len(free) < k:
             raise PoolExhausted(
@@ -112,6 +119,19 @@ class BlockAllocator:
                 self._free[b // self.blocks_per_group].append(b)
             else:
                 self._refs[b] = r
+
+    def reserve(self, blocks: list[int]) -> None:
+        """Adopt specific block ids into a FRESH allocator as allocated
+        (refcount 1): the warm-restart path rebuilds the allocator but must
+        keep the static-prefix blocks — whose pool KV survives the restart —
+        exactly where they are. All-or-nothing like ref()/free()."""
+        for b in blocks:
+            g = b // self.blocks_per_group
+            if b in self._refs or b not in self._free[g]:
+                raise ValueError(f"reserve of unavailable block {b}")
+        for b in blocks:
+            self._free[b // self.blocks_per_group].remove(b)
+            self._refs[b] = 1
 
     def refcount(self, block: int) -> int:
         """Live refcount of one block (0 = untracked/free). Refcounts are
@@ -195,6 +215,7 @@ def paged_chunk_decode_loop(
     trash_idx=None,  # (B,) int32 per-row parked-write index (dp-local trash)
     rules=None,
     logit_mask=None,
+    nan_inject=None,  # (B,) bool or None — chaos drill (see engine.py twin)
     chunk_steps: int = 32,
     greedy: bool = True,
     constrained: bool = True,
@@ -206,7 +227,10 @@ def paged_chunk_decode_loop(
     """chunk_decode_loop's paged twin: forward_paged per step, idle rows'
     writes parked in their group's reserved trash block via write_mask (they
     must never scribble on another slot's — or the shared prefix's —
-    blocks)."""
+    blocks). Returns the dense loop's tuple shape including the per-row
+    ``poison`` fault codes (0 ok / 1 non-finite logits / 2 dead FSM); a
+    poisoned row deactivates without committing the faulty sample, so
+    batch-mates decode token-identically to an undisturbed run."""
     B = cur.shape[0]
     # the engine's max_len, NOT the block-rounded table capacity — with a
     # non-multiple max_len the dense loop stops at max_len-1 and the paged
@@ -225,14 +249,14 @@ def paged_chunk_decode_loop(
 
     carry0 = (k_pool, v_pool, cur, pos, fsm_state, active, eos0, nbytes,
               tokens_left, out, jnp.zeros((B,), jnp.int32), key,
-              jnp.zeros((), jnp.int32))
+              jnp.zeros((), jnp.int32), jnp.zeros((B,), jnp.int32))
 
     def cond(c):
         active, step = c[5], c[12]
         return jnp.logical_and(step < chunk_steps, jnp.any(active))
 
     def body(c):
-        kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
+        kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step, poison = c
         out = out.at[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)].set(
             jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)])
         )
@@ -247,19 +271,26 @@ def paged_chunk_decode_loop(
             block_tables, rules=rules, attn_impl=kernels, write_mask=active,
             trash_idx=trash_idx,
         )
+        raw = logits[:, 0, :]
+        if nan_inject is not None:
+            raw = jnp.where(nan_inject[:, None] & active[:, None],
+                            jnp.float32(jnp.nan), raw)
         key, k = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
-            logits[:, 0, :], state, tables, k, temperature, greedy,
+            raw, state, tables, k, temperature, greedy,
             constrained, kernels, rules, logit_mask
         )
-        state = jnp.where(active, state_next, state)
-        cur = jnp.where(active, nxt, cur)
-        pos = jnp.where(active, pos + 1, pos)
+        ok, poison = _poison_gate(raw, state, state_next, active, poison,
+                                  constrained)
+        state = jnp.where(ok, state_next, state)
+        cur = jnp.where(ok, nxt, cur)
+        pos = jnp.where(ok, pos + 1, pos)
 
-        eos = eos | (active & (cur == eos_id))
+        eos = eos | (ok & (cur == eos_id))
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_pos - 1) | (left <= 0)
-        active = active & ~stop
-        return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
+        active = ok & ~stop
+        return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key,
+                step + 1, poison)
 
     def ff_body(c):
         # the dense ff_body's paged twin: cur + its state's forced chain in
@@ -270,7 +301,12 @@ def paged_chunk_decode_loop(
         # max_pos (table-covered capacity ∧ engine max_len) as the bound —
         # the engine's decode_chunk grew every live row's table to cover a
         # full ff chunk before dispatch.
-        kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step = c
+        kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step, poison = c
+        # dead-at-entry fence (see the dense ff_body): a negative state
+        # wraps the ff_tokens gather — poison it out before it emits
+        dead_in = active & (state < 0)
+        active = active & ~dead_in
+        poison = jnp.maximum(poison, jnp.where(dead_in, 2, 0))
         iw = jnp.arange(1 + W)[None, :]
         chain = tables.ff_tokens[state]  # (B, W); -1 pads
         k = jnp.minimum(jnp.minimum(tables.ff_len[state], left - 1),
@@ -318,25 +354,32 @@ def paged_chunk_decode_loop(
             trash_idx=trash_idx,
         )
         logits_k = jnp.take_along_axis(logits, k[:, None, None], axis=1)[:, 0, :]
+        if nan_inject is not None:
+            logits_k = jnp.where(nan_inject[:, None] & active[:, None],
+                                 jnp.float32(jnp.nan), logits_k)
         key, kk = jax.random.split(key)
         nxt, state_next = _mask_sample_advance(
             logits_k, s_end, tables, kk, temperature, greedy,
             constrained, kernels, rules, logit_mask
         )
-        state = jnp.where(active, state_next, state)
-        cur = jnp.where(active, nxt, cur)
-        pos = jnp.where(active, pos + 1 + k, pos)
+        ok, poison = _poison_gate(logits_k, s_end, state_next, active,
+                                  poison, constrained)
+        state = jnp.where(ok, state_next, state)
+        cur = jnp.where(ok, nxt, cur)
+        pos = jnp.where(ok, pos + 1 + k, pos)
 
-        eos = eos | (active & (cur == eos_id))
+        eos = eos | (ok & (cur == eos_id))
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_pos - 1) | (left <= 0)
-        active = active & ~stop
-        return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step + 1)
+        active = ok & ~stop
+        return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key,
+                step + 1, poison)
 
-    (k_pool, v_pool, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds) = (
+    (k_pool, v_pool, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds,
+     poison) = (
         jax.lax.while_loop(cond, ff_body if use_ff else body, carry0)
     )
     return (out[:, : cap if use_ff else chunk_steps], n, eos, k_pool, v_pool,
-            cur, pos, state, active, nbytes, left, fwds)
+            cur, pos, state, active, nbytes, left, fwds, poison)
 
 
 class PagedDecodeEngine(DecodeEngine):
@@ -408,6 +451,15 @@ class PagedDecodeEngine(DecodeEngine):
         self.radix: list[RadixCache] | None = (
             [RadixCache(self.allocator, bs, group=g, max_nodes=radix_max_nodes)
              for g in range(self.dp)] if radix_enable else None)
+        # pool-pressure gate on session-cache admission (degradation stage
+        # 2): while a recent allocation actually hit PoolExhausted (genuine
+        # thrash — eviction had to run or the request shed), released
+        # chains are NOT adopted into the tree for RADIX_PRESSURE_S, so the
+        # cache stops pinning blocks live admissions immediately need.
+        # Trigger on measured thrash, not a static watermark: a full-but-
+        # quiet pool is the radix cache working as intended.
+        self._pressure_window_s = float(os.environ.get("RADIX_PRESSURE_S", "2.0"))
+        self._pressure_until = 0.0
         # host token ids of the request occupying each slot (radix insert
         # at release needs prompt + generated ids; None when radix is off)
         self._slot_ids: list[list[int] | None] = [None] * self.batch_slots
@@ -475,12 +527,16 @@ class PagedDecodeEngine(DecodeEngine):
 
     def _alloc(self, k: int, group: int) -> list[int]:
         """allocator.alloc with radix backpressure: when the pool is out,
-        evict LRU unreferenced radix leaves and retry once. Without a tree
-        (or with nothing evictable) PoolExhausted propagates — the
-        scheduler's per-request isolation handles it."""
+        evict LRU unreferenced radix leaves and retry once (degradation
+        stage 1). Either way the PoolExhausted marks pool pressure, which
+        gates session-cache admission (stage 2, ``_radix_may_admit``) for
+        the next RADIX_PRESSURE_S. Without a tree (or with nothing
+        evictable) PoolExhausted propagates — the scheduler's backpressure/
+        shed ladder (stage 3) handles it."""
         try:
             return self.allocator.alloc(k, group=group)
         except PoolExhausted:
+            self._pressure_until = time.monotonic() + self._pressure_window_s
             if self.radix is None:
                 raise
             need = k - self.allocator.free_blocks(group)
@@ -682,32 +738,44 @@ class PagedDecodeEngine(DecodeEngine):
                     tokens_left = tokens_left.at[b].set(0)
                     continue
                 self._next_pos[b] = min(self._next_pos[b] + span, self.max_len)
-        out, n, eos, self.k_pool, self.v_pool, cur, pos, fsm, active, nbytes, left, fwds = (
-            paged_chunk_decode_loop(
-                self.params, self.cfg, self.k_pool, self.v_pool, self.block_tables,
-                cur, pos, fsm, active, nbytes, tokens_left,
-                self.tables_ff if self.tables_ff is not None else self.tables,
-                self.byte_len_table,
-                key, jnp.float32(temperature), jnp.int32(byte_budget),
-                trash_idx=self._trash_idx, rules=self.rules,
-                logit_mask=self.logit_mask, chunk_steps=chunk_steps,
-                greedy=greedy, constrained=True, kernels=self.kernels,
-                eos_id=self.eos_id, pad_id=self.pad_id, max_len=self.max_len,
+        out, n, eos, self.k_pool, self.v_pool, cur, pos, fsm, active, nbytes, left, \
+            fwds, pois = (
+                paged_chunk_decode_loop(
+                    self.params, self.cfg, self.k_pool, self.v_pool, self.block_tables,
+                    cur, pos, fsm, active, nbytes, tokens_left,
+                    self.tables_ff if self.tables_ff is not None else self.tables,
+                    self.byte_len_table,
+                    key, jnp.float32(temperature), jnp.int32(byte_budget),
+                    trash_idx=self._trash_idx, rules=self.rules,
+                    logit_mask=self.logit_mask,
+                    nan_inject=self._take_nan_inject(),
+                    chunk_steps=chunk_steps,
+                    greedy=greedy, constrained=True, kernels=self.kernels,
+                    eos_id=self.eos_id, pad_id=self.pad_id, max_len=self.max_len,
+                )
             )
-        )
         # forward-dispatch count for the scheduler's tokens-per-forward
         # gauge (rides its combined readback) — without it the gauge is
-        # silently absent on the paged layout while ff multi-emits there too
+        # silently absent on the paged layout while ff multi-emits there too.
+        # _last_poison rides the same readback (quarantine fault codes).
         self._last_fwds = fwds
+        self._last_poison = pois
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
-    def release_slot(self, slot: int, generated_ids: list[int] | None = None) -> None:
+    def release_slot(self, slot: int, generated_ids: list[int] | None = None,
+                     ok: bool = True) -> None:
         if self._slot_owned[slot] or self._slot_shared[slot]:
-            if (self.radix is not None and generated_ids is not None
-                    and self._slot_ids[slot] is not None):
+            if (ok and self.radix is not None and generated_ids is not None
+                    and self._slot_ids[slot] is not None
+                    and self._radix_may_admit(self._group(slot))):
                 # insert the finished request's prompt+generated chain back
                 # into the tree BEFORE freeing the slot's refs: adopted
-                # blocks gain the tree's own ref and survive the free below
+                # blocks gain the tree's own ref and survive the free below.
+                # ok=False (errored/poisoned/cancelled request) NEVER
+                # inserts: a poisoned generation must not be served to a
+                # later session as a warm prefix. Under pool pressure
+                # (_radix_may_admit) insertion is denied too — caching must
+                # yield to live admissions before live admissions shed.
                 ids = self._slot_ids[slot] + [int(t) for t in generated_ids]
                 blocks = self._slot_shared[slot] + self._slot_owned[slot]
                 self.radix[self._group(slot)].insert(ids, blocks)
@@ -718,6 +786,53 @@ class PagedDecodeEngine(DecodeEngine):
             self._covered[slot] = 0
             self._next_pos[slot] = 0
         self._slot_ids[slot] = None
+
+    def _radix_may_admit(self, group: int) -> bool:
+        """Pool-pressure gate on session-cache admission (degradation stage
+        2 — after cold-leaf eviction, before shedding live work): while a
+        recent allocation hit PoolExhausted, released chains are dropped
+        instead of adopted, so the tree stops pinning blocks the next
+        admission will immediately need. Existing cached chains still
+        serve hits; the cache just stops growing until pressure clears."""
+        if time.monotonic() >= self._pressure_until:
+            return True
+        from ..utils import get_metrics
+
+        get_metrics().inc("radix.admission_denied")
+        return False
+
+    def warm_restart(self) -> None:
+        """Paged warm restart: throw away every slot's mutable state and the
+        allocator/radix bookkeeping, KEEPING params, compiled programs, the
+        pool arrays, and the static-prefix KV (its blocks are re-reserved in
+        the fresh allocator and re-pinned as the radix root — the pool's
+        bytes were never suspect, only the slot/table bookkeeping wedged
+        with a stuck step). Inflight requests are the caller's to fail."""
+        n_blocks = self.allocator.n_blocks
+        self.allocator = BlockAllocator(n_blocks, n_groups=self.dp)
+        for g in range(self.dp):
+            if self._prefix_blocks[g]:
+                self.allocator.reserve(self._prefix_blocks[g])
+        if self.radix is not None:
+            max_nodes = self.radix[0].max_nodes
+            self.radix = [RadixCache(self.allocator, self.block_size, group=g,
+                                     max_nodes=max_nodes)
+                          for g in range(self.dp)]
+            full = len(self.prefix_ids) // self.block_size
+            if full:
+                for g in range(self.dp):
+                    self.radix[g].pin_root_chain(
+                        self.prefix_ids[: full * self.block_size],
+                        self._prefix_blocks[g])
+        self._slot_shared = [[] for _ in range(self.batch_slots)]
+        self._slot_owned = [[] for _ in range(self.batch_slots)]
+        self._covered = [0] * self.batch_slots
+        self._next_pos = [0] * self.batch_slots
+        self._slot_ids = [None] * self.batch_slots
+        self.block_tables = jnp.zeros(
+            (self.batch_slots, self.max_blocks), jnp.int32)
+        self._pressure_until = 0.0
+        self._nan_inject = None
 
     # the dense single-request path doesn't exist here; the batcher is the
     # serving surface (generate_many / services with BRAIN_BATCH)

@@ -268,7 +268,7 @@ class PPDecodeEngine(DecodeEngine):
         # writes + full-mask attend handle any T), emitting chain tokens
         # without extra full-cache reads
         tables = self.tables_ff if self.tables_ff is not None else self.tables
-        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds = chunk_decode_loop(
+        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds, pois = chunk_decode_loop(
             self.params, self.cfg, self.cache,
             cur, pos, fsm, active, nbytes, tokens_left,
             tables, self.byte_len_table,
@@ -280,8 +280,10 @@ class PPDecodeEngine(DecodeEngine):
             fwd=self._fwd, max_len=self.max_len,
         )
         # forward-dispatch count: the scheduler's tokens-per-forward gauge
-        # reads this off the chunk's combined device_get
+        # reads this off the chunk's combined device_get; _last_poison
+        # carries the per-row quarantine fault codes on the same transfer
         self._last_fwds = fwds
+        self._last_poison = pois
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
     def generate(self, *a, **kw):

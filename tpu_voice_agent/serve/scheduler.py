@@ -23,7 +23,9 @@ per token.
 
 from __future__ import annotations
 
+import os
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 import jax
@@ -33,6 +35,22 @@ import numpy as np
 from .engine import DecodeEngine, GenerationResult, _first_token
 from .paged import PoolExhausted
 
+try:  # device faults must PROPAGATE out of per-request fences (a corrupted
+    # engine must not be dispatched again); everything else fails alone
+    from jax.errors import JaxRuntimeError as _DeviceFault
+except ImportError:  # pragma: no cover - older jax
+    from jaxlib.xla_extension import XlaRuntimeError as _DeviceFault
+
+
+def _err_result(error: str, steps: int = 0,
+                prefill_ms: float = 0.0) -> GenerationResult:
+    """The one spelling of a typed per-request failure. Error prefixes are
+    contract: ``shed:`` -> the brain answers 503 + Retry-After (retryable
+    overload), ``quarantined:`` / ``poisoned:`` / ``cancelled:`` -> 500
+    (do not retry the same bytes)."""
+    return GenerationResult(text="", token_ids=[], prefill_ms=prefill_ms,
+                            decode_ms=0.0, steps=steps, finished=False,
+                            error=error)
 
 
 
@@ -96,34 +114,183 @@ class ContinuousBatcher:
         # continuous batching tunes against, without a scrape having to
         # difference the tokens_generated counter itself
         self._tps_ema = 0.0
+        # ---- fault containment state (ISSUE 7) ----
+        # per-request deadlines (x-deadline-ms propagated by the brain):
+        # checked at dequeue (queue wait may have consumed the budget) and
+        # between decode chunks (a dead/expired client must not burn steps)
+        self._deadline: dict[int, object] = {}
+        # repeat-offender quarantine: prompt fingerprint -> offense record.
+        # A prompt that poisons the engine QUARANTINE_AFTER times is refused
+        # at submit — the same poisonous bytes retried by a client (or
+        # mirrored across sessions) must not keep evicting slots. Bounded
+        # LRU; surfaced in the brain's /health.
+        self.quarantine_after = int(os.environ.get("QUARANTINE_AFTER", "2"))
+        self._offenses: "OrderedDict[object, dict]" = OrderedDict()
+        self._prompt_fp: dict[int, object] = {}
+        # chaos drill arming (slots flagged at admission) + epoch fence:
+        # reset()/warm-restart bumps _epoch so a step that was stalled
+        # mid-flight discards its commit instead of scribbling on the
+        # restarted world
+        self._nan_slots: set[int] = set()
+        self._epoch = 0
+        # pool-pressure backpressure: first-PoolExhausted timestamp per rid;
+        # a request that cannot be admitted within SCHED_POOL_WAIT_S (while
+        # other slots could still free blocks) sheds with a typed error the
+        # brain maps to 503 + Retry-After
+        self._pool_wait: dict[int, float] = {}
+        self._pool_wait_s = float(os.environ.get("SCHED_POOL_WAIT_S", "1.0"))
+        # containment counters exist from construction (same discipline as
+        # the breaker-state gauges: a scraper must see every containment
+        # signal at zero, not as an absent series) — these literals are
+        # also what tools/metrics_lint.py pins, since the eviction helper
+        # increments through a parameter
+        from ..utils import get_metrics
+
+        m = get_metrics()
+        m.inc("scheduler.slots_quarantined", 0.0)
+        m.inc("scheduler.cancelled", 0.0)
+        m.inc("scheduler.shed_expired", 0.0)
 
     # ------------------------------------------------------------ submit
 
     def reset(self) -> None:
         """Abandon all queued and in-flight work (decode-fault recovery —
         the cache contents are garbage until fresh admissions overwrite
-        them, which _admit and chunk_decode_loop handle per slot)."""
+        them, which _admit and chunk_decode_loop handle per slot). Bumps
+        the epoch so a step stalled mid-flight (the case the watchdog
+        warm-restarts around) discards its commit on wake instead of
+        scribbling stale device state over the fresh world. The quarantine
+        list deliberately SURVIVES — a poisonous prompt stays quarantined
+        across the restart it caused."""
+        self._epoch += 1
         self.pending.clear()
         self._enqueued_at.clear()
+        self._deadline.clear()
+        self._prompt_fp.clear()
+        self._pool_wait.clear()
+        self._nan_slots.clear()
         self.results.clear()
         self.slots = [_Slot() for _ in range(self.B)]
         self.active = jnp.zeros_like(self.active)
         self._active_h = np.zeros((self.B,), dtype=bool)
         for b in range(self.B):
-            self.engine.release_slot(b)
+            self.engine.release_slot(b, ok=False)
 
-    def submit(self, prompt) -> int:
+    def submit(self, prompt, deadline=None) -> int:
         """Queue one request. ``prompt`` is a string, or a pre-tokenized
         ``list[int]`` — the session-aware brain path builds turn N's ids as
         the literal turn N-1 ids + generated ids + new-frame ids, so the
         radix match sees a STRICT token extension (re-encoding generated
         text is not id-stable: grammar-constrained decoding may emit
-        non-canonical BPE pieces)."""
+        non-canonical BPE pieces). ``deadline`` (utils.resilience.Deadline,
+        optional) arms queue-expiry shedding and mid-decode cancellation.
+        A quarantined prompt (repeat poison offender) is refused here with
+        a typed error, before it can occupy queue or slot."""
         rid = self._next_id
         self._next_id += 1
+        fp = self._fingerprint(prompt)
+        off = self._offenses.get(fp)
+        if off is not None and off["count"] >= self.quarantine_after:
+            off["rejected"] += 1
+            from ..utils import get_metrics
+
+            get_metrics().inc("scheduler.quarantine_rejected")
+            self.results[rid] = _err_result(
+                f"quarantined: {off['reason']} x{off['count']} "
+                f"(prompt {off['preview']!r})")
+            return rid
+        self._prompt_fp[rid] = fp
+        if deadline is not None:
+            self._deadline[rid] = deadline
         self._enqueued_at[rid] = time.perf_counter()
         self.pending.append((rid, prompt))
         return rid
+
+    # ------------------------------------------------- fault containment
+
+    @staticmethod
+    def _fingerprint(prompt) -> object:
+        return prompt if isinstance(prompt, str) else tuple(prompt)
+
+    @staticmethod
+    def _preview(prompt) -> str:
+        return (prompt[:60] if isinstance(prompt, str)
+                else f"<{len(prompt)} token ids>")
+
+    def _record_offense(self, rid: int, reason: str) -> None:
+        """Count a poison event against the request's prompt fingerprint;
+        at ``quarantine_after`` the fingerprint is refused at submit."""
+        fp = self._prompt_fp.get(rid)
+        if fp is None:
+            return
+        off = self._offenses.get(fp)
+        if off is None:
+            off = self._offenses[fp] = {
+                "count": 0, "rejected": 0, "reason": reason,
+                "preview": self._preview(fp)}
+        off["count"] += 1
+        off["reason"] = reason
+        self._offenses.move_to_end(fp)
+        while len(self._offenses) > 64:
+            self._offenses.popitem(last=False)
+
+    def quarantined(self) -> list[dict]:
+        """Active quarantine entries (the brain surfaces these in /health)."""
+        return [
+            {"preview": off["preview"], "count": off["count"],
+             "rejected": off["rejected"], "reason": off["reason"]}
+            for off in self._offenses.values()
+            if off["count"] >= self.quarantine_after
+        ]
+
+    def _cleanup(self, rid: int) -> None:
+        """Drop every per-request map entry (terminal paths only)."""
+        self._enqueued_at.pop(rid, None)
+        self._deadline.pop(rid, None)
+        self._prompt_fp.pop(rid, None)
+        self._pool_wait.pop(rid, None)
+
+    def _evict_slot(self, b: int, error: str, counter: str) -> None:
+        """Evict ONE in-flight slot with a typed error: deactivate the
+        device row, free the engine's KV refs WITHOUT caching its chain
+        (``ok=False`` — a poisoned/cancelled generation must never be
+        served to a later session as a warm radix prefix), and resolve the
+        request. Batch-mates' rows are untouched — their carries never see
+        the eviction, so their tokens are identical to an undisturbed run."""
+        from ..utils import get_metrics
+
+        sl = self.slots[b]
+        rid = sl.request_id
+        self.results[rid] = _err_result(error, steps=len(sl.token_ids),
+                                        prefill_ms=sl.prefill_ms)
+        get_metrics().inc(counter)
+        self._cleanup(rid)
+        self.slots[b] = _Slot()
+        self.active = self.active.at[b].set(False)
+        self._active_h[b] = False
+        self._nan_slots.discard(b)
+        self.engine.release_slot(b, ok=False)
+
+    def cancel(self, rid: int, reason: str = "client gone") -> bool:
+        """Cancel one request mid-flight: queued -> dropped; in a slot ->
+        evicted between decode chunks, releasing the slot and its KV blocks
+        instead of burning steps for a dead socket. MUST run on the thread
+        that drives step() (colocate applies cancellations there); returns
+        True when the request was found live."""
+        from ..utils import get_metrics
+
+        for i, (r, _) in enumerate(self.pending):
+            if r == rid:
+                del self.pending[i]
+                self.results[rid] = _err_result(f"cancelled: {reason}")
+                get_metrics().inc("scheduler.cancelled")
+                self._cleanup(rid)
+                return True
+        for b in range(self.B):
+            if self.slots[b].request_id == rid:
+                self._evict_slot(b, f"cancelled: {reason}", "scheduler.cancelled")
+                return True
+        return False
 
     def _free_slot(self, act: np.ndarray) -> int | None:
         for b in range(self.B):
@@ -182,26 +349,103 @@ class ContinuousBatcher:
     # ------------------------------------------------------------ step
 
     def step(self) -> None:
-        """Admit pending requests into free slots, then run one chunk."""
+        """Admit pending requests into free slots, then run one chunk.
+
+        Containment happens at the chunk boundaries: expired requests are
+        shed at dequeue (``scheduler.shed_expired``) and cancelled between
+        chunks (``scheduler.cancelled``); admission failures fence
+        per-request (device faults still propagate); poisoned rows reported
+        by the decode loop are quarantined (``scheduler.slots_quarantined``)
+        — in every case batch-mates continue token-identically."""
+        from ..utils import get_metrics
+        from ..utils.chaos import chaos_fire
+
+        m = get_metrics()
+        epoch = self._epoch
+        if chaos_fire("stall_step"):
+            # chaos drill for the stalled-step watchdog: sleep as if the
+            # dispatch wedged. On wake, a bumped epoch means the watchdog
+            # already warm-restarted the world — this step must vanish.
+            time.sleep(float(os.environ.get("CHAOS_STALL_S", "2.0")))
+            if epoch != self._epoch:
+                return
+
         act = self._active_h  # host mirror — no device readback for admission
+        # mid-decode cancellation: a slot whose deadline expired aborts at
+        # the chunk boundary, releasing slot + blocks instead of burning
+        # decode steps for a response nobody will read
+        for b in range(self.B):
+            rid = self.slots[b].request_id
+            if rid >= 0:
+                dl = self._deadline.get(rid)
+                if dl is not None and dl.expired:
+                    self._evict_slot(b, "cancelled: deadline expired mid-decode",
+                                     "scheduler.cancelled")
         while self.pending:
             slot = self._free_slot(act)
             if slot is None:
                 break
             rid, prompt = self.pending.pop(0)
+            dl = self._deadline.get(rid)
+            if dl is not None and dl.expired:
+                # satellite fix: admission shed expired deadlines before
+                # ENQUEUE only — re-check at dequeue, where overload queue
+                # time actually accumulates, so a stale request never
+                # occupies a decode slot
+                self.results[rid] = _err_result("shed: deadline expired in queue")
+                m.inc("scheduler.shed_expired")
+                self._cleanup(rid)
+                continue
             try:
                 self._admit(slot, rid, prompt)
                 act[slot] = True
-            except (ValueError, PoolExhausted) as e:
-                # per-request isolation: an oversized prompt or an exhausted
-                # KV pool fails alone, never the batch (mirrors the
-                # executor's per-step try/catch). Deliberately NOT a broad
-                # RuntimeError catch: XlaRuntimeError device faults must
-                # propagate, not dispatch more chunks on a corrupted engine.
-                self.results[rid] = GenerationResult(
-                    text="", token_ids=[], prefill_ms=0.0, decode_ms=0.0,
-                    steps=0, finished=False, error=str(e),
-                )
+                self._pool_wait.pop(rid, None)
+                # chaos drill arming (no-ops with chaos off): NaN logits on
+                # this slot's next chunk / FSM state forced dead
+                if chaos_fire("nan_logits"):
+                    self._nan_slots.add(slot)
+                if chaos_fire("dead_fsm"):
+                    self.fsm = self.fsm.at[slot].set(-1)
+            except PoolExhausted as e:
+                # pool-pressure degradation ladder (stage 3; stages 1-2 —
+                # radix cold-leaf eviction and session-cache admission
+                # denial — live in the paged engine): requeue at the head
+                # while in-flight slots can still free blocks, shed with a
+                # typed 503-mapped error once nothing can (no live slots)
+                # or the wait/deadline budget is burned
+                try:
+                    self.engine.release_slot(slot, ok=False)
+                except Exception:
+                    pass  # partial admission state is best-effort cleanup
+                first = self._pool_wait.setdefault(rid, time.perf_counter())
+                waited = time.perf_counter() - first
+                if (not act.any() or waited >= self._pool_wait_s
+                        or (dl is not None and dl.expired)):
+                    self.results[rid] = _err_result(f"shed: {e}")
+                    m.inc("scheduler.shed_pool")
+                    self._cleanup(rid)
+                else:
+                    self.pending.insert(0, (rid, prompt))
+                break  # stop admitting; let the live batch drain blocks
+            except Exception as e:
+                if isinstance(e, _DeviceFault):
+                    # a device fault is never per-request: propagate rather
+                    # than dispatch more chunks on a corrupted engine (the
+                    # colocate loop fails inflights + the watchdog restarts)
+                    raise
+                # per-request prefill fence: oversized prompt (ValueError),
+                # tokenizer fault, chaos injection — fails alone, never the
+                # batch. Non-ValueError faults count as poison offenses so
+                # a prompt that keeps exploding prefill gets quarantined.
+                try:
+                    self.engine.release_slot(slot, ok=False)
+                except Exception:
+                    pass
+                self.results[rid] = _err_result(str(e))
+                if not isinstance(e, ValueError):
+                    m.inc("scheduler.prefill_faults")
+                    self._record_offense(rid, f"prefill {type(e).__name__}")
+                self._cleanup(rid)
 
         # drop enqueue stamps with no pending entry left (requests admitted
         # above pop their own; these are abandons — colocate tombstoning
@@ -215,10 +459,16 @@ class ContinuousBatcher:
             return
 
         eng = self.engine
+        if self._nan_slots:
+            mask = np.zeros((self.B,), dtype=bool)
+            for b in self._nan_slots:
+                mask[b] = True
+            eng._nan_inject = mask
+            self._nan_slots.clear()
         t_chunk0 = time.perf_counter()
         self._rng, k = jax.random.split(self._rng)
-        (out, n, eos, self.cur, self.pos, self.fsm, self.active,
-         self.nbytes, self.tokens_left) = eng.decode_chunk(
+        (out, n, eos, cur, pos, fsm, active,
+         nbytes, tokens_left) = eng.decode_chunk(
             self.cur, self.pos, self.fsm, self.active, self.nbytes,
             self.tokens_left, k, self.temperature, self.byte_budget,
             self.chunk_steps, self.greedy,
@@ -230,14 +480,24 @@ class ContinuousBatcher:
         # tokens-per-forward truthful under multi-token steps (grammar
         # fast-forward / speculative decoding emit several accepted tokens
         # per forward — counting dispatches as tokens would inflate every
-        # throughput gauge)
+        # throughput gauge). _last_poison rides it too: per-row fault codes
+        # for the quarantine below.
         fwds = getattr(eng, "_last_fwds", None)
-        out_h, n_h, act_h, eos_h, pos_h, fwds_h = (
+        pois = getattr(eng, "_last_poison", None)
+        out_h, n_h, act_h, eos_h, pos_h, fwds_h, pois_h = (
             np.asarray(x)
             for x in jax.device_get(
-                (out, n, self.active, eos, self.pos,
-                 0 if fwds is None else fwds))
+                (out, n, active, eos, pos,
+                 0 if fwds is None else fwds,
+                 0 if pois is None else pois))
         )
+        if epoch != self._epoch:
+            # the watchdog warm-restarted the engine while this step was
+            # stalled in flight: its world is gone — committing the chunk's
+            # state would scribble stale arrays over the fresh one
+            return
+        (self.cur, self.pos, self.fsm, self.active, self.nbytes,
+         self.tokens_left) = cur, pos, fsm, active, nbytes, tokens_left
         self._active_h = np.array(act_h)
         # paged engines clamp their block-growth targets to the actual
         # frontier (the ff worst-case claim must not compound per chunk)
@@ -245,9 +505,6 @@ class ContinuousBatcher:
         if reconcile is not None:
             reconcile(pos_h)
 
-        from ..utils import get_metrics
-
-        m = get_metrics()
         # ACCEPTED/emitted tokens, never verify steps or forward dispatches:
         # `n` is the per-row emitted count in every engine layout (plain,
         # ff, speculative), so the tokens/s EMA below stays truthful when
@@ -282,9 +539,27 @@ class ContinuousBatcher:
 
             record_radix_gauges(radix)
 
+        pois_arr = None if pois is None else pois_h
         for b in range(self.B):
             sl = self.slots[b]
             if sl.request_id < 0:
+                continue
+            if pois_arr is not None and int(pois_arr[b]) > 0:
+                # poison-request quarantine: the loop fenced this row off
+                # mid-chunk (non-finite logits / dead FSM state) without
+                # touching batch-mates. Evict the slot with a typed error,
+                # free its KV refs WITHOUT radix insertion, count the
+                # offense against the prompt, and freeze a flight-recorder
+                # dump — every contained incident leaves evidence.
+                reason = ("non-finite logits" if int(pois_arr[b]) == 1
+                          else "grammar dead state")
+                self._record_offense(sl.request_id, reason)
+                self._evict_slot(b, f"poisoned: {reason}",
+                                 "scheduler.slots_quarantined")
+                from ..utils.tracing import get_flight_recorder
+
+                get_flight_recorder().trigger("scheduler.quarantine",
+                                              detail=reason)
                 continue
             sl.token_ids.extend(int(t) for t in out_h[b, : n_h[b]])
             if not act_h[b]:
@@ -307,6 +582,7 @@ class ContinuousBatcher:
                 m.inc("scheduler.requests_completed")
                 m.observe_ms("scheduler.request_total",
                              (time.perf_counter() - sl.start_s) * 1e3)
+                self._cleanup(sl.request_id)
                 self.slots[b] = _Slot()
                 # paged engines free the blocks; with radix reuse on, the
                 # generated ids let release insert the prompt+generated

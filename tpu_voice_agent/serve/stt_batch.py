@@ -336,8 +336,22 @@ class STTBatcher:
         eng = self.engine
         out: dict[int, tuple] = {}
         for w in works:
-            cross_kv, _, n_frames = eng._encode_window(w.buf)
-            row = pad_cross_kv(cross_kv, eng.cfg.enc_positions)
+            try:
+                cross_kv, _, n_frames = eng._encode_window(w.buf)
+                row = pad_cross_kv(cross_kv, eng.cfg.enc_positions)
+            except Exception as e:
+                # per-ITEM fence (ISSUE 7): one item's malformed buffer or
+                # encode fault fails ITS future only — batch-mates in the
+                # same tick keep their transcriptions (the worker's broad
+                # per-batch catch remains as the backstop for faults in the
+                # shared decode dispatch itself)
+                _metrics().inc("stt.item_faults")
+                if not w.future.done():
+                    try:
+                        w.future.set_exception(e)
+                    except Exception:
+                        pass  # raced a concurrent cancel
+                continue
             out[id(w)] = (row, max(1, n_frames // 2), n_frames)
         return out
 
@@ -361,7 +375,16 @@ class STTBatcher:
             if st is None or st.utt != w.utt:
                 _resolve(w.future, None)
                 continue
-            self._feed_slot(s, st, w.buf)
+            try:
+                self._feed_slot(s, st, w.buf)
+            except Exception:
+                # per-item fence for best-effort partials: a bad buffer or
+                # encode fault drops this partial (same contract as a shed),
+                # never the tick's batch-mates. The slot stays; the next
+                # partial for the utterance retries from host accounting.
+                _metrics().inc("stt.item_faults")
+                _resolve(w.future, None)
+                continue
             if st.enc_len <= 0:
                 # no complete block yet — same as the B=1 path emitting no
                 # partial before the first INC_STEP block lands
@@ -377,6 +400,8 @@ class STTBatcher:
         encode_ms = ((time.perf_counter() - t_enc) * 1e3 / len(finals)
                      if finals else 0.0)
         for w in finals:
+            if id(w) not in enc_results:
+                continue  # per-item encode fault: its future already failed
             row, valid, n_frames = enc_results[id(w)]
             rows.append((w, row, valid, n_frames))
 

@@ -1,0 +1,10 @@
+"""Service-shared aiohttp bits."""
+
+from aiohttp import web
+
+# App flag: cancel in-flight request handlers when their client
+# disconnects (aiohttp >= 3.9 made this opt-in at the AppRunner). Brain
+# and voice set it — a dead socket must abort its in-flight decode, not
+# burn the slot's token budget — and every runner construction site
+# (service main()s, the test/bench AppServer) reads it.
+HANDLER_CANCELLATION = web.AppKey("handler_cancellation", bool)

@@ -29,7 +29,7 @@ from typing import Protocol
 from aiohttp import web
 
 from ..schemas import Intent, ParseRequest, ParseResponse, Target, parse_response_from_json
-from ..utils import SLOTracker, Tracer, load_env_cascade, new_trace_id
+from ..utils import SLOTracker, Tracer, get_metrics, load_env_cascade, new_trace_id
 from ..utils.resilience import (
     AdmissionController,
     Deadline,
@@ -66,6 +66,14 @@ def _result_to_response(res) -> ParseResponse:
     note_stage("decode_ms", round(res.decode_ms, 3))
     note_stage("cached_tokens", int(getattr(res, "cached_tokens", 0)))
     if res.error:
+        # typed scheduler errors (serve.scheduler._err_result contract):
+        # "shed: ..." is retryable overload -> 503 + Retry-After, so the
+        # voice-side retry/degrade kit treats a KV-pool-exhausted or
+        # queue-expired request exactly like an admission shed. Everything
+        # else (poisoned/quarantined/cancelled/engine fault) is terminal
+        # for these bytes -> llm_error.
+        if res.error.startswith("shed:"):
+            raise ParserError("overloaded", res.error)
         raise ParserError("llm_error", res.error)
     if not res.finished:
         raise ParserError(
@@ -235,13 +243,28 @@ class BatchedEngineParser:
         self.runtime.start_watchdog()
 
     def _decode(self, prompt: str):
-        fut = self.runtime.submit_parse(prompt)
+        from concurrent.futures import CancelledError
+
+        from ..utils.resilience import current_request_context
+
+        # the request context (set by build_app on this worker thread)
+        # carries the propagated deadline INTO the scheduler — expired
+        # requests shed at dequeue / cancel mid-decode — and registers the
+        # disconnect canceller: a client that vanishes aborts its decode at
+        # the next chunk boundary instead of burning the slot's budget
+        ctx = current_request_context()
+        fut = self.runtime.submit_parse(
+            prompt, deadline=ctx.deadline if ctx is not None else None)
+        if ctx is not None:
+            ctx.on_cancel(lambda: self.runtime.cancel_parse(fut))
         try:
             return fut.result(timeout=self.timeout_s)
+        except CancelledError as e:  # BaseException: the broad catch misses it
+            raise ParserError("llm_error", "cancelled: client disconnected") from e
         except TimeoutError as e:
             # dequeue the abandoned request so overload can't pile up work
-            # nobody will read (pending entries are dropped immediately; a
-            # slot already decoding finishes its bounded budget)
+            # nobody will read (queued entries drop immediately; a slot
+            # already decoding is evicted at the next chunk boundary)
             self.runtime.abandon_parse(fut)
             raise ParserError("llm_error", "batched decode timed out") from e
         except Exception as e:
@@ -303,6 +326,11 @@ class BatchedEngineParser:
 
     def healthy(self) -> bool:
         return self.runtime.healthy()
+
+    def quarantine_info(self) -> list[dict]:
+        """Active poison-quarantine entries (surfaced in /health): prompts
+        whose repeated poison offenses got them refused at submit."""
+        return self.batcher.quarantined()
 
     def close(self) -> None:
         self.runtime.stop()
@@ -769,6 +797,13 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
               max_inflight: int | None = None) -> web.Application:
     tracer = tracer or Tracer("brain", emit=False)
     app = web.Application()
+    # a client that disconnects must CANCEL its handler (aiohttp >= 3.9
+    # made this opt-in): the CancelledError hook below is what aborts the
+    # request's in-flight decode at the next chunk boundary — without
+    # cancellation a dead socket burns the slot's whole token budget
+    from . import HANDLER_CANCELLATION
+
+    app[HANDLER_CANCELLATION] = True
     # admission control: past the inflight cap /parse answers 503 +
     # Retry-After instead of queueing unboundedly behind the decode (the
     # queue IS the tail latency; the voice service degrades on the 503)
@@ -827,6 +862,11 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
             body["worker_alive"] = bool(probe())
             if not body["worker_alive"]:
                 status = "unhealthy"
+        qinfo = getattr(parser, "quarantine_info", None)
+        if qinfo is not None:
+            # repeat-offender poison quarantine (serve.scheduler): prompts
+            # refused at submit after repeated NaN/dead-FSM/prefill faults
+            body["quarantine"] = qinfo()
         body["status"] = status
         body["ok"] = status != "unhealthy"
         body["slo"] = slo.state()
@@ -881,9 +921,20 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         if not admission.try_acquire():
             return shed("overload")
         loop = asyncio.get_running_loop()
+        from ..utils.resilience import (
+            RequestContext,
+            pop_request_context,
+            push_request_context,
+        )
         from ..utils.tracing import pop_stage_notes
 
         notes: dict = {}
+        # the per-request containment handle: carries the deadline into the
+        # scheduler and collects the decode canceller, so a client that
+        # disconnects (CancelledError below) aborts its in-flight decode at
+        # the next chunk boundary instead of burning the slot for a dead
+        # socket
+        ctx = RequestContext(deadline)
 
         def run_admitted(preq: ParseRequest) -> ParseResponse:
             # queue_ms: arrival -> worker-thread start (thread pool + engine
@@ -895,7 +946,11 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
             if deadline is not None and deadline.expired:
                 raise DeadlineExpired("budget consumed while queued")
             pop_stage_notes()  # drop stale notes from a prior request
-            out = do_parse(preq)
+            push_request_context(ctx)
+            try:
+                out = do_parse(preq)
+            finally:
+                pop_request_context()
             # engine backends deposit prefill_ms/decode_ms on THIS thread
             notes.update(pop_stage_notes())
             return out
@@ -904,9 +959,20 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
             with tracer.span("parse", trace_id=trace_id, chars=len(preq.text)) as sp:
                 resp = await loop.run_in_executor(parse_pool, run_admitted, preq)
                 sp.attrs.update(notes)
+        except asyncio.CancelledError:
+            # client disconnect mid-parse: fire the registered cancellers
+            # (mid-decode cancellation in the scheduler) before unwinding
+            ctx.cancel()
+            get_metrics().inc("brain.parses_cancelled")
+            raise
         except DeadlineExpired:
             return shed("deadline_expired", retry_after_s=0)
         except ParserError as e:
+            if e.kind == "overloaded":
+                # typed engine-plane shed (KV pool exhausted / queue-expired
+                # deadline): same 503 + Retry-After contract as admission
+                # sheds, so the voice retry/degrade kit handles it
+                return shed("engine_overload")
             status = 422 if e.kind == "schema_validation_failed" else 500
             return web.json_response(
                 {"error": e.kind, "detail": e.detail[:500]}, status=status,
@@ -1165,7 +1231,7 @@ def main() -> None:
     port = int(os.environ.get("BRAIN_PORT", "8090"))
     parser = make_parser_from_env()
     app = build_app(parser, Tracer("brain"))
-    web.run_app(app, port=port)
+    web.run_app(app, port=port, handler_cancellation=True)
 
 
 if __name__ == "__main__":

@@ -214,6 +214,14 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     cfg = cfg or VoiceConfig()
     tracer = tracer or Tracer("voice", emit=False)
     app = web.Application()
+    # abrupt WS teardown must cancel the stream handler mid-await (aiohttp
+    # >= 3.9 opt-in): that cancellation aborts the in-flight /parse httpx
+    # call, which cancels the brain handler, which evicts the decode slot —
+    # the full disconnect -> mid-decode-cancellation chain (ISSUE 7). The
+    # teardown finallys (abort SLO sample, STT close) run either way.
+    from . import HANDLER_CANCELLATION
+
+    app[HANDLER_CANCELLATION] = True
 
     # per-dependency circuits, shared across WS connections: one client's
     # timeouts must warn the next client's calls. An open brain circuit is
@@ -598,6 +606,15 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             try:
                 async for msg in ws:
                     if msg.type == WSMsgType.BINARY:
+                        from ..utils.chaos import chaos_fire
+
+                        if chaos_fire("drop_frame"):
+                            # chaos drill: simulated network loss of an
+                            # audio frame — the pipeline must degrade
+                            # (later endpoint, shorter transcript), never
+                            # wedge an utterance or kill the session
+                            get_metrics().inc("voice.frames_dropped_chaos")
+                            continue
                         t_feed0 = time.perf_counter()
                         try:
                             samples = pcm16_to_float(msg.data)
@@ -748,7 +765,7 @@ def main() -> None:
     init_multihost()  # no-op single-host; DCN join for pod-sharded STT
     port = int(os.environ.get("VOICE_PORT", "7072"))
     app = build_app(tracer=Tracer("voice"))
-    web.run_app(app, port=port)
+    web.run_app(app, port=port, handler_cancellation=True)
 
 
 if __name__ == "__main__":

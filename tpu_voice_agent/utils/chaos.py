@@ -1,0 +1,155 @@
+"""Deterministic, env-gated chaos layer: seeded fault injection points.
+
+Fault containment (ISSUE 7) is only trustworthy if it is *drilled*: every
+containment mechanism in the inference plane — poison-request quarantine,
+per-request prefill fencing, pool-pressure degradation, the stalled-step
+watchdog, dropped-frame tolerance — has a named injection point here, and
+``benches/bench_chaos.py`` measures capacity-at-SLO with these faults
+firing against the same swarm that measures clean capacity.
+
+Design constraints:
+
+- **Off means off.** With ``CHAOS_FAULTS`` unset (the default),
+  ``chaos_fire()`` is a dict-miss and a bool check — no RNG draw, no
+  metrics, no logging. Production code paths stay byte-identical.
+- **Deterministic.** Every point draws from its own ``random.Random``
+  seeded with ``(CHAOS_SEED, point)``, and the k-th call to ``fire`` for a
+  point always answers the same way for the same spec+seed. A chaos drill
+  that cannot be replayed is a flaky test, not a drill.
+- **Observable.** Every injected fault increments ``chaos.injected`` (and
+  a per-point ``chaos.<point>`` counter), so a flight-recorder dump frozen
+  during a drill shows exactly which faults fired before the incident.
+
+Spec grammar (``CHAOS_FAULTS`` env var or ``configure()``), comma-separated:
+
+    point:0.05      fire with probability 0.05 per event (seeded)
+    point@7         fire on exactly the 7th event for that point
+    point:1         fire on every event
+
+Known points (callers may add more; unknown points in a spec are an error
+so typos never silently disable a drill):
+
+    nan_logits    scheduler admission -> NaN logits for that slot's next chunk
+    dead_fsm      scheduler admission -> slot's FSM state forced to dead (-1)
+    prefill_exc   DecodeEngine.prefill_slot raises ChaosError
+    alloc_fail    BlockAllocator.alloc raises PoolExhausted
+    stall_step    ContinuousBatcher.step sleeps CHAOS_STALL_S before dispatch
+    drop_frame    voice WS handler drops the incoming binary audio frame
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+KNOWN_POINTS = ("nan_logits", "dead_fsm", "prefill_exc", "alloc_fail",
+                "stall_step", "drop_frame")
+
+
+class ChaosError(RuntimeError):
+    """An injected (not organic) fault. Deliberately NOT a subclass of any
+    device-fault type: containment code must treat it like a per-request
+    failure, and a fence that only survives ChaosError but re-raises real
+    XlaRuntimeError faults is exactly the behavior the drill verifies."""
+
+
+class Chaos:
+    """One parsed fault spec. Thread-safe: fire() is called from the
+    scheduler worker, service handlers, and the allocator concurrently."""
+
+    def __init__(self, spec: str = "", seed: int = 0):
+        self.seed = seed
+        self.rules: dict[str, tuple[str, float]] = {}
+        self.counts: dict[str, int] = {}
+        self._rngs: dict[str, random.Random] = {}
+        self._lock = threading.Lock()
+        spec = (spec or "").strip()
+        if spec:
+            for part in spec.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if "@" in part:
+                    point, _, k = part.partition("@")
+                    self.rules[point.strip()] = ("nth", float(int(k)))
+                else:
+                    point, _, p = part.partition(":")
+                    self.rules[point.strip()] = ("prob", float(p or 1.0))
+            for point in self.rules:
+                if point not in KNOWN_POINTS:
+                    raise ValueError(
+                        f"unknown chaos point {point!r} (known: {KNOWN_POINTS})")
+        if self.rules:
+            # a drill-armed process exports the injection counter from
+            # zero (the breaker-gauge discipline: an armed-but-quiet drill
+            # must scrape as 0, not as an absent series); a chaos-off
+            # process deliberately exports nothing
+            from .tracing import get_metrics
+
+            get_metrics().inc("chaos.injected", 0.0)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.rules)
+
+    def fire(self, point: str) -> bool:
+        """Count one event at ``point``; True when the fault should inject.
+        Deterministic in (spec, seed, call index)."""
+        rule = self.rules.get(point)
+        if rule is None:
+            return False
+        with self._lock:
+            n = self.counts.get(point, 0) + 1
+            self.counts[point] = n
+            kind, arg = rule
+            if kind == "nth":
+                hit = n == int(arg)
+            else:
+                rng = self._rngs.get(point)
+                if rng is None:
+                    rng = self._rngs[point] = random.Random(f"{self.seed}:{point}")
+                hit = rng.random() < arg
+        if hit:
+            from .tracing import get_metrics
+
+            m = get_metrics()
+            m.inc("chaos.injected")
+            m.inc(f"chaos.{point}")
+        return hit
+
+
+_chaos: Chaos | None = None
+_chaos_lock = threading.Lock()
+
+
+def get_chaos() -> Chaos:
+    """Process-global controller; first call reads CHAOS_FAULTS/CHAOS_SEED."""
+    global _chaos
+    if _chaos is None:
+        with _chaos_lock:
+            if _chaos is None:
+                _chaos = Chaos(os.environ.get("CHAOS_FAULTS", ""),
+                               int(os.environ.get("CHAOS_SEED", "0")))
+    return _chaos
+
+
+def configure(spec: str, seed: int = 0) -> Chaos:
+    """Install a fresh controller (benches/tests; counters start at 0)."""
+    global _chaos
+    with _chaos_lock:
+        _chaos = Chaos(spec, seed)
+    return _chaos
+
+
+def reset() -> None:
+    """Back to env-derived lazy init (test hygiene)."""
+    global _chaos
+    with _chaos_lock:
+        _chaos = None
+
+
+def chaos_fire(point: str) -> bool:
+    """The one-line call sites use: False fast when chaos is off."""
+    c = get_chaos()
+    return c.enabled and c.fire(point)

@@ -101,6 +101,66 @@ class Deadline:
         return str(int(self.remaining_s() * 1e3))
 
 
+# ---------------------------------------------------------- request context
+
+
+class RequestContext:
+    """Per-request containment handle threaded from a service handler into
+    the inference plane (brain worker thread -> batcher): carries the
+    propagated ``Deadline`` and collects cancel callbacks, so a client
+    disconnect observed on the event loop (asyncio.CancelledError in the
+    handler) can abort the request's in-flight decode from another thread.
+    ``cancel()`` is idempotent and thread-safe; a callback registered
+    after cancellation fires immediately (no lost-wakeup window)."""
+
+    def __init__(self, deadline: "Deadline | None" = None):
+        self.deadline = deadline
+        self._lock = threading.Lock()
+        self._cancelled = False
+        self._cbs: list = []
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def on_cancel(self, cb) -> None:
+        with self._lock:
+            if not self._cancelled:
+                self._cbs.append(cb)
+                return
+        cb()  # already cancelled: fire now, outside the lock
+
+    def cancel(self) -> None:
+        with self._lock:
+            if self._cancelled:
+                return
+            self._cancelled = True
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                get_metrics().inc("resilience.cancel_callback_errors")
+
+
+_req_ctx = threading.local()
+
+
+def push_request_context(ctx: RequestContext | None) -> None:
+    """Install the context on THIS thread (the brain sets it on the worker
+    thread around parse; parser backends read it with
+    ``current_request_context`` instead of widening every parse signature)."""
+    _req_ctx.ctx = ctx
+
+
+def pop_request_context() -> None:
+    _req_ctx.ctx = None
+
+
+def current_request_context() -> RequestContext | None:
+    return getattr(_req_ctx, "ctx", None)
+
+
 # -------------------------------------------------------------------- retry
 
 
