@@ -24,8 +24,18 @@ Writes ``bench_artifacts/BENCH_spec_<ts>.json`` with every row plus the
 ``spec`` section (benches/common.snapshot_spec, merged into the combined
 run_all artifact like the SLO verdict).
 
+The ``paged+radix`` section (ISSUE 8) measures the COMPOUND plane: the
+same drafters inside a PagedDecodeEngine with the radix session cache on,
+over S sessions x T turns of strict token-extension prompts (the
+session-aware brain's shape). Per drafter it reports tokens/forward and
+the warm-turn (turn 2+) wall p50 against the spec-off paged baseline —
+the two biggest decode multipliers stacking instead of excluding each
+other — with an in-bench token-identity gate (a wrong-but-fast verify
+plane must fail the bench, not win it).
+
 Knobs: BENCH_SPEC_K (default 4), BENCH_SPEC_UTTERANCES (default 6; --quick
-sets 3 via env), BENCH_SPEC_TOKENS (default 160).
+sets 3 via env), BENCH_SPEC_TOKENS (default 160), BENCH_SPEC_PAGED_SESSIONS
+(default 2), BENCH_SPEC_PAGED_TURNS (default 3).
 """
 
 from __future__ import annotations
@@ -150,6 +160,110 @@ def main() -> None:
     row("spec_tokens_per_step", best_tps, "tokens/forward",
         best_tps / base_tps if base_tps else None)
 
+    # ---------------------------------------------- paged + radix + spec
+    from tpu_voice_agent.serve import PagedDecodeEngine
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+    from tpu_voice_agent.services.brain import (
+        SessionTranscripts,
+        install_prompt_prefix,
+    )
+
+    n_sess = int(os.environ.get("BENCH_SPEC_PAGED_SESSIONS", "2"))
+    n_turns = int(os.environ.get("BENCH_SPEC_PAGED_TURNS", "3"))
+    texts = ["search for {t}", "open the second result and summarize it",
+             "sort these by price from low to high",
+             "take a screenshot of this page"]
+    topics = ["wireless headphones", "standing desks", "usb microphones",
+              "laptop stands"]
+    sessions = [
+        [(texts[k % len(texts)].format(t=topics[(s + k) % len(topics)]),
+          {"session": f"s{s}"}) for k in range(n_turns)]
+        for s in range(n_sess)
+    ]
+    log(f"paged+radix spec: {n_sess} sessions x {n_turns} turns, K={k}")
+
+    def mk_paged(spec_cfg=None, self_draft=False):
+        eng = PagedDecodeEngine(
+            preset="test-tiny", max_len=2048, batch_slots=2,
+            prefill_buckets=(512, 1024, 2048),
+            radix_enable=True, spec=spec_cfg, init_weights=False)
+        eng.load_params(jax.device_put(raw))
+        if self_draft:
+            eng.spec = SpecDecoder(
+                eng, SpecConfig(k=k),
+                drafter=DraftModelDrafter(eng, cfg=eng.cfg, params=eng.params))
+        install_prompt_prefix(eng)
+        return eng
+
+    def play_paged(eng):
+        """All sessions sequentially (turn N+1 extends turn N's ids) via
+        the PRODUCTION transcript renderer — SessionTranscripts owns the
+        strict-token-extension construction, so the bench measures exactly
+        the prompts the session-aware brain serves. Returns (per-session
+        token streams, warm-turn wall ms, tokens, forwards). Warm = turn
+        index >= 1, the radix-hit turns."""
+        st = SessionTranscripts(eng.tokenizer)
+        outs, warm_ms, toks, fwds = [], [], 0, 0
+        for si, sess in enumerate(sessions):
+            sid, sess_out = f"bench-s{si}", []
+            for ti, (text, ctx) in enumerate(sess):
+                prompt = st.prompt_for(sid, text, ctx)
+                t1 = time.perf_counter()
+                r = ContinuousBatcher(
+                    eng, chunk_steps=16,
+                    max_new_tokens=max_tok).generate_many([prompt])[0]
+                dt = (time.perf_counter() - t1) * 1e3
+                if r.error:
+                    log(f"paged spec request failed: {r.error}")
+                    sys.exit(1)
+                if ti >= 1:
+                    warm_ms.append(dt)
+                toks += r.steps
+                fwds += r.forwards if r.forwards else r.steps
+                sess_out.append(r.token_ids)
+                st.record(sid, prompt, r.token_ids)
+            outs.append(sess_out)
+        return outs, warm_ms, toks, fwds
+
+    paged_cfgs = [
+        ("paged_baseline", None, False),
+        ("paged_fsm_prompt", SpecConfig(k=k, drafter="fsm,prompt"), False),
+        ("paged_self", None, True),
+    ]
+    paged_section: dict[str, dict] = {}
+    ref_out = base_warm = base_ptps = None
+    best_paged_tps = 0.0
+    for label, cfg, self_draft in paged_cfgs:
+        eng = mk_paged(cfg, self_draft=self_draft)
+        play_paged(eng)  # compile + tree warmup pass
+        # fresh engine for the measured pass: the warmup must not leave
+        # the measured turns replaying their own cached chains
+        eng = mk_paged(cfg, self_draft=self_draft)
+        outs, warm_ms, toks, fwds = play_paged(eng)
+        if ref_out is None:
+            ref_out = outs
+        elif outs != ref_out:
+            # identity gate: spec x radix x batching must not change bytes
+            log(f"TOKEN MISMATCH between paged baseline and {label}")
+            sys.exit(1)
+        ptps = toks / fwds if fwds else 0.0
+        p50 = percentile(warm_ms, 50) if warm_ms else 0.0
+        if base_ptps is None:
+            base_ptps, base_warm = ptps, p50
+        else:
+            best_paged_tps = max(best_paged_tps, ptps)
+        paged_section[label] = {
+            "tokens_per_step": round(ptps, 3),
+            "warm_turn_p50_ms": round(p50, 1),
+            "spec": (eng.spec.stats() if eng.spec is not None else None),
+        }
+        row(f"spec_{label}_tokens_per_step", ptps, "tokens/forward",
+            ptps / base_ptps if base_ptps else None)
+        row(f"spec_{label}_warm_p50_ms", p50, "ms",
+            base_warm / p50 if (base_warm and p50) else None)
+    row("spec_paged_tokens_per_step", best_paged_tps, "tokens/forward",
+        best_paged_tps / base_ptps if base_ptps else None)
+
     art_dir = Path(_ROOT) / "bench_artifacts"
     art_dir.mkdir(exist_ok=True)
     stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
@@ -166,6 +280,10 @@ def main() -> None:
         # warmups and is kept only as the raw registry view
         "spec": {"per_drafter": per_drafter,
                  "tokens_per_step_best": round(best_tps, 3),
+                 # the compound plane (ISSUE 8): spec x radix x batching in
+                 # one paged engine, identity-gated in-bench
+                 "paged": paged_section,
+                 "paged_tokens_per_step_best": round(best_paged_tps, 3),
                  "process_cumulative": snapshot_spec()},
     }, indent=1))
     log(f"artifact: {art}")

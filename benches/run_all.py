@@ -43,8 +43,10 @@ QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
                  "bench_chaos.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
+             "BENCH_SPEC_PAGED_SESSIONS": "2", "BENCH_SPEC_PAGED_TURNS": "2",
              "BENCH_STT_SECONDS": "4", "BENCH_STT_STREAMS": "1,4",
              "BENCH_SWARM_MAX_N": "8", "BENCH_SWARM_UTTERANCES": "3",
+             "BENCH_SWARM_ENGINE_MAX_N": "4",
              "BENCH_CHAOS_MAX_N": "4", "BENCH_CHAOS_UTTERANCES": "2"}
 
 

@@ -239,13 +239,26 @@ def test_spec_from_env(monkeypatch):
     assert cfg is not None and cfg.k == 6 and cfg.drafter == "fsm"
 
 
-def test_spec_refused_on_non_dense_layout(raw_params):
-    from tpu_voice_agent.serve import PagedDecodeEngine
+def test_spec_accepted_on_paged_refused_on_pp(raw_params):
+    """ISSUE 8 flips the layout envelope: the paged engine now BUILDS a
+    SpecDecoder (block-granular rollback on COW-owned draft blocks; the
+    compound-path differentials live in tests/test_spec_paged.py), while
+    the pp staged layout keeps a clear typed refusal — pinned here so the
+    boot-time error an operator sees never silently regresses to the old
+    warn+ignore."""
+    from tpu_voice_agent.serve import PagedDecodeEngine, PPDecodeEngine
+    from tpu_voice_agent.parallel.pipeline import pp_tp_mesh
 
-    with pytest.raises(ValueError, match="dense"):
-        PagedDecodeEngine(preset="test-tiny", max_len=512,
-                          prefill_buckets=(64,), init_weights=False,
-                          spec=SpecConfig(k=4))
+    eng = PagedDecodeEngine(preset="test-tiny", max_len=512,
+                            prefill_buckets=(64,), init_weights=False,
+                            spec=SpecConfig(k=4))
+    assert eng.spec is not None and eng.spec.paged
+
+    with pytest.raises(ValueError,
+                       match="not supported on the pp layout"):
+        PPDecodeEngine(preset="test-tiny", max_len=512,
+                       prefill_buckets=(64,), mesh=pp_tp_mesh(1, 1),
+                       init_weights=False, spec=SpecConfig(k=4))
 
 
 def test_unknown_drafter_rejected(raw_params):

@@ -1,0 +1,393 @@
+"""Speculative decoding over the paged/radix plane (ISSUE 8) — FAST tier.
+
+The compound-path contract: with spec × radix × continuous batching stacked
+in ONE PagedDecodeEngine, greedy output stays byte-identical to the plain
+paged greedy path for EVERY drafter, warm and cold, across ragged block
+boundaries and mid-chain eviction; rejected draft tokens never reach a
+radix-cached block (they only ever land past the accepted frontier, in
+COW-owned blocks the tree refuses to adopt); a chaos NaN injected into a
+verify pass quarantines its row alone with zero leaked blocks; and the
+accounting plane (spec.accept_rate / scheduler.tokens_per_forward /
+per-request forwards / SPEC_TRACE_SINK) reflects paged traffic.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_voice_agent.serve import PagedDecodeEngine, SpecConfig, SpecDecoder
+from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+from tpu_voice_agent.serve.spec import Drafter
+from tpu_voice_agent.services.brain import (
+    SessionTranscripts,
+    install_prompt_prefix,
+)
+from tpu_voice_agent.services.prompts import render_prompt
+from tpu_voice_agent.utils import chaos, get_metrics
+
+BUCKETS = (128, 256, 512, 1024, 2048)
+PROMPT_TEXTS = ["search for usb hubs", "scroll down", "open the first result"]
+MAXTOK = 48
+
+
+def _paged(radix: bool, spec=None, **kw):
+    eng = PagedDecodeEngine(
+        preset="test-tiny", max_len=2048, batch_slots=2,
+        prefill_buckets=BUCKETS, radix_enable=radix, spec=spec, **kw)
+    install_prompt_prefix(eng)
+    return eng
+
+
+def _run(eng, prompts, max_new=MAXTOK):
+    return ContinuousBatcher(eng, chunk_steps=8,
+                             max_new_tokens=max_new).generate_many(prompts)
+
+
+@pytest.fixture(scope="module")
+def eng_plain():
+    """The undisturbed baseline: paged, radix off, no speculation."""
+    return _paged(False)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return [render_prompt(t, {}) for t in PROMPT_TEXTS[:2]]
+
+
+@pytest.fixture(scope="module")
+def baseline(eng_plain, prompts):
+    res = _run(eng_plain, prompts)
+    assert all(r.error is None for r in res)
+    return res
+
+
+@pytest.fixture(scope="module")
+def eng_warm():
+    """The full stack: paged + radix + spec (fsm,prompt chain)."""
+    return _paged(True, spec=SpecConfig(k=4, drafter="fsm,prompt"))
+
+
+# ------------------------------------------------------------ identity
+
+
+@pytest.mark.parametrize("drafter", ["fsm", "prompt", "fsm,prompt", "model"])
+def test_paged_spec_cold_token_identity(eng_plain, prompts, baseline, drafter):
+    """Cold admissions, every drafter: paged+spec output == plain paged
+    greedy, with forwards < steps proving multi-token verify actually ran
+    (the fsm drafter lands structural JSON runs even on random weights)."""
+    eng = _paged(False, spec=SpecConfig(k=4, drafter=drafter))
+    res = _run(eng, prompts)
+    for ref, r in zip(baseline, res):
+        assert r.error is None, r.error
+        assert r.token_ids == ref.token_ids, (drafter, r.text[:80])
+        assert r.finished == ref.finished
+        assert r.steps == len(r.token_ids)
+        assert r.forwards > 0  # per-request participation (widened readback)
+    if drafter != "prompt":  # prompt-only rarely lands on random weights
+        assert eng.spec.stats()["accepted"] > 0, drafter
+
+
+def test_paged_self_draft_multiplier(eng_plain, prompts, baseline):
+    """Self-draft (draft model == target weights) on the PAGED layout: the
+    strongest end-to-end probe of block-granular verify/rollback. Accept
+    rate ~1 (EOS proposals are structurally rejected at stream ends) and
+    the step reduction clears the 3x acceptance bar."""
+    from tpu_voice_agent.serve import DraftModelDrafter
+
+    eng = _paged(False)
+    eng.spec = SpecDecoder(
+        eng, SpecConfig(k=4),
+        drafter=DraftModelDrafter(eng, cfg=eng.cfg, params=eng.params))
+    res = _run(eng, prompts)
+    for ref, r in zip(baseline, res):
+        assert r.error is None and r.token_ids == ref.token_ids
+        assert r.forwards < r.steps / 2  # >= 2 tokens per forward per row
+    s = eng.spec.stats()
+    assert s["accept_rate"] > 0.9
+    assert s["tokens_per_step"] / len(prompts) > 3.0  # per-row multiplier
+
+
+TURNS = [
+    ("search for wireless headphones", {}),
+    ("open the second result", {"last_query": "wireless headphones"}),
+    ("sort these by price from low to high", {"last_query": "wireless headphones"}),
+]
+
+
+def _play_session(eng, turns=TURNS, max_new=MAXTOK):
+    """Drive a multi-turn session through the PRODUCTION transcript
+    renderer (services.brain.SessionTranscripts — the one owner of the
+    strict-token-extension construction): warm turns extend the cached
+    chain at block granularity and the drafters get seeded with the full
+    transcript. Returns (per-turn results, per-turn accepted-stream ids
+    = prompt+generated histories)."""
+    tok = eng.tokenizer
+    st = SessionTranscripts(tok)
+    results, hists = [], []
+    for text, ctx in turns:
+        prompt = st.prompt_for("sess", text, ctx)
+        ids = (tok.encode(prompt, bos=True) if isinstance(prompt, str)
+               else list(prompt))
+        r = _run(eng, [ids], max_new=max_new)[0]
+        assert r.error is None, r.error
+        results.append(r)
+        st.record("sess", ids, r.token_ids)
+        hists.append(ids + list(r.token_ids))
+    return results, hists
+
+
+def test_warm_radix_spec_compound_identity(eng_plain, eng_warm):
+    """THE compound differential: warm radix admissions under speculative
+    decode are token-identical to plain paged greedy, turn by turn, and
+    turn 2+ still rides the cached chain (the two multipliers stack
+    instead of excluding each other)."""
+    cold, _ = _play_session(eng_plain)
+    warm, _ = _play_session(eng_warm)
+    P = len(eng_warm.prefix_ids)
+    for c, w in zip(cold, warm):
+        assert c.token_ids == w.token_ids
+        assert eng_warm.fsm.walk(w.token_ids) >= 0
+    assert warm[0].cached_tokens == P       # turn 1: static prefix only
+    assert warm[1].cached_tokens > P        # turn 2+: session chain hit
+    assert warm[2].cached_tokens >= warm[1].cached_tokens
+    for w in warm[1:]:
+        assert w.forwards > 0               # speculation ran ON a warm turn
+    # full-replay warm turns stay identical (drafters re-seeded from the
+    # cached prompt ids on the radix-hit admission path)
+    warm2, _ = _play_session(eng_warm)
+    for c, w in zip(cold, warm2):
+        assert c.token_ids == w.token_ids
+    assert eng_warm.spec.stats()["accepted"] > 0
+
+
+def test_mid_chain_eviction_with_spec_identity(eng_plain):
+    """A deliberately tight pool churns session chains out of the tree
+    between turns while spec decode claims verify-step coverage — output
+    stays identical and pool accounting drains to exactly the tree."""
+    eng = _paged(True, spec=SpecConfig(k=4, drafter="fsm,prompt"),
+                 pool_blocks=10)
+    sessions = [
+        TURNS,
+        [("navigate to example dot com", {}),
+         ("take a screenshot of this page", {"last_url": "example.com"})],
+        [("filter results under one hundred dollars", {}),
+         ("extract the product table", {"last_query": "deals"})],
+    ]
+    for turns in sessions:
+        cold, _ = _play_session(eng_plain, turns=turns)
+        warm, _ = _play_session(eng, turns=turns)
+        for c, w in zip(cold, warm):
+            assert c.token_ids == w.token_ids
+    assert sum(t.evictions for t in eng.radix) > 0, \
+        "pool was sized to force eviction churn under spec"
+    assert eng.allocator.blocks_in_use == sum(t.nodes for t in eng.radix)
+
+
+# ------------------------------------------------------------ containment
+
+
+class _WrongLegalDrafter(Drafter):
+    """Adversarial: grammar-LEGAL tokens chosen to disagree with the model
+    (highest legal id) — every verify step exercises rejection rollback on
+    the paged layout, leaving stale draft KV past every accepted frontier."""
+
+    name = "wrong"
+
+    def __init__(self, fsm):
+        self.fsm = fsm
+
+    def draft_one(self, ctx, state, k):
+        out, s = [], state
+        for _ in range(k):
+            if s < 0:
+                break
+            allowed = np.nonzero(self.fsm.allowed(s))[0]
+            if len(allowed) == 0:
+                break
+            t = int(allowed[-1])
+            out.append(t)
+            s = self.fsm.step(s, t)
+        return out
+
+
+def test_rejected_drafts_never_reach_radix(eng_plain):
+    """The block-granular rollback guarantee, asserted structurally: after
+    multi-turn sessions under an adversarial mostly-rejected drafter,
+    EVERY cached radix chain is a prefix of some request's accepted
+    prompt+generated stream — zero cached blocks contain a rejected draft
+    token — and a warm replay served FROM those chains stays identical."""
+    eng = _paged(True)
+    eng.spec = SpecDecoder(eng, SpecConfig(k=4),
+                           drafter=_WrongLegalDrafter(eng.fsm))
+    cold, cold_hists = _play_session(eng_plain)
+    warm, hists = _play_session(eng)
+    for c, w in zip(cold, warm):
+        assert c.token_ids == w.token_ids
+    s = eng.spec.stats()
+    assert s["drafted"] > 0 and s["accepted"] < s["drafted"], \
+        "the adversarial drafter must actually be rejected"
+    # accepted-stream containment: every cached chain spells accepted ids
+    accepted = [list(eng.prefix_ids)] + hists
+    for tree in eng.radix:
+        for chain in tree.chains():
+            assert any(chain == h[: len(chain)] for h in accepted), \
+                "radix-cached chain contains tokens outside every " \
+                "accepted stream (rejected draft leaked into the cache)"
+    # warm replay decoding FROM the cached chains: still identical
+    warm2, _ = _play_session(eng)
+    for c, w in zip(cold, warm2):
+        assert c.token_ids == w.token_ids
+    # zero leaked blocks: with no slots live, residency == tree + nothing
+    for tree in eng.radix:
+        tree.clear()
+    assert eng.allocator.blocks_in_use == len(eng._prefix_blocks[0])
+
+
+def _counter(name):
+    return get_metrics().snapshot()["counters"].get(name, 0)
+
+
+def test_chaos_nan_in_verify_pass_quarantines_alone(eng_plain, prompts,
+                                                    baseline, eng_warm):
+    """A NaN injected into a verify pass poisons ONE row: typed error,
+    quarantine counter, batch-mate token-identical, poisoned chain never
+    cached, zero leaked blocks."""
+    before = _counter("scheduler.slots_quarantined")
+    b = ContinuousBatcher(eng_warm, chunk_steps=8, max_new_tokens=MAXTOK)
+    chaos.configure("nan_logits@2")  # 2nd admission's first verify poisoned
+    try:
+        res = b.generate_many(prompts)
+    finally:
+        chaos.reset()
+    assert res[1].error is not None and \
+        res[1].error.startswith("poisoned: non-finite"), res[1].error
+    assert res[0].error is None
+    assert res[0].token_ids == baseline[0].token_ids
+    assert _counter("scheduler.slots_quarantined") == before + 1
+    # the poisoned request's chain was released ok=False and must NOT be
+    # cached: no tree chain may extend its full prompt into generated ids
+    bad = eng_warm.tokenizer.encode(prompts[1], bos=True)
+    for tree in eng_warm.radix:
+        for chain in tree.chains():
+            assert not (len(chain) > len(bad)
+                        and chain[: len(bad)] == bad), \
+                "poisoned request's chain was cached"
+    # no slots live: every resident block is owned by the tree
+    assert eng_warm.allocator.blocks_in_use == \
+        sum(t.nodes for t in eng_warm.radix)
+
+
+def test_chaos_dead_fsm_in_verify_pass(eng_plain, prompts, baseline):
+    eng = _paged(False, spec=SpecConfig(k=4, drafter="fsm"))
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=MAXTOK)
+    chaos.configure("dead_fsm@2")
+    try:
+        res = b.generate_many(prompts)
+    finally:
+        chaos.reset()
+    assert res[1].error is not None and \
+        res[1].error.startswith("poisoned: grammar dead state"), res[1].error
+    assert res[0].error is None and res[0].token_ids == baseline[0].token_ids
+    assert eng.allocator.blocks_in_use == len(eng._prefix_blocks[0])
+
+
+# ------------------------------------------------------------ accounting
+
+
+def test_paged_spec_accounting_and_gauges(eng_warm, prompts):
+    """satellite 2: the spec gauges and the scheduler's tokens-per-forward
+    must reflect PAGED-plane traffic, and per-request forwards ride the
+    widened readback into batched GenerationResults."""
+    res = _run(eng_warm, prompts)
+    snap = get_metrics().snapshot()
+    for name in ("spec.drafted_tokens", "spec.accepted_tokens",
+                 "spec.verify_steps"):
+        assert snap["counters"].get(name, 0) > 0, name
+    assert "spec.accept_rate" in snap["gauges"]
+    assert snap["gauges"]["spec.tokens_per_step"] >= 1.0
+    assert snap["gauges"].get("scheduler.tokens_per_forward", 0) >= 1.0
+    for r in res:
+        assert r.error is None
+        assert 0 < r.forwards <= r.steps
+        # per-request accept counts ride the same widened readback; every
+        # verify step a row participates in emits exactly 1 + accepted
+        # tokens, so the three accounting fields must reconcile exactly
+        assert 0 <= r.spec_accepted < r.steps
+        assert r.spec_accepted + r.forwards == r.steps
+    assert sum(r.spec_accepted for r in res) > 0  # fsm drafts land
+    assert get_metrics().collisions() == []
+
+
+def test_spec_trace_sink_feeds_distill(tmp_path):
+    """satellite 3: SPEC_TRACE_SINK JSONL records round-trip into
+    train.distill draft retraining (the accept-rate flywheel)."""
+    from tpu_voice_agent.train import distill
+
+    sink = tmp_path / "trace.jsonl"
+    eng = _paged(True, spec=SpecConfig(k=4, drafter="fsm,prompt",
+                                       trace_sink=str(sink)))
+    prompts = [render_prompt(t, {}) for t in PROMPT_TEXTS[:2]]
+    res = _run(eng, prompts)
+    assert all(r.error is None for r in res)
+    recs = distill.load_spec_trace(str(sink))
+    assert len(recs) == 2
+    for rec in recs:
+        assert rec["plane"] == "paged"
+        assert 0 <= rec["accepted"] <= rec["drafted"]
+        assert rec["verify_steps"] > 0
+    assert sorted(tuple(r["generated_ids"]) for r in recs) == \
+        sorted(tuple(r.token_ids) for r in res)
+    # a torn tail line (killed mid-write) must not poison the loader
+    with open(sink, "a") as f:
+        f.write('{"prompt_ids": [1, 2')
+    assert len(distill.load_spec_trace(str(sink))) == 2
+    cfg, params, stats = distill.train_draft_from_trace(
+        str(sink), steps=6, batch=2, seq_len=192)
+    assert stats["records"] == 2 and stats["final_loss"] < stats["first_loss"]
+    # the retrained checkpoint loads straight into the drafter path
+    from tpu_voice_agent.serve import DraftModelDrafter
+
+    path = distill.save_ckpt(str(tmp_path), distill.DRAFT_CKPT, cfg, params,
+                             stats)
+    d = DraftModelDrafter.from_checkpoint(eng, path)
+    assert d.cfg.vocab_size == eng.cfg.vocab_size
+    assert _counter("spec.trace_records") >= 2
+
+
+# ------------------------------------------------------------ gating
+
+
+def test_spec_env_unset_keeps_paged_paths(monkeypatch):
+    """SPEC_ENABLE unset: the paged engine never constructs a SpecDecoder
+    — decode_chunk/prefill/release never branch, byte-for-byte the
+    pre-spec paths."""
+    monkeypatch.delenv("SPEC_ENABLE", raising=False)
+    from tpu_voice_agent.serve import spec_from_env
+
+    assert spec_from_env() is None
+    eng = PagedDecodeEngine(preset="test-tiny", max_len=512,
+                            prefill_buckets=(64,), init_weights=False)
+    assert eng.spec is None and eng._spec_cfg is None
+
+
+def test_brain_factory_enables_spec_on_paged(monkeypatch):
+    """satellite 1: the brain factory no longer warn+ignores SPEC_ENABLE
+    on the paged backend — the engine behind /parse carries a live paged
+    SpecDecoder (and the radix tree beside it)."""
+    from tpu_voice_agent.services import brain
+
+    monkeypatch.setenv("BRAIN_BACKEND", "engine:test-tiny")
+    monkeypatch.setenv("BRAIN_PAGED", "1")
+    monkeypatch.setenv("BRAIN_BATCH", "2")
+    monkeypatch.setenv("RADIX_ENABLE", "1")
+    monkeypatch.setenv("SPEC_ENABLE", "1")
+    monkeypatch.setenv("SPEC_DRAFTER", "fsm")
+    parser = brain.make_parser_from_env()
+    try:
+        assert parser.engine.spec is not None
+        assert parser.engine.spec.paged
+        assert parser.engine.radix is not None
+        assert parser.wants_session  # session-aware transcripts still on
+    finally:
+        parser.close()

@@ -53,6 +53,10 @@ class GenerationResult:
     # admission (static prefix cache or radix chain hit) — prefill_ms
     # covers only the COMPUTED suffix, so the two together describe the
     # admission honestly (conflating them was the old prefill_ms bug)
+    spec_accepted: int = 0  # draft tokens accepted by verify passes this
+    # request rode (speculative decoding; 0 = no drafts landed or spec
+    # off) — steps = spec_accepted + bonus/plain tokens, so per-request
+    # accept effectiveness is (steps - spec_accepted) vs forwards
 
     @property
     def tokens_per_s(self) -> float:
@@ -636,12 +640,20 @@ class DecodeEngine:
         self.prefix_kv: dict | None = None
         # speculative decoding (serve.spec): built LAST — the decoder reads
         # engine tables/cache geometry, and a draft-model drafter allocates
-        # its own KV against batch_slots/max_len
+        # its own KV against batch_slots/max_len. Layout subclasses whose
+        # KV surface does not exist yet at this point (the paged engine's
+        # pool/allocator) defer via _spec_cfg and call _build_spec once
+        # their surface is up; the pp engine refuses spec at construction.
         self.spec = None
-        if spec is not None and getattr(spec, "k", 0):
-            from .spec import SpecDecoder
+        self._spec_cfg = spec if (spec is not None and getattr(spec, "k", 0)) \
+            else None
+        if self._spec_cfg is not None and self._alloc_dense_cache:
+            self._build_spec()
 
-            self.spec = SpecDecoder(self, spec)
+    def _build_spec(self) -> None:
+        from .spec import SpecDecoder
+
+        self.spec = SpecDecoder(self, self._spec_cfg)
 
     # ------------------------------------------------------------ helpers
 
@@ -873,7 +885,8 @@ class DecodeEngine:
         construction; non-greedy chunks keep the plain path (temperature
         speculation would need rejection sampling)."""
         if self.spec is not None and greedy:
-            self._last_poison = None  # spec path carries no poison signal
+            # the spec decoder sets _last_fwds/_last_poison itself, plus the
+            # widened per-row accept/participation readbacks (ISSUE 8)
             return self.spec.decode_chunk(
                 cur, pos, fsm, active, nbytes, tokens_left, key,
                 temperature, byte_budget, chunk_steps)
@@ -919,7 +932,7 @@ class DecodeEngine:
         ``ok=False`` marks an errored/cancelled request: resources are
         still freed, but layout subclasses must never cache its chain."""
         if self.spec is not None:
-            self.spec.on_release(slot)
+            self.spec.on_release(slot, ok=ok)
 
     def warm_restart(self) -> None:
         """Rebuild device decode state after a wedged/corrupt step, REUSING
@@ -938,6 +951,11 @@ class DecodeEngine:
             else:
                 self.cache = init_kv_cache(self.cfg, self.batch_slots, self.max_len)
         self._nan_inject = None
+        if self.spec is not None:
+            # drop per-slot host contexts + drafter state and bump the
+            # generation fence: a decode_chunk wedged mid-flight must stop
+            # dispatching verify steps against the restarted engine
+            self.spec.reset()
 
     def _prefill(self, prompt: str):
         if self.batch_slots != 1:
@@ -1069,6 +1087,7 @@ class DecodeEngine:
         out_ids: list[int] = []
         finished = False
         forwards = 0
+        pois = 0
         while True:
             (out, n_c, eos, cur, pos, fsm, active, nbytes, left) = \
                 self.decode_chunk(cur, pos, fsm, active, nbytes, left, None,
@@ -1078,6 +1097,12 @@ class DecodeEngine:
             out_ids.extend(int(t) for t in np.asarray(out_h)[0, : int(n_h[0])])
             finished = finished or bool(eos_h[0])
             forwards += self.spec.last_chunk_forwards
+            # the verify step carries the same per-row fault codes as the
+            # chunk loops — surface them as the typed error generate() does
+            lp = getattr(self, "_last_poison", None)
+            if lp is not None and int(np.asarray(lp)[0]) > 0:
+                pois = int(np.asarray(lp)[0])
+                break
             if not bool(np.asarray(act_h)[0]):
                 break
         decode_ms = (time.perf_counter() - t1) * 1e3
@@ -1097,6 +1122,9 @@ class DecodeEngine:
             decode_ms=decode_ms,
             steps=len(out_ids),
             finished=finished,
+            error=(None if pois == 0 else
+                   "poisoned: " + ("non-finite logits" if pois == 1
+                                   else "grammar dead state")),
             forwards=forwards,
         )
 

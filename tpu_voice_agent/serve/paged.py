@@ -230,7 +230,14 @@ def paged_chunk_decode_loop(
     blocks). Returns the dense loop's tuple shape including the per-row
     ``poison`` fault codes (0 ok / 1 non-finite logits / 2 dead FSM); a
     poisoned row deactivates without committing the faulty sample, so
-    batch-mates decode token-identically to an undisturbed run."""
+    batch-mates decode token-identically to an undisturbed run.
+
+    The batched VERIFY mode of this chunk path (speculative decoding,
+    ISSUE 8) lives in serve.spec.paged_spec_verify_step: drafting is
+    host-side so verify steps cannot run inside this lax.while_loop — the
+    SpecDecoder substitutes for the whole loop behind decode_chunk, one
+    (B, 1+K) forward_paged per step with the same write_mask/trash-block
+    discipline, per-row accept lengths, and the same per-row poison codes."""
     B = cur.shape[0]
     # the engine's max_len, NOT the block-rounded table capacity — with a
     # non-multiple max_len the dense loop stops at max_len-1 and the paged
@@ -463,6 +470,12 @@ class PagedDecodeEngine(DecodeEngine):
         # host token ids of the request occupying each slot (radix insert
         # at release needs prompt + generated ids; None when radix is off)
         self._slot_ids: list[list[int] | None] = [None] * self.batch_slots
+        # speculative decoding (ISSUE 8): deferred from the parent ctor —
+        # the SpecDecoder reads the paged surface (pool/tables/trash) that
+        # only exists now. Greedy batched chunks route through it; rejected
+        # draft positions roll back on COW-owned blocks (spec.py docstring)
+        if self._spec_cfg is not None:
+            self._build_spec()
 
     def _group(self, slot: int) -> int:
         """dp group of a batch slot (slots shard over dp like the dense
@@ -649,6 +662,13 @@ class PagedDecodeEngine(DecodeEngine):
         # the hit is accounted only HERE — a bucket fallback above must not
         # show up as served-from-cache in the radix gauges
         self.radix[g].record_hit(P)
+        if self.spec is not None:
+            # drafter seeding on the warm path (the miss fallback hooks
+            # on_admit inside super().prefill_slot): the drafters get the
+            # FULL cached prompt ids, so prompt-lookup drafting sees the
+            # whole multi-turn transcript from a warm turn's first verify
+            # step — the radix admission feeds the drafter, not just the KV
+            self.spec.on_admit(slot, ids)
         m = len(suffix)
         tokens = np.full((1, bucket), self.pad_id, dtype=np.int32)
         tokens[0, :m] = suffix
@@ -717,6 +737,17 @@ class PagedDecodeEngine(DecodeEngine):
         ``pos`` is a device array mid-async-dispatch, and a host read at
         this point would stall the chain — ContinuousBatcher reconciles
         from the host copy it fetches anyway.)"""
+        if self.spec is not None and greedy:
+            # speculative batched verify mode (ISSUE 8): chunks become
+            # draft-K/verify-once steps through the SpecDecoder, each ONE
+            # (B, 1+K) forward_paged — token-identical to this loop by
+            # construction, stacking on radix warm prefills. The decoder
+            # claims block coverage per verify step via spec_grow (growth
+            # here would over-claim chunk_steps*(1+K) positions at once);
+            # reconcile_coverage still clamps after the chunk.
+            return self.spec.decode_chunk(
+                cur, pos, fsm, active, nbytes, tokens_left, key,
+                temperature, byte_budget, chunk_steps)
         # a fast-forward chunk can emit up to (1+W) tokens per step — the
         # table must cover the worst case BEFORE dispatch (a mid-chunk
         # write past the covered blocks would scribble on the pool). The
@@ -762,6 +793,30 @@ class PagedDecodeEngine(DecodeEngine):
         self._last_poison = pois
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
+    def spec_grow(self, span: int, active=None) -> list[int]:
+        """Claim block coverage for one speculative verify step (cur + K
+        draft writes) — the spec twin of decode_chunk's pre-dispatch
+        claim, paced per verify step because the SpecDecoder pays a host
+        readback each step anyway (and reconciles ``_next_pos`` to the
+        actual frontier after it, so the worst-case claim never compounds
+        across steps). ``active`` restricts the claim to rows still
+        decoding: a slot that finished mid-chunk stays engine-owned until
+        the scheduler's post-chunk release and must not keep bleeding the
+        pool. Returns the slots whose pool claim FAILED (after radix
+        eviction): the caller truncates those rows alone at their covered
+        frontier while batch-mates keep decoding — the same per-request
+        isolation as the plain chunk's ladder."""
+        starved = []
+        for b in range(self.batch_slots):
+            if self._slot_owned[b] and (active is None or active[b]):
+                try:
+                    self._grow(b, self._next_pos[b] + span + 1)
+                except PoolExhausted:
+                    starved.append(b)
+                    continue
+                self._next_pos[b] = min(self._next_pos[b] + span, self.max_len)
+        return starved
+
     def release_slot(self, slot: int, generated_ids: list[int] | None = None,
                      ok: bool = True) -> None:
         if self._slot_owned[slot] or self._slot_shared[slot]:
@@ -776,6 +831,11 @@ class PagedDecodeEngine(DecodeEngine):
                 # later session as a warm prefix. Under pool pressure
                 # (_radix_may_admit) insertion is denied too — caching must
                 # yield to live admissions before live admissions shed.
+                # ``generated_ids`` is the scheduler's ACCEPTED token stream
+                # — under speculation, rejected draft KV only ever lives at
+                # positions PAST len(prompt+accepted), i.e. in the partial
+                # tail block insert() already refuses to adopt, so zero
+                # radix-cached blocks can contain a rejected draft token.
                 ids = self._slot_ids[slot] + [int(t) for t in generated_ids]
                 blocks = self._slot_shared[slot] + self._slot_owned[slot]
                 self.radix[self._group(slot)].insert(ids, blocks)
@@ -786,6 +846,9 @@ class PagedDecodeEngine(DecodeEngine):
             self._covered[slot] = 0
             self._next_pos[slot] = 0
         self._slot_ids[slot] = None
+        # parent hook: the spec decoder drops the slot's host context /
+        # drafter state (and writes its SPEC_TRACE_SINK record on ok)
+        super().release_slot(slot, generated_ids, ok=ok)
 
     def _radix_may_admit(self, group: int) -> bool:
         """Pool-pressure gate on session-cache admission (degradation stage
@@ -833,6 +896,11 @@ class PagedDecodeEngine(DecodeEngine):
             (self.batch_slots, self.max_blocks), jnp.int32)
         self._pressure_until = 0.0
         self._nan_inject = None
+        if self.spec is not None:
+            # per-slot host contexts + drafter state are slot bookkeeping
+            # too; the generation fence stops a wedged decode_chunk from
+            # dispatching further verify steps against the fresh world
+            self.spec.reset()
 
     # the dense single-request path doesn't exist here; the batcher is the
     # serving surface (generate_many / services with BRAIN_BATCH)

@@ -237,6 +237,26 @@ class RadixCache:
 
     # ------------------------------------------------------------ stats
 
+    def chains(self) -> list[list[int]]:
+        """Every root→leaf token-id chain currently cached (debug/test
+        surface). The speculative-decoding containment tests walk this to
+        assert no cached chain ever contains a rejected draft token: each
+        chain must be a prefix of some request's accepted prompt+generated
+        stream (serve.spec — rejected drafts live only past the accepted
+        frontier, in the partial tail ``insert`` refuses to adopt)."""
+        out: list[list[int]] = []
+
+        def walk(node: RadixNode, ids: list[int]) -> None:
+            if not node.children:
+                if ids:
+                    out.append(list(ids))
+                return
+            for child in node.children.values():
+                walk(child, ids + list(child.key))
+
+        walk(self.root, [])
+        return out
+
     @property
     def nodes(self) -> int:
         return self._n_nodes

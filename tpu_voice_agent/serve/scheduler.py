@@ -64,6 +64,10 @@ class _Slot:
     prompt_len: int = 0
     cached_tokens: int = 0  # prompt tokens served from cached KV (static
     # prefix / radix chain) at admission
+    forwards: int = 0  # decode forward dispatches this request rode (spec
+    # engines report per-row participation; 0 = engine doesn't split it)
+    spec_accepts: int = 0  # draft tokens accepted for this request (spec
+    # engines report per-row accept counts on the same widened readback)
     eos: bool = False
 
 
@@ -466,6 +470,11 @@ class ContinuousBatcher:
             eng._nan_inject = mask
             self._nan_slots.clear()
         t_chunk0 = time.perf_counter()
+        # stale-readback fence: the spec decoder publishes per-row accept/
+        # participation arrays; a chunk that takes the plain loop instead
+        # (non-greedy, spec off) must not re-serve the previous chunk's
+        eng._last_accepts = None
+        eng._last_row_fwds = None
         self._rng, k = jax.random.split(self._rng)
         (out, n, eos, cur, pos, fsm, active,
          nbytes, tokens_left) = eng.decode_chunk(
@@ -539,6 +548,14 @@ class ContinuousBatcher:
 
             record_radix_gauges(radix)
 
+        # widened spec readbacks (ISSUE 8): per-row verify participation
+        # and accept counts — host arrays the SpecDecoder already paid the
+        # transfer for, folded into per-REQUEST accounting so batched
+        # results carry an honest ``forwards`` (steps/forwards IS the
+        # request's speculation multiplier) and ``spec_accepted``
+        row_fwds = getattr(eng, "_last_row_fwds", None)
+        row_accepts = getattr(eng, "_last_accepts", None)
+
         pois_arr = None if pois is None else pois_h
         for b in range(self.B):
             sl = self.slots[b]
@@ -562,6 +579,10 @@ class ContinuousBatcher:
                                               detail=reason)
                 continue
             sl.token_ids.extend(int(t) for t in out_h[b, : n_h[b]])
+            if row_fwds is not None:
+                sl.forwards += int(row_fwds[b])
+            if row_accepts is not None:
+                sl.spec_accepts += int(row_accepts[b])
             if not act_h[b]:
                 # slot stopped this chunk: clean EOS, or truncation by
                 # byte/token/length budget (eos flag distinguishes them)
@@ -578,6 +599,8 @@ class ContinuousBatcher:
                     steps=len(sl.token_ids),  # accepted tokens, not forwards
                     finished=bool(eos_h[b]),
                     cached_tokens=sl.cached_tokens,
+                    forwards=sl.forwards,
+                    spec_accepted=sl.spec_accepts,
                 )
                 m.inc("scheduler.requests_completed")
                 m.observe_ms("scheduler.request_total",

@@ -46,14 +46,25 @@ Env contract (read by ``spec_from_env``; services/brain.py plumbs it):
 With ``SPEC_ENABLE`` unset the engine never constructs a SpecDecoder and
 the decode path is byte-identical to before this module existed.
 
-Restrictions: greedy constrained decoding on the dense-cache DecodeEngine
-only (temperature sampling needs rejection-sampling to preserve the
-distribution; the paged/pp layouts would need block-table rollback). The
+Layouts: the dense DecodeEngine (rollback = position rewind in place) AND
+the paged PagedDecodeEngine (ISSUE 8): draft tokens only ever land in
+blocks the slot COW-owns — admission writes start past every shared/radix
+block, so overwrite-before-attend holds at block granularity exactly as it
+does for dense position rewind, and a rejected draft can never dirty a
+cached chain. The pp staged cache has neither rollback story and refuses
+``spec`` at construction. Greedy constrained decoding only (temperature
+sampling needs rejection-sampling to preserve the distribution); the
 batcher falls back to the plain chunk loop outside that envelope.
+
+``SPEC_TRACE_SINK=<path>`` appends one JSONL record per cleanly released
+request (prompt/generated ids + drafted/accepted counts) — the production
+trace ``train.distill.train_draft_from_trace`` retrains ``draft-tiny`` on.
 """
 
 from __future__ import annotations
 
+import json
+import threading
 from dataclasses import dataclass, replace
 from functools import partial
 
@@ -62,7 +73,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..grammar.fsm import DeviceFSM, fsm_advance, fsm_row
-from ..models.llama import PRESETS, forward, init_kv_cache, init_params
+from ..models.llama import PRESETS, forward, forward_paged, init_kv_cache, init_params
 from ..utils.envcfg import env_bool, env_int, env_str
 from .engine import chain_block, chain_byte_cap, prefill_row
 
@@ -78,6 +89,8 @@ class SpecConfig:
     drafter: str = "fsm,prompt"  # comma chain: fsm | prompt | model
     draft_model: str | None = None  # orbax ckpt dir for "model"; None = random
     draft_preset: str = "draft-tiny"  # preset for a random-init draft model
+    trace_sink: str | None = None  # JSONL path: per-request draft traces
+    # (prompt/generated ids + drafted/accepted) for draft-model retraining
 
 
 def spec_from_env() -> SpecConfig | None:
@@ -89,73 +102,35 @@ def spec_from_env() -> SpecConfig | None:
         k=max(1, env_int("SPEC_K", 4)),
         drafter=env_str("SPEC_DRAFTER", "fsm,prompt") or "fsm,prompt",
         draft_model=env_str("SPEC_DRAFT_MODEL") or None,
+        trace_sink=env_str("SPEC_TRACE_SINK") or None,
     )
 
 
 # ---------------------------------------------------------------- verify
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "rules", "K", "kernels", "eos_id", "pad_id",
-                     "unroll", "max_len"),
-    donate_argnames=("cache",),
-)
-def spec_verify_step(
-    params,
-    cfg,
-    cache,
-    cur,  # (B,) sampled-but-unfed token per row (the loop convention)
-    pos,  # (B,) cur's write position
-    fsm_state,  # (B,) grammar state AFTER cur
-    active,  # (B,) bool
-    nbytes,  # (B,) bytes emitted so far
-    tokens_left,  # (B,) remaining token budget
-    draft_toks,  # (B, K) int32 proposals; -1 pad past draft_len
-    draft_len,  # (B,) int32 0..K
-    tables: DeviceFSM,
-    byte_len_table,  # (V,) int32
-    byte_budget,  # scalar int32
-    rules=None,
-    logit_mask=None,
-    K: int = 4,
-    kernels: str = "xla",
-    eos_id: int = 2,
-    pad_id: int = 0,
-    unroll: int = 1,
-    max_len: int | None = None,
-):
-    """ONE speculative step for every row: forward ``[cur, d_1..d_K]``,
-    grammar-mask each position at its own FSM state, accept the longest
-    draft prefix matching the target's greedy choice, take the target's
-    pick at the first mismatch as the bonus token.
+def _draft_cap(draft_len, tokens_left, pos, max_pos, active):
+    """Proposal length, capped so emission fits the token budget and cache
+    (accepted writes land at pos .. pos+a <= max_pos-1, plus the bonus)."""
+    dl = jnp.minimum(jnp.minimum(draft_len, tokens_left - 1), max_pos - 1 - pos)
+    return jnp.where(active, jnp.maximum(dl, 0), 0)
 
-    Structurally the ff_body of chunk_decode_loop with the chain supplied
-    by the host and acceptance decided by argmax-match instead of forcing:
-    the block pads by duplicating the last valid (token, position) — cache
-    scatter writes are idempotent — and emission goes out as ``cur`` plus
-    the accepted prefix. Rollback is implicit: positions past the accepted
-    frontier hold stale draft KV that the next contiguous block write
-    overwrites before its queries can attend it (see _attend's causal +
-    frontier masks)."""
-    B = cur.shape[0]
-    if max_len is None:
-        max_len = cache["k"].shape[2]
+
+def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
+                   draft_toks, dl, step_tok, blk_tok, tables: DeviceFSM,
+                   byte_len_table, byte_budget, logit_mask, K: int,
+                   eos_id: int, pad_id: int, max_pos):
+    """Post-forward half of a verify step — THE one copy shared by the
+    dense and paged jitted steps (jit-inlined at both call sites): FSM scan
+    along the draft path, masked greedy per position, longest-prefix
+    acceptance + bonus token, byte/token/cache caps, and the PR 7 poison
+    gate applied per verify position (non-finite raw logits at any REAL
+    block position, or a dead FSM state at entry / on the bonus advance).
+    A poisoned row deactivates WITHOUT committing anything this step —
+    batch-mates' carries (and tokens) are untouched, exactly the plain
+    loops' containment contract. Returns (out, n_step, eos, new_cur,
+    new_pos, new_state, new_active, nbytes, left, a, dl, poison)."""
     iw = jnp.arange(1 + K)[None, :]  # (1, 1+K) block index
-
-    # proposal length, capped so emission fits the token budget and cache
-    # (accepted writes land at pos .. pos+a <= max_len-1, plus the bonus)
-    dl = jnp.minimum(jnp.minimum(draft_len, tokens_left - 1), max_len - 1 - pos)
-    dl = jnp.where(active, jnp.maximum(dl, 0), 0)
-
-    # block tokens [cur, d_1..d_dl, tail-duplicates]: engine.chain_block —
-    # the ONE copy of the idempotent duplicate-tail construction shared
-    # with the ff loop (never writes a pad/-1 over live KV)
-    step_tok, blk_tok, blk_pos = chain_block(iw, cur, draft_toks, dl, active,
-                                             pad_id, pos)
-
-    logits, cache = forward(params, cfg, blk_tok, blk_pos, cache, rules,
-                            attn_impl=kernels, unroll=unroll)  # (B, 1+K, V)
 
     # FSM states along the draft path: states[i] = state after cur,d_1..d_i
     # (dead/padded transitions pin to -1; clamped only for safe gathers)
@@ -195,34 +170,193 @@ def spec_verify_step(
                                     byte_len_table, byte_budget)
     a = jnp.where(active, a, 0)
 
+    # bonus: the target's choice at the first unaccepted position (its state
+    # is on the accepted path, hence valid)
+    g_a = jnp.take_along_axis(g, a[:, None], axis=1)[:, 0]
+    s_a = jnp.take_along_axis(states.T, a[:, None], axis=1)[:, 0]
+    s_next = fsm_advance(tables, jnp.maximum(s_a, 0), g_a)
+
+    # poison gate (engine._poison_gate's verify-block twin): code 1 =
+    # non-finite raw logits at any REAL position (tail duplicates repeat a
+    # real position's logits, so masking them out loses nothing), code 2 =
+    # dead FSM at entry or along the bonus advance. ``ok`` replaces
+    # ``active`` in every commit below — on healthy rows they are equal,
+    # so token identity with the pre-poison step is structural.
+    real = iw <= dl[:, None]
+    finite = jnp.all(jnp.isfinite(logits), axis=-1)  # (B, 1+K)
+    nanp = active & jnp.any(~finite & real, axis=1)
+    deadp = active & ~nanp & ((fsm_state < 0) | (s_a < 0) | (s_next < 0))
+    poison = jnp.where(nanp, 1, jnp.where(deadp, 2, 0)).astype(jnp.int32)
+    ok = active & ~(nanp | deadp)
+
     # emit cur + accepted prefix
-    valid = (iw <= a[:, None]) & active[:, None]
+    valid = (iw <= a[:, None]) & ok[:, None]
     out = jnp.where(valid, blk_tok, pad_id)  # (B, 1+K); slot i = token i
-    n_step = jnp.where(active, 1 + a, 0)
+    n_step = jnp.where(ok, 1 + a, 0)
     acc_bytes = jnp.where(
         a > 0,
         jnp.take_along_axis(chain_bytes, jnp.maximum(a - 1, 0)[:, None],
                             axis=1)[:, 0],
         0)
     nbytes = nbytes + jnp.where(
-        active, byte_len_table[jnp.maximum(step_tok, 0)] + acc_bytes, 0)
+        ok, byte_len_table[jnp.maximum(step_tok, 0)] + acc_bytes, 0)
     left = tokens_left - n_step
 
-    # bonus: the target's choice at the first unaccepted position (its state
-    # is on the accepted path, hence valid)
-    g_a = jnp.take_along_axis(g, a[:, None], axis=1)[:, 0]
-    s_a = jnp.take_along_axis(states.T, a[:, None], axis=1)[:, 0]
-    s_next = fsm_advance(tables, jnp.maximum(s_a, 0), g_a)
-    new_state = jnp.where(active, s_next, fsm_state)
-    new_cur = jnp.where(active, g_a, cur)
-    new_pos = jnp.where(active, pos + 1 + a, pos)
+    new_state = jnp.where(ok, s_next, fsm_state)
+    new_cur = jnp.where(ok, g_a, cur)
+    new_pos = jnp.where(ok, pos + 1 + a, pos)
 
-    eos = active & (new_cur == eos_id)
+    eos = ok & (new_cur == eos_id)
     stop = (new_cur == eos_id) | (nbytes >= byte_budget) \
-        | (new_pos >= max_len - 1) | (left <= 0)
-    new_active = active & ~stop
+        | (new_pos >= max_pos - 1) | (left <= 0)
+    new_active = ok & ~stop
+    return (out, n_step, eos, new_cur, new_pos, new_state, new_active,
+            nbytes, left, a, dl, poison)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "rules", "K", "kernels", "eos_id", "pad_id",
+                     "unroll", "max_len"),
+    donate_argnames=("cache",),
+)
+def spec_verify_step(
+    params,
+    cfg,
+    cache,
+    cur,  # (B,) sampled-but-unfed token per row (the loop convention)
+    pos,  # (B,) cur's write position
+    fsm_state,  # (B,) grammar state AFTER cur
+    active,  # (B,) bool
+    nbytes,  # (B,) bytes emitted so far
+    tokens_left,  # (B,) remaining token budget
+    draft_toks,  # (B, K) int32 proposals; -1 pad past draft_len
+    draft_len,  # (B,) int32 0..K
+    tables: DeviceFSM,
+    byte_len_table,  # (V,) int32
+    byte_budget,  # scalar int32
+    rules=None,
+    logit_mask=None,
+    nan_inject=None,  # (B,) bool or None — chaos drill (see engine.py twin)
+    K: int = 4,
+    kernels: str = "xla",
+    eos_id: int = 2,
+    pad_id: int = 0,
+    unroll: int = 1,
+    max_len: int | None = None,
+):
+    """ONE speculative step for every row: forward ``[cur, d_1..d_K]``,
+    grammar-mask each position at its own FSM state, accept the longest
+    draft prefix matching the target's greedy choice, take the target's
+    pick at the first mismatch as the bonus token.
+
+    Structurally the ff_body of chunk_decode_loop with the chain supplied
+    by the host and acceptance decided by argmax-match instead of forcing:
+    the block pads by duplicating the last valid (token, position) — cache
+    scatter writes are idempotent — and emission goes out as ``cur`` plus
+    the accepted prefix. Rollback is implicit: positions past the accepted
+    frontier hold stale draft KV that the next contiguous block write
+    overwrites before its queries can attend it (see _attend's causal +
+    frontier masks)."""
+    if max_len is None:
+        max_len = cache["k"].shape[2]
+    iw = jnp.arange(1 + K)[None, :]  # (1, 1+K) block index
+
+    dl = _draft_cap(draft_len, tokens_left, pos, max_len, active)
+
+    # block tokens [cur, d_1..d_dl, tail-duplicates]: engine.chain_block —
+    # the ONE copy of the idempotent duplicate-tail construction shared
+    # with the ff loop (never writes a pad/-1 over live KV)
+    step_tok, blk_tok, blk_pos = chain_block(iw, cur, draft_toks, dl, active,
+                                             pad_id, pos)
+
+    logits, cache = forward(params, cfg, blk_tok, blk_pos, cache, rules,
+                            attn_impl=kernels, unroll=unroll)  # (B, 1+K, V)
+    if nan_inject is not None:
+        logits = jnp.where(nan_inject[:, None, None] & active[:, None, None],
+                           jnp.float32(jnp.nan), logits)
+
+    (out, n_step, eos, new_cur, new_pos, new_state, new_active, nbytes, left,
+     a, dl, poison) = _verify_commit(
+        logits, cur, pos, fsm_state, active, nbytes, tokens_left,
+        draft_toks, dl, step_tok, blk_tok, tables, byte_len_table,
+        byte_budget, logit_mask, K, eos_id, pad_id, max_len)
     return (out, n_step, eos, cache, new_cur, new_pos, new_state, new_active,
-            nbytes, left, a, dl)
+            nbytes, left, a, dl, poison)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "rules", "K", "kernels", "eos_id", "pad_id",
+                     "max_len"),
+    donate_argnames=("k_pool", "v_pool"),
+)
+def paged_spec_verify_step(
+    params,
+    cfg,
+    k_pool,
+    v_pool,
+    block_tables,  # (B, max_blocks) int32
+    cur,
+    pos,
+    fsm_state,
+    active,
+    nbytes,
+    tokens_left,
+    draft_toks,  # (B, K) int32 proposals; -1 pad past draft_len
+    draft_len,  # (B,) int32 0..K
+    tables: DeviceFSM,
+    byte_len_table,
+    byte_budget,
+    trash_idx=None,  # (B,) int32 per-row parked-write index (dp-local trash)
+    rules=None,
+    logit_mask=None,
+    nan_inject=None,  # (B,) bool or None — chaos drill
+    K: int = 4,
+    kernels: str = "xla",
+    eos_id: int = 2,
+    pad_id: int = 0,
+    max_len: int | None = None,
+):
+    """spec_verify_step's paged twin — the batched verify mode of the paged
+    chunk path (ISSUE 8): per-slot ``[cur, d_1..d_K]`` columns in ONE
+    (B, 1+K) forward_paged, per-row FSM-state scan, per-row accept lengths
+    and per-row poison codes via ``_verify_commit``.
+
+    Block-granular rollback contract: draft writes scatter through the
+    slot's block table at positions pos..pos+dl — all past the admission
+    frontier, hence in blocks the slot COW-owns (shared/radix chain blocks
+    cover only positions below the first suffix write; see
+    PagedDecodeEngine._prefill_chain). Rejected draft KV is therefore
+    stale-but-private: the next verify block's contiguous writes overwrite
+    it before any query can attend it (the paged attention paths mask by
+    query position exactly like the dense _attend), and a cached radix
+    chain can never contain it. Idle rows park their writes in their
+    group's trash block via ``write_mask`` like the paged chunk loop."""
+    max_pos = block_tables.shape[1] * k_pool.shape[2]
+    if max_len is not None:
+        max_pos = min(max_pos, max_len)
+    iw = jnp.arange(1 + K)[None, :]
+
+    dl = _draft_cap(draft_len, tokens_left, pos, max_pos, active)
+    step_tok, blk_tok, blk_pos = chain_block(iw, cur, draft_toks, dl, active,
+                                             pad_id, pos)
+
+    logits, k_pool, v_pool = forward_paged(
+        params, cfg, blk_tok, blk_pos, k_pool, v_pool, block_tables,
+        rules=rules, attn_impl=kernels, write_mask=active,
+        trash_idx=trash_idx)  # (B, 1+K, V)
+    if nan_inject is not None:
+        logits = jnp.where(nan_inject[:, None, None] & active[:, None, None],
+                           jnp.float32(jnp.nan), logits)
+
+    (out, n_step, eos, new_cur, new_pos, new_state, new_active, nbytes, left,
+     a, dl, poison) = _verify_commit(
+        logits, cur, pos, fsm_state, active, nbytes, tokens_left,
+        draft_toks, dl, step_tok, blk_tok, tables, byte_len_table,
+        byte_budget, logit_mask, K, eos_id, pad_id, max_pos)
+    return (out, n_step, eos, k_pool, v_pool, new_cur, new_pos, new_state,
+            new_active, nbytes, left, a, dl, poison)
 
 
 # ---------------------------------------------------------------- drafters
@@ -552,7 +686,7 @@ def build_drafter(cfg: SpecConfig, engine) -> Drafter:
 
 
 class SpecDecoder:
-    """Per-engine speculative decode driver.
+    """Per-engine speculative decode driver (dense AND paged layouts).
 
     Owns per-slot host context (prompt + emitted tokens — drafters are
     host-side) and substitutes for the on-device chunk loop behind
@@ -562,54 +696,169 @@ class SpecDecoder:
     verify step (drafting needs cur/state) — the trade the chunk loop
     exists to avoid, bought back K-fold in steps; over a high-latency
     tunnel prefer fast-forward or raise SPEC_K.
+
+    On a ``PagedDecodeEngine`` the verify step goes through
+    ``paged_spec_verify_step`` (writes scatter through the slot's block
+    table, COW-owned blocks only) and each step first claims block
+    coverage for the worst case via ``engine.spec_grow`` — a slot whose
+    pool claim fails truncates alone, exactly like the plain paged chunk.
+    Warm radix admissions seed the drafters with the full cached prompt
+    ids (``on_admit`` fires on the radix-hit path too), so prompt-lookup
+    drafting sees the whole multi-turn transcript from the first verify
+    step of a warm turn.
     """
 
     def __init__(self, engine, cfg: SpecConfig, drafter: Drafter | None = None):
-        if not engine._alloc_dense_cache:
+        self.paged = getattr(engine, "k_pool", None) is not None
+        if not engine._alloc_dense_cache and not self.paged:
             raise ValueError(
-                "speculative decoding needs the dense position-indexed KV "
-                "layout (rollback = rewind pos); the paged/pp engines fall "
-                "back to their own chunk loops")
+                "speculative decoding needs per-position KV rollback: the "
+                "dense layout rewinds positions in place, the paged layout "
+                "overwrites COW-owned draft blocks; this engine layout "
+                "(staged pp cache) supports neither — serve speculation on "
+                "the dense or paged engines")
         self.engine = engine
         self.cfg = cfg
         self.K = max(1, int(cfg.k))
         self.drafter = drafter if drafter is not None else build_drafter(cfg, engine)
         self._ctx: list[list[int] | None] = [None] * engine.batch_slots
+        self._prompt_len = [0] * engine.batch_slots
         self.last_chunk_forwards = 0
         # cumulative accounting behind the spec.* gauges
         self._drafted = 0
         self._accepted = 0
         self._steps = 0
         self._emitted = 0
+        # per-slot accounting for the trace sink + per-request forwards
+        B = engine.batch_slots
+        self._slot_drafted = np.zeros((B,), np.int64)
+        self._slot_accepted = np.zeros((B,), np.int64)
+        self._slot_fwds = np.zeros((B,), np.int64)
+        # generation fence: a warm restart (watchdog) bumps this so a
+        # thread wedged INSIDE decode_chunk discards instead of committing
+        # further verify steps against the restarted engine state — the
+        # spec path mutates engine KV per step, so the scheduler's
+        # epoch-at-commit check alone cannot contain it
+        self._gen = 0
+        # SPEC_TRACE_SINK: per-request JSONL draft traces for
+        # train.distill.train_draft_from_trace (production retraining)
+        self._trace_path = cfg.trace_sink
+        self._trace_lock = threading.Lock()
 
     # ------------------------------------------------------------ hooks
 
     def on_admit(self, slot: int, ids: list[int]) -> None:
         self._ctx[slot] = list(ids)
+        self._prompt_len[slot] = len(ids)
+        self._slot_drafted[slot] = 0
+        self._slot_accepted[slot] = 0
+        self._slot_fwds[slot] = 0
         self.drafter.on_admit(slot, list(ids))
 
-    def on_release(self, slot: int) -> None:
+    def on_release(self, slot: int, ok: bool = True) -> None:
+        ctx = self._ctx[slot]
+        if (ok and self._trace_path and ctx is not None
+                and len(ctx) > self._prompt_len[slot]):
+            self._trace_record(slot, ctx)
         self._ctx[slot] = None
+        self._prompt_len[slot] = 0
         self.drafter.on_release(slot)
 
+    def reset(self) -> None:
+        """Warm-restart hook (engine.warm_restart): drop every slot's host
+        context and drafter state, and bump the generation fence so a
+        decode_chunk wedged mid-flight stops dispatching verify steps
+        against the restarted engine."""
+        self._gen += 1
+        for b in range(self.engine.batch_slots):
+            if self._ctx[b] is not None:
+                self._ctx[b] = None
+                self._prompt_len[b] = 0
+                self.drafter.on_release(b)
+
+    def _trace_record(self, slot: int, ctx: list[int]) -> None:
+        """Append one JSONL draft-trace record (cleanly released requests
+        only — errored/cancelled streams are not training data)."""
+        rec = {
+            "plane": "paged" if self.paged else "dense",
+            "drafter": self.drafter.name,
+            "k": self.K,
+            "prompt_ids": ctx[: self._prompt_len[slot]],
+            "generated_ids": ctx[self._prompt_len[slot]:],
+            "drafted": int(self._slot_drafted[slot]),
+            "accepted": int(self._slot_accepted[slot]),
+            "verify_steps": int(self._slot_fwds[slot]),
+        }
+        try:
+            with self._trace_lock, open(self._trace_path, "a") as f:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        except OSError:  # tracing must never fail serving
+            return
+        from ..utils import get_metrics
+
+        get_metrics().inc("spec.trace_records")
+
     # ------------------------------------------------------------ chunk
+
+    def _verify(self, cur, pos, fsm, active, nbytes, tokens_left, dtoks,
+                dlen, byte_budget: int, nan_inject):
+        """One layout-dispatched verify step. Returns the step tuple with
+        the engine's KV already committed back onto the engine."""
+        eng = self.engine
+        if self.paged:
+            (out, n, eosf, eng.k_pool, eng.v_pool, cur, pos, fsm, active,
+             nbytes, tokens_left, a, dl, pois) = paged_spec_verify_step(
+                eng.params, eng.cfg, eng.k_pool, eng.v_pool,
+                eng.block_tables, cur, pos, fsm, active, nbytes, tokens_left,
+                jnp.asarray(dtoks, jnp.int32), jnp.asarray(dlen),
+                eng.tables, eng.byte_len_table, jnp.int32(byte_budget),
+                trash_idx=eng._trash_idx, rules=eng.rules,
+                logit_mask=eng.logit_mask, nan_inject=nan_inject,
+                K=self.K, kernels=eng.kernels, eos_id=eng.eos_id,
+                pad_id=eng.pad_id, max_len=eng.max_len)
+        else:
+            (out, n, eosf, eng.cache, cur, pos, fsm, active, nbytes,
+             tokens_left, a, dl, pois) = spec_verify_step(
+                eng.params, eng.cfg, eng.cache, cur, pos, fsm, active,
+                nbytes, tokens_left,
+                jnp.asarray(dtoks, jnp.int32), jnp.asarray(dlen),
+                eng.tables, eng.byte_len_table, jnp.int32(byte_budget),
+                rules=eng.rules, logit_mask=eng.logit_mask,
+                nan_inject=nan_inject,
+                K=self.K, kernels=eng.kernels, eos_id=eng.eos_id,
+                pad_id=eng.pad_id, unroll=eng.decode_unroll,
+                max_len=eng.max_len)
+        return (out, n, eosf, cur, pos, fsm, active, nbytes, tokens_left,
+                a, dl, pois)
 
     def decode_chunk(self, cur, pos, fsm, active, nbytes, tokens_left, key,
                      temperature: float, byte_budget: int, chunk_steps: int):
         """Drop-in for the engine's decode_chunk (greedy constrained only;
         the engine gates). Returns the same 9-tuple; ``out``/``n``/``eos``
-        come back as host arrays (the per-step readbacks already paid)."""
+        come back as host arrays (the per-step readbacks already paid).
+        Besides ``_last_fwds``/``_last_poison`` the readback widens to
+        per-row accept counts (``_last_accepts``) and per-row verify
+        participation (``_last_row_fwds``) — the scheduler folds them into
+        per-request ``GenerationResult.forwards`` and the spec gauges
+        reflect paged-plane traffic through the same counters."""
         eng = self.engine
         B = eng.batch_slots
         K = self.K
+        gen0 = self._gen
+        nan_inject = eng._take_nan_inject()  # chaos drill parity: the
+        # scheduler arms the mask per admission; the first verify step of
+        # the chunk injects, exactly like the plain loops' one-shot mask
         cur_h, fsm_h, act_h = (np.asarray(x) for x in
                                jax.device_get((cur, fsm, active)))
         eos_total = (~act_h) & (cur_h == eng.eos_id)
         outs: list[list[int]] = [[] for _ in range(B)]
         fwds = 0
         drafted = accepted = 0
+        row_fwds = np.zeros((B,), np.int64)
+        row_accepts = np.zeros((B,), np.int64)
+        poison_h = np.zeros((B,), np.int32)
         for _ in range(chunk_steps):
-            if not act_h.any():
+            if not act_h.any() or self._gen != gen0:
                 break
             ctxs = [
                 (self._ctx[b] + [int(cur_h[b])])
@@ -618,24 +867,51 @@ class SpecDecoder:
             ]
             dtoks, dlen = self.drafter.draft_batch(ctxs, fsm_h, act_h, K)
             dlen = np.minimum(np.asarray(dlen, np.int32), K)
-            (out, n, eosf, eng.cache, cur, pos, fsm, active, nbytes,
-             tokens_left, a, dl) = spec_verify_step(
-                eng.params, eng.cfg, eng.cache, cur, pos, fsm, active,
-                nbytes, tokens_left,
-                jnp.asarray(dtoks, jnp.int32), jnp.asarray(dlen),
-                eng.tables, eng.byte_len_table, jnp.int32(byte_budget),
-                rules=eng.rules, logit_mask=eng.logit_mask,
-                K=K, kernels=eng.kernels, eos_id=eng.eos_id,
-                pad_id=eng.pad_id, unroll=eng.decode_unroll,
-                max_len=eng.max_len)
+            if self._gen != gen0:
+                # draft_batch is a host-blocking point (draft-model feeds
+                # pay their own readbacks): a warm restart while it was
+                # wedged must stop us BEFORE we mutate the restarted
+                # engine's allocator or dispatch into its pools
+                break
+            if self.paged:
+                # claim worst-case block coverage for this verify step
+                # (cur + K drafts) — ACTIVE rows only: a slot that hit EOS
+                # mid-chunk stays engine-owned until the scheduler releases
+                # it post-chunk, and growing it every step would bleed the
+                # pool for nothing. A slot whose claim fails truncates
+                # alone at its covered frontier, like the plain paged chunk
+                for b in eng.spec_grow(1 + K, active=act_h):
+                    tokens_left = tokens_left.at[b].set(0)
+            (out, n, eosf, cur, pos, fsm, active, nbytes, tokens_left,
+             a, dl, pois) = self._verify(
+                cur, pos, fsm, active, nbytes, tokens_left, dtoks, dlen,
+                byte_budget, nan_inject)
+            nan_inject = None
             # one combined transfer per verify step: the drafters need the
-            # new cur/state, the context needs the emitted tokens
-            out_h, n_h, eos_h, cur_h, fsm_h, act_h, a_h, dl_h = (
+            # new cur/state, the context needs the emitted tokens — and
+            # ``pos`` rides along so the paged engine's growth target
+            # reconciles to each row's ACTUAL frontier every step instead
+            # of ratcheting by the worst case (a low-accept step advances
+            # pos by 1, not 1+K; without the clamp the claims compound)
+            prev_act = act_h
+            (out_h, n_h, eos_h, cur_h, fsm_h, act_h, a_h, dl_h, pois_h,
+             pos_h) = (
                 np.asarray(x) for x in
-                jax.device_get((out, n, eosf, cur, fsm, active, a, dl)))
+                jax.device_get((out, n, eosf, cur, fsm, active, a, dl, pois,
+                                pos)))
+            if self._gen != gen0:
+                break  # warm-restarted mid-step: discard, stop dispatching
+            if self.paged:
+                eng.reconcile_coverage(pos_h)
             fwds += 1
             drafted += int(dl_h.sum())
             accepted += int(a_h.sum())
+            row_fwds += prev_act.astype(np.int64)
+            row_accepts += a_h.astype(np.int64)
+            poison_h = np.maximum(poison_h, pois_h)
+            self._slot_fwds += prev_act.astype(np.int64)
+            self._slot_drafted += dl_h.astype(np.int64)
+            self._slot_accepted += a_h.astype(np.int64)
             for b in range(B):
                 if n_h[b] > 0:
                     toks = [int(t) for t in out_h[b, : n_h[b]]]
@@ -653,6 +929,12 @@ class SpecDecoder:
 
         self.last_chunk_forwards = fwds
         eng._last_fwds = fwds
+        # the widened readback (satellite 2): per-row fault codes for the
+        # scheduler's quarantine (a poisoned verify row evicts alone), and
+        # per-row accept/participation counts for per-request accounting
+        eng._last_poison = poison_h
+        eng._last_accepts = row_accepts
+        eng._last_row_fwds = row_fwds
         self._steps += fwds
         self._drafted += drafted
         self._accepted += accepted

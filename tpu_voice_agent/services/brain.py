@@ -1062,9 +1062,11 @@ def make_parser_from_env() -> IntentParser:
     tree, RADIX_SESSIONS the host transcript LRU (docs/PERF.md "Session KV
     reuse"). Unset keeps the stateless path byte-identical.
     SPEC_ENABLE=1 turns on grammar-aware speculative decoding on the dense
-    engine layouts (SPEC_K / SPEC_DRAFTER / SPEC_DRAFT_MODEL — serve.spec);
-    the paged/pp layouts ignore it with a warning (their KV rollback story
-    does not exist yet) and greedy output stays token-identical either way."""
+    AND paged engine layouts (SPEC_K / SPEC_DRAFTER / SPEC_DRAFT_MODEL /
+    SPEC_TRACE_SINK — serve.spec); on paged it runs inside the batched
+    chunk path and compounds with radix warm prefills (ISSUE 8). The pp
+    layout refuses it with a typed error at boot (no rollback story on the
+    staged cache). Greedy output stays token-identical either way."""
     import logging
 
     log = logging.getLogger("tpu_voice_agent.brain")
@@ -1092,12 +1094,13 @@ def make_parser_from_env() -> IntentParser:
 
         if paged:
             # classmethod polymorphism: from_hf builds cls(...), so the
-            # paged engine loads checkpoints through the same loader
-            warn_unused("paged", SPEC_ENABLE=spec)
+            # paged engine loads checkpoints through the same loader.
+            # SPEC_ENABLE just turns on here (ISSUE 8): spec decode runs
+            # inside the paged chunk path, compounding with radix reuse
             pool = int(os.environ.get("BRAIN_POOL_BLOCKS", "0")) or None
             eng = PagedDecodeEngine.from_hf(
                 model_dir, quant=quant, batch_slots=max(slots, 1),
-                moe_impl=moe, pool_blocks=pool)
+                moe_impl=moe, pool_blocks=pool, spec=spec)
             return _wrap_batched(eng)
         return _wrap_engine(DecodeEngine.from_hf(model_dir, quant=quant,
                                                  batch_slots=slots, fast_forward=ff,
@@ -1139,12 +1142,13 @@ def make_parser_from_env() -> IntentParser:
         if paged:
             # paged KV pool behind the batcher: HBM tracks live tokens, the
             # shared prompt prefix is stored once, BRAIN_POOL_BLOCKS sizes
-            # the pool (default: dense worst case)
-            warn_unused("paged", SPEC_ENABLE=spec)
+            # the pool (default: dense worst case). SPEC_ENABLE composes
+            # (ISSUE 8): greedy chunks become draft-K/verify-once steps on
+            # the paged layout, stacking with radix warm prefills
             pool = int(os.environ.get("BRAIN_POOL_BLOCKS", "0")) or None
             return _wrap_batched(PagedDecodeEngine(
                 preset=preset, cfg=cfg, batch_slots=max(slots, 1),
-                pool_blocks=pool, quant=quant, fast_forward=ff))
+                pool_blocks=pool, quant=quant, fast_forward=ff, spec=spec))
         return _wrap_engine(DecodeEngine(preset=preset, cfg=cfg, batch_slots=slots,
                                          fast_forward=ff, quant=quant, spec=spec))
     if backend.startswith("pp"):
@@ -1156,7 +1160,7 @@ def make_parser_from_env() -> IntentParser:
         from ..parallel.pipeline import pp_tp_mesh
         from ..serve import PPDecodeEngine
 
-        warn_unused("pp", BRAIN_PAGED=paged, BRAIN_MOE=moe, SPEC_ENABLE=spec)
+        warn_unused("pp", BRAIN_PAGED=paged, BRAIN_MOE=moe)
         preset = backend.split(":", 1)[1] if ":" in backend else "tinyllama-1.1b"
         ndev = len(jax.devices())
         pp = int(os.environ.get("BRAIN_PP", "0")) or min(2, ndev)
@@ -1168,9 +1172,13 @@ def make_parser_from_env() -> IntentParser:
         # fill-drain bubble where the dense/paged layouts ride it free.
         # CPU measured the opposite (+14%), so the knob stays available.
         ppff = int(os.environ.get("BRAIN_FF", "0"))
+        # spec passes THROUGH: the engine refuses it with a clear typed
+        # error (no rollback story on the staged cache) instead of the old
+        # warn+ignore — an operator who set SPEC_ENABLE on the pp backend
+        # finds out at boot, not by silently missing the speedup
         return _wrap_batched(PPDecodeEngine(preset=preset, mesh=pp_tp_mesh(pp, tp),
                                             batch_slots=slots, quant=quant,
-                                            fast_forward=ppff))
+                                            fast_forward=ppff, spec=spec))
     if backend.startswith("planner-distilled"):
         # the in-tree trained intent checkpoint behind the SESSION-KEYED
         # planner: multi-turn transcripts with the distilled short prompt
