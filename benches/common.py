@@ -100,9 +100,22 @@ def snapshot_observability(service_url: str, timeout_s: float = 5.0) -> dict:
     except Exception as e:
         log(f"observability snapshot failed: {e}")
         return {}
-    return {
+    out = {
         "slo": m.get("slo"),
         "stage_latency_ms": m.get("local", {}).get("latency_ms", {}),
         "runtime_gauges": m.get("runtime", {}).get("gauges", {}),
         "runtime_counters": m.get("runtime", {}).get("counters", {}),
     }
+    # the device-plane decomposition (ISSUE 9): every bench artifact that
+    # touches an engine-backed service carries the step-ledger stage
+    # histograms, the compile-sentinel counters, and the live HBM ledger
+    # as their own sections — empty dicts when the scraped service runs no
+    # engine (rule-based brain, executor)
+    hists = m.get("runtime", {}).get("latency_ms", {})
+    for section, prefix in (("engine_step", "engine.step."),
+                            ("xla", "xla."), ("hbm", "hbm.")):
+        sec: dict = {}
+        for src in (out["runtime_gauges"], out["runtime_counters"], hists):
+            sec.update({k: v for k, v in src.items() if k.startswith(prefix)})
+        out[section] = sec
+    return out

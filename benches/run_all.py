@@ -22,7 +22,8 @@ from pathlib import Path
 
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_quality.py", "bench_faults.py", "bench_spec.py",
-           "bench_radix.py", "bench_swarm.py", "bench_chaos.py"]
+           "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
+           "bench_steplog.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -38,16 +39,20 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # search at tiny N (seconds on CPU); the chaos bench stays as well — it is
 # the fault-containment regression gate (tiny engine, trimmed search) and
 # a PR that breaks quarantine/cancellation must fail the quick table too
+# the steplog bench stays on --quick too — it is the telemetry-overhead
+# regression gate (tiny engine, seconds on CPU), and a PR that makes the
+# step ledger cost >2% of a decode chunk must fail the quick table
 QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
-                 "bench_chaos.py"]
+                 "bench_chaos.py", "bench_steplog.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_SPEC_PAGED_SESSIONS": "2", "BENCH_SPEC_PAGED_TURNS": "2",
              "BENCH_STT_SECONDS": "4", "BENCH_STT_STREAMS": "1,4",
              "BENCH_SWARM_MAX_N": "8", "BENCH_SWARM_UTTERANCES": "3",
              "BENCH_SWARM_ENGINE_MAX_N": "4",
-             "BENCH_CHAOS_MAX_N": "4", "BENCH_CHAOS_UTTERANCES": "2"}
+             "BENCH_CHAOS_MAX_N": "4", "BENCH_CHAOS_UTTERANCES": "2",
+             "BENCH_STEPLOG_SESSIONS": "6", "BENCH_STEPLOG_ROUNDS": "2"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -120,7 +125,8 @@ def main() -> None:
             if body.get("bench") == name.removesuffix(".py"):
                 entry["artifact"] = art.name
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
-                            "spec", "stt", "radix", "swarm", "chaos"):
+                            "spec", "stt", "radix", "swarm", "chaos",
+                            "steplog", "engine_step", "xla", "hbm"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry
@@ -132,6 +138,21 @@ def main() -> None:
     combined = art_dir / f"BENCH_runall_{stamp}.json"
     combined.write_text(json.dumps(summary, indent=1))
     print(f"[run_all] combined artifact: {combined}", file=sys.stderr, flush=True)
+
+    # bench trajectory gate (ISSUE 9, tools/benchdiff.py): diff this
+    # artifact against the previous run (and BENCHDIFF_BASELINE when the
+    # operator pins one) and fail the table on >10% per-row regressions in
+    # the gated direction. BENCHDIFF_SKIP=1 disarms on known-noisy boxes.
+    if os.environ.get("BENCHDIFF_SKIP") != "1":
+        cmd = [sys.executable, str(root / "tools" / "benchdiff.py"), "--gate"]
+        base = os.environ.get("BENCHDIFF_BASELINE")
+        if base:
+            cmd += ["--baseline", base]
+        diff = subprocess.run(cmd, cwd=root)
+        if diff.returncode != 0:
+            failures += 1
+            print("[run_all] benchdiff GATE FAILED (regressions vs previous "
+                  "run — see rows above)", file=sys.stderr, flush=True)
     sys.exit(1 if failures else 0)
 
 

@@ -1,0 +1,454 @@
+"""Engine microscope (ISSUE 9): step ledger, recompilation sentinel, HBM
+ledger, and the tooling that rides them.
+
+The executable spec for the device-plane telemetry: the StepTimer's tiling
+contract (stages account ≥95% of a real scheduler chunk's wall), the ring's
+bounds and flight-recorder freeze integration, cache-miss compile detection
+with the warmup fence (an induced post-fence recompile must surface as a
+counter + a steplog event + a /health warning within one scrape), the
+ledger-on/off token-identity differential, plan-vs-measured HBM
+reconciliation, and the stepview/benchdiff tools (stepview --self-test
+joins tier-1 here, alongside traceview's in test_observability).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_voice_agent.serve import ContinuousBatcher, DecodeEngine
+from tpu_voice_agent.utils import get_compile_watcher, get_metrics
+from tpu_voice_agent.utils.compilewatch import CompileWatcher, _shape_sig, watch_compiles
+from tpu_voice_agent.utils.hbmledger import (
+    engine_hbm_plan,
+    hbm_report,
+    measure_hbm,
+    record_hbm_gauges,
+)
+from tpu_voice_agent.utils.steplog import STAGES, StepLog, get_steplog
+from tpu_voice_agent.utils.tracing import FlightRecorder
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT / "tools"))
+
+import benchdiff  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_telemetry():
+    """Every test starts with an empty step ring and a disarmed, zeroed
+    compile watcher — and leaves them that way (both are process-global;
+    a leaked armed fence would tag other modules' compiles post-fence)."""
+    get_steplog().clear()
+    get_compile_watcher().reset()
+    yield
+    get_steplog().clear()
+    get_compile_watcher().reset()
+
+
+@pytest.fixture(scope="module")
+def scope_engine():
+    """Module-private engine with bucket/chunk shapes no other module uses,
+    so its traces are cache-cold regardless of suite order (the sentinel
+    counts jit-cache misses — a bucket another test already warmed would
+    hide the induced compile)."""
+    return DecodeEngine(preset="test-tiny", max_len=768, batch_slots=2,
+                        prefill_buckets=(96, 192))
+
+
+def _batcher(engine, **kw):
+    kw.setdefault("chunk_steps", 7)
+    kw.setdefault("max_new_tokens", 16)
+    return ContinuousBatcher(engine, **kw)
+
+
+# ------------------------------------------------------------ StepLog units
+
+
+def test_steptimer_stages_tile_the_wall():
+    import time
+
+    log = StepLog(max_steps=8, enabled=True)
+    t = log.timer()
+    time.sleep(0.002)
+    t.lap("admit")
+    time.sleep(0.005)
+    t.lap("decode")
+    time.sleep(0.002)
+    t.lap("readback")
+    t.lap("release")
+    rec = t.finish(occupancy=2, tokens=5)
+    assert rec["occupancy"] == 2 and rec["tokens"] == 5
+    assert set(rec["stages"]) <= set(STAGES)
+    # laps are contiguous segments of one perf_counter stream: they tile
+    assert sum(rec["stages"].values()) <= rec["wall_ms"] + 1e-6
+    assert sum(rec["stages"].values()) >= 0.95 * rec["wall_ms"]
+
+
+def test_steptimer_carve_moves_subtime_between_stages():
+    log = StepLog(max_steps=8, enabled=True)
+    t = log.timer()
+    t.lap("admit")
+    t.stages["admit"] = 10.0
+    t.carve("admit", "prefill", 4.0)
+    assert t.stages["admit"] == pytest.approx(6.0)
+    assert t.stages["prefill"] == pytest.approx(4.0)
+    # carving more than the source stage holds clamps (tiling preserved)
+    t.carve("admit", "prefill", 100.0)
+    assert t.stages["admit"] == 0.0
+    assert t.stages["prefill"] == pytest.approx(10.0)
+
+
+def test_steplog_ring_bounds_and_seq():
+    log = StepLog(max_steps=4, enabled=True)
+    for _ in range(10):
+        log.timer().finish()
+    dump = log.dump()
+    assert len(dump["steps"]) == 4
+    assert dump["recorded"] == 10
+    assert [s["seq"] for s in dump["steps"]] == [6, 7, 8, 9]
+    assert log.last()["seq"] == 9
+    assert len(log.steps(last=2)) == 2
+
+
+def test_steplog_disabled_records_nothing():
+    log = StepLog(max_steps=4, enabled=False)
+    log.timer().finish()
+    assert log.dump()["steps"] == [] and log.last() is None
+
+
+def test_flight_freeze_carries_the_step_ring():
+    log = get_steplog()
+    log.timer().finish(occupancy=1, tokens=3)
+    fr = FlightRecorder(max_traces=4)
+    assert fr.trigger("test.freeze", detail="steplog ride-along")
+    dump = fr.frozen_dump()
+    assert dump["reason"] == "test.freeze"
+    assert dump["steplog"]["steps"], "freeze must embed the step ring"
+    assert dump["steplog"]["steps"][-1]["tokens"] == 3
+
+
+# ------------------------------------------------- compile sentinel units
+
+
+def test_watch_compiles_counts_cache_misses_once():
+    w = get_compile_watcher()
+
+    @watch_compiles("test.unit_fn")
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    f(jnp.zeros((3,), jnp.float32))  # trace 1
+    f(jnp.ones((3,), jnp.float32))   # cache hit — same shape
+    f(jnp.zeros((5,), jnp.float32))  # trace 2 — new shape
+    st = w.state()
+    assert st["compiles"] == 2
+    evs = w.events()
+    assert [e["site"] for e in evs] == ["test.unit_fn", "test.unit_fn"]
+    assert "float32[5]" in evs[-1]["shape"]
+    assert st["post_fence_compiles"] == 0 and "warning" not in st
+
+
+def test_fence_flags_post_fence_compiles_with_warning():
+    w = get_compile_watcher()
+
+    @watch_compiles("test.fence_fn")
+    @jax.jit
+    def g(x):
+        return x + 1
+
+    g(jnp.zeros((2,), jnp.float32))
+    w.arm_fence("test warm")
+    g(jnp.zeros((4,), jnp.float32))  # the post-fence retrace
+    st = w.state()
+    assert st["fence_armed"] and st["fence_reason"] == "test warm"
+    assert st["post_fence_compiles"] == 1
+    assert "recompile(s) after the warmup fence" in st["warning"]
+    assert "test.fence_fn" in st["warning"]
+    # the pending list hands the event to the step ledger exactly once
+    pend = w.take_pending()
+    assert len(pend) == 2 and pend[-1]["post_fence"]
+    assert w.take_pending() == []
+
+
+def test_shape_sig_compact_and_capped():
+    sig = _shape_sig((jnp.zeros((2, 3), jnp.int32), {"a": 1}, [1, 2], 7), {})
+    assert "int32[2,3]" in sig and "dict(1)" in sig and "seq(2)" in sig
+    many = _shape_sig(tuple(jnp.zeros((i + 1,)) for i in range(10)), {})
+    assert many.endswith("…")
+
+
+# --------------------------------------------- the real scheduler plane
+
+
+def test_ledger_accounts_chunk_wall_and_occupancy(scope_engine):
+    bat = _batcher(scope_engine)
+    res = bat.generate_many(["turn on the lights", "play some jazz"])
+    assert all(r.error is None for r in res)
+    steps = [s for s in get_steplog().steps() if s.get("occupancy")]
+    assert steps, "decode chunks must land in the ring"
+    for s in steps:
+        acct = sum(s["stages"].values()) / s["wall_ms"]
+        assert acct >= 0.95, f"only {acct:.1%} of step {s['seq']} accounted"
+        assert set(s["stages"]) <= set(STAGES)
+    # the per-chunk meta the HUD and stepview render
+    assert steps[0]["occupancy"] >= 1
+    assert sum(s.get("tokens", 0) for s in steps) >= sum(
+        len(r.token_ids) for r in res)
+    # engine.step.* metrics exported alongside
+    snap = get_metrics().snapshot()
+    assert snap["latency_ms"]["engine.step.wall"]["count"] >= len(steps)
+    assert "engine.step.occupancy" in snap["gauges"]
+
+
+def test_induced_post_fence_recompile_surfaces_everywhere(scope_engine):
+    """The acceptance drill: warm the 96-bucket, declare serving warm, then
+    submit a prompt that forces the cold 192-bucket — the sentinel counter,
+    the step ledger's compile event, and the brain's /health warning must
+    all fire within one scrape."""
+    w = get_compile_watcher()
+    bat = _batcher(scope_engine)
+    assert all(r.error is None
+               for r in bat.generate_many(["turn on the lights"]))
+    w.take_pending()
+    get_steplog().clear()
+    before = w.state()["compiles"]
+
+    w.arm_fence("warmup complete")
+    ids = scope_engine.tokenizer.encode("turn on the lights and play jazz",
+                                        bos=True)
+    long_ids = (ids * ((120 // len(ids)) + 1))[:120]  # 96 < n <= 192
+    bat.submit(list(long_ids))
+    bat.run_until_done()
+
+    # (1) the counter
+    st = w.state()
+    assert st["compiles"] > before
+    assert st["post_fence_compiles"] >= 1
+    assert "warning" in st
+    # (2) the steplog event, on the step that paid the trace
+    evs = [ev for s in get_steplog().steps() for ev in (s.get("events") or [])]
+    assert any(ev["post_fence"] and "prefill" in ev["site"] for ev in evs), evs
+    # (3) the /health warning, one scrape
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import RuleBasedParser, build_app
+
+    import urllib.request
+
+    with AppServer(build_app(RuleBasedParser())) as srv:
+        with urllib.request.urlopen(srv.url + "/health", timeout=5) as r:
+            body = json.loads(r.read().decode())
+    cs = body["compile_sentinel"]
+    assert cs["post_fence_compiles"] >= 1
+    assert "recompile(s) after the warmup fence" in cs["warning"]
+    assert body["last_step"]["stages"], "/health carries the last step"
+
+
+def test_all_admissions_shed_still_records_a_step(scope_engine):
+    """Overload churn — every dequeued admission sheds, nothing decodes —
+    must still land in the ring: that admit/shed wall is exactly the time
+    an overload autopsy needs accounted."""
+    from tpu_voice_agent.utils.resilience import Deadline
+
+    bat = _batcher(scope_engine)
+    bat.submit("turn on the lights", deadline=Deadline(0.0))
+    bat.step()
+    assert bat.results, "expired request must shed at dequeue"
+    steps = get_steplog().steps()
+    assert steps, "the shed-only step must be recorded"
+    assert steps[-1]["occupancy"] == 0 and steps[-1]["tokens"] == 0
+    assert "admit" in steps[-1]["stages"]
+
+
+def test_steplog_off_is_token_identical(scope_engine):
+    log = get_steplog()
+    bat_on = _batcher(scope_engine)
+    on = bat_on.generate_many(["dim the bedroom lights", "what time is it"])
+    log.enabled = False
+    try:
+        bat_off = _batcher(scope_engine)
+        off = bat_off.generate_many(["dim the bedroom lights",
+                                     "what time is it"])
+    finally:
+        log.enabled = True
+    assert [r.token_ids for r in on] == [r.token_ids for r in off]
+    assert all(r.error is None for r in on)
+
+
+def test_warm_restart_rearms_the_fence(scope_engine):
+    w = get_compile_watcher()
+    assert not w.fence_armed
+    scope_engine.warm_restart()
+    assert w.fence_armed
+    assert w.state()["fence_reason"] == "warm_restart"
+
+
+# ------------------------------------------------------------ HBM ledger
+
+
+def test_hbm_plan_matches_measured_weights_and_kv(scope_engine):
+    plan = engine_hbm_plan(scope_engine)
+    meas = measure_hbm(scope_engine)
+    # the plan is config arithmetic, the measurement sums real nbytes —
+    # they must agree on the parts both account (dense engine: exact)
+    assert meas["weights_bytes"] == plan["weights_bytes"]
+    assert meas["kv_pool_bytes"] == plan["kv_pool_bytes"]
+    rep = hbm_report(scope_engine)
+    assert abs(rep["drift"]) < 0.02
+    assert rep["plan"]["total_bytes"] > 0
+
+
+def test_hbm_gauges_exported_and_throttled(scope_engine):
+    rep = record_hbm_gauges(scope_engine, force=True)
+    assert rep is not None
+    g = get_metrics().gauges()
+    for name in ("hbm.weights_bytes", "hbm.kv_pool_bytes",
+                 "hbm.plan_total_bytes", "hbm.plan_drift"):
+        assert name in g, name
+    assert g["hbm.weights_bytes"] == rep["measured"]["weights_bytes"]
+    # throttle: an immediate second call inside the interval is a no-op
+    assert record_hbm_gauges(scope_engine, min_interval_s=60.0) is None
+
+
+# ------------------------------------------------------------ tools
+
+
+def test_stepview_self_test_passes():
+    proc = subprocess.run([sys.executable, str(ROOT / "tools" / "stepview.py"),
+                           "--self-test"], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stepview self-test ok" in proc.stdout
+
+
+def test_stepview_renders_real_ring(scope_engine, tmp_path):
+    import stepview
+
+    bat = _batcher(scope_engine)
+    bat.generate_many(["turn on the lights"])
+    body = get_steplog().dump()
+    txt = stepview.render_timeline(body, width=32)
+    assert "step ledger:" in txt and "█" in txt
+    # flight-dump unwrap: stepview reads the frozen ``steplog`` section
+    p = tmp_path / "flight.json"
+    p.write_text(json.dumps({"frozen": True, "steplog": body}))
+    assert stepview.load_dump(str(p))["recorded"] == body["recorded"]
+
+
+def _runall_artifact(path, rows):
+    path.write_text(json.dumps({
+        "quick": True,
+        "benches": {"bench_x.py": {"status": "ok", "rows": rows}},
+    }))
+
+
+def test_benchdiff_flags_directional_regressions(tmp_path):
+    prev = tmp_path / "BENCH_runall_1.json"
+    cur = tmp_path / "BENCH_runall_2.json"
+    _runall_artifact(prev, [
+        {"metric": "x_p50", "value": 100.0, "unit": "ms"},
+        {"metric": "x_tps", "value": 50.0, "unit": "tokens/s"},
+        {"metric": "x_count", "value": 3, "unit": "count"},
+    ])
+    _runall_artifact(cur, [
+        {"metric": "x_p50", "value": 125.0, "unit": "ms"},        # +25% BAD
+        {"metric": "x_tps", "value": 40.0, "unit": "tokens/s"},   # -20% BAD
+        {"metric": "x_count", "value": 30, "unit": "count"},      # not gated
+    ])
+    regs, changes = benchdiff.diff_rows(benchdiff.load_rows(cur),
+                                        benchdiff.load_rows(prev), 0.10)
+    assert {r["metric"] for r in regs} == {"x_p50", "x_tps"}
+    assert {c["metric"] for c in changes} == {"x_p50", "x_tps", "x_count"}
+    # improvements are "moved", never regressions
+    _runall_artifact(cur, [{"metric": "x_p50", "value": 50.0, "unit": "ms"}])
+    regs, changes = benchdiff.diff_rows(benchdiff.load_rows(cur),
+                                        benchdiff.load_rows(prev), 0.10)
+    assert regs == [] and len(changes) == 1
+
+
+def test_benchdiff_never_diffs_quick_against_full(tmp_path):
+    """--quick runs trim workloads (capacity caps, token budgets): a quick
+    artifact diffed against a full one reads as a huge phantom regression.
+    pick_artifacts matches the table kind."""
+    full_old = tmp_path / "BENCH_runall_20200101_000000.json"
+    full_old.write_text(json.dumps({"benches": {}}))
+    quick_old = tmp_path / "BENCH_runall_20200102_000000.json"
+    quick_old.write_text(json.dumps({"quick": True, "benches": {}}))
+    quick_new = tmp_path / "BENCH_runall_20200103_000000.json"
+    quick_new.write_text(json.dumps({"quick": True, "benches": {}}))
+    cur, prev = benchdiff.pick_artifacts(tmp_path)
+    assert (cur, prev) == (quick_new, quick_old)
+    # a full run skips the newer quick artifact back to the last full one
+    full_new = tmp_path / "BENCH_runall_20200104_000000.json"
+    full_new.write_text(json.dumps({"benches": {}}))
+    cur, prev = benchdiff.pick_artifacts(tmp_path)
+    assert (cur, prev) == (full_new, full_old)
+    # no same-kind predecessor: the trajectory starts, nothing to gate
+    quick_old.unlink()
+    quick_new.unlink()
+    full_old.unlink()
+    assert benchdiff.pick_artifacts(tmp_path) == (full_new, None)
+
+
+def test_benchdiff_gate_exit_codes(tmp_path):
+    prev = tmp_path / "BENCH_runall_20200101_000000.json"
+    cur = tmp_path / "BENCH_runall_20200102_000000.json"
+    _runall_artifact(prev, [{"metric": "y_p50", "value": 100.0, "unit": "ms"}])
+    _runall_artifact(cur, [{"metric": "y_p50", "value": 200.0, "unit": "ms"}])
+    assert benchdiff.main(["--artifacts", str(tmp_path), "--gate"]) == 1
+    # without --gate the diff reports but never fails the caller
+    assert benchdiff.main(["--artifacts", str(tmp_path)]) == 0
+    # tolerance raised past the move: clean
+    assert benchdiff.main(["--artifacts", str(tmp_path), "--gate",
+                           "--tolerance", "1.5"]) == 0
+    # single artifact: the trajectory starts, no gate to fail
+    cur.unlink()
+    assert benchdiff.main(["--artifacts", str(tmp_path), "--gate"]) == 0
+
+
+# ------------------------------------------------------------ services
+
+
+def test_voice_health_forwards_brain_engine_microscope():
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.serve.stt import NullSTT
+    from tpu_voice_agent.services.brain import RuleBasedParser
+    from tpu_voice_agent.services.brain import build_app as build_brain
+    from tpu_voice_agent.services.voice import VoiceConfig
+    from tpu_voice_agent.services.voice import build_app as build_voice
+
+    import urllib.request
+
+    get_compile_watcher().arm_fence("test")
+    get_steplog().timer().finish(occupancy=1, tokens=2)
+    with AppServer(build_brain(RuleBasedParser())) as brain:
+        cfg = VoiceConfig(brain_url=brain.url, executor_url="http://127.0.0.1:1",
+                          stt_factory=lambda: NullSTT())
+        with AppServer(build_voice(cfg)) as voice:
+            with urllib.request.urlopen(voice.url + "/health", timeout=5) as r:
+                body = json.loads(r.read().decode())
+    fwd = body["brain"]
+    assert fwd["compile_sentinel"]["fence_armed"]
+    assert fwd["last_step"]["tokens"] == 2
+
+
+def test_brain_debug_steplog_endpoint():
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import RuleBasedParser, build_app
+
+    import urllib.request
+
+    log = get_steplog()
+    for i in range(5):
+        log.timer().finish(occupancy=i, tokens=i)
+    with AppServer(build_app(RuleBasedParser())) as srv:
+        with urllib.request.urlopen(srv.url + "/debug/steplog?last=2",
+                                    timeout=5) as r:
+            body = json.loads(r.read().decode())
+    assert body["service"] == "brain"
+    assert len(body["steps"]) == 2 and body["recorded"] == 5
+    assert body["steps"][-1]["occupancy"] == 4

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Bench-artifact regression differ: the start of the bench trajectory.
+
+``benches/run_all.py`` writes a combined ``BENCH_runall_<ts>.json`` per run
+(per-bench metric rows + observability sections), but until now nothing
+ever COMPARED two of them — a 30% decode-p50 regression sailed through as
+long as every bench exited 0. This tool diffs the current artifact against
+the previous run (and, when pinned, a baseline artifact) row by row and
+flags every regression past the tolerance:
+
+    python tools/benchdiff.py                     # newest vs previous
+    python tools/benchdiff.py CUR PREV            # explicit artifacts
+    python tools/benchdiff.py --baseline PINNED   # also gate vs a pin
+    python tools/benchdiff.py --gate              # exit 1 on regressions
+
+Direction is inferred from each row's unit: ms/s rows regress UP (latency),
+throughput/capacity/accuracy rows regress DOWN; count/bytes rows are
+reported but never gated (a "faults injected" count going up is not a
+regression). ``BENCHDIFF_TOLERANCE`` (default 0.10) sets the relative bar;
+``BENCHDIFF_SKIP=1`` disarms the run_all gate (operator escape hatch for
+known-noisy boxes). run_all.py invokes this with ``--gate`` after writing
+its artifact, so a >10% per-row regression fails the bench table loudly.
+
+Zero dependencies beyond the stdlib.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+# unit -> gating direction. "up" = larger is worse (latency), "down" =
+# smaller is worse (throughput/capacity/quality). Units not listed are
+# informational only — a count or byte total has no regression direction.
+_LOWER_IS_BETTER = {"ms", "s", "x_first_to_last"}
+_HIGHER_IS_BETTER = {"tokens/s", "tokens/step", "tokens/forward", "audio_s/s",
+                     "sessions", "streams", "x", "fraction", "ratio", "rate"}
+
+
+def direction(unit: str) -> str | None:
+    if unit in _LOWER_IS_BETTER:
+        return "up"
+    if unit in _HIGHER_IS_BETTER:
+        return "down"
+    return None
+
+
+def load_rows(path: pathlib.Path) -> dict[str, dict]:
+    """metric -> row over every bench in a BENCH_runall artifact (metric
+    names are globally unique across benches by convention — prefixed)."""
+    body = json.loads(path.read_text())
+    rows: dict[str, dict] = {}
+    for bench, entry in body.get("benches", {}).items():
+        for row in entry.get("rows", []):
+            if "metric" in row and isinstance(row.get("value"), (int, float)):
+                rows[row["metric"]] = dict(row, bench=bench)
+    return rows
+
+
+def diff_rows(cur: dict[str, dict], ref: dict[str, dict],
+              tolerance: float) -> tuple[list[dict], list[dict]]:
+    """(regressions, changes): rows whose value moved in the bad direction
+    past tolerance, and every row that moved past tolerance either way."""
+    regressions, changes = [], []
+    for metric, row in sorted(cur.items()):
+        prev = ref.get(metric)
+        if prev is None or prev["value"] == 0:
+            continue
+        delta = (row["value"] - prev["value"]) / abs(prev["value"])
+        if abs(delta) <= tolerance:
+            continue
+        rec = {"metric": metric, "bench": row.get("bench", "?"),
+               "unit": row.get("unit", ""), "prev": prev["value"],
+               "cur": row["value"], "delta": round(delta, 4)}
+        changes.append(rec)
+        d = direction(row.get("unit", ""))
+        if d == "up" and delta > tolerance:
+            regressions.append(rec)
+        elif d == "down" and delta < -tolerance:
+            regressions.append(rec)
+    return regressions, changes
+
+
+def _is_quick(path: pathlib.Path) -> bool:
+    try:
+        return bool(json.loads(path.read_text()).get("quick"))
+    except (OSError, ValueError):
+        return False
+
+
+def pick_artifacts(art_dir: pathlib.Path) -> tuple[pathlib.Path | None,
+                                                   pathlib.Path | None]:
+    """(current, previous): the newest artifact, and the newest OLDER one
+    from the same table kind — --quick runs trim workloads (capacity caps,
+    token budgets), so diffing a quick artifact against a full one reads as
+    a huge phantom regression (and the reverse masks real ones). Quick
+    compares against quick, full against full."""
+    arts = sorted(art_dir.glob("BENCH_runall_*.json"))
+    if not arts:
+        return None, None
+    cur = arts[-1]
+    cur_quick = _is_quick(cur)
+    for prev in reversed(arts[:-1]):
+        if _is_quick(prev) == cur_quick:
+            return cur, prev
+    return cur, None
+
+
+def report(label: str, regressions: list[dict], changes: list[dict]) -> None:
+    moved = {r["metric"] for r in regressions}
+    for c in changes:
+        tag = "REGRESSION" if c["metric"] in moved else "moved"
+        print(f"[benchdiff] {label} {tag:<10} {c['bench']:<20} "
+              f"{c['metric']:<40} {c['prev']:>12.3f} -> {c['cur']:>12.3f} "
+              f"({100 * c['delta']:+.1f}% {c['unit']})")
+    if not changes:
+        print(f"[benchdiff] {label}: no row moved past tolerance")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("current", nargs="?", help="current BENCH_runall artifact")
+    ap.add_argument("previous", nargs="?", help="reference artifact")
+    ap.add_argument("--baseline", help="pinned baseline artifact (also gated)")
+    ap.add_argument("--artifacts", default=None,
+                    help="artifact dir (default: <repo>/bench_artifacts)")
+    ap.add_argument("--tolerance", type=float,
+                    default=float(os.environ.get("BENCHDIFF_TOLERANCE", "0.10")))
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when any gated row regressed")
+    args = ap.parse_args(argv)
+
+    art_dir = pathlib.Path(args.artifacts) if args.artifacts else \
+        pathlib.Path(__file__).resolve().parents[1] / "bench_artifacts"
+
+    if args.current:
+        cur_path = pathlib.Path(args.current)
+        prev_path = pathlib.Path(args.previous) if args.previous else None
+    else:
+        cur_path, prev_path = pick_artifacts(art_dir)
+        if cur_path is None:
+            print("[benchdiff] no BENCH_runall artifacts found — nothing to diff")
+            return 0
+
+    cur = load_rows(cur_path)
+    print(f"[benchdiff] current: {cur_path.name} ({len(cur)} rows, "
+          f"tolerance {100 * args.tolerance:.0f}%)")
+    n_regressions = 0
+    if prev_path is not None:
+        regressions, changes = diff_rows(cur, load_rows(prev_path),
+                                         args.tolerance)
+        print(f"[benchdiff] previous: {prev_path.name}")
+        report("vs-prev", regressions, changes)
+        n_regressions += len(regressions)
+    else:
+        print("[benchdiff] no previous artifact — trajectory starts here")
+    if args.baseline:
+        regressions, changes = diff_rows(cur, load_rows(pathlib.Path(args.baseline)),
+                                         args.tolerance)
+        print(f"[benchdiff] baseline: {args.baseline}")
+        report("vs-base", regressions, changes)
+        n_regressions += len(regressions)
+
+    if n_regressions:
+        print(f"[benchdiff] {n_regressions} regression(s) past "
+              f"{100 * args.tolerance:.0f}%")
+        return 1 if args.gate else 0
+    print("[benchdiff] ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,29 @@ PINNED: dict[str, str] = {
     "spec.trace_records": "counter",
     "scheduler.tokens_per_forward": "gauge",
     "scheduler.forwards": "counter",
+    # engine microscope (ISSUE 9, utils/steplog.py + utils/compilewatch.py
+    # + utils/hbmledger.py, docs/OBSERVABILITY.md "Engine microscope"):
+    # the step ledger's wall histogram + per-chunk occupancy/token gauges
+    # (the per-STAGE histograms register as the f-string family
+    # ``engine.step.*``), the recompilation sentinel's counters —
+    # compiles_post_fence is THE alertable one (a trace after the warmup
+    # fence is the silent-p99-cliff shape-churn failure, named) — and the
+    # live HBM ledger's plan-vs-measured gauges benchdiff/the HUD read.
+    "engine.step.wall": "histogram",
+    "engine.step.occupancy": "gauge",
+    "engine.step.tokens": "gauge",
+    "engine.step.compile_stalls": "counter",
+    "xla.compiles": "counter",
+    "xla.compile_ms": "counter",
+    "xla.compiles_post_fence": "counter",
+    "hbm.weights_bytes": "gauge",
+    "hbm.kv_pool_bytes": "gauge",
+    "hbm.workspace_bytes": "gauge",
+    "hbm.free_bytes": "gauge",
+    "hbm.live_bytes": "gauge",
+    "hbm.plan_total_bytes": "gauge",
+    "hbm.plan_drift": "gauge",
+    "hbm.drift_events": "counter",
 }
 
 

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compilewatch import watch_compiles
+
 
 @dataclass(frozen=True)
 class WhisperConfig:
@@ -158,6 +160,7 @@ def _proj(x, w, b=None):
 # ---------------------------------------------------------------- encoder
 
 
+@watch_compiles("whisper.encoder_forward")
 @partial(jax.jit, static_argnames=("cfg", "rules", "attn_impl"))
 def encoder_forward(
     params: dict, cfg: WhisperConfig, mel: jax.Array, rules=None, attn_impl: str = "xla",
@@ -259,6 +262,7 @@ def pad_cross_kv(cross_kv: dict, total: int) -> dict:
     return {"k": jnp.pad(cross_kv["k"], pad), "v": jnp.pad(cross_kv["v"], pad)}
 
 
+@watch_compiles("whisper.compute_cross_kv")
 @partial(jax.jit, static_argnames=("cfg", "rules"))
 def compute_cross_kv(params: dict, cfg: WhisperConfig, enc_out: jax.Array, rules=None) -> dict:
     """Precompute per-layer cross-attention K/V from encoder output (one

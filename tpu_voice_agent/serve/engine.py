@@ -25,6 +25,7 @@ from ..grammar.fsm import DeviceFSM, fsm_advance, fsm_row
 from ..grammar.intent_grammar import build_fsm_for, build_intent_fsm
 from ..models.llama import LlamaConfig, PRESETS, forward, init_kv_cache, init_params
 from ..parallel.mesh import default_rules, kv_cache_shardings, param_shardings
+from ..utils.compilewatch import get_compile_watcher, watch_compiles
 
 
 def byte_len_table_for(tokenizer, vocab_size: int) -> jnp.ndarray:
@@ -122,6 +123,7 @@ def _poison_gate(raw, state, state_next, active, poison, constrained: bool):
     return active & ~(nanp | deadp), poison
 
 
+@watch_compiles("engine._decode_step")
 @partial(jax.jit, static_argnames=("cfg", "rules", "greedy", "constrained", "kernels"))
 def _decode_step(
     params,
@@ -148,6 +150,7 @@ def _decode_step(
     return nxt, cache, fsm_state
 
 
+@watch_compiles("engine._first_token")
 @partial(jax.jit, static_argnames=("greedy", "constrained", "kernels", "rules"))
 def _first_token(last_logits, fsm_state, tables: DeviceFSM, key, temperature,
                  greedy: bool = True, constrained: bool = True, kernels: str = "xla",
@@ -158,6 +161,7 @@ def _first_token(last_logits, fsm_state, tables: DeviceFSM, key, temperature,
     )
 
 
+@watch_compiles("engine.prefill_row")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "kernels", "fresh"),
@@ -192,6 +196,7 @@ def prefill_row(
     }
 
 
+@watch_compiles("engine.prefill_row_with_prefix")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "kernels"),
@@ -259,6 +264,7 @@ def chain_byte_cap(k, chain, cur_tok, nbytes, byte_len_table, byte_budget):
     return jnp.minimum(k, jnp.sum(chain_bytes <= rem, axis=1)), chain_bytes
 
 
+@watch_compiles("engine.chunk_decode_loop")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained", "kernels",
@@ -956,6 +962,12 @@ class DecodeEngine:
             # generation fence: a decode_chunk wedged mid-flight must stop
             # dispatching verify steps against the restarted engine
             self.spec.reset()
+        # re-arm the recompilation sentinel's warmup fence: the restart
+        # reuses compiled programs, so any NEW trace after it means the
+        # rebuilt mutable state came back with an unexpected shape — the
+        # post-warm-restart retrace is exactly the p99 cliff the sentinel
+        # exists to name
+        get_compile_watcher().arm_fence("warm_restart")
 
     def _prefill(self, prompt: str):
         if self.batch_slots != 1:

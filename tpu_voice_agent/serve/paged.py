@@ -38,6 +38,7 @@ import numpy as np
 
 from ..grammar.fsm import fsm_advance
 from ..models.llama import forward_paged
+from ..utils.compilewatch import get_compile_watcher, watch_compiles
 from .engine import DecodeEngine, _mask_sample_advance, _poison_gate
 from .radix import RadixCache
 
@@ -182,6 +183,7 @@ def record_pool_gauges(alloc: "BlockAllocator") -> None:
     m.set_gauge("paged.kv_blocks_shared", float(alloc.blocks_shared))
 
 
+@watch_compiles("paged._scatter_blocks")
 @partial(jax.jit, donate_argnames=("k_pool", "v_pool"))
 def _scatter_blocks(k_pool, v_pool, src_k, src_v, dst_idx):
     """Write (L, n, nkv, hd) rows into the flat pool at dst_idx (n,)."""
@@ -194,6 +196,7 @@ def _scatter_blocks(k_pool, v_pool, src_k, src_v, dst_idx):
     return kf.reshape(shp), vf.reshape(shp)
 
 
+@watch_compiles("paged.paged_chunk_decode_loop")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained",
@@ -901,6 +904,10 @@ class PagedDecodeEngine(DecodeEngine):
             # too; the generation fence stops a wedged decode_chunk from
             # dispatching further verify steps against the fresh world
             self.spec.reset()
+        # re-arm the recompilation sentinel (see the dense twin): the
+        # rebuilt tables/allocator must come back at the old shapes — a
+        # post-restart retrace is an alertable event, not background noise
+        get_compile_watcher().arm_fence("warm_restart")
 
     # the dense single-request path doesn't exist here; the batcher is the
     # serving surface (generate_many / services with BRAIN_BATCH)

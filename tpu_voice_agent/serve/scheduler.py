@@ -363,6 +363,7 @@ class ContinuousBatcher:
         — in every case batch-mates continue token-identically."""
         from ..utils import get_metrics
         from ..utils.chaos import chaos_fire
+        from ..utils.steplog import get_steplog
 
         m = get_metrics()
         epoch = self._epoch
@@ -373,6 +374,15 @@ class ContinuousBatcher:
             time.sleep(float(os.environ.get("CHAOS_STALL_S", "2.0")))
             if epoch != self._epoch:
                 return
+
+        # the step ledger (ISSUE 9): one StepTimer per scheduler step,
+        # lapped at each stage boundary so the segments tile the chunk wall.
+        # Host timing only — record() no-ops when STEPLOG_ENABLE=0, and the
+        # decode path is byte-identical either way.
+        timer = get_steplog().timer()
+        n_admitted = 0    # successful admissions (slot went live)
+        n_attempted = 0   # dequeued attempts, failures/sheds included
+        admit_prefill_ms = 0.0
 
         act = self._active_h  # host mirror — no device readback for admission
         # mid-decode cancellation: a slot whose deadline expired aborts at
@@ -390,6 +400,7 @@ class ContinuousBatcher:
             if slot is None:
                 break
             rid, prompt = self.pending.pop(0)
+            n_attempted += 1
             dl = self._deadline.get(rid)
             if dl is not None and dl.expired:
                 # satellite fix: admission shed expired deadlines before
@@ -403,6 +414,8 @@ class ContinuousBatcher:
             try:
                 self._admit(slot, rid, prompt)
                 act[slot] = True
+                n_admitted += 1
+                admit_prefill_ms += self.slots[slot].prefill_ms
                 self._pool_wait.pop(rid, None)
                 # chaos drill arming (no-ops with chaos off): NaN logits on
                 # this slot's next chunk / FSM state forced dead
@@ -459,7 +472,19 @@ class ContinuousBatcher:
             for r in [r for r in self._enqueued_at if r not in live]:
                 del self._enqueued_at[r]
 
+        timer.lap("admit")
+        # prefill compute was measured INSIDE the admission segment
+        # (engine._last_prefill_compute_ms per admission) — report it as
+        # its own stage so admit is pure queue/bookkeeping
+        timer.carve("admit", "prefill", admit_prefill_ms)
+
         if not act.any():
+            if n_attempted:
+                # admissions were attempted but every one failed/shed
+                # (pool-exhaustion storm, expired deadlines, prefill
+                # faults): still a step that spent wall time, during
+                # exactly the overload churn an autopsy needs — record it
+                timer.finish(occupancy=0, tokens=0, admitted=n_admitted)
             return
 
         eng = self.engine
@@ -470,11 +495,13 @@ class ContinuousBatcher:
             eng._nan_inject = mask
             self._nan_slots.clear()
         t_chunk0 = time.perf_counter()
+        occupancy = int(act.sum())  # slots riding THIS chunk's dispatches
         # stale-readback fence: the spec decoder publishes per-row accept/
         # participation arrays; a chunk that takes the plain loop instead
         # (non-greedy, spec off) must not re-serve the previous chunk's
         eng._last_accepts = None
         eng._last_row_fwds = None
+        eng._last_draft_ms = 0.0  # the step ledger's drafter carve
         self._rng, k = jax.random.split(self._rng)
         (out, n, eos, cur, pos, fsm, active,
          nbytes, tokens_left) = eng.decode_chunk(
@@ -482,6 +509,7 @@ class ContinuousBatcher:
             self.tokens_left, k, self.temperature, self.byte_budget,
             self.chunk_steps, self.greedy,
         )
+        timer.lap("decode")
         # one transfer for everything the host needs this chunk (a combined
         # device_get is ONE tunnel round trip; separate gets pay one each).
         # _last_fwds (engines that report it) rides the same transfer: the
@@ -500,6 +528,7 @@ class ContinuousBatcher:
                  0 if fwds is None else fwds,
                  0 if pois is None else pois))
         )
+        timer.lap("readback")
         if epoch != self._epoch:
             # the watchdog warm-restarted the engine while this step was
             # stalled in flight: its world is gone — committing the chunk's
@@ -547,6 +576,15 @@ class ContinuousBatcher:
             from .radix import record_radix_gauges
 
             record_radix_gauges(radix)
+        # live HBM ledger tick (throttled to HBM_LEDGER_S inside — the
+        # jax.live_arrays walk must not run per chunk); plan-vs-measured
+        # drift is an alarm, never a serving fault
+        try:
+            from ..utils.hbmledger import record_hbm_gauges
+
+            record_hbm_gauges(eng)
+        except Exception:
+            pass
 
         # widened spec readbacks (ISSUE 8): per-row verify participation
         # and accept counts — host arrays the SpecDecoder already paid the
@@ -611,6 +649,22 @@ class ContinuousBatcher:
                 # generated ids let release insert the prompt+generated
                 # chain back into the tree first
                 self.engine.release_slot(b, generated_ids=sl.token_ids)
+
+        # close the ledger entry: everything after the readback (commit,
+        # release/radix-insert, gauge exports, HBM tick) is "release"; the
+        # drafter's host share (spec engines report _last_draft_ms on the
+        # same readback) is carved out of the decode segment it was
+        # measured inside, so the six stages still tile the wall
+        timer.lap("release")
+        timer.carve("decode", "draft", float(getattr(eng, "_last_draft_ms", 0.0)))
+        timer.finish(
+            occupancy=occupancy,
+            tokens=int(n_h.sum()),
+            admitted=n_admitted or None,
+            forwards=int(fwds_h) if fwds is not None else None,
+            accepted=(int(np.sum(row_accepts)) if row_accepts is not None
+                      else None),
+        )
 
     # ------------------------------------------------------------ drain
 

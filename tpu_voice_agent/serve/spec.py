@@ -65,6 +65,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from dataclasses import dataclass, replace
 from functools import partial
 
@@ -74,6 +75,7 @@ import numpy as np
 
 from ..grammar.fsm import DeviceFSM, fsm_advance, fsm_row
 from ..models.llama import PRESETS, forward, forward_paged, init_kv_cache, init_params
+from ..utils.compilewatch import watch_compiles
 from ..utils.envcfg import env_bool, env_int, env_str
 from .engine import chain_block, chain_byte_cap, prefill_row
 
@@ -214,6 +216,7 @@ def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
             nbytes, left, a, dl, poison)
 
 
+@watch_compiles("spec.spec_verify_step")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "K", "kernels", "eos_id", "pad_id",
@@ -285,6 +288,7 @@ def spec_verify_step(
             nbytes, left, a, dl, poison)
 
 
+@watch_compiles("spec.paged_spec_verify_step")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "K", "kernels", "eos_id", "pad_id",
@@ -440,6 +444,7 @@ class PromptLookupDrafter(Drafter):
         return []
 
 
+@watch_compiles("spec._draft_model_block")
 @partial(
     jax.jit,
     static_argnames=("cfg", "K", "kernels"),
@@ -853,6 +858,9 @@ class SpecDecoder:
         eos_total = (~act_h) & (cur_h == eng.eos_id)
         outs: list[list[int]] = [[] for _ in range(B)]
         fwds = 0
+        draft_ms = 0.0  # host drafter share of the chunk wall (the step
+        # ledger's "drafter time" — drafting is the host-side cost the
+        # verify speedup pays for, so it gets its own ledger line)
         drafted = accepted = 0
         row_fwds = np.zeros((B,), np.int64)
         row_accepts = np.zeros((B,), np.int64)
@@ -865,7 +873,9 @@ class SpecDecoder:
                 if act_h[b] and self._ctx[b] is not None else None
                 for b in range(B)
             ]
+            t_d0 = time.perf_counter()
             dtoks, dlen = self.drafter.draft_batch(ctxs, fsm_h, act_h, K)
+            draft_ms += (time.perf_counter() - t_d0) * 1e3
             dlen = np.minimum(np.asarray(dlen, np.int32), K)
             if self._gen != gen0:
                 # draft_batch is a host-blocking point (draft-model feeds
@@ -928,7 +938,9 @@ class SpecDecoder:
             n_arr[b] = len(o)
 
         self.last_chunk_forwards = fwds
+        self.last_chunk_draft_ms = draft_ms
         eng._last_fwds = fwds
+        eng._last_draft_ms = draft_ms  # the step ledger's drafter line
         # the widened readback (satellite 2): per-row fault codes for the
         # scheduler's quarantine (a poisoned verify row evicts alone), and
         # per-row accept/participation counts for per-request accounting

@@ -28,6 +28,7 @@ import numpy as np
 
 from ..audio.endpoint import EnergyEndpointer
 from ..audio.mel import MelConfig, log_mel_spectrogram
+from ..utils.compilewatch import watch_compiles
 from ..utils.tracing import get_metrics as _metrics
 from ..grammar.intent_grammar import default_tokenizer
 from ..models.whisper import (
@@ -41,6 +42,7 @@ from ..models.whisper import (
 )
 
 
+@watch_compiles("stt._stt_decode_loop")
 @partial(jax.jit, static_argnames=("cfg", "max_new", "eos_id", "pad_id", "attn_impl"),
          donate_argnames=("self_cache",))
 def _stt_decode_loop(
@@ -124,6 +126,7 @@ class TranscribeResult:
     n_frames: int
 
 
+@watch_compiles("stt._append_cross_kv")
 @partial(jax.jit, donate_argnames=("buf_k", "buf_v"))
 def _append_cross_kv(buf_k, buf_v, new_k, new_v, offset, slot=0):
     """Append one encoded block's cross-KV into the utterance buffer at

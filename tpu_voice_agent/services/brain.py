@@ -867,6 +867,23 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
             # repeat-offender poison quarantine (serve.scheduler): prompts
             # refused at submit after repeated NaN/dead-FSM/prefill faults
             body["quarantine"] = qinfo()
+        # the engine microscope (ISSUE 9): recompilation-sentinel state —
+        # a compile after the warmup fence is the shape-churn p99 cliff,
+        # surfaced here as an alertable ``warning`` line — plus the last
+        # step ledger entry and the live HBM gauges, so one /health scrape
+        # answers "where did the last chunk's time go and does memory
+        # still match the plan"
+        from ..utils import get_compile_watcher
+        from ..utils.steplog import get_steplog
+
+        body["compile_sentinel"] = get_compile_watcher().state()
+        last_step = get_steplog().last()
+        if last_step is not None:
+            body["last_step"] = last_step
+        hbm = {k: v for k, v in get_metrics().gauges().items()
+               if k.startswith("hbm.")}
+        if hbm:
+            body["hbm"] = hbm
         body["status"] = status
         body["ok"] = status != "unhealthy"
         body["slo"] = slo.state()
@@ -1017,6 +1034,9 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
     app.router.add_get("/metrics", make_metrics_handler("brain", tracer, slo=slo))
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("brain", tracer))
     app.router.add_get("/debug/flightrecorder", make_flightrecorder_handler("brain"))
+    from ..utils.steplog import make_steplog_handler
+
+    app.router.add_get("/debug/steplog", make_steplog_handler("brain"))
     app.router.add_post("/parse", parse)
     return app
 

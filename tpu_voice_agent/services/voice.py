@@ -249,17 +249,55 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     capacity_sessions = int(os.environ.get("VOICE_CAPACITY_SESSIONS", "0"))
     get_metrics().set_gauge("voice.live_sessions", 0)
 
+    # engine-microscope forward (ISSUE 9): the web HUD polls voice /health
+    # only, so the brain's compile-sentinel verdict (post-fence recompiles
+    # = the alertable shape-churn event), its last step-ledger entry, and
+    # the live HBM gauges ride along — refreshed in the BACKGROUND at most
+    # every VOICE_BRAIN_HEALTH_S seconds (fetch budgeted to 1 s), so a slow
+    # or overloaded brain costs this handler staleness, never latency.
+    # Only the very first scrape awaits the fetch (nothing cached yet).
+    brain_fwd = {"t": 0.0, "body": None, "task": None, "fetched": False}
+    brain_fwd_s = float(os.environ.get("VOICE_BRAIN_HEALTH_S", "3.0"))
+
+    async def _refresh_brain_fwd() -> None:
+        try:
+            async with httpx.AsyncClient(timeout=1.0) as http:
+                r = await http.get(cfg.brain_url + "/health")
+                h = r.json()
+            brain_fwd["body"] = {
+                k: h[k] for k in ("compile_sentinel", "last_step", "hbm")
+                if h.get(k) is not None
+            } or None
+        except Exception:
+            brain_fwd["body"] = None
+        finally:
+            brain_fwd["fetched"] = True
+            brain_fwd["task"] = None
+
+    async def _brain_engine_health() -> dict | None:
+        now = time.monotonic()
+        if now - brain_fwd["t"] >= brain_fwd_s and brain_fwd["task"] is None:
+            brain_fwd["t"] = now
+            brain_fwd["task"] = asyncio.create_task(_refresh_brain_fwd())
+            if not brain_fwd["fetched"]:
+                await brain_fwd["task"]
+        return brain_fwd["body"]
+
     async def health(_req: web.Request) -> web.Response:
         breakers = {"brain": brain_breaker.state, "executor": exec_breaker.state}
         status = "ok" if all(s == "closed" for s in breakers.values()) else "degraded"
-        # degraded still serves (that is the point) — 200 either way
-        return web.json_response({
+        body = {
             "ok": status == "ok", "status": status, "service": "voice",
             "breakers": breakers,
             "slo": slo.state(),
             "sessions": live_sessions["n"],
             "capacity_sessions": capacity_sessions,
-        })
+        }
+        fwd = await _brain_engine_health()
+        if fwd is not None:
+            body["brain"] = fwd
+        # degraded still serves (that is the point) — 200 either way
+        return web.json_response(body)
 
     async def send(ws: web.WebSocketResponse, type_: str, **payload) -> None:
         if not ws.closed:

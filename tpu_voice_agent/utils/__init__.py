@@ -11,6 +11,8 @@ from .tracing import (
     prometheus_exposition,
 )
 from .slo import SLOTracker
+from .steplog import StepLog, get_steplog
+from .compilewatch import CompileWatcher, get_compile_watcher, watch_compiles
 from .resilience import (
     DEADLINE_HEADER,
     AdmissionController,
@@ -38,6 +40,11 @@ __all__ = [
     "new_trace_id",
     "prometheus_exposition",
     "SLOTracker",
+    "StepLog",
+    "get_steplog",
+    "CompileWatcher",
+    "get_compile_watcher",
+    "watch_compiles",
     "DEADLINE_HEADER",
     "AdmissionController",
     "BreakerOpenError",

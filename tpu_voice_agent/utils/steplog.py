@@ -1,0 +1,192 @@
+"""Per-step engine telemetry: the step ledger.
+
+The PR 2/PR 6 observability plane stops at the service boundary — once a
+request enters ``ContinuousBatcher.step()`` the engine is a black box. The
+step ledger opens it: every scheduler chunk records one bounded ring entry
+with the step's wall-time decomposition —
+
+    admit     queue/admission bookkeeping (prefill compute carved out)
+    prefill   summed engine prefill-compute dispatch ms for this step's
+              admissions (engine._last_prefill_compute_ms per admission)
+    draft     host drafter share of the chunk (spec engines report
+              ``_last_draft_ms`` on the readback; carved out of decode)
+    decode    the decode_chunk dispatch wall — for spec engines this is the
+              whole host-driven draft/verify loop (per-step readbacks
+              included), minus the carved drafter share
+    readback  the scheduler's one combined device_get (host sync — on the
+              plain async-dispatch path this is where device compute time
+              surfaces to the host)
+    release   post-readback commit: result assembly, release_slot /
+              radix-insert, gauge exports, HBM ledger tick
+
+— plus batch occupancy, accepted-token and forward counts, and any compile
+events the recompilation sentinel (utils/compilewatch.py) caught during
+the step ("compile stall": the step that paid a trace shows it).
+
+The five stage segments TILE the step wall by construction (each ``lap``
+closes at the next one's start), so ``sum(stages) ≈ wall`` — the ledger
+accounts for where every millisecond of a chunk went, which is the signal
+chunked streaming prefill / autoscaling / KV-quantization gating will be
+driven by.
+
+Surfaces: ``engine.step.*`` histograms/gauges in the metrics registry,
+``GET /debug/steplog`` on the brain, a ``steplog`` section folded into
+flight-recorder freezes, and the ``tools/stepview.py`` timeline.
+
+``STEPLOG_ENABLE=0`` turns recording off (ring stays empty, no metrics);
+the decode path is host-timing only either way, so tokens are identical
+with the ledger on or off (tests/test_steplog.py holds this
+differentially). ``STEPLOG_STEPS`` sizes the ring (default 256).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+# the tiling stage order (stepview renders bars in this order)
+STAGES = ("admit", "prefill", "draft", "decode", "readback", "release")
+
+
+class StepLog:
+    """Bounded ring of per-step records (FlightRecorder discipline: always
+    on, cheap to feed, immutable dumps on read)."""
+
+    def __init__(self, max_steps: int | None = None,
+                 enabled: bool | None = None):
+        self.max_steps = max_steps if max_steps is not None \
+            else int(os.environ.get("STEPLOG_STEPS", "256"))
+        self.enabled = enabled if enabled is not None \
+            else os.environ.get("STEPLOG_ENABLE", "1") != "0"
+        self._lock = threading.Lock()
+        self._steps: list[dict] = []
+        self._seq = 0
+
+    # ------------------------------------------------------------ feeding
+
+    def timer(self) -> "StepTimer":
+        return StepTimer(self)
+
+    def record(self, rec: dict) -> None:
+        """Append one step record and export its metrics. No-op when
+        disabled — the scheduler's timing calls still happen (perf_counter
+        noise), but nothing is stored or exported."""
+        if not self.enabled:
+            return
+        from . import get_metrics
+
+        with self._lock:
+            rec["seq"] = self._seq
+            self._seq += 1
+            self._steps.append(rec)
+            if len(self._steps) > self.max_steps:
+                del self._steps[: len(self._steps) - self.max_steps]
+        m = get_metrics()
+        m.observe_ms("engine.step.wall", rec["wall_ms"])
+        for stage, ms in rec["stages"].items():
+            m.observe_ms(f"engine.step.{stage}", ms)
+        m.set_gauge("engine.step.occupancy", float(rec.get("occupancy", 0)))
+        m.set_gauge("engine.step.tokens", float(rec.get("tokens", 0)))
+        if rec.get("events"):
+            m.inc("engine.step.compile_stalls", float(len(rec["events"])))
+
+    # ------------------------------------------------------------ reading
+
+    def last(self) -> dict | None:
+        with self._lock:
+            return dict(self._steps[-1]) if self._steps else None
+
+    def steps(self, last: int | None = None) -> list[dict]:
+        with self._lock:
+            out = [dict(s) for s in self._steps]
+        return out[-last:] if last else out
+
+    def dump(self) -> dict:
+        """The /debug/steplog body; also folded into flight-recorder
+        freezes so an overload autopsy carries the device-plane timeline."""
+        with self._lock:
+            return {"enabled": self.enabled, "max_steps": self.max_steps,
+                    "recorded": self._seq, "steps": [dict(s) for s in self._steps]}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._steps.clear()
+            self._seq = 0
+
+
+class StepTimer:
+    """Measures one scheduler step as contiguous wall segments.
+
+    ``lap(stage)`` closes the segment since the previous lap (or
+    construction) into ``stage`` — segments tile the wall, which is what
+    makes the ≥95%-accounted property hold by construction. ``carve``
+    moves measured sub-time out of one stage into another (prefill compute
+    is measured inside the admission segment but reported as its own
+    stage). ``finish`` drains the compile sentinel's pending events and
+    records."""
+
+    def __init__(self, log: StepLog):
+        self._log = log
+        self.t0 = time.perf_counter()
+        self._t_last = self.t0
+        self.stages: dict[str, float] = {}
+
+    def lap(self, stage: str) -> None:
+        now = time.perf_counter()
+        self.stages[stage] = self.stages.get(stage, 0.0) + (now - self._t_last) * 1e3
+        self._t_last = now
+
+    def carve(self, from_stage: str, sub_stage: str, ms: float) -> None:
+        if ms <= 0:
+            return
+        have = self.stages.get(from_stage, 0.0)
+        ms = min(ms, have)  # sub-time was measured inside from_stage
+        self.stages[from_stage] = have - ms
+        self.stages[sub_stage] = self.stages.get(sub_stage, 0.0) + ms
+
+    def finish(self, **meta) -> dict:
+        from .compilewatch import get_compile_watcher
+
+        # the wall closes at the LAST lap: everything after it is this
+        # recorder's own overhead (pending-drain, dict assembly), which
+        # must not show up as unaccounted step time — with it excluded the
+        # stages tile the wall by construction
+        end = self._t_last if self.stages else time.perf_counter()
+        wall_ms = (end - self.t0) * 1e3
+        rec = {
+            "t_s": round(time.time(), 3),
+            "wall_ms": round(wall_ms, 3),
+            "stages": {k: round(v, 3) for k, v in self.stages.items()},
+            "events": get_compile_watcher().take_pending(),
+        }
+        rec.update({k: v for k, v in meta.items() if v is not None})
+        self._log.record(rec)
+        return rec
+
+
+_GLOBAL_STEPLOG = StepLog()
+
+
+def get_steplog() -> StepLog:
+    return _GLOBAL_STEPLOG
+
+
+def make_steplog_handler(service: str):
+    """aiohttp ``GET /debug/steplog``: the step ring as JSON.
+    ``?last=K`` trims to the most recent K steps."""
+    from aiohttp import web
+
+    async def steplog_ep(req) -> web.Response:
+        log = get_steplog()
+        body = log.dump()
+        try:
+            last = int(req.query.get("last", "0"))
+        except ValueError:
+            last = 0
+        if last > 0:
+            body["steps"] = body["steps"][-last:]
+        body["service"] = service
+        return web.json_response(body)
+
+    return steplog_ep

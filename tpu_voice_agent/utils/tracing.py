@@ -357,6 +357,14 @@ class FlightRecorder:
         """Freeze the current rings under ``reason``. Idempotent while
         frozen (first incident wins); returns True when this call froze."""
         self.snapshot_metrics()  # the knee itself belongs in the timeline
+        # the engine's step ledger rides every freeze: an overload autopsy
+        # needs the device-plane timeline (stage decomposition, compile
+        # stalls) next to the utterance waterfalls. Captured OUTSIDE the
+        # ring lock (the steplog has its own), before the frozen check so
+        # the dump reflects the incident moment even on a near-miss race.
+        from .steplog import get_steplog
+
+        steplog = get_steplog().dump()
         with self._lock:
             if self._frozen is not None:
                 return False
@@ -368,6 +376,7 @@ class FlightRecorder:
                 "traces": [{"trace_id": tid, "spans": list(spans)}
                            for tid, spans in self._traces.items()],
                 "metric_snapshots": list(self._snapshots),
+                "steplog": steplog,
                 "config": {"max_traces": self.max_traces,
                            "max_snapshots": self.max_snapshots,
                            "snapshot_interval_s": self.snapshot_interval_s},

@@ -32,6 +32,8 @@ const confirmBar = $("confirm-bar");
 const hudEl = $("hud"), hudTotal = $("hud-total"), hudBar = $("hud-bar"),
   hudSplit = $("hud-split");
 const capacityEl = $("capacity"), capacityText = $("capacity-text");
+const engineEl = $("engine"), engineStep = $("engine-step"),
+  recompileBadge = $("recompile-badge");
 const SLO_BUDGET_MS = 800;  // BASELINE voice->intent p50 target
 const HEALTH_POLL_MS = 5000;
 
@@ -123,7 +125,47 @@ async function pollHealth() {
     capacityText.textContent = text;
     capacityText.className = `hud-split${over ? " over" : ""}`;
     capacityEl.hidden = false;
+    showEngine(h.brain);
   } catch { /* a dead poll must not spam the console */ }
+}
+
+/* ------------------------------------------------------------ engine HUD */
+
+/* the brain's device-plane microscope, forwarded through voice /health:
+ * last step ledger entry (where the most recent scheduler chunk's wall
+ * went), a red "recompile N ms" badge when the compile sentinel caught a
+ * trace after the warmup fence (the silent-p99-cliff event, now named),
+ * and the HBM plan-drift alarm. */
+function showEngine(brain) {
+  if (!brain) { engineEl.hidden = true; return; }
+  const parts = [];
+  const step = brain.last_step;
+  if (step && step.stages) {
+    const split = Object.entries(step.stages)
+      .filter(([, ms]) => ms >= 0.05)
+      .map(([k, ms]) => `${k} ${ms.toFixed(1)}`)
+      .join(" · ");
+    parts.push(`step ${step.wall_ms.toFixed(1)} ms (${split})`);
+    if (step.occupancy != null) parts.push(`${step.occupancy} slots`);
+  }
+  const hbm = brain.hbm;
+  if (hbm && hbm["hbm.plan_drift"] != null) {
+    const d = hbm["hbm.plan_drift"];
+    const txt = `hbm drift ${(100 * d).toFixed(1)}%`;
+    parts.push(Math.abs(d) > 0.15 ? `<span class="drift">${txt}</span>` : txt);
+  }
+  engineStep.innerHTML = parts.join(" · ");
+  const cs = brain.compile_sentinel;
+  if (cs && cs.post_fence_compiles > 0) {
+    const ms = cs.last && cs.last.post_fence ? cs.last.ms : 0;
+    recompileBadge.textContent =
+      `recompile ${ms ? ms.toFixed(0) + " ms" : "×" + cs.post_fence_compiles}`;
+    recompileBadge.title = cs.warning || "";
+    recompileBadge.hidden = false;
+  } else {
+    recompileBadge.hidden = true;
+  }
+  engineEl.hidden = parts.length === 0 && recompileBadge.hidden;
 }
 setInterval(pollHealth, HEALTH_POLL_MS);
 pollHealth();
