@@ -1,0 +1,265 @@
+"""Replica fault-domain drill: the session-affine router under kill/drain.
+
+ISSUE 10's acceptance gates, measured against the real replicated stack
+(N rule-brain replicas behind tpu_voice_agent/services/router.py, voice
+pointed at the router, fake-page executor, ScriptedSTT audio path — the
+same CPU harness every service-level bench uses):
+
+1. **Clean capacity** — tools/swarm.py binary search for max concurrent
+   sessions at client-side SLO ok, replicas all healthy.
+2. **Replica-kill failover** — a fixed-N swarm run at 70% of clean
+   capacity with the deterministic ``replica_kill`` chaos point armed: the
+   k-th /parse latches one replica dead (abrupt connection closes, probes
+   included, like a crashed process). GATE: the run's SLO verdict must
+   stay ``ok`` — capacity-at-SLO during failover >= 0.7x clean. Failed
+   in-flight parses retry once on the new home; re-homed sessions cost a
+   cold re-prefill, never an error.
+3. **Graceful drain** — a fixed-N typed-only swarm (no deliberate aborts:
+   this gate is about the DRAIN, so the mix must not inject its own
+   errors) while ``POST /admin/drain`` retires one replica mid-load.
+   GATE: zero errored utterances across the whole run — a rolling restart
+   drops nothing.
+4. **Re-home identity** — a session parsed on its home replica, the home
+   killed, the next turn routed through the router vs the SAME turn
+   cold-started directly on the new home: byte-identical ParseResponse.
+   Warmth is a latency property, never a correctness one. GATE: exact
+   equality.
+
+SLO thresholds are widened for the CPU harness exactly like bench_chaos
+(the verdict is behavior under faults at IDENTICAL thresholds, not the
+absolute number).
+
+Knobs: BENCH_ROUTER_REPLICAS (3), BENCH_ROUTER_MAX_N (24),
+BENCH_ROUTER_UTTERANCES (3), BENCH_ROUTER_KILL_AT (the k-th parse that
+fires replica_kill; default scales with N), BENCH_ROUTER_SLO_P50_MS
+(8000).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log, snapshot_observability  # noqa: E402
+
+sys.path.insert(0, str(Path(_ROOT) / "tools"))
+import swarm  # noqa: E402
+
+TYPED_MIX = {"single_shot": 3, "multi_turn": 3, "compound": 2, "barge_in": 1}
+
+
+def _post(url: str, body: dict, timeout_s: float = 20.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read().decode())
+
+
+def _counters(voice_url: str) -> dict:
+    try:
+        with urllib.request.urlopen(voice_url.rstrip("/") + "/metrics",
+                                    timeout=5) as r:
+            return json.loads(r.read().decode())["runtime"]["counters"]
+    except Exception:
+        return {}
+
+
+def _stack(tmp_prefix: str, replicas: int, chaos_spec: str = "",
+           chaos_seed: int = 7):
+    tmp = tempfile.mkdtemp(prefix=tmp_prefix)
+    return swarm.build_local_stack(
+        tmp, brain_inflight=8, exec_inflight=8, brain_replicas=replicas,
+        chaos_spec=chaos_spec, chaos_seed=chaos_seed,
+        router_kw={"probe_s": 0.25, "probe_fails": 2})
+
+
+def _teardown(servers) -> None:
+    for srv in servers:
+        try:
+            srv.__exit__(None, None, None)
+        except Exception:
+            pass
+
+
+def main() -> None:
+    replicas = int(os.environ.get("BENCH_ROUTER_REPLICAS", "3"))
+    max_n = int(os.environ.get("BENCH_ROUTER_MAX_N", "24"))
+    utterances = int(os.environ.get("BENCH_ROUTER_UTTERANCES", "3"))
+    os.environ.setdefault("SLO_TARGET_P50_MS",
+                          os.environ.get("BENCH_ROUTER_SLO_P50_MS", "8000"))
+    os.environ.setdefault("SLO_TARGET_P99_MS", "30000")
+    failures: list[str] = []
+
+    # ---------------------------------------------------- 1. clean capacity
+    urls, servers = _stack("bench_router_clean_", replicas)
+    try:
+        log(f"[clean] binary-searching capacity up to {max_n} sessions "
+            f"({replicas} replicas behind the router)")
+        clean = swarm.binary_search_capacity(
+            urls["voice"], max_n=max_n, sample_urls=[urls["voice"]],
+            utterances=utterances, think_s=0.05)
+    finally:
+        _teardown(servers)
+    c_clean = clean["capacity_sessions"]
+    log(f"[clean] capacity {c_clean} sessions at SLO")
+
+    # ------------------------------------------- 2. replica-kill failover
+    n_failover = max(1, int(0.7 * c_clean))
+    # fire the kill deep enough into the run that the ring is warm but
+    # early enough that most of the load rides the failover, scaled so the
+    # drill never degenerates to "killed after the run finished"
+    kill_at = int(os.environ.get(
+        "BENCH_ROUTER_KILL_AT", str(max(3, n_failover * utterances // 4))))
+    urls, servers = _stack("bench_router_kill_", replicas,
+                           chaos_spec=f"replica_kill@{kill_at}")
+    try:
+        log(f"[failover] {n_failover} sessions (0.7x clean) with "
+            f"replica_kill@{kill_at} armed")
+        failover = swarm.run_swarm(
+            urls["voice"], n_failover, utterances=utterances, think_s=0.05,
+            sample_urls=[urls["voice"]])
+        kill_counters = _counters(urls["voice"])
+    finally:
+        _teardown(servers)
+    failover_ok = failover["slo"]["state"] == "ok"
+    injected = kill_counters.get("chaos.injected", 0.0)
+    rehomed = kill_counters.get("router.sessions_rehomed", 0.0)
+    retries = kill_counters.get("router.retries", 0.0)
+    log(f"[failover] slo={failover['slo']['state']} "
+        f"p50={failover['slo']['p50_ms']} err={failover['slo']['error_rate']} "
+        f"(injected={injected:.0f} rehomed={rehomed:.0f} retries={retries:.0f})")
+    if injected < 1:
+        failures.append("replica_kill never fired — the drill proved nothing")
+    if not failover_ok:
+        failures.append(
+            f"failover SLO {failover['slo']['state']} at 0.7x clean "
+            f"({n_failover} sessions) — capacity-at-SLO during failover "
+            "fell below the 0.7x bar")
+
+    # ------------------------------------------------------ 3. drain drill
+    n_drain = max(2, min(c_clean, 8))
+    urls, servers = _stack("bench_router_drain_", replicas)
+    try:
+        import threading
+        import time as _time
+
+        victim = urls["replicas"][0]
+
+        def drain_mid_load():
+            _time.sleep(0.6)
+            try:
+                _post(urls["router"] + "/admin/drain", {"replica": victim})
+            except Exception as e:  # pragma: no cover - diagnostics
+                log(f"[drain] admin/drain failed: {e}")
+
+        log(f"[drain] {n_drain} typed sessions while draining {victim}")
+        t = threading.Thread(target=drain_mid_load, daemon=True)
+        t.start()
+        drain_run = swarm.run_swarm(
+            urls["voice"], n_drain, utterances=utterances, think_s=0.1,
+            mix=TYPED_MIX, sample_urls=[urls["voice"]])
+        t.join(timeout=10)
+        drain_counters = _counters(urls["voice"])
+        with urllib.request.urlopen(urls["router"] + "/health",
+                                    timeout=5) as r:
+            router_health = json.loads(r.read().decode())
+    finally:
+        _teardown(servers)
+    drain_errors = sum(sc["errors"] for sc in drain_run["scenarios"].values())
+    drains = drain_counters.get("router.drains", 0.0)
+    log(f"[drain] errors={drain_errors} (bar: 0) drains={drains:.0f} "
+        f"replicas now {router_health['replicas']}")
+    if drains < 1:
+        failures.append("drain was never issued")
+    if drain_errors > 0:
+        failures.append(f"{drain_errors} utterances errored across the drain "
+                        "— the rolling restart dropped requests")
+
+    # ------------------------------------------------- 4. re-home identity
+    urls, servers = _stack("bench_router_ident_", 2)
+    identity_ok = False
+    try:
+        sid = "identity-session"
+        _post(urls["router"] + "/parse",
+              {"text": "search for usb hubs", "session_id": sid,
+               "context": {}})
+        st, hdrs, _ = _post(urls["router"] + "/parse",
+                            {"text": "scroll down", "session_id": sid,
+                             "context": {}})
+        home = hdrs["x-router-replica"]
+        other = next(u for u in urls["replicas"] if u != home)
+        # kill the home: the session's next turn must re-home and be
+        # token-identical to the same turn cold-started on the new home
+        for srv in [s for s in servers if getattr(s, "url", None) == home]:
+            srv.__exit__(None, None, None)
+            servers.remove(srv)  # never double-exited in the finally
+        import time as _time
+
+        _time.sleep(0.8)  # let the prober eject it
+        st, hdrs, via_router = _post(
+            urls["router"] + "/parse",
+            {"text": "sort by price", "session_id": sid, "context": {}})
+        st2, _, cold = _post(
+            other + "/parse",
+            {"text": "sort by price", "session_id": sid, "context": {}})
+        identity_ok = (st == 200 and st2 == 200 and via_router == cold
+                       and hdrs["x-router-replica"] == other)
+        log(f"[identity] re-homed turn identical to cold start on new "
+            f"home: {identity_ok}")
+        if not identity_ok:
+            failures.append("re-homed session's turn diverged from its "
+                            "cold-start parse on the new replica")
+    finally:
+        _teardown(servers)
+
+    # ------------------------------------------------------------- verdict
+    emit("router_clean_capacity_sessions", float(c_clean), "sessions")
+    emit("router_failover_slo_ok", 1.0 if failover_ok else 0.0, "bool")
+    if failover["slo"].get("p50_ms") is not None:
+        emit("router_failover_p50_ms", failover["slo"]["p50_ms"], "ms")
+    emit("router_failover_rehomed", rehomed, "sessions_rehomed")
+    emit("router_drain_errors", float(drain_errors), "errors")
+    emit("router_rehome_identity", 1.0 if identity_ok else 0.0, "fraction")
+
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art = art_dir / f"BENCH_router_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_router",
+        "ts": stamp,
+        "config": {"replicas": replicas, "max_n": max_n,
+                   "utterances": utterances, "kill_at": kill_at},
+        "router": {
+            "clean_capacity_sessions": c_clean,
+            "clean_probes": clean["probes"],
+            "failover_n": n_failover,
+            "failover_slo": failover["slo"],
+            "failover_ok": failover_ok,
+            "failover_injected": injected,
+            "failover_sessions_rehomed": rehomed,
+            "failover_retries": retries,
+            "drain_n": n_drain,
+            "drain_errors": drain_errors,
+            "drain_slo": drain_run["slo"],
+            "drain_replicas_after": router_health["replicas"],
+            "rehome_identity": identity_ok,
+            "failures": failures,
+        },
+    }, indent=1))
+    log(f"artifact: {art}")
+    if failures:
+        for f in failures:
+            log(f"FAIL: {f}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,7 @@ from pathlib import Path
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_quality.py", "bench_faults.py", "bench_spec.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
-           "bench_steplog.py"]
+           "bench_steplog.py", "bench_router.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -42,9 +42,12 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # the steplog bench stays on --quick too — it is the telemetry-overhead
 # regression gate (tiny engine, seconds on CPU), and a PR that makes the
 # step ledger cost >2% of a decode chunk must fail the quick table
+# the router bench stays on --quick as well — it is the replica-fault-
+# domain regression gate (rule-based replicas, no model, trimmed search),
+# and a PR that breaks failover/drain must fail the quick table too
 QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
-                 "bench_chaos.py", "bench_steplog.py"]
+                 "bench_chaos.py", "bench_steplog.py", "bench_router.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_SPEC_PAGED_SESSIONS": "2", "BENCH_SPEC_PAGED_TURNS": "2",
@@ -52,7 +55,9 @@ QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_SWARM_MAX_N": "8", "BENCH_SWARM_UTTERANCES": "3",
              "BENCH_SWARM_ENGINE_MAX_N": "4",
              "BENCH_CHAOS_MAX_N": "4", "BENCH_CHAOS_UTTERANCES": "2",
-             "BENCH_STEPLOG_SESSIONS": "6", "BENCH_STEPLOG_ROUNDS": "2"}
+             "BENCH_STEPLOG_SESSIONS": "6", "BENCH_STEPLOG_ROUNDS": "2",
+             "BENCH_ROUTER_MAX_N": "6", "BENCH_ROUTER_UTTERANCES": "2",
+             "BENCH_ROUTER_REPLICAS": "2"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -126,7 +131,8 @@ def main() -> None:
                 entry["artifact"] = art.name
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
                             "spec", "stt", "radix", "swarm", "chaos",
-                            "steplog", "engine_step", "xla", "hbm"):
+                            "steplog", "engine_step", "xla", "hbm",
+                            "router"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -118,6 +118,29 @@ def test_breaker_failed_probe_reopens():
     assert br.allow()
 
 
+def test_breaker_state_gated_failure_retrips_after_reset_window():
+    """Callers that gate on ``state`` instead of ``allow()`` (the router's
+    passive per-replica breakers) never drive open->half_open themselves:
+    a failure recorded after the reset window has elapsed IS a failed
+    half-open probe and must re-open the breaker — not fall into the
+    closed-path failure counting that can never trip from 'open'."""
+    clk = FakeClock()
+    br = CircuitBreaker("dep", failure_threshold=3, reset_after_s=1.0, clock=clk)
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == "open"
+    clk.advance(1.5)
+    assert br.state == "half_open"  # state-gated callers admit traffic again
+    br.record_failure()             # ...and the trial traffic failed
+    assert br.state == "open"       # re-tripped, _opened_at refreshed
+    clk.advance(0.6)
+    assert br.state == "open"       # window restarts from the re-trip
+    clk.advance(0.6)
+    assert br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed"
+
+
 def test_breaker_abandoned_probe_does_not_wedge_half_open():
     """A half-open probe whose caller vanished (cancelled WS, torn-down
     client) never records success OR failure; after another reset window a
@@ -214,6 +237,46 @@ def test_post_retries_503_and_returns_final_503():
         http, "http://x/parse", json_body={}, deadline=Deadline.after(30),
         policy=RetryPolicy(max_attempts=2, jitter=0.0), sleep=_no_sleep))
     assert r.status_code == 503 and len(http.calls) == 2  # caller owns policy
+
+
+def test_post_honors_retry_after_as_backoff_floor():
+    """A server-sent Retry-After on 503 floors the backoff: the kit must
+    wait at least what the server asked for, not its own (shorter)
+    jittered schedule."""
+    sleeps: list[float] = []
+
+    async def record_sleep(s):
+        sleeps.append(s)
+
+    http = FakeHTTP([FakeResponse(503, {"Retry-After": "2"}),
+                     FakeResponse(200)])
+    r = asyncio.run(post_with_resilience(
+        http, "http://x/parse", json_body={}, deadline=Deadline.after(30),
+        policy=RetryPolicy(max_attempts=2, base_delay_s=0.01, jitter=0.0),
+        sleep=record_sleep))
+    assert r.status_code == 200 and len(http.calls) == 2
+    assert sleeps == [pytest.approx(2.0)]
+
+
+def test_post_retry_after_capped_by_remaining_deadline():
+    """A Retry-After LONGER than the remaining budget must not forfeit the
+    retry (the old behavior: wait > remaining -> give up without ever
+    re-asking). The wait is capped at half the remaining deadline so the
+    attempt itself still fits."""
+    sleeps: list[float] = []
+
+    async def record_sleep(s):
+        sleeps.append(s)
+
+    http = FakeHTTP([FakeResponse(503, {"Retry-After": "60"}),
+                     FakeResponse(200)])
+    r = asyncio.run(post_with_resilience(
+        http, "http://x/parse", json_body={}, deadline=Deadline.after(2.0),
+        policy=RetryPolicy(max_attempts=2, base_delay_s=0.01, jitter=0.0),
+        sleep=record_sleep))
+    # the retry HAPPENED (old code returned the 503 without a second call)
+    assert r.status_code == 200 and len(http.calls) == 2
+    assert len(sleeps) == 1 and sleeps[0] <= 1.0  # capped at remaining/2
 
 
 def test_post_fails_fast_on_open_breaker():

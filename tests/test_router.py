@@ -1,0 +1,588 @@
+"""Replicated brain tier (ISSUE 10): session-affine router over N replicas.
+
+Fast-tier coverage for tpu_voice_agent/services/router.py against
+lightweight in-process replica apps (plus the real brain/voice services
+where the contract crosses them):
+
+- rendezvous session affinity + spread across the ring
+- health-probed ejection and in-budget failover retry (re-home accounting)
+- graceful drain: new sessions never placed on a draining replica,
+  in-flight completes, existing sessions re-home after the eject —
+  zero dropped requests
+- full outage -> 503 + Retry-After (the shed the voice service maps to
+  the RuleBasedParser degraded mode)
+- hedged parses: second attempt for slow idempotent parses, first wins
+- the race hammer: concurrent submits vs. a racing kill + drain — no
+  request lost, none double-SERVED outside a failover retry, none of the
+  post-drain new sessions routed to the draining replica
+- voice /health forwarding of the router's aggregated replicas shape
+- the satellite-6 bugfix e2e: a replica ejected while a session's
+  speculative parse is in flight must not poison the final — the final
+  re-routes to the new home and the stale spec result is discarded,
+  through the real WS path
+"""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+from aiohttp import web
+
+from tests.http_helper import AppServer
+from tpu_voice_agent.services.brain import RuleBasedParser
+from tpu_voice_agent.services.brain import build_app as build_brain
+from tpu_voice_agent.services.router import BrainRouter, _weight
+from tpu_voice_agent.services.router import build_app as build_router
+from tpu_voice_agent.utils import get_metrics
+
+
+def _counters() -> dict:
+    return get_metrics().snapshot()["counters"]
+
+
+def _post(url: str, body: dict, timeout: float = 20.0):
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read().decode())
+
+
+def _get(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fake_replica(name: str, log: list, *, session_aware: bool = False,
+                  delay_s: float = 0.0, controls: dict | None = None):
+    """Minimal brain-contract stand-in: /parse answers the rule parser's
+    plan and logs (name, session_id, speculative, nonce); ``controls``
+    flips it dead (abrupt transport close on EVERY request, probes
+    included — a crashed process) or slow at runtime."""
+    rule = RuleBasedParser()
+    controls = controls if controls is not None else {}
+
+    def _drop(request: web.Request):
+        if request.transport is not None:
+            request.transport.close()
+        raise asyncio.CancelledError("fake replica killed")
+
+    async def parse(req: web.Request) -> web.Response:
+        if controls.get("dead"):
+            _drop(req)
+        if controls.get("shed"):
+            return web.json_response({"error": "overloaded"}, status=503,
+                                     headers={"Retry-After": "1"})
+        body = await req.json()
+        # log BEFORE the delay so a test can observe an in-flight request
+        # and kill the replica while it is still being "decoded"
+        log.append((name, body.get("session_id"),
+                    bool(body.get("speculative")),
+                    (body.get("context") or {}).get("nonce")))
+        d = controls.get("delay_s", delay_s)
+        if d:
+            await asyncio.sleep(d)
+        if controls.get("dead"):
+            _drop(req)  # killed mid-decode: the response never escapes
+        resp = rule.parse(body["text"], body.get("context") or {})
+        headers = {}
+        if session_aware and body.get("speculative"):
+            headers["x-speculation-pending"] = "1"
+        return web.json_response(json.loads(resp.model_dump_json()),
+                                 headers=headers)
+
+    async def health(req: web.Request) -> web.Response:
+        if controls.get("dead"):
+            _drop(req)
+        body = {"ok": True, "service": "brain"}
+        if controls.get("draining"):
+            body["draining"] = True
+            body["drained"] = True
+        return web.json_response(body)
+
+    async def admin_drain(req: web.Request) -> web.Response:
+        # the real brain's serve-layer latch: sticky until the "restart"
+        # (a test popping controls["draining"])
+        controls["draining"] = True
+        return web.json_response({"ok": True, "draining": True,
+                                  "drained": True})
+
+    app = web.Application()
+    app.router.add_post("/parse", parse)
+    app.router.add_get("/health", health)
+    app.router.add_post("/admin/drain", admin_drain)
+    return app
+
+
+def _ring(n: int, *, session_aware: bool = False, delays=None, **router_kw):
+    """n fake replicas + a router; returns (router_server, replica_servers,
+    logs, controls, router_obj)."""
+    logs = [[] for _ in range(n)]
+    controls = [{} for _ in range(n)]
+    servers = [AppServer(_fake_replica(f"r{i}", logs[i],
+                                       session_aware=session_aware,
+                                       delay_s=(delays or [0] * n)[i],
+                                       controls=controls[i])).__enter__()
+               for i in range(n)]
+    router_kw.setdefault("probe_s", 0.15)
+    router_kw.setdefault("probe_fails", 2)
+    router_obj = BrainRouter([s.url for s in servers], **router_kw)
+    router = AppServer(build_router(router_obj)).__enter__()
+    return router, servers, logs, controls, router_obj
+
+
+def _teardown(router, servers):
+    router.__exit__(None, None, None)
+    for s in servers:
+        try:
+            s.__exit__(None, None, None)
+        except Exception:
+            pass
+
+
+def _sid_homed_on(router_obj: BrainRouter, idx: int, prefix: str) -> str:
+    """A session id whose rendezvous home is replica ``idx``."""
+    urls = [r.url for r in router_obj.replicas]
+    for i in range(10_000):
+        sid = f"{prefix}{i}"
+        if max(range(len(urls)),
+               key=lambda j: _weight(urls[j], sid)) == idx:
+            return sid
+    raise AssertionError("no session hashed onto the target replica")
+
+
+# ----------------------------------------------------------- affinity
+
+
+def test_session_affinity_and_spread():
+    router, servers, logs, _, robj = _ring(3)
+    try:
+        # one session always lands on one replica
+        for _ in range(4):
+            st, hdrs, _b = _post(router.url + "/parse",
+                                 {"text": "scroll down", "session_id": "aff",
+                                  "context": {}})
+            assert st == 200
+        served = {e[0] for log in logs for e in log if e[1] == "aff"}
+        assert len(served) == 1
+        # many sessions spread over the ring (rendezvous, not one hot spot)
+        for i in range(24):
+            _post(router.url + "/parse",
+                  {"text": "go back", "session_id": f"spread{i}",
+                   "context": {}})
+        used = {e[0] for log in logs for e in log}
+        assert len(used) == 3
+    finally:
+        _teardown(router, servers)
+
+
+# ----------------------------------------------------------- failover
+
+
+def test_failover_retries_in_flight_and_rehomes():
+    """The home dies mid-stream: the in-flight parse is retried once on
+    the session's next-highest-weight replica inside the original budget,
+    and the move counts router.sessions_rehomed."""
+    router, servers, logs, controls, robj = _ring(2)
+    try:
+        sid = _sid_homed_on(robj, 0, "fo")
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "scroll down", "session_id": sid,
+                              "context": {}})
+        assert hdrs["x-router-replica"] == robj.replicas[0].url
+        rehomed0 = _counters().get("router.sessions_rehomed", 0)
+        retries0 = _counters().get("router.retries", 0)
+        controls[0]["dead"] = True  # crash: every request drops abruptly
+        st, hdrs, body = _post(router.url + "/parse",
+                               {"text": "scroll down", "session_id": sid,
+                                "context": {}})
+        assert st == 200
+        assert hdrs["x-router-replica"] == robj.replicas[1].url
+        assert body["intents"][0]["type"] == "scroll"
+        c = _counters()
+        assert c.get("router.retries", 0) == retries0 + 1
+        assert c.get("router.sessions_rehomed", 0) == rehomed0 + 1
+        # and the session STAYS on its new home (sticky residence)
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "go back", "session_id": sid,
+                              "context": {}})
+        assert hdrs["x-router-replica"] == robj.replicas[1].url
+    finally:
+        _teardown(router, servers)
+
+
+def test_probe_ejects_dead_replica_and_recovery_rejoins():
+    router, servers, logs, controls, robj = _ring(2, probe_s=0.1)
+    try:
+        controls[0]["dead"] = True
+        deadline = time.monotonic() + 5
+        while robj.replicas[0].state != "down":
+            assert time.monotonic() < deadline, "prober never ejected"
+            time.sleep(0.05)
+        h = _get(router.url + "/health")
+        assert h["replicas"] == {"total": 2, "healthy": 1, "draining": 0}
+        assert h["status"] == "degraded"
+        # recovery: probes succeed again -> the replica rejoins the ring
+        controls[0]["dead"] = False
+        deadline = time.monotonic() + 5
+        while robj.replicas[0].state != "up":
+            assert time.monotonic() < deadline, "recovered replica never rejoined"
+            time.sleep(0.05)
+        assert _get(router.url + "/health")["status"] == "ok"
+    finally:
+        _teardown(router, servers)
+
+
+# -------------------------------------------------------------- drain
+
+
+def test_drain_is_zero_drop():
+    """Drain a replica while one of its sessions has a parse in flight:
+    the in-flight request completes (zero drop), new sessions avoid the
+    draining replica immediately, and once in-flight hits zero the
+    replica is ejected and its sessions re-home."""
+    router, servers, logs, controls, robj = _ring(2)
+    try:
+        sid = _sid_homed_on(robj, 0, "dr")
+        _post(router.url + "/parse", {"text": "go back", "session_id": sid,
+                                      "context": {}})
+        controls[0]["delay_s"] = 0.6  # the in-flight straggler
+        results = {}
+
+        def straggler():
+            results["straggler"] = _post(
+                router.url + "/parse",
+                {"text": "scroll down", "session_id": sid, "context": {}})
+
+        t = threading.Thread(target=straggler)
+        t.start()
+        time.sleep(0.2)  # request is in flight on replica 0
+        st, _h, ack = _post(router.url + "/admin/drain",
+                            {"replica": robj.replicas[0].url})
+        assert ack["state"] == "draining"  # in-flight pending: NOT ejected
+        # new sessions placed while draining must all avoid replica 0
+        for i in range(8):
+            st, hdrs, _b = _post(router.url + "/parse",
+                                 {"text": "go back",
+                                  "session_id": f"post-drain-{i}",
+                                  "context": {}})
+            assert hdrs["x-router-replica"] == robj.replicas[1].url
+        t.join(timeout=10)
+        st, hdrs, body = results["straggler"]
+        assert st == 200 and hdrs["x-router-replica"] == robj.replicas[0].url
+        # in-flight done -> ejected; the session re-homes on its next turn
+        deadline = time.monotonic() + 5
+        while robj.replicas[0].state != "drained":
+            assert time.monotonic() < deadline, "drain never completed"
+            time.sleep(0.05)
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "go back", "session_id": sid,
+                              "context": {}})
+        assert hdrs["x-router-replica"] == robj.replicas[1].url
+        assert _counters().get("router.drains", 0) >= 1
+    finally:
+        _teardown(router, servers)
+
+
+def test_drained_replica_rejoins_after_fast_restart():
+    """A rolling restart faster than probe_fails consecutive probe windows
+    never reads 'down' — the rejoin evidence is the serve-layer drain
+    latch (seen by probes while drained) disappearing from /health, which
+    only a fresh process does. Until it clears, the replica stays drained
+    (a latch-less replica must hold router-side drain forever)."""
+    router, servers, logs, controls, robj = _ring(2, probe_s=0.1)
+    try:
+        _post(router.url + "/admin/drain", {"replica": robj.replicas[0].url})
+        deadline = time.monotonic() + 5
+        while robj.replicas[0].state != "drained":
+            assert time.monotonic() < deadline, "drain never completed"
+            time.sleep(0.05)
+        # probes keep seeing the OLD process's latch: never rejoins
+        time.sleep(0.35)
+        assert robj.replicas[0].state == "drained"
+        assert robj.replicas[0].drain_latched
+        # the restart: a fresh process no longer reports the latch
+        controls[0].pop("draining", None)
+        deadline = time.monotonic() + 5
+        while robj.replicas[0].state != "up":
+            assert time.monotonic() < deadline, "drained replica never rejoined"
+            time.sleep(0.05)
+        assert _counters().get("router.replicas_recovered", 0) >= 1
+        # and new sessions flow there again by rendezvous weight
+        sid = _sid_homed_on(robj, 0, "rr")
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "go back", "session_id": sid,
+                              "context": {}})
+        assert hdrs["x-router-replica"] == robj.replicas[0].url
+    finally:
+        _teardown(router, servers)
+
+
+def test_full_outage_sheds_503_with_retry_after():
+    router, servers, logs, controls, robj = _ring(2, probe_s=0.1,
+                                                  probe_fails=1)
+    try:
+        controls[0]["dead"] = controls[1]["dead"] = True
+        deadline = time.monotonic() + 5
+        while any(r.state != "down" for r in robj.replicas):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(router.url + "/parse",
+                  {"text": "x", "session_id": "s", "context": {}})
+        assert exc.value.code == 503
+        assert exc.value.headers.get("Retry-After") is not None
+        body = json.loads(exc.value.read().decode())
+        assert body["error"] == "overloaded"  # the shed contract voice maps
+        with pytest.raises(urllib.error.HTTPError) as hexc:
+            _get(router.url + "/health")
+        assert hexc.value.code == 503
+    finally:
+        _teardown(router, servers)
+
+
+# ------------------------------------------------------------- hedging
+
+
+def test_hedged_parse_first_wins_and_counts():
+    """An idempotent (speculative) parse on a slow home is hedged to the
+    next-best replica after ROUTER_HEDGE_MS; the fast answer wins."""
+    router, servers, logs, controls, robj = _ring(2, hedge_ms=80)
+    try:
+        sid = _sid_homed_on(robj, 0, "he")
+        controls[0]["delay_s"] = 1.0
+        fired0 = _counters().get("router.hedges_fired", 0)
+        won0 = _counters().get("router.hedges_won", 0)
+        t0 = time.monotonic()
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "scroll down", "session_id": sid,
+                              "context": {}, "speculative": True})
+        dt = time.monotonic() - t0
+        assert st == 200
+        assert hdrs["x-router-replica"] == robj.replicas[1].url
+        assert dt < 0.9  # did not wait out the slow home
+        c = _counters()
+        assert c.get("router.hedges_fired", 0) == fired0 + 1
+        assert c.get("router.hedges_won", 0) == won0 + 1
+        # the hedge never re-homed the session: the next (non-hedged,
+        # session-committing) parse still goes to the slow home
+        controls[0]["delay_s"] = 0.0
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "go back", "session_id": sid,
+                              "context": {}})
+        assert hdrs["x-router-replica"] == robj.replicas[0].url
+    finally:
+        _teardown(router, servers)
+
+
+def test_hedge_error_answer_does_not_beat_running_primary():
+    """The hedge replica shedding an instant 503 must not win the race
+    over the slow-but-healthy home: first USABLE answer wins, and an
+    error answer is only returned once no attempt is still running."""
+    router, servers, logs, controls, robj = _ring(2, hedge_ms=50)
+    try:
+        sid = _sid_homed_on(robj, 0, "hshed")
+        controls[0]["delay_s"] = 0.4  # slow enough to fire the hedge
+        controls[1]["shed"] = True    # the alt sheds instantly
+        fired0 = _counters().get("router.hedges_fired", 0)
+        won0 = _counters().get("router.hedges_won", 0)
+        st, hdrs, body = _post(router.url + "/parse",
+                               {"text": "scroll down", "session_id": sid,
+                                "context": {}, "speculative": True})
+        assert st == 200
+        assert hdrs["x-router-replica"] == robj.replicas[0].url
+        assert body["intents"][0]["type"] == "scroll"
+        c = _counters()
+        assert c.get("router.hedges_fired", 0) == fired0 + 1
+        assert c.get("router.hedges_won", 0) == won0  # the 503 never won
+    finally:
+        _teardown(router, servers)
+
+
+# ---------------------------------------------------------- race hammer
+
+
+def test_router_races_submit_vs_eject_and_drain():
+    """Concurrent submits race a replica kill AND a drain: no request is
+    lost (every one answers 200), no request is double-SERVED outside a
+    failover retry (a nonce appears at most twice, and only when its
+    first serving replica was the killed/drained one), and no post-drain
+    NEW session ever lands on the draining replica."""
+    router, servers, logs, controls, robj = _ring(3, probe_s=0.1,
+                                                  parse_timeout_s=15.0)
+    try:
+        n_threads, per_thread = 6, 8
+        barrier = threading.Barrier(n_threads + 1)
+        errors: list = []
+        statuses: list = []
+        lock = threading.Lock()
+        drain_acked = threading.Event()
+
+        def worker(t: int):
+            try:
+                barrier.wait(timeout=30)
+                for i in range(per_thread):
+                    nonce = f"{t}-{i}"
+                    phase = "post" if drain_acked.is_set() else "pre"
+                    st, hdrs, _b = _post(
+                        router.url + "/parse",
+                        {"text": "scroll down",
+                         "session_id": f"{phase}-hammer-{nonce}",
+                         "context": {"nonce": nonce}}, timeout=30)
+                    with lock:
+                        statuses.append(st)
+            except Exception as e:  # pragma: no cover - failure reporting
+                with lock:
+                    errors.append(e)
+
+        def chaos_monkey():
+            barrier.wait(timeout=30)
+            time.sleep(0.15)
+            controls[0]["dead"] = True  # kill r0 mid-hammer
+            time.sleep(0.1)
+            _post(router.url + "/admin/drain",
+                  {"replica": robj.replicas[1].url})  # drain r1 mid-hammer
+            drain_acked.set()
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        monkey = threading.Thread(target=chaos_monkey)
+        for th in threads + [monkey]:
+            th.start()
+        for th in threads + [monkey]:
+            th.join(timeout=60)
+            assert not th.is_alive(), "hammer worker hung"
+        assert not errors, f"hammer worker raised: {errors[0]!r}"
+        # no request lost: every submit answered 200 (failover is a retry,
+        # never an error, while at least one replica is up)
+        assert len(statuses) == n_threads * per_thread
+        assert all(st == 200 for st in statuses)
+        # double-send audit: a nonce served twice must have been a
+        # failover retry off the killed/drained replica, never a
+        # same-replica repeat or a healthy-replica duplicate
+        by_nonce: dict = {}
+        for ri, log in enumerate(logs):
+            for name, sid, spec, nonce in log:
+                by_nonce.setdefault(nonce, []).append(ri)
+        suspect = {robj.replicas[0].url, robj.replicas[1].url}
+        for nonce, where in by_nonce.items():
+            assert len(where) <= 2, f"nonce {nonce} sent {len(where)} times"
+            if len(where) == 2:
+                assert robj.replicas[where[0]].url in suspect, \
+                    f"nonce {nonce} duplicated off a healthy replica"
+                assert where[0] != where[1], \
+                    f"nonce {nonce} re-sent to the same replica"
+        # drain containment: NEW sessions placed after the drain ack never
+        # landed on the draining replica
+        post_drain_on_r1 = [e for e in logs[1]
+                            if (e[1] or "").startswith("post-hammer-")]
+        assert not post_drain_on_r1, post_drain_on_r1
+    finally:
+        _teardown(router, servers)
+
+
+# ------------------------------------------- voice /health forwarding
+
+
+def test_voice_health_forwards_router_replicas(tmp_path):
+    from tpu_voice_agent.serve.stt import NullSTT
+    from tpu_voice_agent.services.voice import VoiceConfig
+    from tpu_voice_agent.services.voice import build_app as build_voice
+
+    router, servers, logs, controls, robj = _ring(2, probe_s=0.1)
+    voice = AppServer(build_voice(VoiceConfig(
+        brain_url=router.url, executor_url="http://127.0.0.1:1",
+        stt_factory=lambda: NullSTT()))).__enter__()
+    try:
+        h = _get(voice.url + "/health")
+        assert h["brain"]["replicas"] == {"total": 2, "healthy": 2,
+                                          "draining": 0}
+    finally:
+        voice.__exit__(None, None, None)
+        _teardown(router, servers)
+
+
+# ------------------------------------- satellite 6: spec-in-flight kill
+
+
+def test_replica_killed_during_speculative_parse_does_not_poison_final(tmp_path):
+    """E2e through the real WS path: the session's home replica dies while
+    its SPECULATIVE parse is in flight. The stale spec result must be
+    discarded (never replayed on the new home), the final must re-route
+    and deliver the correct intent — token-identical to a cold parse —
+    with no error event and the session alive."""
+    import aiohttp
+
+    from tpu_voice_agent.serve.stt import NullSTT
+    from tpu_voice_agent.services.voice import VoiceConfig
+    from tpu_voice_agent.services.voice import build_app as build_voice
+
+    router, servers, logs, controls, robj = _ring(
+        2, session_aware=True, probe_s=0.1)
+    # the spec parse must still be IN FLIGHT when the kill lands
+    for c in controls:
+        c["delay_s"] = 0.5
+    scripted = [("spec_final", "search for usb hubs"),
+                ("final", "search for usb hubs")]
+    voice = AppServer(build_voice(VoiceConfig(
+        brain_url=router.url, executor_url="http://127.0.0.1:1",
+        stt_factory=lambda: NullSTT(scripted=list(scripted)),
+        parse_timeout_s=10.0))).__enter__()
+    pcm = b"\x00\x00" * 1600
+
+    async def run():
+        events = []
+        async with aiohttp.ClientSession() as sess:
+            async with sess.ws_connect(
+                    voice.url.replace("http", "ws") + "/stream") as ws:
+                await ws.send_bytes(pcm)  # -> spec_final -> speculate()
+                # wait for the speculative parse to REACH a replica, then
+                # kill exactly that one while the parse is in flight
+                deadline = time.monotonic() + 5
+                victim = None
+                while victim is None:
+                    assert time.monotonic() < deadline, "spec never fired"
+                    for i, log in enumerate(logs):
+                        if any(spec for (_n, _s, spec, _x) in log):
+                            victim = i
+                            break
+                    await asyncio.sleep(0.02)
+                controls[victim]["dead"] = True
+                survivor = 1 - victim
+                controls[survivor]["delay_s"] = 0.0
+                await ws.send_bytes(pcm)  # -> transcript_final
+                end = time.monotonic() + 15
+                while time.monotonic() < end:
+                    try:
+                        msg = await ws.receive(timeout=1.0)
+                    except asyncio.TimeoutError:
+                        continue
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        break
+                    ev = json.loads(msg.data)
+                    events.append(ev)
+                    if ev["type"] in ("intent", "error"):
+                        break
+        return events, victim, survivor
+
+    try:
+        events, victim, survivor = asyncio.run(run())
+        types = [e["type"] for e in events]
+        assert "error" not in types, events
+        intent_ev = next(e for e in events if e["type"] == "intent")
+        # token-identical to the cold parse of the same text (warmth is a
+        # latency property, never a correctness one)
+        cold = RuleBasedParser().parse("search for usb hubs", {})
+        assert intent_ev["data"] == json.loads(cold.model_dump_json())
+        # the final was served FRESH by the survivor (the stale spec result
+        # from the dead replica was discarded, not delivered)
+        finals = [e for e in logs[survivor] if not e[2]]
+        assert finals, f"survivor never served the final: {logs}"
+        # the degraded fallback was not needed: the parse itself re-routed
+        assert not intent_ev.get("degraded"), intent_ev
+    finally:
+        voice.__exit__(None, None, None)
+        _teardown(router, servers)

@@ -85,7 +85,10 @@ def test_steptimer_stages_tile_the_wall():
     assert rec["occupancy"] == 2 and rec["tokens"] == 5
     assert set(rec["stages"]) <= set(STAGES)
     # laps are contiguous segments of one perf_counter stream: they tile
-    assert sum(rec["stages"].values()) <= rec["wall_ms"] + 1e-6
+    # (each stage and the wall are rounded to 3 decimals independently, so
+    # allow half-ulp rounding slack per recorded stage)
+    slack = 5e-4 * (len(rec["stages"]) + 1)
+    assert sum(rec["stages"].values()) <= rec["wall_ms"] + slack
     assert sum(rec["stages"].values()) >= 0.95 * rec["wall_ms"]
 
 

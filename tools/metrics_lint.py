@@ -119,6 +119,17 @@ PINNED: dict[str, str] = {
     "hbm.plan_total_bytes": "gauge",
     "hbm.plan_drift": "gauge",
     "hbm.drift_events": "counter",
+    # replicated brain tier (ISSUE 10, services/router.py, docs/
+    # RESILIENCE.md "Replica fault domain"): sessions_rehomed is the
+    # observable failover cost (one cold re-prefill per forced move),
+    # replicas_healthy is the ring-occupancy gauge the HUD badge reads,
+    # hedges_fired/won are the tail-cut dials, drains counts rolling-
+    # restart drills — renaming any of these blinds bench_router's gates
+    "router.sessions_rehomed": "counter",
+    "router.replicas_healthy": "gauge",
+    "router.hedges_fired": "counter",
+    "router.hedges_won": "counter",
+    "router.drains": "counter",
 }
 
 

@@ -655,16 +655,27 @@ def binary_search_capacity(voice_url: str, *, max_n: int = 32,
 def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
                       exec_inflight: int = 8, frames_per_final: int = 4,
                       parser=None, chaos_spec: str | None = None,
-                      chaos_seed: int = 0, parse_timeout_s: float = 10.0):
+                      chaos_seed: int = 0, parse_timeout_s: float = 10.0,
+                      brain_replicas: int = 1, router_kw: dict | None = None):
     """voice + brain + executor on real sockets, wired for swarm runs:
     rule-based brain (or the given parser), fake-page executor, ScriptedSTT
     audio path. ``chaos_spec`` arms the in-process deterministic fault
     layer (tpu_voice_agent.utils.chaos — NaN logits, prefill exceptions,
-    alloc failures, stalled steps, dropped WS frames) so the SAME swarm
-    that measures clean capacity drills the fault-containment claims;
-    None leaves chaos at its env-derived default (off). Returns (urls
-    dict, servers list) — callers __exit__ the servers. Shared by
-    benches/bench_swarm.py, benches/bench_chaos.py and tests."""
+    alloc failures, stalled steps, dropped WS frames, replica kill/hang/
+    slow) so the SAME swarm that measures clean capacity drills the
+    fault-containment claims; None leaves chaos at its env-derived
+    default (off).
+
+    ``brain_replicas > 1`` boots N brain replicas behind the session-affine
+    router (tpu_voice_agent.services.router, ISSUE 10) and points voice at
+    the router — the replicated tier bench_router drills. ``parser`` may
+    then be a zero-arg FACTORY (each replica needs its own instance) or
+    None for per-replica rule parsers; ``router_kw`` passes through to
+    ``BrainRouter``. The urls dict gains ``router`` and ``replicas`` keys.
+
+    Returns (urls dict, servers list) — callers __exit__ the servers.
+    Shared by benches/bench_swarm.py, benches/bench_chaos.py,
+    benches/bench_router.py and tests."""
     import os
 
     from tests.http_helper import AppServer
@@ -680,20 +691,45 @@ def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
     if chaos_spec is not None:
         chaos_mod.configure(chaos_spec, seed=chaos_seed)
 
-    brain = AppServer(build_brain(parser or RuleBasedParser(),
-                                  max_inflight=brain_inflight)).__enter__()
+    servers: list = []
+    urls: dict = {}
+    if brain_replicas > 1:
+        from tpu_voice_agent.services.router import BrainRouter
+        from tpu_voice_agent.services.router import build_app as build_router
+
+        def make_parser():
+            if parser is None:
+                return RuleBasedParser()
+            return parser() if callable(parser) and not hasattr(parser, "parse") \
+                else parser
+
+        replicas = [AppServer(build_brain(make_parser(),
+                                          max_inflight=brain_inflight)).__enter__()
+                    for _ in range(brain_replicas)]
+        router = AppServer(build_router(BrainRouter(
+            [b.url for b in replicas], **(router_kw or {})))).__enter__()
+        brain_url = router.url
+        urls["router"] = router.url
+        urls["replicas"] = [b.url for b in replicas]
+        servers += [router] + replicas
+    else:
+        brain = AppServer(build_brain(parser or RuleBasedParser(),
+                                      max_inflight=brain_inflight)).__enter__()
+        brain_url = brain.url
+        servers.append(brain)
+    urls["brain"] = brain_url
     manager = SessionManager(page_factory=FakePage.demo,
                              artifacts_root=os.path.join(tmp_dir, "art"),
                              uploads_dir=os.path.join(tmp_dir, "up"))
     executor = AppServer(build_executor(manager,
                                         max_inflight=exec_inflight)).__enter__()
     voice = AppServer(build_voice(VoiceConfig(
-        brain_url=brain.url, executor_url=executor.url,
+        brain_url=brain_url, executor_url=executor.url,
         stt_factory=lambda: ScriptedSTT(frames_per_final=frames_per_final),
         parse_timeout_s=parse_timeout_s, retry_attempts=2,
     ))).__enter__()
-    urls = {"voice": voice.url, "brain": brain.url, "executor": executor.url}
-    return urls, [voice, executor, brain]
+    urls.update(voice=voice.url, executor=executor.url)
+    return urls, [voice, executor] + servers
 
 
 # --------------------------------------------------------------- CLI

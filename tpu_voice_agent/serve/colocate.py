@@ -79,6 +79,12 @@ class ColocatedServing:
         # batcher.step(), cleared when it returns; the watchdog compares
         # against ENGINE_STALL_S to detect a wedged dispatch
         self._step_t0: float | None = None
+        # graceful-drain latch (ISSUE 10): the routing tier stops placing
+        # NEW sessions here; this runtime keeps serving whatever still
+        # arrives — drain is zero-drop by contract, so stragglers racing
+        # the router's eject decision complete normally — and ``drained()``
+        # flips once both lanes are empty
+        self._draining = False
 
     # ------------------------------------------------------------ submit
 
@@ -245,6 +251,32 @@ class ColocatedServing:
             # registration share one lock, so no still-wanted rid lacks one)
             for rid in [r for r in self.batcher.results if r not in self._parse_futs]:
                 self.batcher.results.pop(rid)
+
+    def begin_drain(self) -> None:
+        """Arm the graceful-drain latch (rolling-restart protocol, ISSUE
+        10). Deliberately does NOT refuse new submissions: a request that
+        races the router's stop-admitting decision must be served, not
+        dropped — the zero-drop drain contract. The brain's /health
+        surfaces ``draining``/``drained`` so the router knows when the
+        replica is safe to eject."""
+        with self._lock:
+            self._draining = True
+        from ..utils import get_metrics
+
+        get_metrics().inc("colocate.drains_started")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drained(self) -> bool:
+        """True once the drain latch is set AND both lanes are empty (no
+        queued STT work, no parse future unresolved, no slot decoding)."""
+        if not self._draining:
+            return False
+        with self._lock:
+            return (not self._stt_q and not self._parse_futs
+                    and not self._has_decode_work())
 
     def drain(self, timeout_s: float = 120.0) -> None:
         """Block until all queued work (both lanes) has completed.

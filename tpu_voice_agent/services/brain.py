@@ -327,6 +327,15 @@ class BatchedEngineParser:
     def healthy(self) -> bool:
         return self.runtime.healthy()
 
+    # graceful drain (ISSUE 10): the serve-layer latch — the router stops
+    # placing NEW sessions on this replica, in-flight work completes, and
+    # /health's ``drained`` flip tells the router it is safe to eject
+    def begin_drain(self) -> None:
+        self.runtime.begin_drain()
+
+    def drained(self) -> bool:
+        return self.runtime.drained()
+
     def quarantine_info(self) -> list[dict]:
         """Active poison-quarantine entries (surfaced in /health): prompts
         whose repeated poison offenses got them refused at submit."""
@@ -793,10 +802,49 @@ class RuleBasedParser:
 # ---------------------------------------------------------------- app
 
 
+def _chaos_replica_middleware():
+    """Replica-level chaos points (ISSUE 10, drilled by bench_router):
+    ``replica_kill`` latches this app dead — every later request on it
+    (/parse AND the router's /health probes) gets an abrupt connection
+    close, like a crashed process; ``replica_hang`` wedges one request for
+    ``CHAOS_HANG_S``; ``replica_slow`` adds ``CHAOS_SLOW_S`` of latency.
+    Points only DRAW on POST /parse so health probes never consume the
+    deterministic ``@kth`` event counting. Chaos off (the default) is one
+    dict-miss per request."""
+    from ..utils.chaos import chaos_fire
+
+    dead = {"dead": False}
+
+    def _drop(request: web.Request):
+        # no HTTP response at all: close the TCP transport and unwind via
+        # CancelledError (which aiohttp treats as a torn-down client, not
+        # a handler error) — the caller sees a connection reset, exactly
+        # what a killed process produces mid-request
+        if request.transport is not None:
+            request.transport.close()
+        raise asyncio.CancelledError("chaos: replica killed")
+
+    @web.middleware
+    async def chaos_mw(request: web.Request, handler):
+        if dead["dead"]:
+            _drop(request)
+        if request.method == "POST" and request.path == "/parse":
+            if chaos_fire("replica_kill"):
+                dead["dead"] = True
+                _drop(request)
+            if chaos_fire("replica_hang"):
+                await asyncio.sleep(float(os.environ.get("CHAOS_HANG_S", "60")))
+            elif chaos_fire("replica_slow"):
+                await asyncio.sleep(float(os.environ.get("CHAOS_SLOW_S", "0.25")))
+        return await handler(request)
+
+    return chaos_mw
+
+
 def build_app(parser: IntentParser, tracer: Tracer | None = None,
               max_inflight: int | None = None) -> web.Application:
     tracer = tracer or Tracer("brain", emit=False)
-    app = web.Application()
+    app = web.Application(middlewares=[_chaos_replica_middleware()])
     # a client that disconnects must CANCEL its handler (aiohttp >= 3.9
     # made this opt-in): the CancelledError hook below is what aborts the
     # request's in-flight decode at the next chunk boundary — without
@@ -849,11 +897,41 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
             return locked_parse(preq.text, preq.context, preq.session_id)
         return locked_parse(preq.text, preq.context)
 
+    # graceful drain (ISSUE 10): POST /admin/drain latches this replica
+    # draining; the router (services/router.py) sees the flag in /health,
+    # stops placing NEW sessions here, and ejects once in-flight work is
+    # done — a rolling restart with zero dropped requests. ``drained`` is
+    # COMPUTED, not latched: the serve-layer hook (ColocatedServing) knows
+    # when both lanes are empty; parsers without one fall back to the
+    # admission inflight count.
+    drain_state = {"draining": False}
+
+    def _drained() -> bool:
+        if not drain_state["draining"]:
+            return False
+        probe = getattr(parser, "drained", None)
+        if probe is not None:
+            return bool(probe())
+        return admission.inflight == 0
+
+    async def admin_drain(_req: web.Request) -> web.Response:
+        if not drain_state["draining"]:
+            drain_state["draining"] = True
+            get_metrics().inc("brain.drains_received")
+            hook = getattr(parser, "begin_drain", None)
+            if hook is not None:
+                hook()
+        return web.json_response({"ok": True, "draining": True,
+                                  "drained": _drained()})
+
     async def health(_req: web.Request) -> web.Response:
         """ok / degraded (saturated but serving) / unhealthy (dead worker)."""
         body = {"ok": True, "service": "brain",
                 "inflight": admission.inflight,
                 "max_inflight": admission.max_inflight}
+        if drain_state["draining"]:
+            body["draining"] = True
+            body["drained"] = _drained()
         status = "ok"
         if admission.saturated:
             status = "degraded"  # shedding load, but alive
@@ -1038,6 +1116,7 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
 
     app.router.add_get("/debug/steplog", make_steplog_handler("brain"))
     app.router.add_post("/parse", parse)
+    app.router.add_post("/admin/drain", admin_drain)
     return app
 
 

@@ -1,0 +1,776 @@
+"""Replicated brain tier: a session-affine router over N brain replicas.
+
+Everything before this PR was one brain process — a single point of failure
+holding every piece of warm state (radix chains, session transcripts, spec
+drafter seeds). This service is the *replica* fault domain: an HTTP tier
+that exposes the existing brain contract (``POST /parse``, ``GET /health``,
+``GET /metrics``, ``/debug/*`` fan-out, ``POST /admin/drain``) in front of
+``BRAIN_REPLICAS=url,url,...``, so the voice service just points
+``BRAIN_URL`` at it and a replica crash, hang, or rolling restart costs a
+cold re-prefill — never a session, never the SLO. The same "keep the stream
+alive while a stage restarts" discipline WhisperFlow applies to real-time
+speech serving, applied to the LLM side of the pipeline (PAPERS.md).
+
+Design:
+
+- **Session affinity by rendezvous hashing.** ``session_id`` → replica via
+  highest-random-weight over the *admitting* set, so each replica's radix
+  tree / transcript LRU stays hot for its own sessions. Placement is
+  rendezvous; residence is sticky: a placed session stays on its home while
+  that home remains servable (warmth built after a failover is not thrown
+  away when the old home recovers — re-homing costs a cold re-prefill, so
+  it is paid only when forced). When a home dies, the session deterministically
+  re-homes to its next-highest-weight replica; every forced move counts
+  ``router.sessions_rehomed`` (the observable cost = one cold re-prefill).
+
+- **Health = active probe + passive breaker.** A prober polls each
+  replica's ``/health`` every ``ROUTER_PROBE_S``; ``ROUTER_PROBE_FAILS``
+  consecutive failures (or a 503 body) ejects the replica from the ring.
+  Passively, every transport failure feeds a per-replica PR 1
+  ``CircuitBreaker`` — a replica that hangs on /parse while answering
+  probes trips it and leaves the ring anyway. Both recover automatically.
+
+- **Failover inside the budget.** A parse whose home fails mid-flight is
+  retried ONCE on the session's new home, inside the original
+  ``x-deadline-ms`` budget (the first attempt is capped at half the
+  remaining budget whenever a retry is still possible, so the retry always
+  fits; a mid-flight probe ejection cancels the attempt early rather than
+  waiting out the cap). Speculative parses are NEVER replayed on the new
+  home — the final re-routes and parses fresh; a replayed speculation could
+  interleave with that re-routed final on the new replica (the voice
+  service's spec machinery already treats the resulting 503 as a miss).
+
+- **Graceful drain.** ``POST /admin/drain {"replica": url}`` forwards the
+  drain to the replica (whose serve layer latches ``ColocatedServing.
+  begin_drain``) and stops placing NEW sessions there; existing sessions
+  keep hitting it until the router-side in-flight count reaches zero, then
+  the replica is ejected (``drained`` state) and its sessions re-home — a
+  rolling restart with zero dropped requests. A drained replica that then
+  goes down and comes back (the restart) rejoins as ``up``; a restart too
+  fast for the probe to see it go down is detected by the serve-layer
+  drain latch disappearing from /health (only a fresh process drops it);
+  ``POST /admin/admit`` forces a rejoin.
+
+- **Hedged parses.** ``ROUTER_HEDGE_MS > 0`` fires a second attempt at the
+  next-best replica for idempotent parses (speculative or session-less)
+  still unanswered after the hedge delay; first usable answer wins, the
+  loser's HTTP request is cancelled — which cancels the replica's handler
+  and, through the PR 7 chain, evicts its decode slot at the next chunk
+  boundary. Session-committing parses are never hedged (two replicas must
+  not both record the turn).
+
+- **Full outage.** Every replica out of the ring → ``503 + Retry-After``,
+  which the voice service already maps to the RuleBasedParser degraded
+  mode: quality degrades, sessions survive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import time
+from collections import OrderedDict
+
+from aiohttp import web
+
+from ..utils import SLOTracker, Tracer, get_metrics, load_env_cascade, new_trace_id
+from ..utils.resilience import (
+    DEADLINE_HEADER,
+    CircuitBreaker,
+    Deadline,
+    shed_response,
+)
+
+# response headers forwarded back to the caller verbatim (the brain's
+# decode-split contract the voice service folds into latency_budget, plus
+# the two-phase speculation marker and the shed backoff hint)
+_PASS_HEADERS = ("x-trace-id", "x-prefill-ms", "x-decode-ms",
+                 "x-cached-tokens", "x-speculation-pending", "retry-after")
+
+
+class ReplicaFailed(RuntimeError):
+    """One forward attempt failed at the transport level (connect error,
+    reset, attempt timeout, or mid-flight ejection) — retryable on the
+    session's next home; NOT raised for HTTP answers (those are the
+    replica's own semantics and pass through)."""
+
+
+class Replica:
+    """One brain replica's routing state. ``state`` is the administrative
+    machine (up | draining | drained | down); the breaker overlays
+    transport health on top of it without changing it."""
+
+    __slots__ = ("idx", "url", "state", "breaker", "probe_fails",
+                 "inflight", "last_health", "drain_latched")
+
+    def __init__(self, idx: int, url: str, breaker_threshold: int,
+                 breaker_reset_s: float):
+        self.idx = idx
+        self.url = url.rstrip("/")
+        self.state = "up"
+        # passive failure counting through the PR 1 breaker: a replica that
+        # hangs on /parse while answering /health probes still leaves the
+        # ring after breaker_threshold consecutive transport failures, and
+        # the half-open window re-discovers it without operator action
+        self.breaker = CircuitBreaker(
+            f"replica{idx}", failure_threshold=breaker_threshold,
+            reset_after_s=breaker_reset_s)
+        self.probe_fails = 0
+        self.inflight = 0
+        self.last_health: dict | None = None
+        # set when a probe has SEEN the replica's serve-layer drain latch
+        # in /health while draining/drained; its later disappearance is the
+        # evidence of a completed restart (fresh process, latch gone)
+        self.drain_latched = False
+
+    def admitting(self) -> bool:
+        """May receive NEW sessions (and anonymous parses)."""
+        return self.state == "up" and self.breaker.state != "open"
+
+    def servable(self) -> bool:
+        """May keep serving its EXISTING sessions (draining replicas
+        finish their own sessions' turns until ejected)."""
+        return self.state in ("up", "draining") and self.breaker.state != "open"
+
+    def describe(self) -> dict:
+        return {"url": self.url, "state": self.state,
+                "breaker": self.breaker.state, "inflight": self.inflight,
+                "probe_fails": self.probe_fails}
+
+
+def _weight(url: str, session_id: str) -> int:
+    """Rendezvous (highest-random-weight) score: deterministic per
+    (replica, session) pair, so removing a replica re-homes ONLY its own
+    sessions — each to its next-highest-weight choice."""
+    digest = hashlib.blake2b(f"{url}|{session_id}".encode(),
+                             digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+class BrainRouter:
+    """Routing state + forwarding logic; ``build_app`` wires it to HTTP.
+
+    Every mutation of routing state happens between awaits on the event
+    loop (route selection + session-table update + inflight accounting are
+    single, await-free critical sections), so the racy surface the hammer
+    test drives — concurrent submits vs. a probing eject vs. a drain — is
+    serialized by the loop itself, no locks needed.
+    """
+
+    def __init__(self, replica_urls: list[str], *,
+                 probe_s: float | None = None,
+                 probe_timeout_s: float | None = None,
+                 probe_fails: int | None = None,
+                 hedge_ms: float | None = None,
+                 parse_timeout_s: float | None = None,
+                 max_sessions: int | None = None,
+                 breaker_threshold: int | None = None,
+                 breaker_reset_s: float | None = None):
+        if not replica_urls:
+            raise ValueError("BRAIN_REPLICAS must name at least one replica")
+        env = os.environ.get
+        self.probe_s = probe_s if probe_s is not None else \
+            float(env("ROUTER_PROBE_S", "0.5"))
+        self.probe_timeout_s = probe_timeout_s if probe_timeout_s is not None \
+            else float(env("ROUTER_PROBE_TIMEOUT_S", "2.0"))
+        self.probe_fails_limit = probe_fails if probe_fails is not None else \
+            int(env("ROUTER_PROBE_FAILS", "2"))
+        self.hedge_ms = hedge_ms if hedge_ms is not None else \
+            float(env("ROUTER_HEDGE_MS", "0"))
+        self.parse_timeout_s = parse_timeout_s if parse_timeout_s is not None \
+            else float(env("ROUTER_PARSE_TIMEOUT_S", "60"))
+        self.max_sessions = max_sessions if max_sessions is not None else \
+            int(env("ROUTER_SESSIONS", "4096"))
+        bt = breaker_threshold if breaker_threshold is not None else \
+            int(env("ROUTER_BREAKER_THRESHOLD", "3"))
+        br = breaker_reset_s if breaker_reset_s is not None else \
+            float(env("ROUTER_BREAKER_RESET_S", "2.0"))
+        self.replicas = [Replica(i, u, bt, br)
+                         for i, u in enumerate(replica_urls)]
+        self._by_url = {r.url: r for r in self.replicas}
+        # session -> home-replica url, LRU-capped; stickiness (drain, no
+        # flap-back on recovery) and the re-home accounting both live here
+        self._sessions: "OrderedDict[str, str]" = OrderedDict()
+        self._http = None  # httpx.AsyncClient, created on the app's loop
+        self._probe_task: asyncio.Task | None = None
+        # the contract counters/gauges exist from construction (the breaker
+        # gauge discipline: scrape-visible at zero, never an absent series)
+        m = get_metrics()
+        m.inc("router.sessions_rehomed", 0.0)
+        m.inc("router.hedges_fired", 0.0)
+        m.inc("router.hedges_won", 0.0)
+        m.inc("router.drains", 0.0)
+        m.inc("router.retries", 0.0)
+        m.inc("router.spec_discarded", 0.0)
+        m.set_gauge("router.replicas_total", len(self.replicas))
+        self._update_health_gauge()
+
+    # ------------------------------------------------------------ routing
+
+    def _update_health_gauge(self) -> None:
+        get_metrics().set_gauge("router.replicas_healthy",
+                                sum(1 for r in self.replicas if r.servable()))
+
+    def _pick(self, session_id: str | None, exclude=()) -> Replica | None:
+        """Pure placement (no session-table update): rendezvous over the
+        admitting set for keyed sessions, least-inflight for anonymous
+        parses. The hedging path uses this so a hedge never re-homes."""
+        cands = [r for r in self.replicas
+                 if r.admitting() and r.url not in exclude]
+        if not cands:
+            return None
+        if session_id:
+            return max(cands, key=lambda r: _weight(r.url, session_id))
+        return min(cands, key=lambda r: r.inflight)
+
+    def route(self, session_id: str | None, exclude=()) -> Replica | None:
+        """The authoritative per-request decision: sticky home while it is
+        servable, else rendezvous placement over the admitting set (which
+        IS the deterministic next-highest-weight re-home when the old home
+        left the ring). Counts every forced move."""
+        if session_id:
+            prev_url = self._sessions.get(session_id)
+            if prev_url is not None and prev_url not in exclude:
+                prev = self._by_url.get(prev_url)
+                if prev is not None and prev.servable():
+                    self._sessions.move_to_end(session_id)
+                    return prev
+        home = self._pick(session_id, exclude)
+        if home is None:
+            return None
+        if session_id:
+            prev_url = self._sessions.get(session_id)
+            if prev_url is not None and prev_url != home.url:
+                get_metrics().inc("router.sessions_rehomed")
+            self._sessions[session_id] = home.url
+            self._sessions.move_to_end(session_id)
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+        return home
+
+    # ------------------------------------------------------------- drain
+
+    def start_drain(self, replica: Replica) -> bool:
+        """Stop placing new sessions on ``replica``; existing sessions keep
+        hitting it until in-flight reaches zero, then it is ejected."""
+        if replica.state != "up":
+            return False
+        replica.state = "draining"
+        replica.drain_latched = False  # fresh drain cycle
+        get_metrics().inc("router.drains")
+        self._update_health_gauge()
+        self._maybe_finish_drain(replica)
+        return True
+
+    def _maybe_finish_drain(self, replica: Replica) -> None:
+        if replica.state == "draining" and replica.inflight == 0:
+            replica.state = "drained"
+            get_metrics().inc("router.drains_completed")
+            self._update_health_gauge()
+
+    def admit(self, replica: Replica) -> None:
+        replica.state = "up"
+        replica.probe_fails = 0
+        replica.drain_latched = False
+        self._update_health_gauge()
+
+    # ------------------------------------------------------------ probing
+
+    async def probe_once(self) -> None:
+        """One active-probe sweep: every replica's /health, concurrently."""
+        await asyncio.gather(*(self._probe_replica(r) for r in self.replicas))
+        for r in self.replicas:
+            self._maybe_finish_drain(r)
+        self._update_health_gauge()
+
+    async def _probe_replica(self, r: Replica) -> None:
+        import httpx
+
+        try:
+            resp = await self._http.get(r.url + "/health",
+                                        timeout=self.probe_timeout_s)
+            body = resp.json()
+            ok = resp.status_code == 200 and bool(body.get("ok", True))
+        except (httpx.HTTPError, OSError, ValueError, asyncio.TimeoutError):
+            ok, body = False, None
+        if ok:
+            r.probe_fails = 0
+            r.last_health = body
+            if r.state == "down":
+                # recovered (or restarted after a drain): rejoin the ring.
+                # Its old sessions stay where they re-homed (stickiness);
+                # new sessions flow here again by rendezvous weight.
+                r.state = "up"
+                r.drain_latched = False
+                get_metrics().inc("router.replicas_recovered")
+            elif r.state in ("draining", "drained") and body.get("draining"):
+                r.drain_latched = True
+            elif r.state == "drained" and r.drain_latched:
+                # the rolling restart was faster than probe_fails
+                # consecutive probe windows, so the replica never read
+                # "down" — but the serve-layer drain latch we saw while it
+                # was drained is gone now, and only a FRESH process drops
+                # it: rejoin directly from drained. (A replica that never
+                # showed the latch stays drained until /admin/admit — the
+                # router-side drain must hold for latch-less replicas.)
+                r.state = "up"
+                r.drain_latched = False
+                get_metrics().inc("router.replicas_recovered")
+            elif r.state == "up" and body.get("draining"):
+                # drain issued directly at the replica: honor it here too
+                self.start_drain(r)
+        else:
+            r.probe_fails += 1
+            if r.probe_fails >= self.probe_fails_limit and r.state != "down":
+                r.state = "down"
+                get_metrics().inc("router.replicas_ejected")
+                import logging
+
+                logging.getLogger("tpu_voice_agent.router").warning(
+                    "replica %s ejected after %d failed probes",
+                    r.url, r.probe_fails)
+
+    async def _probe_loop(self) -> None:
+        while True:
+            try:
+                await self.probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # pragma: no cover - probe must never die
+                import logging
+
+                logging.getLogger("tpu_voice_agent.router").exception(
+                    "probe sweep failed")
+            await asyncio.sleep(self.probe_s)
+
+    # --------------------------------------------------------- forwarding
+
+    async def _forward(self, replica: Replica, raw: bytes, headers: dict,
+                       deadline: Deadline):
+        replica.inflight += 1
+        try:
+            return await self._http.post(
+                replica.url + "/parse", content=raw,
+                headers={**headers, "Content-Type": "application/json",
+                         DEADLINE_HEADER: deadline.header_value()},
+                timeout=max(0.05, deadline.remaining_s()))
+        finally:
+            replica.inflight -= 1
+            self._maybe_finish_drain(replica)
+
+    async def _guarded(self, replica: Replica, raw: bytes, headers: dict,
+                       deadline: Deadline, budget_s: float):
+        """One forward attempt bounded by ``budget_s`` wall clock and
+        cancelled EARLY when the prober/breaker ejects the replica
+        mid-flight (a dead replica's in-flight parses must not wait out
+        their budget before failing over). Records the attempt's outcome
+        on the replica's breaker."""
+        import httpx
+
+        task = asyncio.ensure_future(
+            self._forward(replica, raw, headers, deadline))
+        end = time.monotonic() + budget_s
+        try:
+            while True:
+                left = end - time.monotonic()
+                if left <= 0:
+                    task.cancel()
+                    replica.breaker.record_failure()
+                    raise ReplicaFailed(
+                        f"{replica.url}: attempt exceeded its budget")
+                done, _ = await asyncio.wait({task},
+                                             timeout=min(0.25, left))
+                if done:
+                    break
+                if not replica.servable():
+                    task.cancel()
+                    # the prober already ejected it; no extra breaker count
+                    raise ReplicaFailed(f"{replica.url}: ejected mid-flight")
+        except asyncio.CancelledError:
+            task.cancel()  # our caller was torn down: drop the forward too
+            raise
+        try:
+            resp = task.result()
+        except asyncio.CancelledError:
+            replica.breaker.record_failure()
+            raise ReplicaFailed(f"{replica.url}: forward cancelled")
+        except (httpx.HTTPError, OSError) as e:
+            replica.breaker.record_failure()
+            raise ReplicaFailed(f"{replica.url}: {type(e).__name__}: {e}")
+        # any HTTP answer is transport health; 5xx is dependency-health
+        # evidence (the PR 1 kit's discipline) EXCEPT 503, which is a
+        # healthy replica shedding load
+        if resp.status_code >= 500 and resp.status_code != 503:
+            replica.breaker.record_failure()
+        else:
+            replica.breaker.record_success()
+        return resp
+
+    async def _attempt(self, home: Replica, session_id: str | None,
+                       raw: bytes, headers: dict, deadline: Deadline,
+                       budget_s: float, idempotent: bool):
+        """Primary forward, optionally hedged: for idempotent parses still
+        unanswered after ``ROUTER_HEDGE_MS``, a second attempt fires at the
+        next-best replica; first usable answer wins and the loser is
+        cancelled (→ the replica's handler cancels → the PR 7 chain evicts
+        its decode slot). Returns (response, served_replica, hedged)."""
+        primary = asyncio.ensure_future(
+            self._guarded(home, raw, headers, deadline, budget_s))
+        try:
+            return await self._attempt_inner(primary, home, session_id, raw,
+                                             headers, deadline, idempotent)
+        except asyncio.CancelledError:
+            # our caller (the router handler) was torn down — the voice
+            # client vanished. Cancelling the _guarded task cancels its
+            # forward, which cancels the replica's handler, which evicts
+            # the decode slot at the next chunk boundary (the PR 7 chain,
+            # now crossing one more hop).
+            primary.cancel()
+            raise
+
+    async def _attempt_inner(self, primary, home: Replica,
+                             session_id: str | None, raw: bytes,
+                             headers: dict, deadline: Deadline,
+                             idempotent: bool):
+        if not (self.hedge_ms > 0 and idempotent):
+            return await primary, home, False
+        done, _ = await asyncio.wait({primary},
+                                     timeout=self.hedge_ms / 1e3)
+        if done:
+            return primary.result(), home, False  # may raise ReplicaFailed
+        alt = self._pick(session_id, exclude={home.url})
+        if alt is None:
+            return await primary, home, False
+        get_metrics().inc("router.hedges_fired")
+        secondary = asyncio.ensure_future(
+            self._guarded(alt, raw, headers, deadline,
+                          max(0.05, deadline.remaining_s())))
+        tasks = {primary: home, secondary: alt}
+        pending = set(tasks)
+        winner = None
+        fallback = None
+        last_exc: Exception | None = None
+        try:
+            while pending and winner is None:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    try:
+                        resp = t.result()
+                    except ReplicaFailed as e:
+                        last_exc = e
+                        continue
+                    if resp.status_code >= 500 and pending:
+                        # "first USABLE answer wins": a shed 503 (or 5xx)
+                        # from one replica must not beat an attempt that is
+                        # still running and may yet succeed — hold it as
+                        # the fallback and let the race continue
+                        if fallback is None:
+                            fallback = (resp, tasks[t], True)
+                        continue
+                    winner = (resp, tasks[t], True)
+                    break
+        finally:
+            for t in pending:
+                t.cancel()  # the losing attempt: cancelled, not abandoned
+        if winner is None:
+            winner = fallback
+        if winner is None:
+            raise last_exc or ReplicaFailed("all hedged attempts failed")
+        if winner[1] is alt:
+            get_metrics().inc("router.hedges_won")
+        return winner
+
+    async def forward_parse(self, raw: bytes, body: dict,
+                            headers: dict) -> tuple:
+        """The full /parse policy: route → (hedged) attempt → on transport
+        failure, retry ONCE on the session's new home inside the original
+        deadline (speculative parses are discarded instead — satellite 6).
+        Returns (httpx response | None, served replica | None, error str)."""
+        session_id = body.get("session_id") or None
+        speculative = bool(body.get("speculative"))
+        deadline = (Deadline.from_headers(headers)
+                    or Deadline.after(self.parse_timeout_s))
+        idempotent = speculative or not session_id
+        home = self.route(session_id)
+        if home is None:
+            return None, None, "no_replicas"
+        # a retry can only follow a non-speculative attempt with somewhere
+        # else to go; cap the first attempt at half the remaining budget in
+        # that case so the retry is guaranteed to fit (mid-flight ejection
+        # usually fails over much faster than this cap)
+        can_retry = (not speculative
+                     and any(r.admitting() and r.url != home.url
+                             for r in self.replicas))
+        remaining = deadline.remaining_s()
+        budget = remaining * 0.5 if can_retry else remaining
+        try:
+            resp, served, _hedged = await self._attempt(
+                home, session_id, raw, headers, deadline,
+                max(0.05, budget), idempotent)
+            return resp, served, None
+        except ReplicaFailed as e:
+            if speculative:
+                # satellite-6 bugfix: a speculative parse whose replica
+                # died is DISCARDED, never replayed — the final re-routes
+                # to the new home and parses fresh; replaying the spec
+                # here could interleave with that re-routed final
+                get_metrics().inc("router.spec_discarded")
+                return None, None, "spec_discarded"
+            if deadline.expired:
+                return None, None, f"deadline_expired: {e}"
+            home2 = self.route(session_id, exclude={home.url})
+            if home2 is None:
+                return None, None, "no_replicas"
+            get_metrics().inc("router.retries")
+            try:
+                resp, served, _h = await self._attempt(
+                    home2, session_id, raw, headers, deadline,
+                    max(0.05, deadline.remaining_s()), idempotent=False)
+                return resp, served, None
+            except ReplicaFailed as e2:
+                return None, None, f"retry_failed: {e2}"
+
+    # ------------------------------------------------------------- fanout
+
+    async def fan_out_get(self, path: str, query: str = "") -> dict:
+        """GET ``path`` on every replica; per-replica bodies keyed by url
+        (unreachable replicas report an ``error`` entry instead)."""
+        import httpx
+
+        async def one(r: Replica):
+            try:
+                resp = await self._http.get(
+                    r.url + path + (f"?{query}" if query else ""),
+                    timeout=self.probe_timeout_s)
+                return r.url, resp.json()
+            except (httpx.HTTPError, OSError, ValueError) as e:
+                return r.url, {"error": f"{type(e).__name__}: {e}"}
+
+        out = await asyncio.gather(*(one(r) for r in self.replicas))
+        return dict(out)
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        import httpx
+
+        if self._http is None:
+            self._http = httpx.AsyncClient()
+        if self._probe_task is None:
+            await self.probe_once()  # first routing decision sees real state
+            self._probe_task = asyncio.ensure_future(self._probe_loop())
+
+    async def stop(self) -> None:
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._probe_task = None
+        if self._http is not None:
+            await self._http.aclose()
+            self._http = None
+
+
+# ------------------------------------------------------------------- app
+
+
+def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Application:
+    tracer = tracer or Tracer("router", emit=False)
+    app = web.Application(client_max_size=8 * 1024 * 1024)
+    # a vanished caller must cancel the in-flight forward (aiohttp >= 3.9
+    # opt-in): the cancellation crosses the router hop into the replica's
+    # handler and from there evicts the decode slot (the PR 7 chain)
+    from . import HANDLER_CANCELLATION
+
+    app[HANDLER_CANCELLATION] = True
+    slo = SLOTracker("router")
+
+    async def on_startup(_app):
+        await router.start()
+
+    async def on_cleanup(_app):
+        await router.stop()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+
+    async def parse(req: web.Request) -> web.Response:
+        t0 = time.perf_counter()
+        resp = await _parse_inner(req)
+        slo.record((time.perf_counter() - t0) * 1e3, ok=resp.status < 500)
+        return resp
+
+    async def _parse_inner(req: web.Request) -> web.Response:
+        trace_id = req.headers.get("x-trace-id", new_trace_id())
+        headers = {"x-trace-id": trace_id}
+        raw = await req.read()
+        try:
+            body = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response(
+                {"error": "invalid_request", "detail": "body must be JSON"},
+                status=400, headers=headers)
+        fwd_headers = dict(headers)
+        if DEADLINE_HEADER in req.headers:
+            fwd_headers[DEADLINE_HEADER] = req.headers[DEADLINE_HEADER]
+        with tracer.span("route_parse", trace_id=trace_id) as sp:
+            resp, served, err = await router.forward_parse(
+                raw, body if isinstance(body, dict) else {}, fwd_headers)
+            if served is not None:
+                sp.attrs["replica"] = served.url
+            if err is not None:
+                sp.attrs["error"] = err
+        if resp is None:
+            if err == "spec_discarded":
+                # a speculative parse whose replica died: a SEMANTIC
+                # answer, not dependency-health evidence — 409 so the
+                # voice-side breaker/retry kit ignores it (the final is
+                # about to re-route and parse fresh; burning breaker
+                # budget on a lost optimization would open the circuit
+                # exactly when the failover needs it closed)
+                return web.json_response(
+                    {"error": "speculation_discarded",
+                     "detail": "home replica failed mid-speculation; "
+                               "parse at final"},
+                    status=409, headers=headers)
+            # full outage / failed failover: the one 503 + Retry-After
+            # shed contract — voice degrades to the rule parser and the
+            # session survives
+            return shed_response(
+                "router",
+                "no_replicas" if err == "no_replicas" else "replica_failed",
+                headers=headers,
+                retry_after_s=max(1.0, 2 * router.probe_s))
+        out_headers = {k: v for k, v in resp.headers.items()
+                       if k.lower() in _PASS_HEADERS}
+        out_headers["x-trace-id"] = trace_id
+        out_headers["x-router-replica"] = served.url
+        out_headers["Content-Type"] = resp.headers.get(
+            "Content-Type", "application/json")
+        return web.Response(body=resp.content, status=resp.status_code,
+                            headers=out_headers)
+
+    async def health(_req: web.Request) -> web.Response:
+        total = len(router.replicas)
+        healthy = sum(1 for r in router.replicas if r.servable())
+        draining = sum(1 for r in router.replicas if r.state == "draining")
+        status = ("ok" if healthy == total
+                  else "unhealthy" if healthy == 0 else "degraded")
+        body = {
+            "ok": healthy > 0, "service": "router", "status": status,
+            "replicas": {"total": total, "healthy": healthy,
+                         "draining": draining},
+            "replica_detail": [r.describe() for r in router.replicas],
+            "slo": slo.state(),
+        }
+        # the engine microscope rides along from a representative healthy
+        # replica's last probe body, so the voice /health forward (and the
+        # web HUD behind it) keeps its compile-sentinel / step-ledger / HBM
+        # view when BRAIN_URL points at the router instead of one brain
+        for r in router.replicas:
+            if r.servable() and r.last_health:
+                for k in ("compile_sentinel", "last_step", "hbm",
+                          "quarantine"):
+                    if r.last_health.get(k) is not None:
+                        body[k] = r.last_health[k]
+                body["home_replica"] = r.url
+                break
+        return web.json_response(body, status=200 if body["ok"] else 503)
+
+    async def admin_drain(req: web.Request) -> web.Response:
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            body = {}
+        target = body.get("replica")
+        r = router._by_url.get(str(target).rstrip("/")) if target else None
+        if r is None and isinstance(target, int) and \
+                0 <= target < len(router.replicas):
+            r = router.replicas[target]
+        if r is None:
+            return web.json_response(
+                {"error": "unknown_replica", "detail": str(target),
+                 "replicas": [x.url for x in router.replicas]}, status=404)
+        started = router.start_drain(r)
+        # forward the drain to the replica itself (best-effort): its serve
+        # layer flips ColocatedServing.begin_drain so /health can report
+        # drained once both lanes are empty
+        import httpx
+
+        try:
+            await router._http.post(r.url + "/admin/drain",
+                                    timeout=router.probe_timeout_s)
+        except (httpx.HTTPError, OSError):
+            pass
+        return web.json_response({"ok": True, "replica": r.url,
+                                  "state": r.state, "started": started})
+
+    async def admin_admit(req: web.Request) -> web.Response:
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            body = {}
+        r = router._by_url.get(str(body.get("replica", "")).rstrip("/"))
+        if r is None:
+            return web.json_response({"error": "unknown_replica"}, status=404)
+        router.admit(r)
+        return web.json_response({"ok": True, "replica": r.url,
+                                  "state": r.state})
+
+    def fan_out(path: str):
+        async def handler(req: web.Request) -> web.Response:
+            return web.json_response({
+                "service": "router",
+                "replicas": await router.fan_out_get(
+                    path.format(**req.match_info), req.query_string),
+            })
+
+        return handler
+
+    app.router.add_post("/parse", parse)
+    app.router.add_get("/health", health)
+    app.router.add_post("/admin/drain", admin_drain)
+    app.router.add_post("/admin/admit", admin_admit)
+    from ..utils.tracing import make_metrics_handler, make_trace_handler
+
+    app.router.add_get("/metrics", make_metrics_handler("router", tracer,
+                                                        slo=slo))
+    # the router's OWN trace ring (route_parse spans) lives at /debug/trace
+    # like every other service; the replica fan-outs live under
+    # /debug/replicas/* so traceview can merge either view
+    app.router.add_get("/debug/trace/{trace_id}",
+                       make_trace_handler("router", tracer))
+    app.router.add_get("/debug/replicas/trace/{trace_id}",
+                       fan_out("/debug/trace/{trace_id}"))
+    app.router.add_get("/debug/replicas/flightrecorder",
+                       fan_out("/debug/flightrecorder"))
+    app.router.add_get("/debug/replicas/steplog", fan_out("/debug/steplog"))
+    from ..utils.tracing import make_flightrecorder_handler
+
+    app.router.add_get("/debug/flightrecorder",
+                       make_flightrecorder_handler("router"))
+    return app
+
+
+def replicas_from_env() -> list[str]:
+    spec = os.environ.get("BRAIN_REPLICAS", "")
+    return [u.strip() for u in spec.split(",") if u.strip()]
+
+
+def main() -> None:
+    load_env_cascade()
+    urls = replicas_from_env()
+    if not urls:
+        raise SystemExit("BRAIN_REPLICAS=url,url,... is required")
+    port = int(os.environ.get("ROUTER_PORT", "8095"))
+    app = build_app(BrainRouter(urls), Tracer("router"))
+    web.run_app(app, port=port, handler_cancellation=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -264,8 +264,15 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             async with httpx.AsyncClient(timeout=1.0) as http:
                 r = await http.get(cfg.brain_url + "/health")
                 h = r.json()
+            # the router's aggregated shape (ISSUE 10) forwards alongside
+            # the single-brain microscope keys: ``replicas`` {total,
+            # healthy, draining} drives the HUD's red replica badge, and
+            # the engine/compile-sentinel block the router lifted from a
+            # healthy home replica keeps the engine line rendering when
+            # BRAIN_URL points at the tier instead of one process
             brain_fwd["body"] = {
-                k: h[k] for k in ("compile_sentinel", "last_step", "hbm")
+                k: h[k] for k in ("compile_sentinel", "last_step", "hbm",
+                                  "replicas", "home_replica")
                 if h.get(k) is not None
             } or None
         except Exception:

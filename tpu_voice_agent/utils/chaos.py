@@ -35,6 +35,20 @@ so typos never silently disable a drill):
     alloc_fail    BlockAllocator.alloc raises PoolExhausted
     stall_step    ContinuousBatcher.step sleeps CHAOS_STALL_S before dispatch
     drop_frame    voice WS handler drops the incoming binary audio frame
+
+Replica-level points (ISSUE 10 — drilled by ``benches/bench_router.py``
+against the session-affine router; the brain service's chaos middleware
+fires them on /parse, and a killed replica stays dead for EVERY later
+request on that app, /health probes included, like a crashed process):
+
+    replica_kill  the serving replica drops this connection without a
+                  response and latches dead — all later requests (parse,
+                  health probe) get the same abrupt close until restart
+    replica_hang  this request sleeps CHAOS_HANG_S (60) before answering —
+                  a wedged-but-listening replica (probe-invisible; the
+                  router's passive breaker/deadline path must catch it)
+    replica_slow  this request sleeps CHAOS_SLOW_S (0.25) first — the
+                  tail-latency shape hedged parses (ROUTER_HEDGE_MS) cut
 """
 
 from __future__ import annotations
@@ -44,7 +58,8 @@ import random
 import threading
 
 KNOWN_POINTS = ("nan_logits", "dead_fsm", "prefill_exc", "alloc_fail",
-                "stall_step", "drop_frame")
+                "stall_step", "drop_frame", "replica_kill", "replica_hang",
+                "replica_slow")
 
 
 class ChaosError(RuntimeError):

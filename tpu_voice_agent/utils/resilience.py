@@ -264,7 +264,19 @@ class CircuitBreaker:
     def record_failure(self) -> None:
         tripped = False
         with self._lock:
-            if self._state == "half_open":
+            # callers that gate on ``state`` instead of ``allow()`` (the
+            # router's passive per-replica breakers) never drive the
+            # open->half_open transition themselves: once the reset window
+            # has elapsed the breaker IS half-open regardless of which
+            # internal label is stored, and a failure during that trial
+            # window must re-open it (refreshing _opened_at) — otherwise a
+            # still-dead dependency would read half_open forever and never
+            # be rejected again
+            half_open = (self._state == "half_open"
+                         or (self._state == "open"
+                             and self._clock() - self._opened_at
+                             >= self.reset_after_s))
+            if half_open:
                 self._trip()  # the probe failed: straight back to open
                 tripped = True
             else:
@@ -369,7 +381,9 @@ async def post_with_resilience(http, url: str, *, json_body, deadline: Deadline,
     transport errors (the request never reached the server, so side effects
     are impossible) and ``retry_statuses`` (503 shed — the server rejected
     before doing work, and its ``Retry-After`` is honored as a backoff
-    floor). A read timeout or reset mid-response is NOT retried: the server
+    floor, capped at half the remaining deadline so a long server-named
+    horizon still leaves room for the retry it schedules instead of
+    forfeiting it). A read timeout or reset mid-response is NOT retried: the server
     may have executed the request, and both downstream hops (/parse session
     turns, /execute browser actions) are not idempotent.
 
@@ -447,7 +461,16 @@ async def post_with_resilience(http, url: str, *, json_body, deadline: Deadline,
                 retry_after_s = 0.0
         if attempt + 1 >= max(1, policy.max_attempts):
             break
-        delay = max(policy.backoff_s(attempt, rng), retry_after_s)
+        delay = policy.backoff_s(attempt, rng)
+        if retry_after_s > 0:
+            # the server named its own recovery horizon: honor it as a
+            # backoff floor, but CAP it by the remaining deadline (half,
+            # so the attempt itself still fits) — a router/brain answering
+            # "Retry-After: 10" with 2 s of budget left must degrade to
+            # one last try at the deadline's edge, not forfeit the retry
+            # entirely and guarantee the failure the header was trying to
+            # schedule around
+            delay = max(delay, min(retry_after_s, deadline.remaining_s() * 0.5))
         if deadline.remaining_s() <= delay:
             break  # the budget can't cover the wait, let alone the attempt
         get_metrics().inc(f"resilience.{name}.retries")

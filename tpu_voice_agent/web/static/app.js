@@ -33,7 +33,7 @@ const hudEl = $("hud"), hudTotal = $("hud-total"), hudBar = $("hud-bar"),
   hudSplit = $("hud-split");
 const capacityEl = $("capacity"), capacityText = $("capacity-text");
 const engineEl = $("engine"), engineStep = $("engine-step"),
-  recompileBadge = $("recompile-badge");
+  recompileBadge = $("recompile-badge"), replicaBadge = $("replica-badge");
 const SLO_BUDGET_MS = 800;  // BASELINE voice->intent p50 target
 const HEALTH_POLL_MS = 5000;
 
@@ -137,6 +137,21 @@ async function pollHealth() {
  * trace after the warmup fence (the silent-p99-cliff event, now named),
  * and the HBM plan-drift alarm. */
 function showEngine(brain) {
+  /* replica badge (ISSUE 10): BRAIN_URL may point at the router tier,
+   * whose aggregated /health forwards replicas {total, healthy, draining}
+   * — red the moment any replica is out of the ring (dead, hung, or
+   * draining for a rolling restart). */
+  /* an actively-draining replica still counts as healthy (servable), so
+   * the badge must also key on draining > 0 or the whole drain is
+   * invisible until the eject. */
+  const rep = brain && brain.replicas;
+  if (rep && rep.total > 0 && (rep.healthy < rep.total || rep.draining > 0)) {
+    replicaBadge.textContent = `replicas ${rep.healthy}/${rep.total}`
+      + (rep.draining ? ` (${rep.draining} draining)` : "");
+    replicaBadge.hidden = false;
+  } else {
+    replicaBadge.hidden = true;
+  }
   if (!brain) { engineEl.hidden = true; return; }
   const parts = [];
   const step = brain.last_step;
