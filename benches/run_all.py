@@ -93,6 +93,22 @@ def main() -> None:
         env = dict(os.environ)
         for k, v in QUICK_ENV.items():
             env.setdefault(k, v)
+    # invariant firewall (ISSUE 11, tools/analyze): the bench table runs on
+    # an analyzer-clean tree or not at all — a bench number measured on a
+    # tree that violates the serving plane's contracts (unsentineled jit,
+    # blocking call on a service loop, undeclared knob) is not a number
+    # worth recording. Runs on --quick too: AST-only, ~seconds.
+    print("[run_all] tools.analyze (invariant firewall)", file=sys.stderr,
+          flush=True)
+    firewall = subprocess.run([sys.executable, "-m", "tools.analyze"],
+                              cwd=root)
+    if firewall.returncode != 0:
+        print("[run_all] invariant firewall FAILED — fix or suppress (with "
+              "justification) the findings above before benching",
+              file=sys.stderr, flush=True)
+        sys.exit(1)
+    summary["analyze"] = "clean"
+
     for name in (QUICK_BENCHES if quick else BENCHES):
         print(f"[run_all] {name}", file=sys.stderr, flush=True)
         try:

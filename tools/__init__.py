@@ -1,0 +1,5 @@
+"""Repo tooling (``python -m tools.analyze``, metrics lint, swarm, views).
+
+Modules here are ALSO imported flat (``sys.path.insert(0, tools)`` +
+``import metrics_lint``) by tests and benches; both spellings stay valid.
+"""

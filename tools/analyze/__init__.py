@@ -1,0 +1,83 @@
+"""The invariant firewall: ``python -m tools.analyze``.
+
+Six AST-based checkers that turn the serving plane's hand-kept contracts
+into mechanical gates (stdlib ``ast`` only — no imports of the package
+under analysis, no third-party deps):
+
+- ``jit-sentinel``    every jitted entry point is wrapped by the PR 9
+                      recompile sentinel (``watch_compiles``)
+- ``async-blocking``  no synchronous stalls inside ``async def`` bodies on
+                      the services' event loops
+- ``atomic-section``  no ``await``/``yield`` inside marked await-free
+                      critical sections (the router's correctness argument)
+- ``env-knob``        every env read resolves to a declared knob in
+                      ``tpu_voice_agent/utils/knobs.py``, two-way-synced
+                      against the docs knob tables
+- ``traced-purity``   no host nondeterminism (time/env/np.random/print)
+                      inside functions traced by jit/lax combinators
+- ``metrics-catalog`` ``tools/metrics_lint.py`` folded in: name-kind
+                      collisions, pinned names, and the two-way
+                      OBSERVABILITY.md catalog sync
+
+Findings are suppressed inline (``# analyze: ok[checker-id] -- why``) or
+via ``tools/analyze/baseline.json``; both REQUIRE a justification. Exit is
+non-zero on any unsuppressed finding or stale suppression. See
+docs/ANALYSIS.md for the catalog and how to add a checker.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from . import (atomic_sections, env_knobs, event_loop, jit_sentinel,
+               metrics_catalog, traced_purity)
+from .core import (Finding, RepoCtx, apply_baseline,
+                   apply_inline_suppressions, load_baseline)
+
+DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+CHECKERS = {
+    jit_sentinel.ID: jit_sentinel.check,
+    event_loop.ID: event_loop.check,
+    atomic_sections.ID: atomic_sections.check,
+    env_knobs.ID: env_knobs.check,
+    traced_purity.ID: traced_purity.check,
+    metrics_catalog.ID: metrics_catalog.check,
+}
+
+
+def run(repo_root: pathlib.Path | None = None,
+        baseline: pathlib.Path | None = None,
+        only: set[str] | None = None) -> tuple[list[Finding], list[Finding]]:
+    """Run every checker (or the ``only`` subset) over the tree.
+
+    Returns ``(live, suppressed)`` — live findings are failures. Inline
+    suppressions apply first, then the baseline; stale baseline entries
+    and justification-less markers surface AS live findings."""
+    repo = RepoCtx(repo_root)
+    raw: list[Finding] = []
+    # a file that does not parse blinds EVERY checker to it (they all skip
+    # tree=None) — that must be a finding, not a silent pass, or the
+    # firewall exits 0 on a tree that cannot even import. Runs regardless
+    # of --only: no subset of checkers can vouch for an unparseable file.
+    for ctx in repo.package_files():
+        if ctx.tree is None:
+            raw.append(Finding(
+                checker="syntax-error", path=ctx.rel, line=1,
+                key="syntax-error",
+                message="file does not parse — every checker is blind to it"))
+    for cid, check in CHECKERS.items():
+        if only is not None and cid not in only:
+            continue
+        raw.extend(check(repo))
+    live, sup_inline = apply_inline_suppressions(repo._files, raw)
+    entries, baseline_problems = load_baseline(baseline or DEFAULT_BASELINE)
+    bl_rel = (baseline or DEFAULT_BASELINE)
+    try:
+        bl_rel = bl_rel.resolve().relative_to(repo.repo_root).as_posix()
+    except ValueError:
+        bl_rel = str(bl_rel)
+    live, sup_baseline = apply_baseline(entries, live, bl_rel)
+    live.extend(baseline_problems)
+    live.sort(key=lambda f: (f.path, f.line, f.checker, f.key))
+    return live, sup_inline + sup_baseline

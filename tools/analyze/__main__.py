@@ -1,0 +1,53 @@
+"""CLI: ``python -m tools.analyze [--baseline PATH] [--only id,id] [-q]``.
+
+Exit 0 = tree is analyzer-clean (every finding suppressed WITH a
+justification, no stale suppressions). Exit 1 = live findings, listed
+one per line as ``path:line: [checker] message``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from . import CHECKERS, DEFAULT_BASELINE, run
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="AST invariant firewall over tpu_voice_agent/")
+    ap.add_argument("--baseline", type=pathlib.Path, default=DEFAULT_BASELINE,
+                    help="suppression baseline (default: tools/analyze/"
+                         "baseline.json)")
+    ap.add_argument("--only", default=None,
+                    help=f"comma-separated checker ids to run "
+                         f"(of: {', '.join(CHECKERS)})")
+    ap.add_argument("--root", type=pathlib.Path, default=None,
+                    help="repo root override (tests use tmp trees)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print findings only, no summary line")
+    args = ap.parse_args(argv)
+
+    only = None
+    if args.only:
+        only = {s.strip() for s in args.only.split(",") if s.strip()}
+        unknown = only - set(CHECKERS)
+        if unknown:
+            ap.error(f"unknown checker id(s): {', '.join(sorted(unknown))}")
+
+    live, suppressed = run(repo_root=args.root, baseline=args.baseline,
+                           only=only)
+    for f in live:
+        print(f.format())
+    if not args.quiet:
+        ran = sorted(only) if only else sorted(CHECKERS)
+        print(f"[analyze] {len(ran)} checkers ({', '.join(ran)}): "
+              f"{len(live)} finding(s), {len(suppressed)} suppressed",
+              file=sys.stderr)
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

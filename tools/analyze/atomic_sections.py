@@ -1,0 +1,105 @@
+"""atomic-section: no suspension points inside marked critical sections.
+
+The router's correctness argument (services/router.py) is that every
+mutation of routing state — the session table, the ring/replica states,
+the breaker and inflight counters — happens in an *await-free* stretch of
+event-loop code, so the loop itself serializes racy callers and no locks
+exist to forget. That invariant is invisible to Python: an ``await``
+added inside one of those stretches compiles, passes the unit tests that
+don't race it, and corrupts routing state under load.
+
+The marker makes the invariant visible and this checker enforces it:
+
+    # atomic-section: <name> -- <why this region must not suspend>
+    ...event-loop-atomic statements...
+    # end-atomic-section
+
+Inside a marked region, ``await``, ``yield``, ``yield from``,
+``async for`` and ``async with`` are findings. Unbalanced or nested
+markers are findings too (an unclosed region silently guards nothing).
+Regions are lexical line ranges — they may open inside a function and
+must close in the same file.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, RepoCtx
+
+ID = "atomic-section"
+
+_BEGIN = re.compile(r"#\s*atomic-section:\s*(?P<name>[A-Za-z0-9_.\-]+)")
+_END = re.compile(r"#\s*end-atomic-section")
+
+_SUSPEND = {
+    ast.Await: "await",
+    ast.Yield: "yield",
+    ast.YieldFrom: "yield from",
+    ast.AsyncFor: "async for",
+    ast.AsyncWith: "async with",
+}
+
+
+def regions(ctx) -> tuple[list[tuple[str, int, int]], list[Finding]]:
+    """[(name, begin_line, end_line)], plus marker-balance findings."""
+    out: list[tuple[str, int, int]] = []
+    problems: list[Finding] = []
+    open_name: str | None = None
+    open_line = 0
+    for i, line in enumerate(ctx.lines, 1):
+        b, e = _BEGIN.search(line), _END.search(line)
+        if b:
+            if open_name is not None:
+                problems.append(Finding(
+                    checker=ID, path=ctx.rel, line=i,
+                    key=f"{b.group('name')}:nested",
+                    message=(f"atomic-section {b.group('name')!r} opens "
+                             f"inside {open_name!r} (line {open_line}) — "
+                             "regions cannot nest")))
+            open_name, open_line = b.group("name"), i
+        elif e:
+            if open_name is None:
+                problems.append(Finding(
+                    checker=ID, path=ctx.rel, line=i, key=f"unopened@{i}",
+                    message="end-atomic-section with no open region"))
+            else:
+                out.append((open_name, open_line, i))
+                open_name = None
+    if open_name is not None:
+        problems.append(Finding(
+            checker=ID, path=ctx.rel, line=open_line,
+            key=f"{open_name}:unclosed",
+            message=(f"atomic-section {open_name!r} never closed — an "
+                     "unclosed region guards nothing")))
+    return out, problems
+
+
+def check(repo: RepoCtx) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in repo.package_files():
+        if ctx.tree is None or "atomic-section" not in ctx.text:
+            continue
+        regs, problems = regions(ctx)
+        findings.extend(problems)
+        if not regs:
+            continue
+        for node in ast.walk(ctx.tree):
+            kind = _SUSPEND.get(type(node))
+            if kind is None:
+                continue
+            line = getattr(node, "lineno", None)
+            if line is None:
+                continue
+            for name, b, e in regs:
+                if b <= line <= e:
+                    findings.append(Finding(
+                        checker=ID, path=ctx.rel, line=line,
+                        key=f"{name}:{kind}",
+                        message=(f"{kind!r} inside atomic-section "
+                                 f"{name!r} (lines {b}-{e}) — a suspension "
+                                 "point here breaks the await-free "
+                                 "critical-section contract")))
+                    break
+    return findings

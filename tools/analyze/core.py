@@ -1,0 +1,259 @@
+"""Shared plumbing for the invariant firewall (``tools/analyze``).
+
+Everything here is stdlib-``ast`` based — no third-party deps, no imports
+of the package under analysis (a lint must run on a tree too broken to
+import). The pieces:
+
+- ``FileCtx``: one parsed source file (text, lines, AST) — parsed once,
+  shared by every checker.
+- ``Finding``: one violation. Identity is ``(checker, path, key)`` where
+  ``key`` is a *stable* symbol (function name, env-var name, metric name),
+  never a line number — baselines survive unrelated edits.
+- suppressions: an inline ``# analyze: ok[checker-id] -- justification``
+  comment on the flagged line (or the line above; for decorated defs,
+  anywhere in the decorator block). The justification is REQUIRED — a bare
+  ``ok[...]`` is itself a finding. Baseline entries (``baseline.json``)
+  carry the same contract: every entry names its checker/path/key and a
+  non-empty ``justification``.
+- ``run_checkers``: parse tree once, run every checker, apply inline +
+  baseline suppressions, report stale baseline entries.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+PACKAGE_ROOT = REPO_ROOT / "tpu_voice_agent"
+
+
+def load_metrics_lint():
+    """The standalone ``tools/metrics_lint.py`` module (flat import — it
+    predates this package and tests/operators call it directly). Shared by
+    the metrics-catalog checker and the docs-table walkers."""
+    import sys
+    tools_dir = str(pathlib.Path(__file__).resolve().parents[1])
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import metrics_lint
+    return metrics_lint
+
+# `# analyze: ok[checker-a,checker-b] -- why this is fine`
+_SUPPRESS = re.compile(
+    r"#\s*analyze:\s*ok\[(?P<ids>[a-z0-9_,\- ]+)\]\s*(?:[-—–:]+\s*(?P<why>\S.*))?")
+
+
+@dataclass
+class Finding:
+    checker: str
+    path: str  # repo-relative posix path
+    line: int
+    key: str  # stable identity within (checker, path) — symbol, not line
+    message: str
+    # lines where an inline suppression comment is honored (defaults to
+    # the finding line and the one above; def-shaped findings widen this
+    # to their decorator block)
+    sup_lines: tuple[int, ...] = ()
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+@dataclass
+class FileCtx:
+    path: pathlib.Path
+    rel: str
+    text: str
+    lines: list[str]
+    tree: ast.Module | None  # None when the file does not parse
+    _suppress: dict[int, tuple[set[str], str]] | None = field(
+        default=None, repr=False)
+
+    def suppressions(self) -> dict[int, tuple[set[str], str]]:
+        """line -> (checker ids, justification) for every inline marker."""
+        if self._suppress is None:
+            out: dict[int, tuple[set[str], str]] = {}
+            for i, line in enumerate(self.lines, 1):
+                m = _SUPPRESS.search(line)
+                if m:
+                    ids = {s.strip() for s in m.group("ids").split(",")
+                           if s.strip()}
+                    out[i] = (ids, (m.group("why") or "").strip())
+            self._suppress = out
+        return self._suppress
+
+
+class RepoCtx:
+    """Parsed-once view of the tree the checkers share."""
+
+    def __init__(self, repo_root: pathlib.Path | None = None):
+        self.repo_root = repo_root or REPO_ROOT
+        self.package_root = self.repo_root / "tpu_voice_agent"
+        self._files: dict[str, FileCtx] = {}
+
+    def file(self, path: pathlib.Path) -> FileCtx:
+        rel = path.resolve().relative_to(self.repo_root).as_posix()
+        if rel not in self._files:
+            text = path.read_text()
+            try:
+                tree = ast.parse(text)
+            except SyntaxError:
+                tree = None
+            self._files[rel] = FileCtx(path=path, rel=rel, text=text,
+                                       lines=text.splitlines(), tree=tree)
+        return self._files[rel]
+
+    def package_files(self, subdir: str = "") -> list[FileCtx]:
+        root = self.package_root / subdir if subdir else self.package_root
+        out = []
+        for p in sorted(root.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            out.append(self.file(p))
+        return out
+
+
+# ----------------------------------------------------------- suppression
+
+
+def apply_inline_suppressions(
+        ctx_by_rel: dict[str, FileCtx],
+        findings: list[Finding]) -> tuple[list[Finding], list[Finding]]:
+    """Split findings into (live, suppressed). A marker with an empty
+    justification suppresses nothing and raises its own finding."""
+    live: list[Finding] = []
+    suppressed: list[Finding] = []
+    bad_markers: list[Finding] = []
+    for f in findings:
+        ctx = ctx_by_rel.get(f.path)
+        hit = False
+        if ctx is not None:
+            sup = ctx.suppressions()
+            cand = f.sup_lines or (f.line, f.line - 1)
+            for ln in cand:
+                ids_why = sup.get(ln)
+                if ids_why and f.checker in ids_why[0]:
+                    if not ids_why[1]:
+                        bad_markers.append(Finding(
+                            checker=f.checker, path=f.path, line=ln,
+                            key=f"{f.key}:no-justification",
+                            message=(f"suppression for {f.key!r} has no "
+                                     "justification — `# analyze: ok[...]` "
+                                     "must say WHY")))
+                    else:
+                        hit = True
+                    break
+        (suppressed if hit else live).append(f)
+    return live + bad_markers, suppressed
+
+
+def load_baseline(path: pathlib.Path) -> tuple[list[dict], list[Finding]]:
+    """Read baseline.json; entries missing a justification are findings."""
+    problems: list[Finding] = []
+    try:
+        data = json.loads(path.read_text())
+    except FileNotFoundError:
+        return [], []
+    except (json.JSONDecodeError, OSError) as e:
+        return [], [Finding(
+            checker="baseline", path=_rel(path), line=1, key="unreadable",
+            message=f"baseline unreadable: {e}")]
+    entries = data.get("suppressions", [])
+    for i, e in enumerate(entries):
+        missing = [k for k in ("checker", "path", "key") if not e.get(k)]
+        if missing:
+            problems.append(Finding(
+                checker="baseline", path=_rel(path), line=1,
+                key=f"entry{i}:malformed",
+                message=f"baseline entry {i} missing {missing}"))
+        elif not str(e.get("justification", "")).strip():
+            problems.append(Finding(
+                checker="baseline", path=_rel(path), line=1,
+                key=f"{e['checker']}:{e['path']}:{e['key']}",
+                message=(f"baseline entry for {e['key']!r} "
+                         f"({e['checker']}, {e['path']}) has no "
+                         "justification")))
+    return entries, problems
+
+
+def apply_baseline(entries: list[dict], findings: list[Finding],
+                   baseline_rel: str) -> tuple[list[Finding], list[Finding]]:
+    """(live, suppressed); stale entries (matching nothing) are findings —
+    a baseline line that outlived its violation must be deleted, not
+    accumulate."""
+    keyed = {(e.get("checker"), e.get("path"), e.get("key")): e
+             for e in entries
+             if e.get("checker") and str(e.get("justification", "")).strip()}
+    used: set[tuple] = set()
+    live, suppressed = [], []
+    for f in findings:
+        k = (f.checker, f.path, f.key)
+        if k in keyed:
+            used.add(k)
+            suppressed.append(f)
+        else:
+            live.append(f)
+    for k in keyed:
+        if k not in used:
+            live.append(Finding(
+                checker="baseline", path=baseline_rel, line=1,
+                key=f"stale:{k[0]}:{k[2]}",
+                message=(f"stale baseline entry: {k[0]} / {k[1]} / {k[2]} "
+                         "matches no current finding — delete it")))
+    return live, suppressed
+
+
+def _rel(path: pathlib.Path) -> str:
+    try:
+        return path.resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return str(path)
+
+
+# ------------------------------------------------------------- AST helpers
+
+
+def dotted(node: ast.AST) -> str:
+    """`a.b.c` for Name/Attribute chains, '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# jit-family recognition, shared by jit_sentinel and traced_purity — one
+# definition of "what counts as jitted", so sentinel coverage and purity
+# checking can never disagree about it. Add new spellings HERE.
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def is_jit_ref(node: ast.AST) -> bool:
+    return dotted(node) in JIT_NAMES
+
+
+def is_jit_factory(node: ast.AST) -> bool:
+    """`partial(jax.jit, ...)` — a configured jit waiting for its fn."""
+    return (isinstance(node, ast.Call)
+            and dotted(node.func) in PARTIAL_NAMES
+            and bool(node.args) and is_jit_ref(node.args[0]))
+
+
+def decorator_is_jit(dec: ast.AST) -> bool:
+    return is_jit_ref(dec) or is_jit_factory(dec) or (
+        isinstance(dec, ast.Call) and is_jit_ref(dec.func))
+
+
+def def_sup_lines(node: ast.FunctionDef | ast.AsyncFunctionDef) -> tuple[int, ...]:
+    """Suppression window for a def-shaped finding: the whole decorator
+    block, the def line, and the line above the first decorator."""
+    first = min([d.lineno for d in node.decorator_list] + [node.lineno])
+    return tuple(range(first - 1, node.lineno + 1))

@@ -1,0 +1,338 @@
+"""env-knob: every environment read resolves to a declared, documented knob.
+
+~90 raw ``os.environ`` reads back the serving plane's tuning surface, and
+until now the only record of a knob's existence was the call site plus —
+sometimes — a hand-kept row in one of three docs tables. This checker
+closes the loop through the central registry
+(``tpu_voice_agent/utils/knobs.py``):
+
+- every env read under ``tpu_voice_agent/`` with a literal name must name
+  a declared knob (reads via ``os.environ.get`` / ``[]`` / ``setdefault``
+  / ``os.getenv`` / ``"X" in os.environ``, the ``envcfg`` helpers
+  ``env_str``/``env_int``/``env_bool``, ``knobs.get``-style accessors,
+  and simple aliases like ``env = os.environ.get``);
+- a read whose name is not a literal is flagged (generic accessors
+  suppress inline with the reason);
+- two-way docs sync: a knob declared with ``table=<docs file>`` must
+  appear in that file's knob tables, every ALL_CAPS name in any knob
+  table must be declared *for that file*, and a knob declared
+  infrastructure (``table=None``) must not appear in any table;
+- a declared knob that is never read anywhere is stale and flagged
+  (reads in ``benches/`` and ``tools/`` count toward liveness — bench
+  knobs are documented too — but only reads under ``tpu_voice_agent/``
+  must be declared).
+
+The registry is parsed with ``ast`` (never imported): a lint must work on
+a tree too broken to import, and the declarations are literals anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, RepoCtx, dotted, load_metrics_lint
+
+ID = "env-knob"
+
+KNOBS_REL = "tpu_voice_agent/utils/knobs.py"
+DOC_FILES = ("docs/RESILIENCE.md", "docs/PERF.md", "docs/OBSERVABILITY.md")
+
+_ENV_HELPERS = {"env_str", "env_int", "env_bool", "env_float"}
+_KNOB_ACCESSORS = {"knob", "knob_str", "knob_int", "knob_float", "knob_bool"}
+_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]{2,}$")
+# a knob-table row's first cell: | `NAME` ... | — tables are recognized by
+# a header row whose first cell is `knob` or `env`
+_TABLE_HEADER = re.compile(r"^\|\s*(knob|env)\s*\|", re.IGNORECASE)
+_BACKTICKED = re.compile(r"`([^`]+)`")
+
+
+# ------------------------------------------------------------ registry
+
+
+def parse_registry(repo: RepoCtx) -> tuple[dict[str, dict], list[Finding]]:
+    """knobs.py -> {name: {"table": rel-path | None, "default": str | None,
+    "default_known": bool}}. Pure AST: ``declare("NAME", default, doc,
+    table=CONST)`` with CONST a module string constant (or None/omitted
+    for infrastructure env)."""
+    path = repo.repo_root / KNOBS_REL
+    if not path.is_file():
+        return {}, [Finding(
+            checker=ID, path=KNOBS_REL, line=1, key="missing-registry",
+            message=f"central knob registry {KNOBS_REL} does not exist")]
+    ctx = repo.file(path)
+    if ctx.tree is None:
+        return {}, [Finding(
+            checker=ID, path=KNOBS_REL, line=1, key="registry-syntax",
+            message="knob registry does not parse")]
+    consts: dict[str, str | None] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant):
+            consts[node.targets[0].id] = node.value.value
+    knobs: dict[str, dict] = {}
+    problems: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func).split(".")[-1] == "declare"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            problems.append(Finding(
+                checker=ID, path=ctx.rel, line=node.lineno,
+                key=f"declare@{node.lineno}",
+                message="declare(...) first arg must be a literal name"))
+            continue
+        name = node.args[0].value
+        table: str | None = None
+        table_node = node.args[3] if len(node.args) > 3 else None
+        for kw in node.keywords:
+            if kw.arg == "table":
+                table_node = kw.value
+        if table_node is not None:
+            if isinstance(table_node, ast.Constant):
+                table = table_node.value
+            elif isinstance(table_node, ast.Name):
+                table = consts.get(table_node.id)
+        default: str | None = None
+        default_known = False
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+            default = node.args[1].value
+            default_known = True
+        if name in knobs:
+            problems.append(Finding(
+                checker=ID, path=ctx.rel, line=node.lineno,
+                key=f"{name}:duplicate",
+                message=f"knob {name!r} declared twice"))
+        knobs[name] = {"table": table, "default": default,
+                       "default_known": default_known}
+    return knobs, problems
+
+
+# ------------------------------------------------------------- env reads
+
+
+_NO_DEFAULT = object()  # sentinel: the call site passes no default literal
+
+
+class _EnvReadScan(ast.NodeVisitor):
+    """Collect (name | None, line, default) env reads; name None = dynamic
+    name, default ``_NO_DEFAULT`` = no literal default at the site (absent
+    or computed — only literal defaults participate in drift checking)."""
+
+    def __init__(self):
+        self.reads: list[tuple[str | None, int, object]] = []
+        self.aliases: set[str] = set()  # local names bound to environ.get etc.
+
+    def _record(self, node: ast.AST, arg: ast.AST | None,
+                default: ast.AST | None = None) -> None:
+        dval = _NO_DEFAULT
+        if isinstance(default, ast.Constant):
+            dval = default.value
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            self.reads.append((arg.value, node.lineno, dval))
+        else:
+            self.reads.append((None, node.lineno, dval))
+
+    @staticmethod
+    def _default_arg(node: ast.Call) -> ast.AST | None:
+        if len(node.args) > 1:
+            return node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "default":
+                return kw.value
+        return None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # `env = os.environ.get` / `getenv = os.getenv`
+        if dotted(node.value) in ("os.environ.get", "os.getenv",
+                                  "environ.get", "os.environ.setdefault"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.aliases.add(t.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = dotted(node.func)
+        parts = fn.split(".")
+        leaf = parts[-1]
+        first = node.args[0] if node.args else None
+        if fn in ("os.environ.get", "os.getenv", "environ.get", "getenv",
+                  "os.environ.setdefault", "environ.setdefault"):
+            self._record(node, first, self._default_arg(node))
+        elif fn in self.aliases:
+            self._record(node, first, self._default_arg(node))
+        elif leaf in _ENV_HELPERS:
+            self._record(node, first, self._default_arg(node))
+        elif leaf in _KNOB_ACCESSORS or (
+                leaf == "get" and len(parts) >= 2 and parts[-2] == "knobs"):
+            # the registry's own accessors: knobs.get("NAME")/knob_int(..)
+            # — a second arg there is a deliberate per-call override of the
+            # declared default, so it does not participate in drift
+            # checking (a bare `.get` leaf would false-positive on dicts)
+            self._record(node, first)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if dotted(node.value) in ("os.environ", "environ"):
+            self._record(node, node.slice)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # `"X" in os.environ`
+        if (len(node.ops) == 1 and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and dotted(node.comparators[0]) in ("os.environ", "environ")):
+            self._record(node, node.left)
+        self.generic_visit(node)
+
+
+# ------------------------------------------------------------ docs tables
+
+
+def doc_table_names(text: str) -> dict[str, int]:
+    """ALL_CAPS backticked names in the FIRST cell of knob-table rows ->
+    first line seen. Only tables whose header's first cell is `knob` or
+    `env` count — metric catalogs and fault matrices don't declare env.
+    Table walking is shared with the metric-catalog parser
+    (``metrics_lint.iter_table_rows``) so the two cannot diverge."""
+    out: dict[str, int] = {}
+    for i, cells in load_metrics_lint().iter_table_rows(text, _TABLE_HEADER):
+        for tok in _BACKTICKED.findall(cells[1]):
+            if _NAME_RE.match(tok):
+                out.setdefault(tok, i)
+    return out
+
+
+# --------------------------------------------------------------- checker
+
+
+def _defaults_agree(declared: str | None, site) -> bool:
+    """Tolerant equality between the declared default (str | None) and a
+    call-site literal: numeric equality (`"2.0"` ≡ `2`), and the unset/
+    empty/False class collapses (a knob declared default None reads
+    behaviorally identically through `os.environ.get(n, "")`)."""
+    def norm(v):
+        if v is None or v is False or v == "":
+            return None
+        if v is True:
+            return "1"
+        return str(v)
+    a, b = norm(declared), norm(site)
+    if a == b:
+        return True
+    try:
+        return a is not None and b is not None and float(a) == float(b)
+    except (TypeError, ValueError):
+        return False
+
+
+def check(repo: RepoCtx) -> list[Finding]:
+    knobs, findings = parse_registry(repo)
+
+    # 1. every env read resolves to a declared knob
+    read_names: set[str] = set()
+    # benches/tools reads keep a documented knob alive but need no
+    # declaration of their own — the registry covers the SERVING plane
+    for aux in ("benches", "tools"):
+        root = repo.repo_root / aux
+        if not root.is_dir():
+            continue
+        for p in sorted(root.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            aux_ctx = repo.file(p)
+            if aux_ctx.tree is None:
+                continue
+            scan = _EnvReadScan()
+            scan.visit(aux_ctx.tree)
+            read_names.update(n for n, _, _ in scan.reads if n)
+    for ctx in repo.package_files():
+        if ctx.tree is None:
+            continue
+        scan = _EnvReadScan()
+        scan.visit(ctx.tree)
+        dyn = 0
+        drift = 0
+        for name, line, site_default in scan.reads:
+            if name is None:
+                key = "dynamic-env-read" if dyn == 0 else f"dynamic-env-read#{dyn}"
+                dyn += 1
+                findings.append(Finding(
+                    checker=ID, path=ctx.rel, line=line, key=key,
+                    message=("env read with a non-literal name — the "
+                             "registry cannot vouch for it")))
+                continue
+            read_names.add(name)
+            if name not in knobs:
+                findings.append(Finding(
+                    checker=ID, path=ctx.rel, line=line, key=name,
+                    message=(f"env knob {name!r} is not declared in "
+                             f"{KNOBS_REL} — declare(name, default, doc, "
+                             "table=...)")))
+            elif (site_default is not _NO_DEFAULT
+                    and knobs[name]["default_known"]
+                    and not _defaults_agree(knobs[name]["default"],
+                                            site_default)):
+                # the declared default must BE the call-site default, or
+                # the registry (and its docs row) silently lies about
+                # behavior — the drift class this checker exists to close
+                key = (f"{name}:default-drift" if drift == 0
+                       else f"{name}:default-drift#{drift}")
+                drift += 1
+                findings.append(Finding(
+                    checker=ID, path=ctx.rel, line=line, key=key,
+                    message=(f"knob {name!r} read with default "
+                             f"{site_default!r} but declared default "
+                             f"{knobs[name]['default']!r} in {KNOBS_REL} — "
+                             "the registry/docs row lies about behavior")))
+
+    # 2. two-way docs sync
+    doc_names: dict[str, dict[str, int]] = {}
+    for rel in DOC_FILES:
+        p = repo.repo_root / rel
+        doc_names[rel] = doc_table_names(p.read_text()) if p.is_file() else {}
+    for name, info in sorted(knobs.items()):
+        table = info["table"]
+        if table is not None:
+            if table not in doc_names:
+                findings.append(Finding(
+                    checker=ID, path=KNOBS_REL, line=1,
+                    key=f"{name}:bad-table",
+                    message=(f"knob {name!r} declares table {table!r} "
+                             f"which is not one of {DOC_FILES}")))
+            elif name not in doc_names[table]:
+                findings.append(Finding(
+                    checker=ID, path=table, line=1, key=f"{name}:undocumented",
+                    message=(f"knob {name!r} is declared for {table} but "
+                             "its knob tables have no row for it")))
+        else:
+            for rel, names in doc_names.items():
+                if name in names:
+                    findings.append(Finding(
+                        checker=ID, path=KNOBS_REL, line=1,
+                        key=f"{name}:infra-documented",
+                        message=(f"knob {name!r} is declared infrastructure "
+                                 f"(table=None) but {rel} documents it at "
+                                 f"line {names[name]} — point the "
+                                 "declaration at that table")))
+        if name not in read_names:
+            findings.append(Finding(
+                checker=ID, path=KNOBS_REL, line=1, key=f"{name}:unread",
+                message=(f"knob {name!r} is declared but never read under "
+                         "tpu_voice_agent/ — stale declaration")))
+    for rel, names in doc_names.items():
+        for name, line in sorted(names.items()):
+            if name not in knobs:
+                findings.append(Finding(
+                    checker=ID, path=rel, line=line, key=f"{name}:orphan",
+                    message=(f"{rel} documents knob {name!r} but the "
+                             f"registry does not declare it — doc-orphaned")))
+            elif knobs[name]["table"] is not None and knobs[name]["table"] != rel:
+                # documented in a second table: fine only if it's the
+                # declared home; a row in the WRONG doc drifts silently
+                findings.append(Finding(
+                    checker=ID, path=rel, line=line, key=f"{name}:wrong-table",
+                    message=(f"knob {name!r} is documented here but "
+                             f"declared for {knobs[name]['table']}")))
+    return findings

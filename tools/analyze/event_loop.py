@@ -1,0 +1,118 @@
+"""async-blocking: no synchronous stalls on the services' event loops.
+
+The three services (voice, brain/router, executor) are single-event-loop
+aiohttp apps; one blocking call inside an ``async def`` stalls EVERY live
+WebSocket and in-flight parse — the whole-service head-of-line blocking
+failure the PR 4/PR 7 offload work (``run_in_executor``, ``feed_async``,
+worker threads) exists to prevent. Flagged inside ``async def`` bodies
+under ``tpu_voice_agent/services/``:
+
+- ``time.sleep(...)`` (use ``asyncio.sleep``);
+- synchronous HTTP: any ``requests.*`` call, and ``httpx``'s sync module
+  API / ``httpx.Client`` (``httpx.AsyncClient`` methods are awaited and
+  fine);
+- ``<fut>.result()`` — blocking on a ``concurrent.futures.Future``
+  parks the loop until a worker thread finishes (``asyncio.Task.result()``
+  on a just-completed task is the legitimate exception: suppress with the
+  proof it is non-blocking);
+- direct engine dispatch: ``.generate(...)`` or a raw model forward
+  (``forward`` / ``forward_paged`` / ``decoder_forward`` / ...) — device
+  compute belongs on the batcher/executor threads, never the loop.
+
+Nested *sync* ``def``s inside an async body are skipped: that is exactly
+the ``def work(): ...  await run_in_executor(None, work)`` offload idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, RepoCtx, dotted
+
+ID = "async-blocking"
+
+_HTTPX_SYNC = {"get", "post", "put", "delete", "head", "options", "patch",
+               "request", "stream", "Client"}
+_FORWARD_NAMES = {"generate", "forward", "forward_paged", "decoder_forward",
+                  "forward_embeds", "vision_forward", "encoder_forward"}
+
+
+def _classify(call: ast.Call) -> str | None:
+    fn = dotted(call.func)
+    if fn == "time.sleep":
+        return "time.sleep blocks the event loop — use asyncio.sleep"
+    if fn.startswith("requests."):
+        return f"synchronous HTTP call {fn!r} blocks the event loop"
+    if fn.startswith("httpx.") and fn.split(".", 1)[1] in _HTTPX_SYNC:
+        return (f"{fn!r} is httpx's SYNC api — use httpx.AsyncClient "
+                "on the loop")
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr == "result":
+            # with or without a timeout: .result(timeout=5) still parks
+            # the loop for up to that long
+            return (".result() blocks the loop if the future is not "
+                    "already done")
+        if attr in _FORWARD_NAMES:
+            return (f".{attr}(...) dispatches engine/model compute on the "
+                    "event loop — offload to the batcher or an executor "
+                    "thread")
+    elif isinstance(call.func, ast.Name) and call.func.id in _FORWARD_NAMES:
+        return (f"{call.func.id}(...) is a raw model forward on the event "
+                "loop — offload it")
+    return None
+
+
+class _AsyncBodyScan(ast.NodeVisitor):
+    def __init__(self, ctx, findings):
+        self.ctx = ctx
+        self.findings = findings
+        self.async_depth = 0
+        self.fn_stack: list[str] = []
+        self._counts: dict[str, int] = {}
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.async_depth += 1
+        self.fn_stack.append(node.name)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.fn_stack.pop()
+        self.async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a nested sync def is the offload idiom — its body runs on a
+        # worker thread, not the loop
+        prev, self.async_depth = self.async_depth, 0
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.async_depth = prev
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        prev, self.async_depth = self.async_depth, 0
+        self.generic_visit(node)
+        self.async_depth = prev
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.async_depth > 0:
+            msg = _classify(node)
+            if msg is not None:
+                # stable key: enclosing async fn + call shape + occurrence
+                # index within that fn (never a line number)
+                base = (f"{self.fn_stack[-1] if self.fn_stack else '?'}:"
+                        f"{dotted(node.func) or node.func.__class__.__name__}")
+                n = self._counts.get(base, 0)
+                self._counts[base] = n + 1
+                self.findings.append(Finding(
+                    checker=ID, path=self.ctx.rel, line=node.lineno,
+                    key=base if n == 0 else f"{base}#{n}",
+                    message=msg))
+        self.generic_visit(node)
+
+
+def check(repo: RepoCtx) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in repo.package_files("services"):
+        if ctx.tree is None:
+            continue
+        _AsyncBodyScan(ctx, findings).visit(ctx.tree)
+    return findings

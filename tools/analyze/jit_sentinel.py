@@ -1,0 +1,118 @@
+"""jit-sentinel coverage: every jitted entry point flows through the
+PR 9 recompilation sentinel.
+
+The sentinel (``utils/compilewatch.py``) only sees compiles on callables
+it wraps — a new ``@jax.jit`` added anywhere in the serving plane without
+``@watch_compiles("site")`` silently escapes the post-warmup fence, and
+the first symptom is an unexplained p99 cliff in production. This checker
+makes the wrap a mechanical requirement:
+
+- a ``def`` decorated with the jit family (``@jax.jit``, ``@jit``,
+  ``@partial(jax.jit, ...)``, ``@functools.partial(jax.jit, ...)``) must
+  ALSO carry ``@watch_compiles("site")`` — and the sentinel must be
+  OUTSIDE the jit (listed above it), or it wraps the plain function and
+  never sees the jit cache;
+- a stored jitted callable (``f = jax.jit(g)``, ``f = partial(jax.jit,
+  ...)(g)``) must be wrapped at the assignment
+  (``f = watch_compiles("site")(jax.jit(g))``);
+- an immediately-invoked jit (``jax.jit(init)(key)``) is exempt: it is a
+  one-shot init compile at construction time, not a serving dispatch
+  entry point the fence could ever catch re-tracing.
+
+Sites that are NOT dispatch entry points (kernel wrappers traced inline
+by a watched caller, offline training steps) carry an inline
+``# analyze: ok[jit-sentinel] -- why`` with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, RepoCtx, def_sup_lines, dotted,
+                   decorator_is_jit as _decorator_is_jit,
+                   is_jit_factory as _is_jit_factory,
+                   is_jit_ref as _is_jit_ref)
+
+ID = "jit-sentinel"
+
+_WATCH_NAMES = {"watch_compiles"}
+
+
+def _is_jitted_callable(node: ast.AST) -> bool:
+    """An expression that EVALUATES to a jitted callable:
+    ``jax.jit(f, ...)`` or ``partial(jax.jit, ...)(f)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    return _is_jit_ref(node.func) or _is_jit_factory(node.func)
+
+
+def _is_watch_wrapped(node: ast.AST) -> bool:
+    """``watch_compiles("site")(<jitted callable>)``."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Call)
+            and dotted(node.func.func).split(".")[-1] in _WATCH_NAMES
+            and bool(node.args) and _is_jitted_callable(node.args[0]))
+
+
+def _decorator_is_watch(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return dotted(dec).split(".")[-1] in _WATCH_NAMES
+
+
+def check(repo: RepoCtx) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in repo.package_files():
+        if ctx.tree is None:
+            continue
+        invoked: set[int] = set()  # ids of jit-calls that are immediately invoked
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_jitted_callable(node.func):
+                invoked.add(id(node.func))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                jit_idx = [i for i, d in enumerate(node.decorator_list)
+                           if _decorator_is_jit(d)]
+                if not jit_idx:
+                    continue
+                watch_idx = [i for i, d in enumerate(node.decorator_list)
+                             if _decorator_is_watch(d)]
+                if not watch_idx:
+                    findings.append(Finding(
+                        checker=ID, path=ctx.rel, line=node.lineno,
+                        key=node.name,
+                        message=(f"jitted def {node.name!r} is not wrapped "
+                                 "by watch_compiles(site) — it escapes the "
+                                 "recompile sentinel"),
+                        sup_lines=def_sup_lines(node)))
+                elif min(watch_idx) > min(jit_idx):
+                    findings.append(Finding(
+                        checker=ID, path=ctx.rel, line=node.lineno,
+                        key=f"{node.name}:order",
+                        message=(f"{node.name!r}: watch_compiles is INSIDE "
+                                 "the jit decorator — list it above jax.jit "
+                                 "so it wraps the jit cache, not the plain "
+                                 "function"),
+                        sup_lines=def_sup_lines(node)))
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+                value = node.value
+                if value is None:
+                    continue
+                if _is_watch_wrapped(value):
+                    continue
+                if _is_jitted_callable(value) and id(value) not in invoked:
+                    target = (node.targets[0] if isinstance(node, ast.Assign)
+                              else node.target)
+                    name = dotted(target) or ast.dump(target)[:40]
+                    findings.append(Finding(
+                        checker=ID, path=ctx.rel, line=value.lineno,
+                        key=name,
+                        message=(f"stored jitted callable {name!r} is not "
+                                 "wrapped by watch_compiles(site) — wrap "
+                                 "the assignment: "
+                                 "watch_compiles(site)(jax.jit(...))"),
+                        sup_lines=(value.lineno, value.lineno - 1,
+                                   node.lineno, node.lineno - 1)))
+    return findings

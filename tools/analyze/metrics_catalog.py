@@ -1,0 +1,69 @@
+"""metrics-catalog: ``tools/metrics_lint.py`` folded into the firewall.
+
+The metric-name lint predates the suite (PR 4) and keeps its standalone
+entry point (``python tools/metrics_lint.py``) — tests and operators call
+it directly. This wrapper runs the same three gates under the suite's
+finding/suppression model so one command covers every contract:
+
+- name-kind collisions (a counter and a gauge sharing a name shadow each
+  other in the snapshot and fight over the Prometheus ``# TYPE`` line);
+- PINNED names (external dashboard/bench contracts) present with the
+  pinned kind;
+- two-way OBSERVABILITY.md catalog sync: registered-but-undocumented,
+  documented-but-gone, pinned-but-undocumented, wrong-type rows.
+
+Keys are the metric name (or catalog pattern) — stable across edits, so a
+baseline entry survives unrelated line churn.
+"""
+
+from __future__ import annotations
+
+from .core import Finding, RepoCtx, load_metrics_lint as _lint
+
+ID = "metrics-catalog"
+
+
+def check(repo: RepoCtx) -> list[Finding]:
+    ml = _lint()
+    reg = ml.scan_source(repo.package_root)
+    findings: list[Finding] = []
+
+    def _site(name: str) -> tuple[str, int]:
+        """First registration site of a metric name -> (rel path, line)."""
+        kinds = reg.get(name)
+        if not kinds:
+            return "tools/metrics_lint.py", 1
+        site = sorted(next(iter(sorted(kinds.items())))[1])[0]
+        path, _, line = site.rpartition(":")
+        return f"tpu_voice_agent/{path}", int(line) if line.isdigit() else 1
+
+    for name, kinds in ml.find_collisions(reg):
+        path, line = _site(name)
+        sites = "; ".join(f"{k}: {', '.join(v)}" for k, v in sorted(kinds.items()))
+        findings.append(Finding(
+            checker=ID, path=path, line=line, key=f"collision:{name}",
+            message=f"metric {name!r} registered under multiple kinds ({sites})"))
+    for p in ml.check_pinned(reg):
+        name = p.split("'")[1] if "'" in p else p
+        path, line = _site(name)
+        findings.append(Finding(checker=ID, path=path, line=line,
+                                key=f"pin:{name}", message=p))
+
+    catalog_path = repo.repo_root / "docs" / "OBSERVABILITY.md"
+    if catalog_path.is_file():
+        catalog = ml.parse_catalog(catalog_path.read_text())
+        for p in ml.check_catalog(reg, catalog):
+            name = p.split("'")[1] if "'" in p else p
+            if "stale doc row" in p or "is documented as" in p:
+                path, line = "docs/OBSERVABILITY.md", catalog.get(name, (None, 1))[1]
+            else:
+                path, line = _site(name)
+            findings.append(Finding(checker=ID, path=path, line=line,
+                                    key=f"catalog:{name}", message=p))
+    else:
+        findings.append(Finding(
+            checker=ID, path="docs/OBSERVABILITY.md", line=1,
+            key="catalog:missing",
+            message="docs/OBSERVABILITY.md does not exist — the metric "
+                    "catalog is the operator contract"))
+    return findings

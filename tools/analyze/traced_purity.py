@@ -1,0 +1,166 @@
+"""traced-purity: no host nondeterminism inside traced functions.
+
+``jax.jit`` / ``lax.scan`` / ``lax.while_loop`` run the Python body ONCE,
+at trace time; a ``time.time()``, ``os.environ`` read, ``np.random``
+draw or ``print`` inside one does not do what it looks like — it bakes a
+single trace-time value into the compiled program (or silently prints
+once per compile, never per step). That is exactly the class of bug the
+chaos layer's determinism contract exists to prevent: the serving plane
+must replay byte-identically under a fixed seed, and a hidden host read
+inside a traced body breaks it in a way no test that doesn't re-trace
+will ever see.
+
+A function counts as traced when it is:
+- decorated with the jit family (``@jax.jit``, ``@partial(jax.jit, ..)``);
+- passed by name or as an inline ``lambda`` to ``jax.jit(...)`` or to a
+  ``lax`` control-flow combinator (``scan``, ``while_loop``, ``fori_loop``,
+  ``cond``, ``switch``, ``map``, ``associative_scan``) — name references
+  resolve to defs in the same module.
+
+Flagged inside a traced body: ``time.time/monotonic/perf_counter*``,
+``os.environ`` / ``os.getenv`` reads, ``np.random.*`` /
+``numpy.random.*`` / ``random.*`` draws, ``datetime.now/utcnow``, and
+builtin ``print`` (``jax.debug.print`` is the traced-safe spelling and is
+not flagged).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (PARTIAL_NAMES as _PARTIAL_NAMES, Finding, RepoCtx,
+                   decorator_is_jit as _decorator_is_jit, def_sup_lines,
+                   dotted, is_jit_factory as _is_jit_factory,
+                   is_jit_ref as _is_jit_ref)
+
+ID = "traced-purity"
+
+_LAX_COMBINATORS = {"scan", "while_loop", "fori_loop", "cond", "switch",
+                    "map", "associative_scan"}
+# which argument positions of each combinator take traced callables
+_LAX_FN_ARGS = {"scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+                "cond": (1, 2), "switch": (1, 2, 3, 4, 5), "map": (0,),
+                "associative_scan": (0,)}
+
+_TIME_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+               "time.perf_counter_ns", "time.time_ns", "time.monotonic_ns"}
+_DATETIME_CALLS = {"datetime.now", "datetime.utcnow", "datetime.datetime.now",
+                   "datetime.datetime.utcnow"}
+
+
+def _lax_combinator(call: ast.Call) -> str | None:
+    fn = dotted(call.func)
+    if not fn:
+        return None
+    parts = fn.split(".")
+    if parts[-1] in _LAX_COMBINATORS and (
+            len(parts) == 1 or parts[-2] in ("lax", "jax")):
+        # `lax.scan`, `jax.lax.scan`; bare `scan` only if imported from lax
+        # is too ambiguous — require the lax/jax prefix
+        return parts[-1] if len(parts) > 1 else None
+    return None
+
+
+def _purity_violation(call: ast.Call) -> str | None:
+    fn = dotted(call.func)
+    if fn in _TIME_CALLS:
+        return f"{fn}() inside a traced function is frozen at trace time"
+    if fn in _DATETIME_CALLS:
+        return f"{fn}() inside a traced function is frozen at trace time"
+    if fn in ("os.getenv", "os.environ.get", "environ.get"):
+        return (f"{fn}(...) inside a traced function reads the env ONCE at "
+                "trace time — hoist it to a static arg")
+    if fn.startswith(("np.random.", "numpy.random.")):
+        return (f"{fn}(...) inside a traced function draws host randomness "
+                "at trace time — use jax.random with an explicit key")
+    if fn.startswith("random.") and fn.count(".") == 1:
+        return (f"{fn}(...) inside a traced function draws host randomness "
+                "at trace time — use jax.random with an explicit key")
+    if fn == "print":
+        return ("print() inside a traced function fires once per COMPILE, "
+                "not per step — use jax.debug.print")
+    return None
+
+
+def _subscript_violation(node: ast.Subscript) -> str | None:
+    if dotted(node.value) in ("os.environ", "environ"):
+        return ("os.environ[...] inside a traced function reads the env "
+                "ONCE at trace time")
+    return None
+
+
+class _Module:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.defs: dict[str, list[ast.AST]] = {}
+        self.traced: list[tuple[ast.AST, str]] = []  # (fn node, why)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+    def collect(self) -> None:
+        seen: set[int] = set()
+
+        def add(fn_node: ast.AST, why: str) -> None:
+            if id(fn_node) not in seen:
+                seen.add(id(fn_node))
+                self.traced.append((fn_node, why))
+
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_decorator_is_jit(d) for d in node.decorator_list):
+                    add(node, f"@jit def {node.name}")
+            elif isinstance(node, ast.Call):
+                comb = _lax_combinator(node)
+                if comb is not None:
+                    for pos in _LAX_FN_ARGS.get(comb, ()):
+                        if pos < len(node.args):
+                            self._resolve(node.args[pos], f"lax.{comb}", add)
+                elif _is_jit_ref(node.func) or _is_jit_factory(node.func):
+                    if node.args:
+                        self._resolve(node.args[0], "jax.jit(...)", add)
+
+    def _resolve(self, arg: ast.AST, why: str, add) -> None:
+        if isinstance(arg, ast.Lambda):
+            add(arg, f"lambda passed to {why}")
+        elif isinstance(arg, ast.Name):
+            for d in self.defs.get(arg.id, ()):
+                add(d, f"{d.name} passed to {why}")
+        elif isinstance(arg, ast.Call) and dotted(arg.func) in _PARTIAL_NAMES \
+                and arg.args:
+            self._resolve(arg.args[0], why, add)
+
+
+def check(repo: RepoCtx) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in repo.package_files():
+        if ctx.tree is None:
+            continue
+        mod = _Module(ctx)
+        mod.collect()
+        for fn_node, why in mod.traced:
+            name = getattr(fn_node, "name", "<lambda>")
+            counts: dict[str, int] = {}
+            for node in ast.walk(fn_node):
+                msg = None
+                if isinstance(node, ast.Call):
+                    msg = _purity_violation(node)
+                    sym = dotted(node.func)
+                elif isinstance(node, ast.Subscript):
+                    msg = _subscript_violation(node)
+                    sym = "os.environ[]"
+                if msg is None:
+                    continue
+                base = f"{name}:{sym}"
+                n = counts.get(base, 0)
+                counts[base] = n + 1
+                sup = (node.lineno, node.lineno - 1)
+                if isinstance(fn_node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    sup = sup + def_sup_lines(fn_node)
+                findings.append(Finding(
+                    checker=ID, path=ctx.rel, line=node.lineno,
+                    key=base if n == 0 else f"{base}#{n}",
+                    message=f"{msg} (traced via {why})",
+                    sup_lines=sup))
+    return findings

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Metric-name collision lint.
+"""Metric-name collision lint + OBSERVABILITY.md catalog sync.
 
 One name must map to one metric type: a counter named ``x`` and a gauge
 named ``x`` registered from two call sites would silently shadow each other
@@ -9,12 +9,21 @@ every ``inc(...)`` / ``set_gauge(...)`` / ``observe_ms(...)`` registration
 (f-string name templates are normalized: ``{expr}`` -> ``*``) and fails on
 any name registered under more than one kind.
 
+Since ISSUE 11 it is also the two-way catalog sync: every registered name
+must appear in the docs/OBSERVABILITY.md metric catalog (with a matching
+type where the row declares one), every catalog row must still match a
+registered name, and every PINNED name must be documented — so the source,
+the pin table, and the operator-facing catalog cannot drift apart. Catalog
+rows may use ``<placeholder>`` segments for f-string name families
+(``resilience.<dep>.breaker_state`` ↔ ``resilience.{name}.breaker_state``).
+
 The runtime half lives in ``Metrics.collisions()`` (kind tracking at
 registration time); this static half catches collisions between code paths
 no single test executes together. Wired into tier-1 via
-tests/test_observability.py; also runnable standalone:
+tests/test_observability.py and into ``python -m tools.analyze``
+(metrics-catalog checker); also runnable standalone:
 
-    python tools/metrics_lint.py [root_dir]
+    python tools/metrics_lint.py [root_dir [catalog.md]]
 """
 
 from __future__ import annotations
@@ -151,6 +160,142 @@ def _normalize(name: str, is_fstring: bool) -> str:
     return _PLACEHOLDER.sub("*", name) if is_fstring else name
 
 
+# ------------------------------------------------------------- catalog sync
+
+DEFAULT_CATALOG = pathlib.Path(__file__).resolve().parents[1] / "docs" / "OBSERVABILITY.md"
+
+# catalog tables are recognized by a header row whose first cell starts
+# with `name`; the first cell of each row carries the metric names in
+# backticks (`a.b` / `c` shorthand inherits the first name's prefix,
+# `→ `prom_name`` arrow targets are display-only, `<x>` placeholders are
+# f-string wildcards)
+_CAT_HEADER = re.compile(r"^\|\s*name\b", re.IGNORECASE)
+_ARROW_TARGET = re.compile(r"(?:→|->)\s*`[^`]+`")
+_CAT_TOKEN = re.compile(r"`([^`]+)`")
+_ANGLE = re.compile(r"<[^<>]+>")
+
+
+def iter_table_rows(text: str, header_re: re.Pattern):
+    """(line_no, cells) for every data row of markdown tables whose header
+    row matches ``header_re``; separator rows skipped. Shared by this
+    module's catalog parser and the env-knob checker's table walker."""
+    in_table = False
+    for i, line in enumerate(text.splitlines(), 1):
+        if header_re.match(line):
+            in_table = True
+            continue
+        if not line.startswith("|"):
+            in_table = False
+            continue
+        if not in_table or set(line.replace("|", "").strip()) <= {"-", ":", " "}:
+            continue
+        yield i, line.split("|")
+
+
+def parse_catalog(text: str) -> dict[str, tuple[str | None, int]]:
+    """OBSERVABILITY.md -> {normalized name pattern: (type | None, line)}.
+
+    Only rows of tables whose header's first cell is ``name...`` count.
+    The second cell, when it is exactly a metric kind, pins the type."""
+    out: dict[str, tuple[str | None, int]] = {}
+    for i, cells in iter_table_rows(text, _CAT_HEADER):
+        if len(cells) < 3:
+            continue
+        first = _ARROW_TARGET.sub("", cells[1])
+        kind_cell = cells[2].strip().lower()
+        kind = kind_cell if kind_cell in ("counter", "gauge", "histogram") else None
+        prefix = None
+        for tok in _CAT_TOKEN.findall(first):
+            tok = _ANGLE.sub("*", tok.strip().rstrip(".,;…"))
+            if not re.fullmatch(r"[a-z0-9_*][a-z0-9_.*]*", tok):
+                continue
+            if "." in tok:
+                prefix = tok.rsplit(".", 1)[0] + "."
+            elif prefix is not None:
+                tok = prefix + tok
+            else:
+                continue  # bare token before any dotted name: not a metric
+            out.setdefault(tok, (kind, i))
+    return out
+
+
+def _rx(p: str) -> str:
+    return "".join(".+" if c == "*" else re.escape(c) for c in p)
+
+
+def _covers(pattern: str, name: str) -> bool:
+    """True when a ``*``-wildcarded pattern and a (possibly wildcarded)
+    registered name describe the same metric family. ``*`` on either side
+    matches one or more characters."""
+    return bool(pattern == name or re.fullmatch(_rx(pattern), name)
+                or re.fullmatch(_rx(name), pattern))
+
+
+def _pattern_covers(pattern: str, name: str) -> bool:
+    """Directional: the doc pattern describes THIS registered name (not
+    merely some member of a wildcard family the name denotes). Only then
+    is the row's declared type binding — a generic registered family like
+    the tracer's ``{service}.{span}`` histogram matches many specific
+    rows without being described by them."""
+    return bool(pattern == name or re.fullmatch(_rx(pattern), name))
+
+
+def check_catalog(reg: dict[str, dict[str, list[str]]],
+                  catalog: dict[str, tuple[str | None, int]]) -> list[str]:
+    """Two-way drift: registered-but-undocumented, documented-but-gone,
+    PINNED-but-undocumented, and documented-with-the-wrong-type."""
+    problems: list[str] = []
+    pats = list(catalog)
+    for name, kinds in sorted(reg.items()):
+        hits = [p for p in pats if _covers(p, name)]
+        if not hits:
+            sites = next(iter(kinds.values()))
+            problems.append(
+                f"registered metric {name!r} ({'/'.join(sorted(kinds))}, "
+                f"e.g. {sites[0]}) is not in the OBSERVABILITY.md catalog")
+            continue
+        # specificity: an exact row beats a `<x>`-wildcard family row for
+        # the type claim (`engine.step.<stage>` histogram must not bind
+        # the separately-documented `engine.step.occupancy` gauge)
+        exact = [p for p in hits if p == name]
+        for p in exact or hits:
+            want = catalog[p][0]
+            if want is not None and _pattern_covers(p, name) \
+                    and list(kinds) != [want]:
+                problems.append(
+                    f"metric {name!r} is documented as a {want} "
+                    f"(catalog line {catalog[p][1]}) but registers as "
+                    f"{sorted(kinds)}")
+    def _witnessed(p: str, kind: str | None) -> bool:
+        """A doc row is alive when a registered name vouches for it. A
+        registered UNIVERSAL family (all-wildcard segments, e.g. the
+        tracer's ``{service}.{span}`` → ``*.*``) matches every dotted
+        string, which would make stale-row detection vacuous — so such a
+        family only vouches for rows declaring its own kind (a histogram
+        span row), never for typed rows of another kind or untyped ones."""
+        for name, kinds in reg.items():
+            if not _covers(p, name):
+                continue
+            if _pattern_covers(p, name) or any(
+                    c.isalnum() for c in name.replace("*", "")):
+                return True
+            if kind is not None and list(kinds) == [kind]:
+                return True
+        return False
+
+    for p, (kind, line) in sorted(catalog.items()):
+        if not _witnessed(p, kind):
+            problems.append(
+                f"catalog entry {p!r} (OBSERVABILITY.md line {line}) matches "
+                "no registered metric — stale doc row")
+    for name in sorted(PINNED):
+        if not any(_covers(p, name) for p in pats):
+            problems.append(
+                f"pinned metric {name!r} is not in the OBSERVABILITY.md "
+                "catalog")
+    return problems
+
+
 def scan_source(root: pathlib.Path) -> dict[str, dict[str, list[str]]]:
     """name -> kind -> [file:line, ...] over every .py under root."""
     reg: dict[str, dict[str, list[str]]] = {}
@@ -178,13 +323,20 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     root = pathlib.Path(argv[0]) if argv else \
         pathlib.Path(__file__).resolve().parents[1] / "tpu_voice_agent"
+    catalog_path = pathlib.Path(argv[1]) if len(argv) > 1 else DEFAULT_CATALOG
     reg = scan_source(root)
     collisions = find_collisions(reg)
     pin_problems = check_pinned(reg)
+    catalog_problems = []
+    if catalog_path.is_file():
+        catalog = parse_catalog(catalog_path.read_text())
+        catalog_problems = check_catalog(reg, catalog)
+        print(f"[metrics-lint] catalog: {len(catalog)} documented name "
+              f"patterns in {catalog_path.name}")
     print(f"[metrics-lint] {len(reg)} distinct metric names under {root}")
-    if not collisions and not pin_problems:
+    if not collisions and not pin_problems and not catalog_problems:
         print("[metrics-lint] ok — no name registered under more than one type; "
-              f"{len(PINNED)} pinned names present")
+              f"{len(PINNED)} pinned names present; catalog in sync")
         return 0
     for name, kinds in collisions:
         print(f"[metrics-lint] COLLISION {name!r}:")
@@ -193,6 +345,8 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"  {kind:<9} {site}")
     for p in pin_problems:
         print(f"[metrics-lint] PIN {p}")
+    for p in catalog_problems:
+        print(f"[metrics-lint] CATALOG {p}")
     return 1
 
 

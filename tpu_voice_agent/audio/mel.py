@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compilewatch import watch_compiles
+
 
 @dataclass(frozen=True)
 class MelConfig:
@@ -84,6 +86,7 @@ def _dft_matrices(cfg: MelConfig) -> tuple[np.ndarray, np.ndarray]:
     return cos_m, sin_m
 
 
+@watch_compiles("audio.log_mel_spectrogram")
 @partial(jax.jit, static_argnames=("cfg",))
 def log_mel_spectrogram(audio: jax.Array, cfg: MelConfig = MelConfig()) -> jax.Array:
     """audio (n_samples,) float32 in [-1, 1] -> (n_frames, n_mels) float32.

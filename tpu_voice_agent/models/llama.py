@@ -25,6 +25,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..utils.compilewatch import watch_compiles
+
 # ---------------------------------------------------------------- config
 
 
@@ -411,6 +413,7 @@ def _layer_out(p, x, attn, cfg: LlamaConfig, cs=_identity_cs):
 # ---------------------------------------------------------------- forward
 
 
+@watch_compiles("llama.forward")
 @partial(jax.jit, static_argnames=("cfg", "rules", "remat", "attn_impl", "fresh_block", "unroll"))
 def forward(
     params: dict,
@@ -523,6 +526,7 @@ def forward(
     return logits, {"k": new_k, "v": new_v}
 
 
+@watch_compiles("llama.forward_paged")
 @partial(jax.jit, static_argnames=("cfg", "rules", "attn_impl", "fresh_block",
                                    "gather_blocks"),
          donate_argnames=("k_pool", "v_pool"))

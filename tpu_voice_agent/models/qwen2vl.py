@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compilewatch import watch_compiles
 from .llama import rms_norm
 from .whisper import layer_norm
 
@@ -268,6 +269,7 @@ def patchify(cfg: VisionConfig, images: jax.Array) -> jax.Array:
     return x.reshape(B, g * g, p * p * 3)
 
 
+@watch_compiles("qwen2vl.vision_forward")
 @partial(jax.jit, static_argnames=("cfg", "rules"))
 def vision_forward(params: dict, cfg: VisionConfig, images: jax.Array, rules=None) -> jax.Array:
     """(B, H, W, 3) -> merged vision embeds (B, n_tokens, out_dim)."""
@@ -376,6 +378,7 @@ def _apply_rope3(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
 
 
+@watch_compiles("qwen2vl.forward_embeds")
 @partial(jax.jit, static_argnames=("cfg", "rules"))
 def forward_embeds(
     params: dict,

@@ -282,6 +282,7 @@ def compute_cross_kv(params: dict, cfg: WhisperConfig, enc_out: jax.Array, rules
     return {"k": ks, "v": vs}  # (L, B, T_enc, nh, hd)
 
 
+# analyze: ok[jit-sentinel] -- traced inline by the watched stt._stt_decode_loop; host-dispatched only in offline distill training
 @partial(jax.jit, static_argnames=("cfg", "rules", "attn_impl"))
 def decoder_forward(
     params: dict,

@@ -56,6 +56,7 @@ def _gmm_kernel(gid_ref, x_ref, w_ref, o_ref, acc_ref):
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
+# analyze: ok[jit-sentinel] -- kernel wrapper traced inline by the watched engine/stt loops, never a serving dispatch entry point
 @functools.partial(jax.jit, static_argnames=("tm", "tn", "tk", "interpret"))
 def grouped_matmul(
     x: jax.Array,  # (M, d) rows, expert-sorted and tile-padded

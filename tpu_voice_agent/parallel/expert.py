@@ -104,6 +104,7 @@ def _expert_ffn(p: dict, xe: jax.Array) -> jax.Array:
                       preferred_element_type=jnp.float32).astype(xe.dtype)
 
 
+# analyze: ok[jit-sentinel] -- MoE FFN traced inline by llama.forward's watched layer stack; jitted standalone only for unit tests
 @partial(jax.jit, static_argnames=("cfg",))
 def moe_ffn(params: dict, cfg: MoEConfig, x: jax.Array) -> jax.Array:
     """Single-device reference. x (T, d) -> (T, d)."""

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.llama import (
     LlamaConfig, _layer_out, _layer_qkv, _qe, rms_norm, rope_tables,
 )
+from ..utils.compilewatch import watch_compiles
 from .ring import ring_attention
 
 
@@ -44,6 +45,7 @@ def sp_pad_len(n: int, sp: int, multiple: int = 1) -> int:
     return -(-max(n, 1) // q) * q
 
 
+@watch_compiles("longctx.llama_sp_prefill")
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
 def llama_sp_prefill(
     params: dict,

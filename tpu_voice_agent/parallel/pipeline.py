@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..utils.compilewatch import watch_compiles
 from ..utils.jaxcompat import shard_map  # jax.shard_map, gated for old jax
 
 from ..models.llama import (
@@ -129,10 +130,12 @@ def init_pp_cache(cfg: LlamaConfig, mesh: Mesh, batch: int, max_len: int,
         raise ValueError(f"n_layers ({cfg.n_layers}) must divide into {S} stages")
     shape = (S, cfg.n_layers // S, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     sh = NamedSharding(mesh, P("pp", None, None, None, None, None))
+    # analyze: ok[jit-sentinel] -- one-shot cache-init compile at construction time, not a serving dispatch the fence could catch
     z = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sh)
     return {"k": z(), "v": z()}
 
 
+@watch_compiles("pipeline.llama_pp_forward_cached")
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("staged_cache",))
 def llama_pp_forward_cached(
     params: dict,
@@ -367,9 +370,9 @@ def pp_tp_forward_cached(
     return logits, {"k": ck, "v": cv}
 
 
-llama_pp_tp_forward_cached = partial(
+llama_pp_tp_forward_cached = watch_compiles("pipeline.llama_pp_tp_forward_cached")(partial(
     jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("staged_cache",)
-)(pp_tp_forward_cached)
+)(pp_tp_forward_cached))
 
 
 def init_pp_tp_cache(cfg: LlamaConfig, mesh: Mesh, batch: int, max_len: int,
@@ -381,10 +384,12 @@ def init_pp_tp_cache(cfg: LlamaConfig, mesh: Mesh, batch: int, max_len: int,
         raise ValueError(f"n_layers ({cfg.n_layers}) must divide into {S} stages")
     shape = (S, cfg.n_layers // S, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     sh = NamedSharding(mesh, P("pp", None, None, None, "tp", None))
+    # analyze: ok[jit-sentinel] -- one-shot cache-init compile at construction time, not a serving dispatch the fence could catch
     z = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sh)
     return {"k": z(), "v": z()}
 
 
+@watch_compiles("pipeline.llama_pp_forward")
 @partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"))
 def llama_pp_forward(
     params: dict,

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..grammar.fsm import TokenFSM
+from ..utils.compilewatch import watch_compiles
 from ..grammar.regexlang import compile_regex
 from ..grammar.tokenizer import BOS_ID, EOS_ID, PAD_ID, Tokenizer
 from ..models.qwen2vl import (
@@ -122,6 +123,7 @@ def letterbox(image: np.ndarray, size: int) -> tuple[np.ndarray, float, int, int
     return out, scale, pad_x, pad_y
 
 
+@watch_compiles("grounding._ground_decode_loop")
 @partial(jax.jit, static_argnames=("cfg", "max_new", "eos_id"))
 def _ground_decode_loop(params, cfg: Qwen2VLConfig, cache, token0, slot0, pos_start,
                         state0, mask_table, next_table, max_new: int,

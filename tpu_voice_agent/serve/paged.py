@@ -431,6 +431,7 @@ class PagedDecodeEngine(DecodeEngine):
             from ..parallel.mesh import paged_pool_shardings
 
             sh = paged_pool_shardings(self.mesh, nkv)
+            # analyze: ok[jit-sentinel] -- one-shot cache-init compile at construction time, not a serving dispatch the fence could catch
             z = jax.jit(partial(jnp.zeros, shape, jnp.bfloat16), out_shardings=sh)
             self.k_pool, self.v_pool = z(), z()
         else:

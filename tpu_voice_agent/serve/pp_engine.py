@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.llama import LlamaConfig, init_params, quantize_leaf as _quant_leaf
+from ..utils.compilewatch import watch_compiles
 from ..parallel.pipeline import (
     init_pp_tp_cache,
     pp_tp_forward_cached,
@@ -49,6 +50,7 @@ def _pp_fwd(params, cache, tokens, positions, *, cfg, mesh):
     return pp_tp_forward_cached(params, cache, cfg, tokens, positions, mesh)
 
 
+@watch_compiles("pp_engine.pp_prefill_row")
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("cache",))
 def pp_prefill_row(params, cache, cfg: LlamaConfig, tokens, positions, slot, mesh):
     """Admission prefill for ONE batch row of the staged cache (axis 2)."""
@@ -62,6 +64,7 @@ def pp_prefill_row(params, cache, cfg: LlamaConfig, tokens, positions, slot, mes
     }
 
 
+@watch_compiles("pp_engine.pp_prefill_row_with_prefix")
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("cache",))
 def pp_prefill_row_with_prefix(params, cache, cfg: LlamaConfig, prefix_k,
                                prefix_v, tokens, positions, slot, mesh):

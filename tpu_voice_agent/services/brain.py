@@ -1270,7 +1270,7 @@ def make_parser_from_env() -> IntentParser:
         # 031554.json) — the wide (B, 1+W) step multiplies the per-stage
         # fill-drain bubble where the dense/paged layouts ride it free.
         # CPU measured the opposite (+14%), so the knob stays available.
-        ppff = int(os.environ.get("BRAIN_FF", "0"))
+        ppff = int(os.environ.get("BRAIN_FF", "0"))  # analyze: ok[env-knob] -- deliberate per-backend default: ff measured HURTING the staged pp layout (see comment above); every other backend keeps the declared default 8
         # spec passes THROUGH: the engine refuses it with a clear typed
         # error (no rollback story on the staged cache) instead of the old
         # warn+ignore — an operator who set SPEC_ENABLE on the pp backend

@@ -230,6 +230,7 @@ class BrainRouter:
         servable, else rendezvous placement over the admitting set (which
         IS the deterministic next-highest-weight re-home when the old home
         left the ring). Counts every forced move."""
+        # atomic-section: router.route -- session-table read+mutate must be one event-loop step: an await between the sticky lookup and the re-home write lets a racing request route the same session elsewhere
         if session_id:
             prev_url = self._sessions.get(session_id)
             if prev_url is not None and prev_url not in exclude:
@@ -248,10 +249,12 @@ class BrainRouter:
             self._sessions.move_to_end(session_id)
             while len(self._sessions) > self.max_sessions:
                 self._sessions.popitem(last=False)
+        # end-atomic-section
         return home
 
     # ------------------------------------------------------------- drain
 
+    # atomic-section: router.ring-state -- replica state transitions (up/draining/drained) and the health gauge must commit atomically: a suspension mid-transition exposes a half-drained ring to concurrent route() calls
     def start_drain(self, replica: Replica) -> bool:
         """Stop placing new sessions on ``replica``; existing sessions keep
         hitting it until in-flight reaches zero, then it is ejected."""
@@ -275,6 +278,7 @@ class BrainRouter:
         replica.probe_fails = 0
         replica.drain_latched = False
         self._update_health_gauge()
+    # end-atomic-section
 
     # ------------------------------------------------------------ probing
 
@@ -295,6 +299,7 @@ class BrainRouter:
             ok = resp.status_code == 200 and bool(body.get("ok", True))
         except (httpx.HTTPError, OSError, ValueError, asyncio.TimeoutError):
             ok, body = False, None
+        # atomic-section: router.probe-verdict -- the eject/rejoin/drain-latch state machine runs after the probe await resolves and must not suspend again: route() must never observe a replica between two of these transitions
         if ok:
             r.probe_fails = 0
             r.last_health = body
@@ -331,6 +336,7 @@ class BrainRouter:
                 logging.getLogger("tpu_voice_agent.router").warning(
                     "replica %s ejected after %d failed probes",
                     r.url, r.probe_fails)
+        # end-atomic-section
 
     async def _probe_loop(self) -> None:
         while True:
@@ -357,8 +363,10 @@ class BrainRouter:
                          DEADLINE_HEADER: deadline.header_value()},
                 timeout=max(0.05, deadline.remaining_s()))
         finally:
+            # atomic-section: router.inflight-release -- the inflight decrement and the drain-completion check must be one step: a suspension between them can eject a draining replica while this request still counts against it
             replica.inflight -= 1
             self._maybe_finish_drain(replica)
+            # end-atomic-section
 
     async def _guarded(self, replica: Replica, raw: bytes, headers: dict,
                        deadline: Deadline, budget_s: float):
@@ -392,7 +400,7 @@ class BrainRouter:
             task.cancel()  # our caller was torn down: drop the forward too
             raise
         try:
-            resp = task.result()
+            resp = task.result()  # analyze: ok[async-blocking] -- asyncio.Task just surfaced in asyncio.wait's done set — .result() is a non-blocking readback
         except asyncio.CancelledError:
             replica.breaker.record_failure()
             raise ReplicaFailed(f"{replica.url}: forward cancelled")
@@ -439,7 +447,8 @@ class BrainRouter:
         done, _ = await asyncio.wait({primary},
                                      timeout=self.hedge_ms / 1e3)
         if done:
-            return primary.result(), home, False  # may raise ReplicaFailed
+            # analyze: ok[async-blocking] -- asyncio.Task just surfaced in asyncio.wait's done set — .result() is a non-blocking readback (may raise ReplicaFailed)
+            return primary.result(), home, False
         alt = self._pick(session_id, exclude={home.url})
         if alt is None:
             return await primary, home, False
@@ -458,7 +467,7 @@ class BrainRouter:
                     pending, return_when=asyncio.FIRST_COMPLETED)
                 for t in done:
                     try:
-                        resp = t.result()
+                        resp = t.result()  # analyze: ok[async-blocking] -- asyncio.Task just surfaced in asyncio.wait's done set — .result() is a non-blocking readback
                     except ReplicaFailed as e:
                         last_exc = e
                         continue

@@ -589,6 +589,7 @@ def train_intent_model(
     optimizer = optax.adamw(sched, weight_decay=0.01)
     opt_state = optimizer.init(params)
 
+    # analyze: ok[jit-sentinel] -- offline training step, not a serving dispatch — the recompile sentinel guards the serving plane
     @jax.jit
     def step_fn(params, opt_state, tokens, targets, loss_mask):
         loss, grads = jax.value_and_grad(loss_fn_targets)(
@@ -766,6 +767,7 @@ def train_draft_from_trace(path: str, steps: int = 400, batch: int = 8,
     optimizer = optax.adamw(sched, weight_decay=0.01)
     opt_state = optimizer.init(params)
 
+    # analyze: ok[jit-sentinel] -- offline training step, not a serving dispatch — the recompile sentinel guards the serving plane
     @jax.jit
     def step_fn(params, opt_state, tokens, targets, loss_mask):
         loss, grads = jax.value_and_grad(loss_fn_targets)(
@@ -924,6 +926,7 @@ def train_whisper_generalize(
 
     # ---- precompute augmented mel variants (the mel front-end is fixed;
     # only the waveforms vary). R = n_sentences * variants rows.
+    # analyze: ok[jit-sentinel] -- offline training-data mel precompute, not a serving dispatch
     mel_fn = jax.jit(partial(log_mel_spectrogram, cfg=mel_cfg))
     rows_mel, rows_valid, rows_sent = [], [], []
     for si, text in enumerate(texts):
@@ -997,6 +1000,7 @@ def train_whisper_generalize(
         m = mask_j[:, 1:]
         return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
 
+    # analyze: ok[jit-sentinel] -- offline training step, not a serving dispatch — the recompile sentinel guards the serving plane
     @jax.jit
     def step_fn(params, opt_state, mel_j, valid_j, toks_j, mask_j, key):
         loss, grads = jax.value_and_grad(loss_fn)(
@@ -1102,6 +1106,7 @@ def train_whisper_overfit(
         m = mask_j[:, 1:]
         return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
 
+    # analyze: ok[jit-sentinel] -- offline training step, not a serving dispatch — the recompile sentinel guards the serving plane
     @jax.jit
     def step_fn(params, opt_state):
         loss, grads = jax.value_and_grad(loss_fn)(params)

@@ -262,6 +262,7 @@ def train_grounding(
         nll = -jnp.take_along_axis(logp, toks_j[..., None], axis=-1)[..., 0]
         return jnp.sum(nll * mask_j) / jnp.maximum(jnp.sum(mask_j), 1.0)
 
+    # analyze: ok[jit-sentinel] -- offline training step, not a serving dispatch — the recompile sentinel guards the serving plane
     @jax.jit
     def step_fn(params, opt_state, img_j, toks_j, mask_j):
         loss, grads = jax.value_and_grad(loss_fn)(params, img_j, toks_j, mask_j)

@@ -1,3 +1,4 @@
+from . import knobs
 from .envcfg import load_env_cascade, env_str, env_int, env_bool
 from .tracing import (
     Span,
@@ -26,6 +27,7 @@ from .resilience import (
 )
 
 __all__ = [
+    "knobs",
     "load_env_cascade",
     "env_str",
     "env_int",

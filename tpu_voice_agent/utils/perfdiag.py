@@ -45,7 +45,13 @@ def decode_step_hlo(engine) -> str:
     cache = init_kv_cache(engine.cfg, 1, engine.max_len)
     tok = jnp.zeros((1, 1), jnp.int32)
     pos = jnp.zeros((1, 1), jnp.int32)
-    lowered = forward.lower(
+    # unwrap the compile sentinel down to a jit object: .lower lives there.
+    # Guard on hasattr, not bare __wrapped__ — jit objects expose their own
+    # __wrapped__ (the plain Python function), which has no .lower
+    fwd = forward
+    while not hasattr(fwd, "lower") and hasattr(fwd, "__wrapped__"):
+        fwd = fwd.__wrapped__
+    lowered = fwd.lower(
         engine.params, engine.cfg, tok, pos, cache, engine.rules,
         attn_impl=engine.kernels, unroll=engine.decode_unroll,
     )
