@@ -33,6 +33,15 @@ the two biggest decode multipliers stacking instead of excluding each
 other — with an in-bench token-identity gate (a wrong-but-fast verify
 plane must fail the bench, not win it).
 
+The ``kv_quant`` section (ISSUE 12) adds the KV_QUANT column — off/int8/
+int4 paged engines over the same prompts: decode p50 and tokens/forward
+per tier (honest CPU wall — quantize/dequant is visible VPU work on the
+XLA CPU backend; on-chip the win is HBM bytes), plus the portable modeled
+verdicts benchdiff gates: per-step bytes-moved speedup at matched batch
+(``utils.hbmledger.decode_step_bytes``; bar ≥ 1.5× int8) and pool
+capacity at a fixed byte budget (bar ≥ 1.9× int8 / ≥ 3.5× int4). A
+grammar-invalid stream from a lossy tier fails the bench.
+
 Knobs: BENCH_SPEC_K (default 4), BENCH_SPEC_UTTERANCES (default 6; --quick
 sets 3 via env), BENCH_SPEC_TOKENS (default 160), BENCH_SPEC_PAGED_SESSIONS
 (default 2), BENCH_SPEC_PAGED_TURNS (default 3).
@@ -264,6 +273,92 @@ def main() -> None:
     row("spec_paged_tokens_per_step", best_paged_tps, "tokens/forward",
         best_paged_tps / base_ptps if base_ptps else None)
 
+    # ------------------------------------------------------------ kv_quant
+    # The KV_QUANT column (ISSUE 12): the same paged decode workload per
+    # storage tier. Wall rows are honest CPU-harness numbers (quantize/
+    # dequant is extra VPU work the XLA CPU backend pays visibly; on-chip
+    # the win is HBM bytes) — the PORTABLE decode-stage verdict is the
+    # modeled step-bytes speedup (utils.hbmledger.decode_step_bytes, the
+    # same accounting docs/PERF.md's roofline uses: decode is HBM-bound,
+    # wall ∝ bytes moved) and the capacity multiple at a fixed pool budget.
+    from tpu_voice_agent.ops.kvquant import kv_block_bytes
+    from tpu_voice_agent.utils.hbmledger import decode_step_bytes
+
+    kvq_prompts = prompts[: min(3, len(prompts))]
+    kvq_section: dict[str, dict] = {}
+    base_p50 = base_bytes = None
+    for tier in (None, "int8", "int4"):
+        label = tier or "off"
+        # explicit "off" for the baseline: kv_quant=None falls through to
+        # the KV_QUANT env var, which would quietly quantize the bf16 rows
+        # under an operator's ambient KV_QUANT=int8
+        eng = PagedDecodeEngine(
+            preset="test-tiny", max_len=2048, batch_slots=2,
+            prefill_buckets=(512, 1024, 2048), kv_quant=tier or "off",
+            init_weights=False)
+        eng.load_params(jax.device_put(raw))
+        install_prompt_prefix(eng)
+        mk_bat = lambda e=eng: ContinuousBatcher(e, chunk_steps=16,
+                                                 max_new_tokens=max_tok)
+        mk_bat().generate_many(kvq_prompts)  # compile warmup
+        lat, toks, fwds = [], 0, 0
+        for p in kvq_prompts:
+            t1 = time.perf_counter()
+            r = mk_bat().generate_many([p])[0]
+            lat.append((time.perf_counter() - t1) * 1e3)
+            if r.error:
+                log(f"kv_quant={label} request failed: {r.error}")
+                sys.exit(1)
+            if eng.fsm.walk(r.token_ids) < 0:
+                # lossy tiers may drift token streams; escaping the grammar
+                # is the line none may cross (evals/golden.py pins it)
+                log(f"kv_quant={label} emitted a grammar-INVALID stream")
+                sys.exit(1)
+            toks += r.steps
+            fwds += r.forwards if r.forwards else r.steps
+        p50 = percentile(lat, 50)
+        cfg = eng.cfg
+        sb = decode_step_bytes(cfg, batch=2, context_tokens=1024,
+                               kv_quant=tier)
+        bpb = kv_block_bytes(cfg.n_layers, eng.block_size, cfg.n_kv_heads,
+                             cfg.head_dim, tier)
+        if tier is None:
+            base_p50, base_bytes = p50, sb["total_bytes"]
+        row(f"kvq_decode_p50_ms_{label}", p50, "ms",
+            base_p50 / p50 if (base_p50 and p50) else None)
+        row(f"kvq_tokens_per_forward_{label}", toks / fwds if fwds else 0.0,
+            "tokens/forward")
+        kvq_section[label] = {
+            "decode_p50_ms": round(p50, 1),
+            "tokens_per_forward": round(toks / fwds if fwds else 0.0, 3),
+            "step_bytes_total": sb["total_bytes"],
+            "kv_bytes_per_block": bpb,
+        }
+        if tier is not None:
+            # the decode-stage scoreboard: step-bytes speedup (bar >=
+            # 1.5x int8) modeled at THIS engine's shape — test-tiny dims,
+            # the same engine the wall rows measured, so the two rows
+            # describe one machine. Pool capacity at a fixed byte budget
+            # is computed at the FLAGSHIP serving dims instead
+            # (docs/PERF.md config, head_dim 64; bar >= 1.9x int8 /
+            # >= 3.5x int4) — test-tiny's head_dim 32 pays
+            # proportionally more scale overhead (1.88x), a toy-dims
+            # artifact the serving capacity claim must not inherit.
+            from tpu_voice_agent.models.llama import LlamaConfig
+
+            serve = LlamaConfig()
+            bytes_x = base_bytes / sb["total_bytes"]
+            cap_x = kv_block_bytes(
+                serve.n_layers, 128, serve.n_kv_heads, serve.head_dim,
+                None) / kv_block_bytes(
+                serve.n_layers, 128, serve.n_kv_heads, serve.head_dim, tier)
+            row(f"kvq_step_bytes_speedup_{label}", bytes_x, "x",
+                bytes_x / (1.5 if tier == "int8" else 2.0))
+            row(f"kvq_pool_capacity_{label}", cap_x, "x",
+                cap_x / (1.9 if tier == "int8" else 3.5))
+            kvq_section[label]["step_bytes_speedup"] = round(bytes_x, 3)
+            kvq_section[label]["pool_capacity_x"] = round(cap_x, 3)
+
     art_dir = Path(_ROOT) / "bench_artifacts"
     art_dir.mkdir(exist_ok=True)
     stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
@@ -285,6 +380,10 @@ def main() -> None:
                  "paged": paged_section,
                  "paged_tokens_per_step_best": round(best_paged_tps, 3),
                  "process_cumulative": snapshot_spec()},
+        # the KV_QUANT column (off/int8/int4): per-tier decode p50 /
+        # tokens-per-forward plus the portable modeled verdicts (step-bytes
+        # speedup, fixed-budget pool capacity) — benchdiff gates the x rows
+        "kv_quant": kvq_section,
     }, indent=1))
     log(f"artifact: {art}")
 

@@ -148,7 +148,7 @@ def main() -> None:
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
                             "spec", "stt", "radix", "swarm", "chaos",
                             "steplog", "engine_step", "xla", "hbm",
-                            "router"):
+                            "router", "kv_quant"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -128,6 +128,17 @@ PINNED: dict[str, str] = {
     "hbm.plan_total_bytes": "gauge",
     "hbm.plan_drift": "gauge",
     "hbm.drift_events": "counter",
+    # quantized paged KV + fused decode tail (ISSUE 12, ops/kvquant.py +
+    # serve/paged.py + ops/grammar_mask.py, docs/PERF.md "Quantized KV +
+    # fused decode tail"): kv_quant_bits is the active-tier dial the bench
+    # kv_quant rows and the HBM-plan drift check key on, kv_bytes_per_block
+    # the bytes-denominated capacity unit (block counts stopped being a
+    # unit of HBM when KV_QUANT halved them), fused_mask_sample_ms the
+    # dispatch-side wall of the one host-dispatched fused-tail instance —
+    # renaming any of these blinds the bench capacity/latency verdicts
+    "paged.kv_quant_bits": "gauge",
+    "paged.kv_bytes_per_block": "gauge",
+    "engine.step.fused_mask_sample_ms": "gauge",
     # replicated brain tier (ISSUE 10, services/router.py, docs/
     # RESILIENCE.md "Replica fault domain"): sessions_rehomed is the
     # observable failover cost (one cold re-prefill per forced move),

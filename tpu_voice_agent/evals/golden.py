@@ -18,6 +18,7 @@ Scoring is two-tier:
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -232,6 +233,76 @@ def score_parser_dialogs(parser, dialogs: list[GoldenDialog] | None = None,
     n = len(dialogs)
     return {"dialogs": n, "errors": errors,
             "type_accuracy": type_hits / n, "args_score": args_total / n}
+
+
+# ------------------------------------------------------ quantized-KV tiers
+
+_INTENT_TYPE = re.compile(r'"type"\s*:\s*"([a-z_]+)"')
+
+
+def intent_types(text: str) -> tuple[str, ...]:
+    """Intent-type sequence of a grammar-constrained JSON generation (the
+    grammar guarantees the shape, so a regex pull is exact)."""
+    return tuple(_INTENT_TYPE.findall(text))
+
+
+def kv_quant_differential(make_engine, cases: list[GoldenCase] | None = None,
+                          tiers: tuple[str, ...] = ("int8", "int4"),
+                          max_new_tokens: int = 96,
+                          chunk_steps: int = 16) -> dict:
+    """The lossy-KV accuracy budget (ISSUE 12 satellite): decode the golden
+    utterances' rendered prompts through the continuous batcher once per
+    KV tier and score each tier against the KV_QUANT-off baseline —
+
+    - ``token_identical``: fraction of cases whose token stream matches the
+      bf16 baseline exactly (the int8 bar);
+    - ``type_agreement``: fraction whose intent-TYPE sequence matches (the
+      int4 accuracy floor — a tier may rephrase an argument string inside
+      the grammar without changing what the executor does);
+    - ``grammar_valid``: fraction accepted by the FSM (must be 1.0 for
+      every tier — quantization noise must never escape the grammar).
+
+    ``make_engine(kv_quant)`` builds a fresh paged engine per tier (same
+    weights/seed each time — the differential is meaningless otherwise).
+    The baseline is requested as the explicit ``"off"`` tier, never None:
+    a None kv_quant falls through to the KV_QUANT env var in the engine
+    ctor, which would silently turn the bf16 baseline into the quantized
+    tier under ``KV_QUANT=int8`` and make every floor trivially 1.0.
+    Deterministic end to end: same weights + prompts => same verdict, so
+    the floors pin as a fast tier-1 test (tests/test_kv_quant.py) and
+    gate the bench kv_quant sections."""
+    from ..serve.scheduler import ContinuousBatcher
+    from ..services.prompts import render_prompt
+
+    cases = cases if cases is not None else GOLDEN_INTENT_CASES
+    prompts = [render_prompt(c.text, dict(c.context)) for c in cases]
+    runs: dict[str | None, list] = {}
+    fsm = None
+    for tier in (None, *tiers):
+        eng = make_engine(tier or "off")
+        fsm = eng.fsm
+        res = ContinuousBatcher(
+            eng, chunk_steps=chunk_steps,
+            max_new_tokens=max_new_tokens).generate_many(prompts)
+        for r in res:
+            if r.error is not None:
+                raise AssertionError(f"kv_quant={tier}: {r.error}")
+        runs[tier] = res
+    base = runs[None]
+    out = {"cases": len(cases), "tiers": {}}
+    for tier in tiers:
+        res = runs[tier]
+        n = len(cases)
+        out["tiers"][tier] = {
+            "token_identical": sum(
+                r.token_ids == b.token_ids for r, b in zip(res, base)) / n,
+            "type_agreement": sum(
+                intent_types(r.text) == intent_types(b.text)
+                for r, b in zip(res, base)) / n,
+            "grammar_valid": sum(
+                fsm.walk(r.token_ids) >= 0 for r in res) / n,
+        }
+    return out
 
 
 def score_parser(parser, cases: list[GoldenCase] | None = None) -> dict:

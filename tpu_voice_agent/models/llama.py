@@ -528,14 +528,15 @@ def forward(
 
 @watch_compiles("llama.forward_paged")
 @partial(jax.jit, static_argnames=("cfg", "rules", "attn_impl", "fresh_block",
-                                   "gather_blocks"),
-         donate_argnames=("k_pool", "v_pool"))
+                                   "gather_blocks", "kv_quant"),
+         donate_argnames=("k_pool", "v_pool", "k_scale", "v_scale"))
 def forward_paged(
     params: dict,
     cfg: LlamaConfig,
     tokens: jax.Array,  # (B, T) int32
     positions: jax.Array,  # (B, T) int32 — absolute positions of `tokens`
     k_pool: jax.Array,  # (L, N, bs, nkv, hd) — global paged KV pool
+    # (KV_QUANT on: (L, N, bs, nkv, hdp) int8 stored values, ops.kvquant)
     v_pool: jax.Array,
     block_tables: jax.Array,  # (B, max_blocks) int32 pool-block ids
     rules=None,  # parallel.ShardingRules | None — pool blocks shard over
@@ -554,20 +555,39 @@ def forward_paged(
     gather_blocks: int | None = None,  # T>1 non-fresh path: gather only the
     # first N table entries per row (the caller's covered-block bucket)
     # instead of the whole table width
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    k_scale: jax.Array | None = None,  # (L, N, bs, nkv) bf16 per-(position,
+    # head) scales when KV_QUANT is on (None keeps the bf16 path
+    # byte-identical — the scale leaves are empty pytree nodes)
+    v_scale: jax.Array | None = None,
+    kv_quant: str | None = None,  # None | "int8" | "int4" (static)
+):
     """The paged twin of ``forward`` (parity-tested): sequences own
     non-contiguous pool blocks via per-row block tables (SURVEY.md §7
     step 2's paged KV cache). KV writes scatter through the table into the
     flat pool; T=1 decode attends via the ops.paged_attention kernel
     (block-table indirection in the index map — no contiguous per-sequence
     cache ever materializes); T>1 prefill gathers the row's blocks once per
-    layer (a per-prefill cost, not per-token). Returns
-    (logits, k_pool, v_pool)."""
+    layer (a per-prefill cost, not per-token).
+
+    KV_QUANT (ISSUE 12): with ``kv_quant`` set, writes QUANTIZE in the
+    scatter (ops.kvquant: per-(position, head) bf16 scales stored
+    block-major beside the int8/int4 values, at the same flat index — so
+    sharing, rollback, and warm-restart reserve all travel with the block)
+    and every read dequantizes in place: the T=1 / block Pallas kernels
+    fold the scales into their score/probability tiles (fp KV never
+    round-trips through HBM), the XLA gather and fresh-block paths attend
+    ``dequantize_kv`` of exactly the stored values, so prefill logits match
+    what decode later reads.
+
+    Returns (logits, k_pool, v_pool, k_scale, v_scale) — the scale slots
+    are None when ``kv_quant`` is None."""
     B, T = tokens.shape
     L, N, bs = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
     nb = gather_blocks if gather_blocks is not None else block_tables.shape[1]
     S = nb * bs  # gathered context capacity
     cs = lambda x, name: rules.constrain(x, name) if rules is not None else x
+    bits = {None: 16, "int8": 8, "int4": 4}[kv_quant]
+    hdp = k_pool.shape[4]  # stored last-axis width (hd, or hd/2 packed int4)
 
     x = params["embed"][tokens]
     x = cs(x, "act")
@@ -583,69 +603,127 @@ def forward_paged(
         flat_idx = jnp.where(write_mask[:, None], flat_idx, park[:, None])
 
     def layer(carry, layer_in):
-        x, kp, vp = carry
+        x, kp, vp, ksc, vsc = carry
         p, li = layer_in
         q, k, v = _layer_qkv(p, x, cfg, cos, sin, cs)
 
-        kp_flat = kp.reshape(L, N * bs, cfg.n_kv_heads, cfg.head_dim)
-        vp_flat = vp.reshape(L, N * bs, cfg.n_kv_heads, cfg.head_dim)
-        kp = kp_flat.at[li, flat_idx].set(k.astype(kp.dtype)).reshape(kp.shape)
-        vp = vp_flat.at[li, flat_idx].set(v.astype(vp.dtype)).reshape(vp.shape)
+        kp_flat = kp.reshape(L, N * bs, cfg.n_kv_heads, hdp)
+        vp_flat = vp.reshape(L, N * bs, cfg.n_kv_heads, hdp)
+        if kv_quant is None:
+            kp = kp_flat.at[li, flat_idx].set(k.astype(kp.dtype)).reshape(kp.shape)
+            vp = vp_flat.at[li, flat_idx].set(v.astype(vp.dtype)).reshape(vp.shape)
+        else:
+            from ..ops.kvquant import quantize_kv
+
+            # quantize-on-write: one deterministic rowwise quantization at
+            # the scatter, values and their scales landing at the SAME
+            # flat index (a shared/rolled-back/reserved block carries its
+            # scales by construction)
+            qk, sk = quantize_kv(k, kv_quant)
+            qv, sv = quantize_kv(v, kv_quant)
+            kp = kp_flat.at[li, flat_idx].set(qk).reshape(kp.shape)
+            vp = vp_flat.at[li, flat_idx].set(qv).reshape(vp.shape)
+            ksc_flat = ksc.reshape(L, N * bs, cfg.n_kv_heads)
+            vsc_flat = vsc.reshape(L, N * bs, cfg.n_kv_heads)
+            ksc = ksc_flat.at[li, flat_idx].set(sk).reshape(ksc.shape)
+            vsc = vsc_flat.at[li, flat_idx].set(sv).reshape(vsc.shape)
 
         if attn_impl == "pallas" and T == 1:
-            from ..ops import sharded_paged_attention
-
             mesh = rules.mesh if rules is not None else None
-            attn = sharded_paged_attention(
-                mesh, q[:, 0], kp, vp, block_tables, frontier + 1, li
-            ).reshape(B, T, -1)
+            if kv_quant is None:
+                from ..ops import sharded_paged_attention
+
+                attn = sharded_paged_attention(
+                    mesh, q[:, 0], kp, vp, block_tables, frontier + 1, li
+                ).reshape(B, T, -1)
+            else:
+                from ..ops import sharded_paged_attention_quant
+
+                # fused dequant: the kernel scales score/probability tiles
+                # by the per-position scales — half (a quarter) of the KV
+                # bytes cross HBM and fp KV never materializes
+                attn = sharded_paged_attention_quant(
+                    mesh, q[:, 0], kp, vp, ksc, vsc, block_tables,
+                    frontier + 1, li, bits=bits,
+                ).reshape(B, T, -1)
         elif (attn_impl == "pallas" and not fresh_block
               and T <= MAX_BLOCK_DECODE_T):
-            from ..ops import sharded_paged_block_attention
-
             # small mid-sequence block (grammar fast-forward chain step):
             # the paged twin of the dense frontier-read block kernel — T
             # queries per row read the row's own pool blocks up to its own
             # positions; no per-layer table gather
             mesh = rules.mesh if rules is not None else None
-            attn = sharded_paged_block_attention(
-                mesh, q, kp, vp, block_tables, positions, li
-            ).reshape(B, T, -1)
+            if kv_quant is None:
+                from ..ops import sharded_paged_block_attention
+
+                attn = sharded_paged_block_attention(
+                    mesh, q, kp, vp, block_tables, positions, li
+                ).reshape(B, T, -1)
+            else:
+                from ..ops import sharded_paged_block_attention_quant
+
+                attn = sharded_paged_block_attention_quant(
+                    mesh, q, kp, vp, ksc, vsc, block_tables, positions, li,
+                    bits=bits,
+                ).reshape(B, T, -1)
         elif fresh_block and T > 1:
             # fresh sequence starting at position 0: attention over the
             # block's own k/v IS attention over the sequence — no pool
-            # gather at all (the scatter above still persists the KV)
+            # gather at all (the scatter above still persists the KV).
+            # Under KV_QUANT the attended values are the quantize->dequant
+            # roundtrip of the block — exactly what the pool stores and a
+            # later decode read dequantizes, so prefill logits agree with
+            # the quantized serving plane, not the fp one.
+            if kv_quant is not None:
+                from ..ops.kvquant import dequantize_kv, quantize_kv
+
+                k_at = dequantize_kv(*quantize_kv(k, kv_quant), kv_quant)
+                v_at = dequantize_kv(*quantize_kv(v, kv_quant), kv_quant)
+            else:
+                k_at = k.astype(kp.dtype)
+                v_at = v.astype(vp.dtype)
             if attn_impl == "pallas":
                 from ..ops import sharded_flash_attention
 
                 mesh = rules.mesh if rules is not None else None
-                attn = sharded_flash_attention(mesh, q, k, v, causal=True).reshape(B, T, -1)
+                attn = sharded_flash_attention(mesh, q, k_at, v_at,
+                                               causal=True).reshape(B, T, -1)
             else:
                 # attend the POOL-dtype values (what the scatter persisted
                 # and decode later reads) — raw compute-dtype k/v would
                 # break prefill parity with the dense engine's bf16 cache
-                attn = _attend(q, k.astype(kp.dtype), v.astype(vp.dtype),
+                attn = _attend(q, k_at, v_at,
                                positions, jnp.ones((B, T), dtype=bool))
         else:
             # mid-sequence prefill (prefix-cached suffix): gather the row's
             # COVERED blocks to a contiguous view once per layer
             tbl = block_tables[:, :nb]
-            kl = kp[li][tbl].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-            vl = vp[li][tbl].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+            if kv_quant is None:
+                kl = kp[li][tbl].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+                vl = vp[li][tbl].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+            else:
+                from ..ops.kvquant import dequantize_kv
+
+                kl = dequantize_kv(
+                    kp[li][tbl].reshape(B, S, cfg.n_kv_heads, hdp),
+                    ksc[li][tbl].reshape(B, S, cfg.n_kv_heads), kv_quant)
+                vl = dequantize_kv(
+                    vp[li][tbl].reshape(B, S, cfg.n_kv_heads, hdp),
+                    vsc[li][tbl].reshape(B, S, cfg.n_kv_heads), kv_quant)
             attn = _attend(q, kl, vl, positions, kv_len_mask)
         x = _layer_out(p, x, attn, cfg, cs)
-        return (x, kp, vp), None
+        return (x, kp, vp, ksc, vsc), None
 
-    (x, k_pool, v_pool), _ = jax.lax.scan(
+    (x, k_pool, v_pool, k_scale, v_scale), _ = jax.lax.scan(
         layer,
-        (x, k_pool, v_pool),
+        (x, k_pool, v_pool, k_scale, v_scale),
         (params["layers"], jnp.arange(cfg.n_layers, dtype=jnp.int32)),
     )
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = _qe("btd,dv->btv", x, params["lm_head"])
     logits = cs(logits, "logits")
-    return logits, k_pool, v_pool
+    return logits, k_pool, v_pool, k_scale, v_scale
 
 
 def param_count(cfg: LlamaConfig) -> int:

@@ -34,14 +34,41 @@ from .decode_attention import (
     sharded_decode_attention,
     sharded_decode_attention_layer,
 )
-from .grammar_mask import masked_argmax, masked_argmax_reference, sharded_masked_argmax
+from .decode_attention import (
+    decode_attention_quant,
+    decode_attention_quant_reference,
+)
+from .grammar_mask import (
+    masked_argmax,
+    masked_argmax_advance,
+    masked_argmax_advance_reference,
+    masked_argmax_block,
+    masked_argmax_reference,
+    sharded_masked_argmax,
+    sharded_masked_argmax_advance,
+    sharded_masked_argmax_block,
+)
 from .grouped_matmul import grouped_matmul, grouped_matmul_reference
+from .kvquant import (
+    dequantize_kv,
+    kv_block_bytes,
+    kv_quant_bits,
+    kv_store_dim,
+    kv_store_dtype,
+    quantize_kv,
+)
 from .paged_attention import (
     paged_attention,
+    paged_attention_quant,
+    paged_attention_quant_reference,
     paged_attention_reference,
     paged_block_attention,
-    sharded_paged_block_attention,
+    paged_block_attention_quant,
+    paged_block_attention_quant_reference,
     sharded_paged_attention,
+    sharded_paged_attention_quant,
+    sharded_paged_block_attention,
+    sharded_paged_block_attention_quant,
 )
 
 __all__ = [
@@ -59,12 +86,31 @@ __all__ = [
     "sharded_decode_attention_layer",
     "grouped_matmul",
     "grouped_matmul_reference",
+    "decode_attention_quant",
+    "decode_attention_quant_reference",
     "masked_argmax",
+    "masked_argmax_advance",
+    "masked_argmax_advance_reference",
+    "masked_argmax_block",
     "masked_argmax_reference",
     "sharded_masked_argmax",
+    "sharded_masked_argmax_advance",
+    "sharded_masked_argmax_block",
+    "dequantize_kv",
+    "kv_block_bytes",
+    "kv_quant_bits",
+    "kv_store_dim",
+    "kv_store_dtype",
+    "quantize_kv",
     "paged_attention",
+    "paged_attention_quant",
+    "paged_attention_quant_reference",
     "paged_block_attention",
+    "paged_block_attention_quant",
+    "paged_block_attention_quant_reference",
     "sharded_paged_block_attention",
+    "sharded_paged_block_attention_quant",
     "paged_attention_reference",
     "sharded_paged_attention",
+    "sharded_paged_attention_quant",
 ]

@@ -607,3 +607,156 @@ def decode_block_attention_reference(
         preferred_element_type=jnp.float32,
     )
     return out.reshape(B, T, nq, hd).astype(q.dtype)
+
+
+# ------------------------------------------------------------ quantized cache
+#
+# KV_QUANT (ISSUE 12) building block for DENSE caches: single-token decode
+# against an int8 (or packed int4) (B, S, nkv, hdp) cache with bf16
+# per-(position, head) scales. The paged plane's fused-dequant kernels live
+# in ops.paged_attention; this is the same score/probability scale-folding
+# on the contiguous layout — the seam a future dense-engine KV tier plugs
+# into, and the simplest kernel the quantization math is verified on.
+
+
+def _decode_kernel_quant(
+    kv_len_ref,  # SMEM (B,) int32
+    q_ref,  # (1, nkv, group, hd)
+    k_ref,  # (1, block_k, nkv, hdp) int8
+    v_ref,
+    ks_ref,  # (1, block_k, nkv) bf16
+    vs_ref,
+    o_ref,  # (1, nkv, group, hd)
+    acc_ref,  # VMEM (nkv, group, hd) f32
+    m_ref,  # VMEM (nkv, group, 128) f32
+    l_ref,
+    *,
+    scale: float,
+    nkv: int,
+    group: int,
+    block_k: int,
+    hd: int,
+    bits: int,
+):
+    # the packed-dot arithmetic has ONE copy (ops.kvquant pack contract):
+    # the paged kernels' helpers, fed the pre-sliced (block_k, hdp) tile
+    from .paged_attention import _NEG_INF as _NI
+    from .paged_attention import _pv_dot, _qk_dot
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    kv_len = kv_len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NI)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * block_k < kv_len)
+    def _tile():
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (group, block_k), 1)
+        valid = k_pos < kv_len
+        for h in range(nkv):
+            q = q_ref[0, h].astype(jnp.float32)  # (group, hd)
+            ks = ks_ref[0, :, h].astype(jnp.float32)  # (block_k,)
+            vs = vs_ref[0, :, h].astype(jnp.float32)
+            s = _qk_dot(q, k_ref[0, :, h], bits, hd) * ks[None, :] * scale
+            s = jnp.where(valid, s, _NI)
+
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            pv = _pv_dot(p * vs[None, :], v_ref[0, :, h], bits)
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        o_ref[0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+# analyze: ok[jit-sentinel] -- kernel wrapper traced inline by the watched engine/stt loops, never a serving dispatch entry point
+@functools.partial(jax.jit, static_argnames=("bits", "scale", "block_k", "interpret"))
+def decode_attention_quant(
+    q: jax.Array,  # (B, nq, hd)
+    k_cache: jax.Array,  # (B, S, nkv, hdp) int8 stored values
+    v_cache: jax.Array,
+    k_scale: jax.Array,  # (B, S, nkv) bf16
+    v_scale: jax.Array,
+    kv_len: jax.Array,  # (B,) int32
+    *,
+    bits: int = 8,
+    scale: float | None = None,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``decode_attention`` against a quantized dense cache. S must be a
+    multiple of the chosen block (the engines bucket cache capacity)."""
+    B, nq, hd = q.shape
+    S, nkv = k_cache.shape[1], k_cache.shape[2]
+    assert nq % nkv == 0
+    assert bits in (8, 4)
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+    block_k = min(block_k, S)
+    if S % block_k:
+        raise ValueError(
+            f"decode_attention_quant needs cache length {S} divisible by "
+            f"block_k={block_k}; bucket the cache")
+    qg = q.reshape(B, nkv, group, hd)
+    hdp = k_cache.shape[3]
+
+    grid = (B, S // block_k)
+    kernel = functools.partial(
+        _decode_kernel_quant, scale=scale, nkv=nkv, group=group,
+        block_k=block_k, hd=hd, bits=bits,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B,), lambda b, j: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, nkv, group, hd), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, nkv, hdp), lambda b, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, block_k, nkv, hdp), lambda b, j: (b, j, 0, 0)),
+            pl.BlockSpec((1, block_k, nkv), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, nkv), lambda b, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, group, hd), lambda b, j: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, group, hd), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kv_len.astype(jnp.int32), qg, k_cache, v_cache, k_scale, v_scale)
+    return out.reshape(B, nq, hd)
+
+
+def decode_attention_quant_reference(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    kv_len: jax.Array,
+    *,
+    bits: int = 8,
+    scale: float | None = None,
+) -> jax.Array:
+    """Pure-jnp twin of ``decode_attention_quant``."""
+    from .kvquant import dequantize_kv
+
+    kv_quant = "int8" if bits == 8 else "int4"
+    kc = dequantize_kv(k_cache, k_scale, kv_quant, jnp.float32)
+    vc = dequantize_kv(v_cache, v_scale, kv_quant, jnp.float32)
+    return decode_attention_reference(q, kc, vc, kv_len, scale=scale)

@@ -145,3 +145,220 @@ def masked_argmax_reference(
     """Pure-jnp twin (the engine's original XLA path)."""
     masked = jnp.where(mask_table[fsm_state], logits, -jnp.inf)
     return jnp.argmax(masked, axis=-1).astype(jnp.int32)
+
+
+# ------------------------------------------------------- fused decode tail
+#
+# ISSUE 12: the per-step sampling tail was mask -> argmax (this kernel) ->
+# a separate two-gather FSM advance; and the speculative verify step ran
+# K+1 SEQUENTIAL (B, V) mask+argmax rounds in XLA. The two entries below
+# finish the fusion:
+#
+# - ``masked_argmax_advance``: mask + argmax + FSM advance in ONE kernel.
+#   The col_id class tiles stream beside the logits tiles, the kernel
+#   tracks the argmax position's class, and the scalar-prefetched
+#   (1, C) row of the compressed transition table — indexed by the row's
+#   own state, the same trick as the mask tiles — yields the next state
+#   with one dynamic scalar load at finish. Nothing V-sized ever leaves
+#   the kernel.
+# - ``masked_argmax_block``: every verify position of a (B, 1+K) spec
+#   block masked at its OWN state and argmaxed in ONE pallas_call (the
+#   grid folds positions into rows), replacing the K+1-round XLA loop in
+#   serve.spec._verify_commit.
+
+
+def _argmax_advance_kernel(
+    state_ref,  # scalar prefetch (B,) int32 (caller clamps >= 0)
+    logits_ref,  # (1, SUB, 128) f32 tile of row b
+    mask_ref,  # (1, SUB, 128) bool tile of row state[b]
+    col_ref,  # (SUB, 128) int32 col_id tile (token -> class)
+    trow_ref,  # (1, C) int32 — row state[b] of the compressed table
+    idx_out_ref,  # SMEM (B,) int32
+    next_out_ref,  # SMEM (B,) int32
+    best_val_ref,  # SMEM (1,) f32
+    best_idx_ref,  # SMEM (1,) int32
+    best_cls_ref,  # SMEM (1,) int32
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        best_val_ref[0] = -jnp.inf
+        best_idx_ref[0] = 0
+        best_cls_ref[0] = 0  # class 0 is the all-dead column: a fully
+        # masked row advances to -1, exactly what the poison gate expects
+
+    s = jnp.where(mask_ref[0], logits_ref[0].astype(jnp.float32), -1e30)
+    sub = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    idx = j * _TILE + sub * _LANE + lane
+    tile_max = jnp.max(s)
+    tile_arg = jnp.min(jnp.where(s == tile_max, idx, jnp.iinfo(jnp.int32).max))
+    # the class at the winning position (unique, so min picks exactly it)
+    tile_cls = jnp.min(jnp.where(idx == tile_arg, col_ref[...],
+                                 jnp.iinfo(jnp.int32).max))
+
+    @pl.when(tile_max > best_val_ref[0])
+    def _update():
+        best_val_ref[0] = tile_max
+        best_idx_ref[0] = tile_arg
+        best_cls_ref[0] = tile_cls
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        idx_out_ref[b] = best_idx_ref[0]
+        next_out_ref[b] = trow_ref[0, best_cls_ref[0]]
+
+
+# analyze: ok[jit-sentinel] -- kernel wrapper traced inline by the watched engine/stt loops, never a serving dispatch entry point
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_argmax_advance(
+    logits: jax.Array,  # (B, V) float
+    fsm_state: jax.Array,  # (B,) int32
+    mask_table: jax.Array,  # (n_states, V) bool
+    table: jax.Array,  # (n_states, C) int32 compressed transitions; -1 dead
+    col_id: jax.Array,  # (V,) int32 token -> class
+    *,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (tok, next_state), both (B,) int32 — tok is the masked
+    argmax (``masked_argmax`` parity) and next_state equals
+    ``grammar.fsm.fsm_advance(tables, state, tok)`` for live states.
+    Negative (dead) states are clamped to 0; their results are garbage the
+    engine's poison gate already fences (it keys on the ENTRY state)."""
+    B, V = logits.shape
+    S, C = table.shape
+    interpret = interpret if interpret is not None else _on_cpu()
+    state = jnp.maximum(fsm_state.astype(jnp.int32), 0)
+    pad_v = (-V) % _TILE
+    if pad_v:
+        logits = jnp.pad(logits, ((0, 0), (0, pad_v)), constant_values=-jnp.inf)
+        mask_table = jnp.pad(mask_table, ((0, 0), (0, pad_v)))
+        col_id = jnp.pad(col_id, (0, pad_v))  # class 0: the all-dead column
+    pad_c = (-C) % _LANE
+    if pad_c:
+        table = jnp.pad(table, ((0, 0), (0, pad_c)), constant_values=-1)
+    Cp = table.shape[1]
+    Vp = logits.shape[1]
+    logits3 = logits.reshape(B, Vp // _LANE, _LANE)
+    mask3 = mask_table.reshape(S, Vp // _LANE, _LANE)
+    col2 = col_id.astype(jnp.int32).reshape(Vp // _LANE, _LANE)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Vp // _TILE),
+        in_specs=[
+            pl.BlockSpec((1, _SUB, _LANE), lambda b, j, state: (b, j, 0)),
+            pl.BlockSpec((1, _SUB, _LANE), lambda b, j, state: (state[b], j, 0)),
+            pl.BlockSpec((_SUB, _LANE), lambda b, j, state: (j, 0)),
+            pl.BlockSpec((1, Cp), lambda b, j, state: (state[b], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((B,), lambda b, j, state: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((B,), lambda b, j, state: (0,), memory_space=pltpu.SMEM),
+        ],
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+    )
+    tok, nxt = pl.pallas_call(
+        _argmax_advance_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B,), jnp.int32),
+                   jax.ShapeDtypeStruct((B,), jnp.int32)],
+        interpret=interpret,
+    )(state, logits3, mask3, col2, table.astype(jnp.int32))
+    return tok, nxt
+
+
+def sharded_masked_argmax_advance(
+    mesh,
+    logits: jax.Array,  # (B, V)
+    fsm_state: jax.Array,  # (B,)
+    mask_table: jax.Array,  # (n_states, V) bool — replicated
+    table: jax.Array,  # (n_states, C) int32 — replicated
+    col_id: jax.Array,  # (V,) int32 — replicated
+    **kw,
+) -> tuple[jax.Array, jax.Array]:
+    """masked_argmax_advance over a (dp, tp) mesh: batch over dp, tables
+    replicated — no collectives. ``mesh=None`` falls through."""
+    if mesh is None:
+        return masked_argmax_advance(logits, fsm_state, mask_table, table,
+                                     col_id, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    dp = mesh.shape.get("dp", 1)
+    dp_ax = "dp" if (dp > 1 and logits.shape[0] % dp == 0) else None
+    fn = jax.shard_map(
+        functools.partial(masked_argmax_advance, **kw),
+        mesh=mesh,
+        in_specs=(P(dp_ax, None), P(dp_ax), P(None, None), P(None, None),
+                  P(None)),
+        out_specs=(P(dp_ax), P(dp_ax)),
+        check_vma=False,
+    )
+    return fn(logits, fsm_state, mask_table, table, col_id)
+
+
+def masked_argmax_advance_reference(
+    logits: jax.Array, fsm_state: jax.Array, mask_table: jax.Array,
+    table: jax.Array, col_id: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Pure-jnp twin of ``masked_argmax_advance`` (clamped-state contract)."""
+    state = jnp.maximum(fsm_state, 0)
+    tok = masked_argmax_reference(logits, state, mask_table)
+    return tok, table[state, col_id[tok]]
+
+
+# analyze: ok[jit-sentinel] -- kernel wrapper traced inline by the watched engine/stt loops, never a serving dispatch entry point
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_argmax_block(
+    logits: jax.Array,  # (B, T, V) float — one verify block per row
+    fsm_state: jax.Array,  # (B, T) int32 — each position's OWN state
+    mask_table: jax.Array,  # (n_states, V) bool
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-position masked argmax for a whole speculative verify block in
+    ONE pallas_call: positions fold into grid rows, each streaming the mask
+    tiles of its own FSM state. Returns (B, T) int32. Dead (negative)
+    states are clamped to 0 — serve.spec._verify_commit proves their
+    positions sit strictly past the first draft mismatch, so the clamped
+    garbage can never affect acceptance or the bonus pick."""
+    B, T, V = logits.shape
+    out = masked_argmax(
+        logits.reshape(B * T, V),
+        jnp.maximum(fsm_state.reshape(B * T), 0),
+        mask_table,
+        interpret=interpret,
+    )
+    return out.reshape(B, T)
+
+
+def sharded_masked_argmax_block(
+    mesh,
+    logits: jax.Array,  # (B, T, V)
+    fsm_state: jax.Array,  # (B, T)
+    mask_table: jax.Array,  # (n_states, V) bool — replicated
+    **kw,
+) -> jax.Array:
+    """masked_argmax_block over a (dp, tp) mesh (batch over dp, table
+    replicated; ``mesh=None`` falls through)."""
+    if mesh is None:
+        return masked_argmax_block(logits, fsm_state, mask_table, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    dp = mesh.shape.get("dp", 1)
+    dp_ax = "dp" if (dp > 1 and logits.shape[0] % dp == 0) else None
+    fn = jax.shard_map(
+        functools.partial(masked_argmax_block, **kw),
+        mesh=mesh,
+        in_specs=(P(dp_ax, None, None), P(dp_ax, None), P(None, None)),
+        out_specs=P(dp_ax, None),
+        check_vma=False,
+    )
+    return fn(logits, fsm_state, mask_table)

@@ -153,6 +153,269 @@ def paged_attention(
     return out.reshape(B, nq, hd)
 
 
+# ------------------------------------------------------------ quantized pool
+#
+# KV_QUANT (ISSUE 12): the pool stores per-(position, head)-scaled int8 (or
+# packed int4) values, so decode moves half (a quarter) of the KV bytes per
+# step. Dequantization is FUSED: the per-position scale is constant along
+# head_dim, so it factors OUT of both attention dots — scores multiply by
+# the k-scale row after the q·k dot, probabilities multiply by the v-scale
+# row before the p·v dot — and fp KV never exists in HBM or VMEM. The int4
+# tier never unpacks either: low/high nibbles hold head dims [0, hd/2) and
+# [hd/2, hd) (ops.kvquant pack contract), so the dots run per half.
+
+
+def _qk_dot(qh, k2, bits: int, hd: int):
+    """Score tile (rows, kv_rows) of fp queries against one head's stored
+    values ``k2`` (kv_rows, hdp) — int4 dots its halves against the
+    sign-extended nibbles. THE one copy of the packed-dot arithmetic
+    (ops.kvquant pack contract: low nibble = dims [0, hd/2)), shared by
+    the paged kernels here and the dense decode kernel
+    (ops.decode_attention._decode_kernel_quant)."""
+    if bits == 8:
+        return jax.lax.dot_general(
+            qh, k2.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    p32 = k2.astype(jnp.int32)  # (kv_rows, hd/2) packed
+    lo = jnp.right_shift(jnp.left_shift(p32, 28), 28).astype(jnp.float32)
+    hi = jnp.right_shift(p32, 4).astype(jnp.float32)
+    s_lo = jax.lax.dot_general(
+        qh[:, : hd // 2], lo, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    s_hi = jax.lax.dot_general(
+        qh[:, hd // 2:], hi, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return s_lo + s_hi
+
+
+def _pv_dot(p_scaled, v2, bits: int):
+    """(rows, kv_rows) v-scaled probabilities times one head's stored
+    values ``v2`` (kv_rows, hdp): (rows, hd) f32. int4 concatenates its
+    two half-dim products back in the pack order (low nibble = first
+    half). Shared like ``_qk_dot``."""
+    if bits == 8:
+        return jax.lax.dot_general(
+            p_scaled, v2.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    p32 = v2.astype(jnp.int32)
+    lo = jnp.right_shift(jnp.left_shift(p32, 28), 28).astype(jnp.float32)
+    hi = jnp.right_shift(p32, 4).astype(jnp.float32)
+    pv_lo = jax.lax.dot_general(
+        p_scaled, lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    pv_hi = jax.lax.dot_general(
+        p_scaled, hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    return jnp.concatenate([pv_lo, pv_hi], axis=1)
+
+
+def _paged_kernel_quant(
+    scalars_ref,  # SMEM: [kv_len (B,) | layer (1,) | table (B*max_blocks,)]
+    q_ref,  # (1, nkv, group, hd)
+    k_ref,  # (1, 1, bs, nkv, hdp) int8 — pool block picked by the index map
+    v_ref,
+    ks_ref,  # (1, 1, bs, nkv) bf16 per-(position, head) k scales
+    vs_ref,
+    o_ref,  # (1, nkv, group, hd)
+    acc_ref,  # VMEM (nkv, group, hd) f32
+    m_ref,  # VMEM (nkv, group, 128) f32
+    l_ref,  # VMEM (nkv, group, 128) f32
+    *,
+    scale: float,
+    nkv: int,
+    group: int,
+    bs: int,
+    hd: int,
+    bits: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    kv_len = scalars_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * bs < kv_len)
+    def _tile():
+        k_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1)
+        valid = k_pos < kv_len
+        for h in range(nkv):  # static unroll; nkv is small (GQA)
+            q = q_ref[0, h].astype(jnp.float32)  # (group, hd)
+            ks = ks_ref[0, 0, :, h].astype(jnp.float32)  # (bs,)
+            vs = vs_ref[0, 0, :, h].astype(jnp.float32)
+            # fused dequant: the per-position scale is constant along hd,
+            # so (q · (k_int * ks)) == (q · k_int) * ks — one row multiply
+            # on the score tile instead of materializing fp K
+            s = _qk_dot(q, k_ref[0, 0, :, h], bits, hd) * ks[None, :] * scale
+            s = jnp.where(valid, s, _NEG_INF)
+
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            # same trick on V: (p · (v_int * vs)) == ((p * vs) · v_int)
+            pv = _pv_dot(p * vs[None, :], v_ref[0, 0, :, h], bits)
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        o_ref[0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+# analyze: ok[jit-sentinel] -- kernel wrapper traced inline by the watched engine/stt loops, never a serving dispatch entry point
+@functools.partial(jax.jit, static_argnames=("bits", "scale", "interpret"))
+def paged_attention_quant(
+    q: jax.Array,  # (B, nq, hd) — one query token per row
+    k_pool: jax.Array,  # (L, N, bs, nkv, hdp) int8 stored values
+    v_pool: jax.Array,
+    k_scale: jax.Array,  # (L, N, bs, nkv) bf16 per-(position, head) scales
+    v_scale: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32 pool-block ids
+    kv_len: jax.Array,  # (B,) int32 valid keys per row
+    layer: jax.Array,  # scalar int32
+    *,
+    bits: int = 8,  # 8 | 4 (ops.kvquant storage contract)
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``paged_attention`` over the quantized pool: same block-table
+    indirection, dequant fused into the score/probability tiles. Returns
+    (B, nq, hd) in q.dtype."""
+    B, nq, hd = q.shape
+    bs, nkv = k_pool.shape[2], k_pool.shape[3]
+    max_blocks = block_tables.shape[1]
+    assert nq % nkv == 0
+    assert bits in (8, 4)
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+    qg = q.reshape(B, nkv, group, hd)
+
+    scalars = jnp.concatenate([
+        kv_len.astype(jnp.int32),
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        block_tables.astype(jnp.int32).reshape(-1),
+    ])
+    kernel = functools.partial(
+        _paged_kernel_quant, scale=scale, nkv=nkv, group=group, bs=bs, hd=hd,
+        bits=bits,
+    )
+    hdp = k_pool.shape[4]
+    pool_spec = pl.BlockSpec(
+        (1, 1, bs, nkv, hdp),
+        lambda b, j, sc, M=max_blocks: (sc[B], sc[B + 1 + b * M + j], 0, 0, 0),
+    )
+    scale_spec = pl.BlockSpec(
+        (1, 1, bs, nkv),
+        lambda b, j, sc, M=max_blocks: (sc[B], sc[B + 1 + b * M + j], 0, 0),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, nkv, group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+            pool_spec, pool_spec, scale_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((1, nkv, group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, group, hd), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+            pltpu.VMEM((nkv, group, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
+        interpret=interpret,
+    )(scalars, qg, k_pool, v_pool, k_scale, v_scale)
+    return out.reshape(B, nq, hd)
+
+
+def sharded_paged_attention_quant(
+    mesh,
+    q: jax.Array,  # (B, nq, hd)
+    k_pool: jax.Array,  # (L, N, bs, nkv, hdp) int8
+    v_pool: jax.Array,
+    k_scale: jax.Array,  # (L, N, bs, nkv)
+    v_scale: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) GLOBAL block ids
+    kv_len: jax.Array,
+    layer: jax.Array,
+    **kw,
+) -> jax.Array:
+    """``paged_attention_quant`` over a (dp, tp) mesh — the scale planes
+    shard exactly like the pool minus the head_dim axis
+    (parallel.mesh.paged_scale_shardings), so each dp shard's rows read
+    only local values AND local scales. Same divisibility contract as
+    ``sharded_paged_attention``."""
+    if mesh is None:
+        return paged_attention_quant(q, k_pool, v_pool, k_scale, v_scale,
+                                     block_tables, kv_len, layer, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    B, nq = q.shape[0], q.shape[1]
+    N, nkv = k_pool.shape[1], k_pool.shape[3]
+    tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
+    if dp > 1 and (B % dp != 0 or N % dp != 0):
+        raise ValueError(
+            f"sharded_paged_attention_quant: batch B={B} and pool blocks "
+            f"N={N} must both be divisible by dp={dp}")
+    dp_ax = "dp" if dp > 1 else None
+    local_blocks = N // dp if dp_ax else N
+
+    def local(q, kp, vp, ks, vs, bt, kl, layer):
+        if dp_ax is not None:
+            bt = bt - jax.lax.axis_index("dp") * local_blocks
+        return paged_attention_quant(q, kp, vp, ks, vs, bt, kl, layer, **kw)
+
+    qs = P(dp_ax, tp_ax, None)
+    ps = P(None, dp_ax, None, tp_ax, None)
+    ss = P(None, dp_ax, None, tp_ax)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(qs, ps, ps, ss, ss, P(dp_ax, None), P(dp_ax), P()),
+        out_specs=qs,
+        check_vma=False,
+    )
+    return fn(q, k_pool, v_pool, k_scale, v_scale,
+              block_tables.astype(jnp.int32), kv_len.astype(jnp.int32), layer)
+
+
+def paged_attention_quant_reference(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    block_tables: jax.Array,
+    kv_len: jax.Array,
+    layer,
+    *,
+    bits: int = 8,
+    scale: float | None = None,
+) -> jax.Array:
+    """Pure-jnp twin: dequantize the gathered blocks and run the plain
+    reference."""
+    from .kvquant import dequantize_kv
+
+    kv_quant = "int8" if bits == 8 else "int4"
+    kq = dequantize_kv(k_pool[layer], k_scale[layer], kv_quant, jnp.float32)
+    vq = dequantize_kv(v_pool[layer], v_scale[layer], kv_quant, jnp.float32)
+    return paged_attention_reference(
+        q, kq[None], vq[None], block_tables, kv_len, 0, scale=scale)
+
+
 def sharded_paged_attention(
     mesh,
     q: jax.Array,  # (B, nq, hd)
@@ -435,3 +698,226 @@ def sharded_paged_block_attention(
     )
     return fn(q, k_pool, v_pool, block_tables.astype(jnp.int32),
               q_positions.astype(jnp.int32), layer)
+
+
+def _paged_block_kernel_quant(
+    scalars_ref,  # SMEM: [q_pos (B*T,) | layer (1,) | table (B*max_blocks,)]
+    q_ref,  # (1, nkv, T*group, hd)
+    k_ref,  # (1, 1, bs, nkv, hdp) int8 — pool block picked by the index map
+    v_ref,
+    ks_ref,  # (1, 1, bs, nkv) bf16
+    vs_ref,
+    o_ref,  # (1, nkv, T*group, hd)
+    acc_ref,  # VMEM (nkv, T*group, hd) f32
+    m_ref,  # VMEM (nkv, T*group, 128) f32
+    l_ref,
+    *,
+    scale: float,
+    nkv: int,
+    group: int,
+    T: int,
+    bs: int,
+    hd: int,
+    bits: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    rows = T * group
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    max_pos = scalars_ref[b * T]
+    for _i in range(1, T):
+        max_pos = jnp.maximum(max_pos, scalars_ref[b * T + _i])
+
+    @pl.when(j * bs <= max_pos)
+    def _tile():
+        k_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        qpos_rows = jnp.zeros((rows, 1), jnp.int32)
+        for i in range(T):
+            qpos_rows = jnp.where(
+                (jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // group) == i,
+                scalars_ref[b * T + i], qpos_rows)
+        valid = k_pos <= qpos_rows  # causal + frontier in one mask
+        for h in range(nkv):
+            q = q_ref[0, h].astype(jnp.float32)  # (rows, hd)
+            ks = ks_ref[0, 0, :, h].astype(jnp.float32)  # (bs,)
+            vs = vs_ref[0, 0, :, h].astype(jnp.float32)
+            s = _qk_dot(q, k_ref[0, 0, :, h], bits, hd) * ks[None, :] * scale
+            s = jnp.where(valid, s, _NEG_INF)
+
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            pv = _pv_dot(p * vs[None, :], v_ref[0, 0, :, h], bits)
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        o_ref[0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+# analyze: ok[jit-sentinel] -- kernel wrapper traced inline by the watched engine/stt loops, never a serving dispatch entry point
+@functools.partial(jax.jit, static_argnames=("bits", "scale", "interpret"))
+def paged_block_attention_quant(
+    q: jax.Array,  # (B, T, nq, hd)
+    k_pool: jax.Array,  # (L, N, bs, nkv, hdp) int8
+    v_pool: jax.Array,
+    k_scale: jax.Array,  # (L, N, bs, nkv) bf16
+    v_scale: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    q_positions: jax.Array,  # (B, T) int32
+    layer: jax.Array,  # scalar int32
+    *,
+    bits: int = 8,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``paged_block_attention`` over the quantized pool (grammar ff chain
+    and speculative verify steps): per-query frontiers, fused dequant."""
+    B, T, nq, hd = q.shape
+    bs, nkv = k_pool.shape[2], k_pool.shape[3]
+    max_blocks = block_tables.shape[1]
+    assert nq % nkv == 0
+    assert bits in (8, 4)
+    group = nq // nkv
+    scale = scale if scale is not None else hd**-0.5
+    interpret = interpret if interpret is not None else _on_cpu()
+    qg = q.reshape(B, T, nkv, group, hd).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(B, nkv, T * group, hd)
+
+    scalars = jnp.concatenate([
+        q_positions.astype(jnp.int32).reshape(-1),
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        block_tables.astype(jnp.int32).reshape(-1),
+    ])
+    kernel = functools.partial(
+        _paged_block_kernel_quant, scale=scale, nkv=nkv, group=group, T=T,
+        bs=bs, hd=hd, bits=bits,
+    )
+    BT = B * T
+    hdp = k_pool.shape[4]
+    pool_spec = pl.BlockSpec(
+        (1, 1, bs, nkv, hdp),
+        lambda b, j, sc, M=max_blocks: (sc[BT], sc[BT + 1 + b * M + j], 0, 0, 0),
+    )
+    scale_spec = pl.BlockSpec(
+        (1, 1, bs, nkv),
+        lambda b, j, sc, M=max_blocks: (sc[BT], sc[BT + 1 + b * M + j], 0, 0),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, nkv, T * group, hd), lambda b, j, sc: (b, 0, 0, 0)),
+            pool_spec, pool_spec, scale_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((1, nkv, T * group, hd),
+                               lambda b, j, sc: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, T * group, hd), jnp.float32),
+            pltpu.VMEM((nkv, T * group, 128), jnp.float32),
+            pltpu.VMEM((nkv, T * group, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, T * group, hd), q.dtype),
+        interpret=interpret,
+    )(scalars, qg, k_pool, v_pool, k_scale, v_scale)
+    return (out.reshape(B, nkv, T, group, hd)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, T, nq, hd))
+
+
+def sharded_paged_block_attention_quant(
+    mesh,
+    q: jax.Array,  # (B, T, nq, hd)
+    k_pool: jax.Array,  # (L, N, bs, nkv, hdp) int8
+    v_pool: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) GLOBAL block ids
+    q_positions: jax.Array,  # (B, T)
+    layer: jax.Array,
+    **kw,
+) -> jax.Array:
+    """``paged_block_attention_quant`` over a (dp, tp) mesh — same layout
+    contract as ``sharded_paged_attention_quant``."""
+    if mesh is None:
+        return paged_block_attention_quant(
+            q, k_pool, v_pool, k_scale, v_scale, block_tables, q_positions,
+            layer, **kw)
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    B, T, nq = q.shape[0], q.shape[1], q.shape[2]
+    N, nkv = k_pool.shape[1], k_pool.shape[3]
+    tp_ax = "tp" if (tp > 1 and nq % tp == 0 and nkv % tp == 0) else None
+    if dp > 1 and (B % dp != 0 or N % dp != 0):
+        raise ValueError(
+            f"sharded_paged_block_attention_quant: batch B={B} and pool "
+            f"blocks N={N} must both be divisible by dp={dp}")
+    dp_ax = "dp" if dp > 1 else None
+    local_blocks = N // dp if dp_ax else N
+
+    def local(q, kp, vp, ks, vs, bt, qp, layer):
+        if dp_ax is not None:
+            bt = bt - jax.lax.axis_index("dp") * local_blocks
+        return paged_block_attention_quant(q, kp, vp, ks, vs, bt, qp, layer, **kw)
+
+    qs = P(dp_ax, None, tp_ax, None)
+    ps = P(None, dp_ax, None, tp_ax, None)
+    ss = P(None, dp_ax, None, tp_ax)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(qs, ps, ps, ss, ss, P(dp_ax, None), P(dp_ax, None), P()),
+        out_specs=qs,
+        check_vma=False,
+    )
+    return fn(q, k_pool, v_pool, k_scale, v_scale,
+              block_tables.astype(jnp.int32), q_positions.astype(jnp.int32),
+              layer)
+
+
+def paged_block_attention_quant_reference(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    block_tables: jax.Array,
+    q_positions: jax.Array,
+    layer,
+    *,
+    bits: int = 8,
+    scale: float | None = None,
+) -> jax.Array:
+    """Pure-jnp twin: dequantize the pool plane, gather, dense block twin."""
+    from .decode_attention import decode_block_attention_reference
+    from .kvquant import dequantize_kv
+
+    B = q.shape[0]
+    bs, nkv = k_pool.shape[2], k_pool.shape[3]
+    hd = q.shape[-1]
+    kv_quant = "int8" if bits == 8 else "int4"
+    kq = dequantize_kv(k_pool[layer], k_scale[layer], kv_quant, jnp.float32)
+    vq = dequantize_kv(v_pool[layer], v_scale[layer], kv_quant, jnp.float32)
+    S = block_tables.shape[1] * bs
+    kc = kq[block_tables].reshape(B, S, nkv, hd)
+    vc = vq[block_tables].reshape(B, S, nkv, hd)
+    return decode_block_attention_reference(q, kc, vc, q_positions, scale=scale)

@@ -125,6 +125,15 @@ def paged_pool_shardings(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
         mesh, P(None, "dp", None, "tp" if tp_ok else None, None))
 
 
+def paged_scale_shardings(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
+    """Sharding for the quantized pool's (L, N, bs, nkv) scale planes
+    (KV_QUANT, ops.kvquant): exactly the pool's spec minus the head_dim
+    axis, so each dp shard's rows read local values AND local scales."""
+    tp_ok = n_kv_heads % mesh.shape["tp"] == 0
+    return NamedSharding(
+        mesh, P(None, "dp", None, "tp" if tp_ok else None))
+
+
 def quantized_param_shardings(mesh: Mesh, n_kv_heads: int, n_experts: int = 0) -> dict:
     """param_shardings for an int8-quantized tree (models.llama.
     quantize_params): every quantized matmul weight becomes {"q", "s"} where

@@ -82,11 +82,18 @@ def _mask_sample_advance(logits, fsm_state, tables: DeviceFSM, key, temperature,
     the compressed XLA path runs even under kernels="pallas". On a mesh
     (rules given) the kernel runs per-shard under shard_map."""
     if constrained and greedy and kernels == "pallas" and tables.dense_mask is not None:
-        from ..ops import sharded_masked_argmax
+        from ..ops import sharded_masked_argmax_advance
 
+        # ONE fused kernel for the whole tail (ISSUE 12): grammar mask +
+        # argmax + FSM advance — the compressed transition row rides the
+        # same scalar-prefetch indirection as the mask tiles, so the two
+        # XLA advance gathers disappear into the kernel. For live states
+        # the result is exactly masked_argmax + fsm_advance (differential-
+        # tested); dead states are fenced by the poison gate either way.
         mesh = rules.mesh if rules is not None else None
-        tok = sharded_masked_argmax(mesh, logits, fsm_state, tables.dense_mask)
-        return tok, fsm_advance(tables, fsm_state, tok)
+        return sharded_masked_argmax_advance(
+            mesh, logits, fsm_state, tables.dense_mask, tables.table,
+            tables.col_id)
     if logit_mask is not None:
         # padded-vocab ids (mesh tp padding / checkpoint embed padding) have
         # real logits (zero columns -> 0.0) but no tokenizer meaning: dead

@@ -170,10 +170,18 @@ class BlockAllocator:
         return self.blocks_in_use / u if u > 0 else 0.0
 
 
-def record_pool_gauges(alloc: "BlockAllocator") -> None:
+def record_pool_gauges(alloc: "BlockAllocator", engine=None) -> None:
     """Export one allocator's occupancy as runtime gauges. Called by the
     continuous batcher each chunk (so the gauges track the live pool the
-    scheduler actually allocates from) and directly by tests."""
+    scheduler actually allocates from) and directly by tests.
+
+    With ``engine`` given the BYTES-denominated view rides along (ISSUE 12
+    satellite): block counts stopped being a unit of HBM the moment
+    KV_QUANT halved/quartered bytes-per-block, so capacity dashboards and
+    the swarm's saturation attribution get ``paged.kv_bytes_*`` beside the
+    counts. ``paged.kv_utilization`` itself needs NO re-expression — it is
+    used ÷ usable of ONE pool whose blocks are uniform, so the fraction is
+    invariant under any bytes-per-block (audited in docs/PERF.md)."""
     from ..utils import get_metrics
 
     m = get_metrics()
@@ -181,6 +189,12 @@ def record_pool_gauges(alloc: "BlockAllocator") -> None:
     m.set_gauge("paged.kv_blocks_total", float(alloc.usable_blocks))
     m.set_gauge("paged.kv_utilization", alloc.utilization)
     m.set_gauge("paged.kv_blocks_shared", float(alloc.blocks_shared))
+    if engine is not None:
+        bpb = engine.kv_bytes_per_block
+        m.set_gauge("paged.kv_quant_bits", float(engine.kv_quant_bits))
+        m.set_gauge("paged.kv_bytes_per_block", float(bpb))
+        m.set_gauge("paged.kv_bytes_used", float(alloc.blocks_in_use * bpb))
+        m.set_gauge("paged.kv_bytes_total", float(alloc.usable_blocks * bpb))
 
 
 @watch_compiles("paged._scatter_blocks")
@@ -196,12 +210,35 @@ def _scatter_blocks(k_pool, v_pool, src_k, src_v, dst_idx):
     return kf.reshape(shp), vf.reshape(shp)
 
 
+@watch_compiles("paged._scatter_blocks_quant")
+@partial(jax.jit, static_argnames=("kv_quant",),
+         donate_argnames=("k_pool", "v_pool", "k_scale", "v_scale"))
+def _scatter_blocks_quant(k_pool, v_pool, k_scale, v_scale, src_k, src_v,
+                          dst_idx, kv_quant: str = "int8"):
+    """_scatter_blocks' KV_QUANT twin: quantize the fp (L, n, nkv, hd)
+    rows on write (ops.kvquant — the same deterministic rowwise math the
+    in-forward scatter uses, so prefix-installed and decode-written KV
+    stay bitwise comparable) and land values + scales at dst_idx."""
+    from ..ops.kvquant import quantize_kv
+
+    L, N, bs = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    shp, sshp = k_pool.shape, k_scale.shape
+    qk, sk = quantize_kv(src_k, kv_quant)
+    qv, sv = quantize_kv(src_v, kv_quant)
+    kf = k_pool.reshape(L, N * bs, *shp[3:]).at[:, dst_idx].set(qk)
+    vf = v_pool.reshape(L, N * bs, *shp[3:]).at[:, dst_idx].set(qv)
+    ksf = k_scale.reshape(L, N * bs, sshp[3]).at[:, dst_idx].set(sk)
+    vsf = v_scale.reshape(L, N * bs, sshp[3]).at[:, dst_idx].set(sv)
+    return (kf.reshape(shp), vf.reshape(shp),
+            ksf.reshape(sshp), vsf.reshape(sshp))
+
+
 @watch_compiles("paged.paged_chunk_decode_loop")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained",
-                     "kernels", "eos_id", "pad_id", "max_len"),
-    donate_argnames=("k_pool", "v_pool"),
+                     "kernels", "eos_id", "pad_id", "max_len", "kv_quant"),
+    donate_argnames=("k_pool", "v_pool", "k_scale", "v_scale"),
 )
 def paged_chunk_decode_loop(
     params,
@@ -219,6 +256,9 @@ def paged_chunk_decode_loop(
     rules=None,
     logit_mask=None,
     nan_inject=None,  # (B,) bool or None — chaos drill (see engine.py twin)
+    k_scale=None,  # (L, N, bs, nkv) bf16 KV_QUANT scale planes (None = off:
+    # empty pytree leaves, the traced loop is byte-identical to pre-quant)
+    v_scale=None,
     chunk_steps: int = 32,
     greedy: bool = True,
     constrained: bool = True,
@@ -226,6 +266,7 @@ def paged_chunk_decode_loop(
     eos_id: int = 2,
     pad_id: int = 0,
     max_len: int | None = None,
+    kv_quant: str | None = None,
 ):
     """chunk_decode_loop's paged twin: forward_paged per step, idle rows'
     writes parked in their group's reserved trash block via write_mask (they
@@ -257,16 +298,18 @@ def paged_chunk_decode_loop(
                    dtype=jnp.int32)
     eos0 = (~active) & (cur == eos_id)
 
-    carry0 = (k_pool, v_pool, cur, pos, fsm_state, active, eos0, nbytes,
+    carry0 = (k_pool, v_pool, k_scale, v_scale, cur, pos, fsm_state, active,
+              eos0, nbytes,
               tokens_left, out, jnp.zeros((B,), jnp.int32), key,
               jnp.zeros((), jnp.int32), jnp.zeros((B,), jnp.int32))
 
     def cond(c):
-        active, step = c[5], c[12]
+        active, step = c[7], c[14]
         return jnp.logical_and(step < chunk_steps, jnp.any(active))
 
     def body(c):
-        kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step, poison = c
+        (kp, vp, ksc, vsc, cur, pos, state, active, eos, nbytes, left, out, n,
+         key, step, poison) = c
         out = out.at[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)].set(
             jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)])
         )
@@ -276,10 +319,10 @@ def paged_chunk_decode_loop(
 
         step_tok = jnp.where(active, cur, pad_id)
         write_pos = jnp.where(active, pos, 0)
-        logits, kp, vp = forward_paged(
+        logits, kp, vp, ksc, vsc = forward_paged(
             params, cfg, step_tok[:, None], write_pos[:, None], kp, vp,
             block_tables, rules=rules, attn_impl=kernels, write_mask=active,
-            trash_idx=trash_idx,
+            trash_idx=trash_idx, k_scale=ksc, v_scale=vsc, kv_quant=kv_quant,
         )
         raw = logits[:, 0, :]
         if nan_inject is not None:
@@ -299,8 +342,8 @@ def paged_chunk_decode_loop(
         eos = eos | (ok & (cur == eos_id))
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_pos - 1) | (left <= 0)
         active = ok & ~stop
-        return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key,
-                step + 1, poison)
+        return (kp, vp, ksc, vsc, cur, pos, state, active, eos, nbytes, left,
+                out, n, key, step + 1, poison)
 
     def ff_body(c):
         # the dense ff_body's paged twin: cur + its state's forced chain in
@@ -311,7 +354,8 @@ def paged_chunk_decode_loop(
         # max_pos (table-covered capacity ∧ engine max_len) as the bound —
         # the engine's decode_chunk grew every live row's table to cover a
         # full ff chunk before dispatch.
-        kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key, step, poison = c
+        (kp, vp, ksc, vsc, cur, pos, state, active, eos, nbytes, left, out, n,
+         key, step, poison) = c
         # dead-at-entry fence (see the dense ff_body): a negative state
         # wraps the ff_tokens gather — poison it out before it emits
         dead_in = active & (state < 0)
@@ -358,10 +402,10 @@ def paged_chunk_decode_loop(
 
         s_end, _ = jax.lax.scan(cstep, state, (chain.T, jnp.arange(W)))
 
-        logits, kp, vp = forward_paged(
+        logits, kp, vp, ksc, vsc = forward_paged(
             params, cfg, blk_tok, blk_pos, kp, vp,
             block_tables, rules=rules, attn_impl=kernels, write_mask=active,
-            trash_idx=trash_idx,
+            trash_idx=trash_idx, k_scale=ksc, v_scale=vsc, kv_quant=kv_quant,
         )
         logits_k = jnp.take_along_axis(logits, k[:, None, None], axis=1)[:, 0, :]
         if nan_inject is not None:
@@ -381,15 +425,16 @@ def paged_chunk_decode_loop(
         eos = eos | (ok & (cur == eos_id))
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_pos - 1) | (left <= 0)
         active = ok & ~stop
-        return (kp, vp, cur, pos, state, active, eos, nbytes, left, out, n, key,
-                step + 1, poison)
+        return (kp, vp, ksc, vsc, cur, pos, state, active, eos, nbytes, left,
+                out, n, key, step + 1, poison)
 
-    (k_pool, v_pool, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds,
-     poison) = (
+    (k_pool, v_pool, k_scale, v_scale, cur, pos, state, active, eos, nbytes,
+     left, out, n, _, fwds, poison) = (
         jax.lax.while_loop(cond, ff_body if use_ff else body, carry0)
     )
     return (out[:, : cap if use_ff else chunk_steps], n, eos, k_pool, v_pool,
-            cur, pos, state, active, nbytes, left, fwds, poison)
+            k_scale, v_scale, cur, pos, state, active, nbytes, left, fwds,
+            poison)
 
 
 class PagedDecodeEngine(DecodeEngine):
@@ -412,12 +457,25 @@ class PagedDecodeEngine(DecodeEngine):
 
     def __init__(self, *args, block_size: int = 128, pool_blocks: int | None = None,
                  radix_enable: bool | None = None,
-                 radix_max_nodes: int | None = None, **kw):
+                 radix_max_nodes: int | None = None,
+                 kv_quant: str | None = None, **kw):
         super().__init__(*args, **kw)
         bs = block_size
         self.block_size = bs
         self.max_blocks = -(-self.max_len // bs)
         self.dp = self.mesh.shape.get("dp", 1) if self.mesh is not None else 1
+        # quantized KV storage tier (ISSUE 12): KV_QUANT=int8|int4 stores
+        # per-(position, head) scaled values (ops.kvquant) — half/quarter
+        # the HBM bytes per block, so a fixed pool budget holds ~2x/~4x the
+        # blocks. Unset keeps the bf16 pool byte-identical, differentially
+        # tested like RADIX_ENABLE/SPEC_ENABLE before it.
+        if kv_quant is None:
+            kv_quant = os.environ.get("KV_QUANT") or None
+        if kv_quant in ("", "off"):
+            kv_quant = None
+        if kv_quant not in (None, "int8", "int4"):
+            raise ValueError(f"KV_QUANT must be int8 or int4, got {kv_quant!r}")
+        self.kv_quant = kv_quant
         if pool_blocks is None:
             # default: same worst case as dense, plus each group's trash block
             pool_blocks = self.batch_slots * self.max_blocks + self.dp
@@ -425,18 +483,36 @@ class PagedDecodeEngine(DecodeEngine):
             raise ValueError(
                 f"pool_blocks ({pool_blocks}) must divide into the mesh dp "
                 f"axis ({self.dp}): each dp group owns its own block range")
+        from ..ops.kvquant import kv_store_dim, kv_store_dtype
+
         L, nkv, hd = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
-        shape = (L, pool_blocks, bs, nkv, hd)
+        hdp = kv_store_dim(hd, kv_quant)
+        dtype = kv_store_dtype(kv_quant)
+        shape = (L, pool_blocks, bs, nkv, hdp)
+        sshape = (L, pool_blocks, bs, nkv)
         if self.mesh is not None:
-            from ..parallel.mesh import paged_pool_shardings
+            from ..parallel.mesh import paged_pool_shardings, paged_scale_shardings
 
             sh = paged_pool_shardings(self.mesh, nkv)
             # analyze: ok[jit-sentinel] -- one-shot cache-init compile at construction time, not a serving dispatch the fence could catch
-            z = jax.jit(partial(jnp.zeros, shape, jnp.bfloat16), out_shardings=sh)
+            z = jax.jit(partial(jnp.zeros, shape, dtype), out_shardings=sh)
             self.k_pool, self.v_pool = z(), z()
+            if kv_quant is not None:
+                ssh = paged_scale_shardings(self.mesh, nkv)
+                # analyze: ok[jit-sentinel] -- one-shot cache-init compile at construction time, not a serving dispatch the fence could catch
+                zs = jax.jit(partial(jnp.zeros, sshape, jnp.bfloat16),
+                             out_shardings=ssh)
+                self.k_scale, self.v_scale = zs(), zs()
+            else:
+                self.k_scale = self.v_scale = None
         else:
-            self.k_pool = jnp.zeros(shape, jnp.bfloat16)
-            self.v_pool = jnp.zeros(shape, jnp.bfloat16)
+            self.k_pool = jnp.zeros(shape, dtype)
+            self.v_pool = jnp.zeros(shape, dtype)
+            if kv_quant is not None:
+                self.k_scale = jnp.zeros(sshape, jnp.bfloat16)
+                self.v_scale = jnp.zeros(sshape, jnp.bfloat16)
+            else:
+                self.k_scale = self.v_scale = None
         self.allocator = BlockAllocator(pool_blocks, n_groups=self.dp)
         self.block_tables = jnp.zeros((self.batch_slots, self.max_blocks), jnp.int32)
         self._slot_shared: list[list[int]] = [[] for _ in range(self.batch_slots)]
@@ -486,6 +562,38 @@ class PagedDecodeEngine(DecodeEngine):
         cache's batch axis: contiguous runs of batch_slots/dp)."""
         return slot // (self.batch_slots // self.dp)
 
+    @property
+    def kv_quant_bits(self) -> int:
+        """Stored bits per KV element (16 bf16 / 8 / 4) — exported as the
+        ``paged.kv_quant_bits`` gauge."""
+        from ..ops.kvquant import kv_quant_bits
+
+        return kv_quant_bits(self.kv_quant)
+
+    @property
+    def kv_bytes_per_block(self) -> int:
+        """HBM bytes one pool block occupies under the active KV tier
+        (values + scale planes; ops.kvquant.kv_block_bytes is the single
+        source the HBM ledger plan and the bench capacity rows share)."""
+        from ..ops.kvquant import kv_block_bytes
+
+        return kv_block_bytes(self.cfg.n_layers, self.block_size,
+                              self.cfg.n_kv_heads, self.cfg.head_dim,
+                              self.kv_quant)
+
+    def _scatter_pool(self, src_k, src_v, dst_idx) -> None:
+        """Pool scatter dispatch: plain bf16 write, or quantize-on-write
+        with the scales landing at the same flat indices (the ONE seam the
+        prefix install and the sub-block chain-tail scatter go through)."""
+        if self.kv_quant is None:
+            self.k_pool, self.v_pool = _scatter_blocks(
+                self.k_pool, self.v_pool, src_k, src_v, dst_idx)
+        else:
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale) = (
+                _scatter_blocks_quant(
+                    self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                    src_k, src_v, dst_idx, kv_quant=self.kv_quant))
+
     # ------------------------------------------------------------ prefix
 
     def set_prompt_prefix(self, *sample_prompts: str) -> int:
@@ -513,10 +621,8 @@ class PagedDecodeEngine(DecodeEngine):
                 self._prefix_blocks[g] = self.allocator.alloc(full, group=g)
                 blocks = np.asarray(self._prefix_blocks[g], np.int32)
                 dst = (blocks[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
-                self.k_pool, self.v_pool = _scatter_blocks(
-                    self.k_pool, self.v_pool, pk[:, : full * bs], pv[:, : full * bs],
-                    jnp.asarray(dst),
-                )
+                self._scatter_pool(pk[:, : full * bs], pv[:, : full * bs],
+                                   jnp.asarray(dst))
         if P % bs:
             self._prefix_tail = {"k": pk[:, full * bs:], "v": pv[:, full * bs:]}
         if full and self.radix is not None:
@@ -598,9 +704,7 @@ class PagedDecodeEngine(DecodeEngine):
             # owned block (shared blocks stay read-only)
             R = P - full * bs
             dst = jnp.asarray(owned[0] * bs + np.arange(R, dtype=np.int32))
-            self.k_pool, self.v_pool = _scatter_blocks(
-                self.k_pool, self.v_pool, tail["k"], tail["v"], dst,
-            )
+            self._scatter_pool(tail["k"], tail["v"], dst)
         # gather only the COVERED blocks, bucketed to a power of two so
         # compile count stays log-bounded (gathering the whole table width
         # — max_len of context — per layer was round-2 verdict weak #6)
@@ -620,12 +724,15 @@ class PagedDecodeEngine(DecodeEngine):
             gb = gb * 3 // 4
         gb = min(gb, self.max_blocks)
         self._next_pos[slot] = n
-        logits, self.k_pool, self.v_pool = forward_paged(
-            self.params, self.cfg, tokens, positions,
-            self.k_pool, self.v_pool, self.block_tables[slot][None],
-            rules=self.rules, attn_impl="xla",
-            fresh_block=False, gather_blocks=gb,
-        )
+        logits, self.k_pool, self.v_pool, self.k_scale, self.v_scale = \
+            forward_paged(
+                self.params, self.cfg, tokens, positions,
+                self.k_pool, self.v_pool, self.block_tables[slot][None],
+                rules=self.rules, attn_impl="xla",
+                fresh_block=False, gather_blocks=gb,
+                k_scale=self.k_scale, v_scale=self.v_scale,
+                kv_quant=self.kv_quant,
+            )
         return logits
 
     def prefill_slot(self, ids: list[int], slot: int):
@@ -694,12 +801,15 @@ class PagedDecodeEngine(DecodeEngine):
         self._covered[slot] = len(owned) * bs
         self._next_pos[slot] = n
         # position 0 start: block-local attention, no pool gather at all
-        logits, self.k_pool, self.v_pool = forward_paged(
-            self.params, self.cfg, tokens, positions,
-            self.k_pool, self.v_pool, self.block_tables[slot][None],
-            rules=self.rules, attn_impl=self.kernels,
-            fresh_block=True, gather_blocks=None,
-        )
+        logits, self.k_pool, self.v_pool, self.k_scale, self.v_scale = \
+            forward_paged(
+                self.params, self.cfg, tokens, positions,
+                self.k_pool, self.v_pool, self.block_tables[slot][None],
+                rules=self.rules, attn_impl=self.kernels,
+                fresh_block=True, gather_blocks=None,
+                k_scale=self.k_scale, v_scale=self.v_scale,
+                kv_quant=self.kv_quant,
+            )
         return logits
 
     # ------------------------------------------------------------ decode
@@ -773,8 +883,8 @@ class PagedDecodeEngine(DecodeEngine):
                     tokens_left = tokens_left.at[b].set(0)
                     continue
                 self._next_pos[b] = min(self._next_pos[b] + span, self.max_len)
-        out, n, eos, self.k_pool, self.v_pool, cur, pos, fsm, active, nbytes, left, \
-            fwds, pois = (
+        out, n, eos, self.k_pool, self.v_pool, self.k_scale, self.v_scale, \
+            cur, pos, fsm, active, nbytes, left, fwds, pois = (
                 paged_chunk_decode_loop(
                     self.params, self.cfg, self.k_pool, self.v_pool, self.block_tables,
                     cur, pos, fsm, active, nbytes, tokens_left,
@@ -784,9 +894,11 @@ class PagedDecodeEngine(DecodeEngine):
                     trash_idx=self._trash_idx, rules=self.rules,
                     logit_mask=self.logit_mask,
                     nan_inject=self._take_nan_inject(),
+                    k_scale=self.k_scale, v_scale=self.v_scale,
                     chunk_steps=chunk_steps,
                     greedy=greedy, constrained=True, kernels=self.kernels,
                     eos_id=self.eos_id, pad_id=self.pad_id, max_len=self.max_len,
+                    kv_quant=self.kv_quant,
                 )
             )
         # forward-dispatch count for the scheduler's tokens-per-forward

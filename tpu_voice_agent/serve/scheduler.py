@@ -315,11 +315,21 @@ class ContinuousBatcher:
         last_logits = eng.prefill_slot(ids, slot)
         self._rng, k = jax.random.split(self._rng)
         start_state = jnp.full((1,), self.engine.fsm.start, dtype=jnp.int32)
+        t_fm = time.perf_counter()
         tok0, fsm0 = _first_token(
             last_logits, start_state, eng.tables, k,
             jnp.float32(self.temperature), greedy=self.greedy, constrained=True,
             kernels=eng.kernels, rules=eng.rules, logit_mask=eng.logit_mask,
         )
+        # the fused grammar-mask→sample tail's ONE host-dispatched instance
+        # (every in-chunk instance is jit-inlined inside the decode loops):
+        # dispatch-side wall of the standalone _first_token jit, the number
+        # that moves when the fused Pallas tail (ops.masked_argmax_advance)
+        # replaces the mask/argmax/advance op chain
+        from ..utils import get_metrics as _gm
+
+        _gm().set_gauge("engine.step.fused_mask_sample_ms",
+                        (time.perf_counter() - t_fm) * 1e3)
         self.cur = self.cur.at[slot].set(tok0[0])
         self.fsm = self.fsm.at[slot].set(fsm0[0])
         self.pos = self.pos.at[slot].set(n)
@@ -570,7 +580,7 @@ class ContinuousBatcher:
         if alloc is not None:
             from .paged import record_pool_gauges
 
-            record_pool_gauges(alloc)
+            record_pool_gauges(alloc, engine=eng)
         radix = getattr(eng, "radix", None)
         if radix is not None:
             from .radix import record_radix_gauges

@@ -121,7 +121,8 @@ def _draft_cap(draft_len, tokens_left, pos, max_pos, active):
 def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
                    draft_toks, dl, step_tok, blk_tok, tables: DeviceFSM,
                    byte_len_table, byte_budget, logit_mask, K: int,
-                   eos_id: int, pad_id: int, max_pos):
+                   eos_id: int, pad_id: int, max_pos,
+                   kernels: str = "xla", rules=None):
     """Post-forward half of a verify step — THE one copy shared by the
     dense and paged jitted steps (jit-inlined at both call sites): FSM scan
     along the draft path, masked greedy per position, longest-prefix
@@ -144,19 +145,36 @@ def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
     _, states_rest = jax.lax.scan(sstep, fsm_state, draft_toks.T)  # (K, B)
     states = jnp.concatenate([fsm_state[None, :], states_rest], axis=0)
 
-    # target greedy per position under the SAME masks as the plain path
-    # (logit_mask then grammar row) — identical argmax, one position at a
-    # time to keep the (B, V) mask footprint of the non-speculative step
-    gs = []
-    for i in range(K + 1):
-        s_i = states[i]
-        lg = logits[:, i, :]
-        if logit_mask is not None:
-            lg = jnp.where(logit_mask[None, :], lg, -jnp.inf)
-        row = fsm_row(tables, jnp.maximum(s_i, 0))
-        lg = jnp.where((row >= 0) & (s_i >= 0)[:, None], lg, -jnp.inf)
-        gs.append(jnp.argmax(lg, axis=-1).astype(jnp.int32))
-    g = jnp.stack(gs, axis=1)  # (B, K+1) target greedy choices
+    if kernels == "pallas" and tables.dense_mask is not None:
+        # fused verify tail (ISSUE 12): every position's grammar mask +
+        # argmax in ONE Pallas call (ops.masked_argmax_block folds the
+        # (B, 1+K) positions into kernel rows, each streaming its own
+        # state's mask tiles) instead of K+1 sequential (B, V) XLA rounds.
+        # logit_mask is subsumed: padded-vocab ids are never grammar-legal.
+        # Dead states clamp to 0 — their positions sit strictly past the
+        # first draft mismatch (a draft token that matched the target's
+        # grammar-legal pick cannot have made a dead transition), so the
+        # clamped garbage can never affect acceptance, bonus, or poison.
+        from ..ops import sharded_masked_argmax_block
+
+        mesh = rules.mesh if rules is not None else None
+        g = sharded_masked_argmax_block(
+            mesh, logits, states.T, tables.dense_mask)  # (B, K+1)
+        g = jnp.where((states.T >= 0), g, 0)
+    else:
+        # target greedy per position under the SAME masks as the plain path
+        # (logit_mask then grammar row) — identical argmax, one position at
+        # a time to keep the (B, V) mask footprint of the non-spec step
+        gs = []
+        for i in range(K + 1):
+            s_i = states[i]
+            lg = logits[:, i, :]
+            if logit_mask is not None:
+                lg = jnp.where(logit_mask[None, :], lg, -jnp.inf)
+            row = fsm_row(tables, jnp.maximum(s_i, 0))
+            lg = jnp.where((row >= 0) & (s_i >= 0)[:, None], lg, -jnp.inf)
+            gs.append(jnp.argmax(lg, axis=-1).astype(jnp.int32))
+        g = jnp.stack(gs, axis=1)  # (B, K+1) target greedy choices
 
     # accept: d_{i+1} must equal the target's pick, never be EOS (the plain
     # loop never emits EOS — it becomes the stopping cur), inside the capped
@@ -283,7 +301,8 @@ def spec_verify_step(
      a, dl, poison) = _verify_commit(
         logits, cur, pos, fsm_state, active, nbytes, tokens_left,
         draft_toks, dl, step_tok, blk_tok, tables, byte_len_table,
-        byte_budget, logit_mask, K, eos_id, pad_id, max_len)
+        byte_budget, logit_mask, K, eos_id, pad_id, max_len,
+        kernels=kernels, rules=rules)
     return (out, n_step, eos, cache, new_cur, new_pos, new_state, new_active,
             nbytes, left, a, dl, poison)
 
@@ -292,8 +311,8 @@ def spec_verify_step(
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "K", "kernels", "eos_id", "pad_id",
-                     "max_len"),
-    donate_argnames=("k_pool", "v_pool"),
+                     "max_len", "kv_quant"),
+    donate_argnames=("k_pool", "v_pool", "k_scale", "v_scale"),
 )
 def paged_spec_verify_step(
     params,
@@ -316,11 +335,17 @@ def paged_spec_verify_step(
     rules=None,
     logit_mask=None,
     nan_inject=None,  # (B,) bool or None — chaos drill
+    k_scale=None,  # (L, N, bs, nkv) KV_QUANT scale planes (None = bf16 pool;
+    # draft writes land values AND scales past the admission frontier, so
+    # block-granular rollback covers the quantized tier unchanged — a
+    # rejected draft's stale scale is overwritten with its stale value)
+    v_scale=None,
     K: int = 4,
     kernels: str = "xla",
     eos_id: int = 2,
     pad_id: int = 0,
     max_len: int | None = None,
+    kv_quant: str | None = None,
 ):
     """spec_verify_step's paged twin — the batched verify mode of the paged
     chunk path (ISSUE 8): per-slot ``[cur, d_1..d_K]`` columns in ONE
@@ -346,10 +371,11 @@ def paged_spec_verify_step(
     step_tok, blk_tok, blk_pos = chain_block(iw, cur, draft_toks, dl, active,
                                              pad_id, pos)
 
-    logits, k_pool, v_pool = forward_paged(
+    logits, k_pool, v_pool, k_scale, v_scale = forward_paged(
         params, cfg, blk_tok, blk_pos, k_pool, v_pool, block_tables,
         rules=rules, attn_impl=kernels, write_mask=active,
-        trash_idx=trash_idx)  # (B, 1+K, V)
+        trash_idx=trash_idx, k_scale=k_scale, v_scale=v_scale,
+        kv_quant=kv_quant)  # (B, 1+K, V)
     if nan_inject is not None:
         logits = jnp.where(nan_inject[:, None, None] & active[:, None, None],
                            jnp.float32(jnp.nan), logits)
@@ -358,9 +384,10 @@ def paged_spec_verify_step(
      a, dl, poison) = _verify_commit(
         logits, cur, pos, fsm_state, active, nbytes, tokens_left,
         draft_toks, dl, step_tok, blk_tok, tables, byte_len_table,
-        byte_budget, logit_mask, K, eos_id, pad_id, max_pos)
-    return (out, n_step, eos, k_pool, v_pool, new_cur, new_pos, new_state,
-            new_active, nbytes, left, a, dl, poison)
+        byte_budget, logit_mask, K, eos_id, pad_id, max_pos,
+        kernels=kernels, rules=rules)
+    return (out, n_step, eos, k_pool, v_pool, k_scale, v_scale, new_cur,
+            new_pos, new_state, new_active, nbytes, left, a, dl, poison)
 
 
 # ---------------------------------------------------------------- drafters
@@ -811,7 +838,8 @@ class SpecDecoder:
         the engine's KV already committed back onto the engine."""
         eng = self.engine
         if self.paged:
-            (out, n, eosf, eng.k_pool, eng.v_pool, cur, pos, fsm, active,
+            (out, n, eosf, eng.k_pool, eng.v_pool, eng.k_scale, eng.v_scale,
+             cur, pos, fsm, active,
              nbytes, tokens_left, a, dl, pois) = paged_spec_verify_step(
                 eng.params, eng.cfg, eng.k_pool, eng.v_pool,
                 eng.block_tables, cur, pos, fsm, active, nbytes, tokens_left,
@@ -819,8 +847,10 @@ class SpecDecoder:
                 eng.tables, eng.byte_len_table, jnp.int32(byte_budget),
                 trash_idx=eng._trash_idx, rules=eng.rules,
                 logit_mask=eng.logit_mask, nan_inject=nan_inject,
+                k_scale=eng.k_scale, v_scale=eng.v_scale,
                 K=self.K, kernels=eng.kernels, eos_id=eng.eos_id,
-                pad_id=eng.pad_id, max_len=eng.max_len)
+                pad_id=eng.pad_id, max_len=eng.max_len,
+                kv_quant=eng.kv_quant)
         else:
             (out, n, eosf, eng.cache, cur, pos, fsm, active, nbytes,
              tokens_left, a, dl, pois) = spec_verify_step(

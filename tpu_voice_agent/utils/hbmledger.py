@@ -73,7 +73,14 @@ def engine_hbm_plan(engine) -> dict:
 
     pool_blocks = getattr(getattr(engine, "allocator", None), "n_blocks", None)
     if pool_blocks is not None:
-        kv = 2 * L * pool_blocks * engine.block_size * nkv * hd * 2
+        # KV_QUANT-aware (ISSUE 12 satellite): bytes-per-block from the
+        # stored dtype + scale-plane overhead (ops.kvquant is the single
+        # source), so hbm.plan_drift stays ~0 under int8/int4 instead of
+        # flagging a phantom 2-4x drift against a bf16-assumed plan
+        from ..ops.kvquant import kv_block_bytes
+
+        kv = pool_blocks * kv_block_bytes(
+            L, engine.block_size, nkv, hd, getattr(engine, "kv_quant", None))
     else:
         kv = 2 * L * engine.batch_slots * engine.max_len * nkv * hd * 2
         P = len(getattr(engine, "prefix_ids", ()) or ())
@@ -96,6 +103,11 @@ def measure_hbm(engine) -> dict:
     weights = _tree_bytes(getattr(engine, "params", None))
     if getattr(engine, "allocator", None) is not None:
         kv = int(engine.k_pool.nbytes + engine.v_pool.nbytes)
+        # quantized pools carry their bf16 scale planes beside the values
+        for sc in (getattr(engine, "k_scale", None),
+                   getattr(engine, "v_scale", None)):
+            if sc is not None:
+                kv += int(sc.nbytes)
     else:
         cache = getattr(engine, "cache", None)
         kv = _tree_bytes(cache)
@@ -130,6 +142,29 @@ def measure_hbm(engine) -> dict:
     else:
         out["workspace_bytes"] = 0
     return out
+
+
+def decode_step_bytes(cfg, batch: int, context_tokens: int,
+                      kv_quant: str | None = None,
+                      weight_quant: str | None = "int8") -> dict:
+    """Modeled HBM bytes ONE decode step moves at (batch, context) — the
+    CPU-harness proxy for the decode-stage wall (docs/PERF.md: decode is
+    HBM-bound, so step wall ∝ bytes moved). Weights stream once per step
+    for the whole batch; each live slot reads its attended KV. KV bytes
+    follow the ops.kvquant per-(position, head) layout, so the ratio
+    between tiers IS the modeled decode-stage speedup the bench kv_quant
+    rows report (benches/bench_spec.py)."""
+    from ..ops.kvquant import KV_QUANT_VBYTES, KV_SCALE_BYTES
+
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    nq, nkv, L, V = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers, cfg.vocab_size
+    wbytes = 1 if weight_quant == "int8" else 2
+    attn = d * nq * hd + 2 * d * nkv * hd + nq * hd * d
+    weights = (L * (attn + 3 * d * f) + V * d) * wbytes
+    per_pos_head = hd * KV_QUANT_VBYTES[kv_quant] + KV_SCALE_BYTES[kv_quant]
+    kv = int(2 * L * context_tokens * nkv * per_pos_head) * batch
+    return {"weights_bytes": int(weights), "kv_read_bytes": int(kv),
+            "total_bytes": int(weights + kv)}
 
 
 def hbm_report(engine) -> dict:

@@ -146,6 +146,7 @@ declare("SPEC_K", "4", "draft width — each verify step emits 1..K+1 tokens", t
 declare("SPEC_DRAFTER", "fsm,prompt", "drafter chain: fsm | prompt | model, first non-empty proposal wins", table=PERF)
 declare("SPEC_DRAFT_MODEL", None, "orbax checkpoint dir for the model drafter", table=PERF)
 declare("SPEC_TRACE_SINK", None, "JSONL path for per-request speculation traces (drafter retraining)", table=PERF)
+declare("KV_QUANT", None, "paged KV pool storage tier: int8 | int4 (unset = bf16, byte-identical path)", table=PERF)
 declare("RADIX_ENABLE", None, "1 builds the radix KV session cache", table=PERF)
 declare("RADIX_MAX_NODES", "4096", "radix tree size cap per dp group", table=PERF)
 declare("RADIX_SESSIONS", "256", "host-side transcript LRU in the brain", table=PERF)
