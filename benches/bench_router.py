@@ -23,7 +23,10 @@ same CPU harness every service-level bench uses):
    killed, the next turn routed through the router vs the SAME turn
    cold-started directly on the new home: byte-identical ParseResponse.
    Warmth is a latency property, never a correctness one. GATE: exact
-   equality.
+   equality. (The re-home COST claim — warm handoff dropping the re-homed
+   turn's computed prefill from cold-re-prefill to ~transfer bookkeeping —
+   is gated by ``benches/bench_handoff.py`` against real engine replicas;
+   this bench's rule-based replicas have no prefill to measure.)
 
 SLO thresholds are widened for the CPU harness exactly like bench_chaos
 (the verdict is behavior under faults at IDENTICAL thresholds, not the

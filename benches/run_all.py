@@ -23,7 +23,7 @@ from pathlib import Path
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_quality.py", "bench_faults.py", "bench_spec.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
-           "bench_steplog.py", "bench_router.py"]
+           "bench_steplog.py", "bench_router.py", "bench_handoff.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -45,9 +45,14 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # the router bench stays on --quick as well — it is the replica-fault-
 # domain regression gate (rule-based replicas, no model, trimmed search),
 # and a PR that breaks failover/drain must fail the quick table too
+# the handoff bench stays on --quick too — it is the STT-failover and
+# warm-re-home regression gate (tiny engines, fixed-N drill, seconds on
+# CPU), and a PR that breaks zero-lost failover or the warm re-home's
+# prefill collapse must fail the quick table as well
 QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
-                 "bench_chaos.py", "bench_steplog.py", "bench_router.py"]
+                 "bench_chaos.py", "bench_steplog.py", "bench_router.py",
+                 "bench_handoff.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_SPEC_PAGED_SESSIONS": "2", "BENCH_SPEC_PAGED_TURNS": "2",
@@ -57,7 +62,10 @@ QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_CHAOS_MAX_N": "4", "BENCH_CHAOS_UTTERANCES": "2",
              "BENCH_STEPLOG_SESSIONS": "6", "BENCH_STEPLOG_ROUNDS": "2",
              "BENCH_ROUTER_MAX_N": "6", "BENCH_ROUTER_UTTERANCES": "2",
-             "BENCH_ROUTER_REPLICAS": "2"}
+             "BENCH_ROUTER_REPLICAS": "2",
+             "BENCH_HANDOFF_STT_STREAMS": "2",
+             "BENCH_HANDOFF_STT_UTTERANCES": "2",
+             "BENCH_HANDOFF_TURNS": "5"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -148,7 +156,7 @@ def main() -> None:
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
                             "spec", "stt", "radix", "swarm", "chaos",
                             "steplog", "engine_step", "xla", "hbm",
-                            "router", "kv_quant"):
+                            "router", "kv_quant", "handoff"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -1,0 +1,246 @@
+"""Warm-state handoff (serve.handoff, ISSUE 13) — FAST tier, because the
+re-home identity contract gates tier-1.
+
+The non-negotiable contract: a session re-homed with warm state produces
+TOKEN-IDENTICAL output to having stayed home — per KV tier (off/int8/int4)
+— and every fallback (mid-chain-evicted donor, pool-pressured recipient,
+tier mismatch, malformed blob) is CLEAN: the transcript still ships, the
+next turn cold-prefills, the tokens still match, the fallback is counted.
+Block accounting must balance on both ends (allocator refcounts are the
+single source of truth, exactly like the radix plane's own tests).
+"""
+
+import pytest
+
+from tpu_voice_agent.serve import PagedDecodeEngine
+from tpu_voice_agent.serve import handoff
+from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+from tpu_voice_agent.services.brain import (
+    SessionTranscripts,
+    install_prompt_prefix,
+)
+from tpu_voice_agent.utils import get_metrics
+
+BUCKETS = (128, 256, 512, 1024, 2048)
+SID = "handoff-session"
+
+TURNS = [
+    ("search for wireless headphones", {}),
+    ("open the second result", {"last_query": "wireless headphones"}),
+]
+TURN3 = ("sort these by price from low to high",
+         {"last_query": "wireless headphones"})
+
+
+def _paged(kv_quant=None, **kw):
+    eng = PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=2,
+                            prefill_buckets=BUCKETS, radix_enable=True,
+                            kv_quant=kv_quant, **kw)
+    install_prompt_prefix(eng)
+    return eng
+
+
+def _run(eng, prompts, max_new=32):
+    return ContinuousBatcher(eng, chunk_steps=16,
+                             max_new_tokens=max_new).generate_many(prompts)
+
+
+def _play(eng, transcripts, turns, sid=SID):
+    """Drive turns exactly like the session-aware brain (prompt_for /
+    record); returns per-turn GenerationResults."""
+    out = []
+    for text, ctx in turns:
+        prompt = transcripts.prompt_for(sid, text, ctx)
+        r = _run(eng, [prompt])[0]
+        assert r.error is None, r.error
+        transcripts.record(sid, prompt, r.token_ids)
+        out.append(r)
+    return out
+
+
+def _counters():
+    return get_metrics().snapshot()["counters"]
+
+
+def _assert_balanced(eng):
+    """Every live block is owned by the engine prefix or the radix tree
+    (slots are all released): blocks_in_use must equal prefix blocks plus
+    the tree's non-pinned nodes — a leak or double-free breaks this."""
+    pb = len(eng._prefix_blocks[0])
+    nodes = eng.radix[0].nodes
+    assert eng.allocator.blocks_in_use == pb + (nodes - pb)
+
+
+# ------------------------------------------------------------ happy path
+
+
+@pytest.mark.parametrize("tier", [None, "int8", "int4"])
+def test_rehomed_turn_token_identical_per_tier(tier):
+    """THE differential: donor plays two turns, ships the session, the
+    recipient's turn 3 is token-identical to the donor's own turn 3 —
+    with the full transcript chain served from adopted KV (cached_tokens
+    match), per storage tier."""
+    donor, recip = _paged(tier), _paged(tier)
+    tr_d = SessionTranscripts(donor.tokenizer)
+    tr_r = SessionTranscripts(recip.tokenizer)
+    _play(donor, tr_d, TURNS)
+    blob = handoff.export_session(donor, tr_d, SID)
+    assert blob is not None
+    stay = _play(donor, tr_d, [TURN3])[0]
+    adopted = handoff.adopt_session(recip, tr_r, blob)
+    P = len(donor.prefix_ids)
+    assert adopted > P  # a real chain beyond the static prefix shipped
+    moved = _play(recip, tr_r, [TURN3])[0]
+    assert moved.token_ids == stay.token_ids
+    assert moved.cached_tokens == stay.cached_tokens
+    assert moved.cached_tokens >= adopted  # the adopted chain was SERVED
+    _assert_balanced(recip)
+    _assert_balanced(donor)
+
+
+def test_adopt_is_idempotent_and_leak_free():
+    """Adopting the same blob twice (a retried handoff) must not leak
+    blocks or duplicate tree nodes — the duplicate chain's blocks fall
+    straight back to the free list."""
+    donor, recip = _paged(), _paged()
+    tr_d = SessionTranscripts(donor.tokenizer)
+    tr_r = SessionTranscripts(recip.tokenizer)
+    _play(donor, tr_d, TURNS)
+    blob = handoff.export_session(donor, tr_d, SID)
+    a1 = handoff.adopt_session(recip, tr_r, blob)
+    nodes1 = recip.radix[0].nodes
+    used1 = recip.allocator.blocks_in_use
+    a2 = handoff.adopt_session(recip, tr_r, blob)
+    assert a1 == a2 > 0
+    assert recip.radix[0].nodes == nodes1
+    assert recip.allocator.blocks_in_use == used1
+    _assert_balanced(recip)
+
+
+def test_pack_unpack_roundtrip_and_malformed_blob():
+    import numpy as np
+
+    arrays = {"k": np.arange(12, dtype=np.int8).reshape(3, 4),
+              "s": np.ones((2, 2), dtype=np.float32)}
+    blob = handoff.pack({"session_id": "x", "ids": [1, 2]}, arrays)
+    meta, out = handoff.unpack(blob)
+    assert meta["ids"] == [1, 2]
+    assert out["k"].tolist() == arrays["k"].tolist()
+    assert out["s"].dtype == np.float32
+    with pytest.raises(ValueError):
+        handoff.unpack(b"not a handoff blob")
+    with pytest.raises(ValueError):
+        handoff.unpack(blob[:-4])  # truncated array bytes
+
+
+# ------------------------------------------------------------- fallbacks
+
+
+def test_mid_chain_evicted_donor_still_ships_transcript_and_matches():
+    """The donor's session chain was (partially) evicted before the
+    handoff: whatever still matches ships; the transcript always ships;
+    the recipient's turn is token-identical either way (the un-shipped
+    span just re-prefills)."""
+    donor, recip = _paged(), _paged()
+    tr_d = SessionTranscripts(donor.tokenizer)
+    tr_r = SessionTranscripts(recip.tokenizer)
+    _play(donor, tr_d, TURNS)
+    # evict EVERYTHING evictable (the whole unreferenced session chain)
+    donor.radix[0].evict(10_000)
+    blob = handoff.export_session(donor, tr_d, SID)
+    assert blob is not None
+    stay = _play(donor, tr_d, [TURN3])[0]
+    adopted = handoff.adopt_session(recip, tr_r, blob)
+    assert adopted == 0  # nothing beyond the static prefix was cached
+    moved = _play(recip, tr_r, [TURN3])[0]
+    assert moved.token_ids == stay.token_ids  # cold re-prefill, same tokens
+    assert moved.cached_tokens >= len(recip.prefix_ids) // recip.block_size \
+        * recip.block_size  # its own pinned prefix still serves
+    _assert_balanced(recip)
+
+
+def test_pool_pressured_recipient_falls_back_cold_counted():
+    """The recipient's pool cannot take the chain (PoolExhausted even
+    after radix eviction): adoption returns 0, the fallback is counted,
+    the transcript is still adopted, and the next turn is token-identical
+    through a cold prefill."""
+    donor, recip = _paged(), _paged()
+    tr_d = SessionTranscripts(donor.tokenizer)
+    tr_r = SessionTranscripts(recip.tokenizer)
+    _play(donor, tr_d, TURNS)
+    blob = handoff.export_session(donor, tr_d, SID)
+    stay = _play(donor, tr_d, [TURN3])[0]
+    # squeeze the recipient's pool: hold every free block so the adoption
+    # alloc fails with nothing evictable, then release the squeeze
+    hold = recip.allocator.alloc(recip.allocator.free_blocks(0))
+    before = _counters().get("handoff.adopt_fallbacks", 0)
+    adopted = handoff.adopt_session(recip, tr_r, blob)
+    assert adopted == 0
+    assert _counters().get("handoff.adopt_fallbacks", 0) == before + 1
+    assert tr_r.peek(SID) is not None  # the transcript DID ship
+    recip.allocator.free(hold)
+    moved = _play(recip, tr_r, [TURN3])[0]
+    assert moved.token_ids == stay.token_ids
+    _assert_balanced(recip)
+
+
+def test_capacity_capped_recipient_tree_counts_cold():
+    """The recipient's radix tree is at max_nodes with only pinned nodes:
+    insert adopts nothing and the blocks fall back to the pool — the
+    adoption must report COLD (counted), never a warm re-home that the
+    next turn then cold-prefills anyway."""
+    donor = _paged()
+    tr_d = SessionTranscripts(donor.tokenizer)
+    _play(donor, tr_d, TURNS)
+    blob = handoff.export_session(donor, tr_d, SID)
+    # cap the recipient's tree at exactly its pinned prefix chain
+    recip = PagedDecodeEngine(
+        preset="test-tiny", max_len=2048, batch_slots=2,
+        prefill_buckets=BUCKETS, radix_enable=True, radix_max_nodes=1)
+    install_prompt_prefix(recip)  # pin_root_chain installs regardless
+    tr_r = SessionTranscripts(recip.tokenizer)
+    used0 = recip.allocator.blocks_in_use
+    before = _counters().get("handoff.adopt_fallbacks", 0)
+    adopted = handoff.adopt_session(recip, tr_r, blob)
+    assert adopted == 0
+    assert _counters().get("handoff.adopt_fallbacks", 0) == before + 1
+    assert recip.allocator.blocks_in_use == used0  # blocks fell back
+    assert tr_r.peek(SID) is not None  # transcript still shipped
+
+
+def test_tier_mismatch_falls_back_clean():
+    """Donor int8, recipient bf16: the KV bytes are not adoptable (the
+    stored formats differ) — transcript-only adoption, counted, and the
+    recipient still parses the turn without error."""
+    donor, recip = _paged("int8"), _paged(None)
+    tr_d = SessionTranscripts(donor.tokenizer)
+    tr_r = SessionTranscripts(recip.tokenizer)
+    _play(donor, tr_d, TURNS)
+    blob = handoff.export_session(donor, tr_d, SID)
+    before = _counters().get("handoff.adopt_fallbacks", 0)
+    adopted = handoff.adopt_session(recip, tr_r, blob)
+    assert adopted == 0
+    assert _counters().get("handoff.adopt_fallbacks", 0) == before + 1
+    moved = _play(recip, tr_r, [TURN3])[0]
+    assert moved.error is None and recip.fsm.walk(moved.token_ids) >= 0
+    _assert_balanced(recip)
+
+
+def test_handoff_kv_ablation_ships_transcript_only(monkeypatch):
+    """HANDOFF_KV=0 (the cold-re-home baseline the bench measures): the
+    blob carries no arrays, adoption is transcript-only, and the turn is
+    still token-identical — only the prefill cost differs."""
+    donor, recip = _paged(), _paged()
+    tr_d = SessionTranscripts(donor.tokenizer)
+    tr_r = SessionTranscripts(recip.tokenizer)
+    _play(donor, tr_d, TURNS)
+    monkeypatch.setenv("HANDOFF_KV", "0")
+    blob = handoff.export_session(donor, tr_d, SID)
+    monkeypatch.delenv("HANDOFF_KV")
+    meta, arrays = handoff.unpack(blob)
+    assert not arrays and meta["chain_tokens"] == 0
+    stay = _play(donor, tr_d, [TURN3])[0]
+    assert handoff.adopt_session(recip, tr_r, blob) == 0
+    moved = _play(recip, tr_r, [TURN3])[0]
+    assert moved.token_ids == stay.token_ids
+    assert moved.cached_tokens < stay.cached_tokens  # cold: prefix only

@@ -101,6 +101,8 @@ def _fake_replica(name: str, log: list, *, session_aware: bool = False,
         if controls.get("draining"):
             body["draining"] = True
             body["drained"] = True
+        if "pressure" in controls:
+            body["pressure"] = {"score": controls["pressure"]}
         return web.json_response(body)
 
     async def admin_drain(req: web.Request) -> web.Response:
@@ -110,10 +112,26 @@ def _fake_replica(name: str, log: list, *, session_aware: bool = False,
         return web.json_response({"ok": True, "draining": True,
                                   "drained": True})
 
+    async def handoff_get(req: web.Request) -> web.Response:
+        # the warm-state export surface: controls["warm"] maps session id
+        # -> blob bytes (a real brain serializes transcript + KV here)
+        blob = (controls.get("warm") or {}).get(req.match_info["session_id"])
+        if blob is None:
+            return web.json_response({"error": "no_warm_state"}, status=404)
+        return web.Response(body=blob,
+                            content_type="application/octet-stream")
+
+    async def handoff_post(req: web.Request) -> web.Response:
+        blob = await req.read()
+        controls.setdefault("adopted", []).append(blob)
+        return web.json_response({"ok": True, "adopted_tokens": 7})
+
     app = web.Application()
     app.router.add_post("/parse", parse)
     app.router.add_get("/health", health)
     app.router.add_post("/admin/drain", admin_drain)
+    app.router.add_get("/admin/handoff/{session_id}", handoff_get)
+    app.router.add_post("/admin/handoff", handoff_post)
     return app
 
 
@@ -480,6 +498,130 @@ def test_router_races_submit_vs_eject_and_drain():
         post_drain_on_r1 = [e for e in logs[1]
                             if (e[1] or "").startswith("post-hammer-")]
         assert not post_drain_on_r1, post_drain_on_r1
+    finally:
+        _teardown(router, servers)
+
+
+# ------------------------------------------ warm-state handoff (ISSUE 13)
+
+
+def test_drain_rehome_ships_warm_state_and_counts_warm():
+    """A drained home is still alive: the re-home ships the session's warm
+    state (GET old /admin/handoff/{sid} -> POST new /admin/handoff) before
+    the first forwarded parse, and the move counts sessions_rehomed_warm."""
+    router, servers, logs, controls, robj = _ring(2, probe_s=0.1,
+                                                  handoff_enable=True)
+    try:
+        sid = _sid_homed_on(robj, 0, "wh")
+        _post(router.url + "/parse",
+              {"text": "go back", "session_id": sid, "context": {}})
+        controls[0]["warm"] = {sid: b"warm-session-blob"}
+        warm0 = _counters().get("router.sessions_rehomed_warm", 0)
+        _post(router.url + "/admin/drain", {"replica": robj.replicas[0].url})
+        deadline = time.monotonic() + 5
+        while robj.replicas[0].state != "drained":
+            assert time.monotonic() < deadline, "drain never completed"
+            time.sleep(0.05)
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "scroll down", "session_id": sid,
+                              "context": {}})
+        assert st == 200
+        assert hdrs["x-router-replica"] == robj.replicas[1].url
+        # the blob crossed replicas verbatim
+        assert controls[1].get("adopted") == [b"warm-session-blob"]
+        c = _counters()
+        assert c.get("router.sessions_rehomed_warm", 0) == warm0 + 1
+    finally:
+        _teardown(router, servers)
+
+
+def test_crash_rehome_counts_cold():
+    """A crashed home cannot ship anything: the failover retry re-homes
+    the session and the move counts sessions_rehomed_cold — the PR 10
+    behavior, now explicitly accounted."""
+    router, servers, logs, controls, robj = _ring(2, probe_s=0.1,
+                                                  handoff_enable=True)
+    try:
+        sid = _sid_homed_on(robj, 0, "ch")
+        _post(router.url + "/parse",
+              {"text": "go back", "session_id": sid, "context": {}})
+        cold0 = _counters().get("router.sessions_rehomed_cold", 0)
+        controls[0]["dead"] = True
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "scroll down", "session_id": sid,
+                              "context": {}})
+        assert st == 200
+        assert hdrs["x-router-replica"] == robj.replicas[1].url
+        assert _counters().get("router.sessions_rehomed_cold", 0) == cold0 + 1
+        assert not controls[1].get("adopted")  # nothing was shipped
+    finally:
+        _teardown(router, servers)
+
+
+def test_handoff_disabled_counts_cold_and_ships_nothing():
+    router, servers, logs, controls, robj = _ring(2, probe_s=0.1)
+    try:
+        sid = _sid_homed_on(robj, 0, "hd")
+        _post(router.url + "/parse",
+              {"text": "go back", "session_id": sid, "context": {}})
+        controls[0]["warm"] = {sid: b"blob"}
+        cold0 = _counters().get("router.sessions_rehomed_cold", 0)
+        controls[0]["dead"] = True
+        deadline = time.monotonic() + 5
+        while robj.replicas[0].state != "down":
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        _post(router.url + "/parse",
+              {"text": "scroll down", "session_id": sid, "context": {}})
+        assert _counters().get("router.sessions_rehomed_cold", 0) == cold0 + 1
+        assert not controls[1].get("adopted")
+    finally:
+        _teardown(router, servers)
+
+
+# --------------------------------------- gauge-driven shedding (ISSUE 13)
+
+
+def test_pressure_sheds_new_sessions_but_not_sticky_ones():
+    """A replica reporting pressure >= ROUTER_SHED_PRESSURE stops
+    receiving NEW sessions (they redirect, counted) while its existing
+    sessions stay home; with EVERY replica over, placement falls back to
+    plain rendezvous instead of erroring."""
+    router, servers, logs, controls, robj = _ring(2, probe_s=0.1,
+                                                  shed_pressure=0.9)
+    try:
+        sticky = _sid_homed_on(robj, 0, "ps")
+        _post(router.url + "/parse",
+              {"text": "go back", "session_id": sticky, "context": {}})
+        controls[0]["pressure"] = 0.97
+        deadline = time.monotonic() + 5
+        while robj.replicas[0].pressure < 0.9:
+            assert time.monotonic() < deadline, "probe never saw pressure"
+            time.sleep(0.05)
+        shed0 = _counters().get("router.shed_pressure", 0)
+        fresh = _sid_homed_on(robj, 0, "ps-new")
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "scroll down", "session_id": fresh,
+                              "context": {}})
+        assert hdrs["x-router-replica"] == robj.replicas[1].url
+        assert _counters().get("router.shed_pressure", 0) == shed0 + 1
+        # sticky sessions never move for pressure
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "go back", "session_id": sticky,
+                              "context": {}})
+        assert hdrs["x-router-replica"] == robj.replicas[0].url
+        # every replica over: degrade placement quality, never error
+        controls[1]["pressure"] = 0.99
+        deadline = time.monotonic() + 5
+        while robj.replicas[1].pressure < 0.9:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        both = _sid_homed_on(robj, 0, "ps-full")
+        st, hdrs, _b = _post(router.url + "/parse",
+                             {"text": "go back", "session_id": both,
+                              "context": {}})
+        assert st == 200
+        assert hdrs["x-router-replica"] == robj.replicas[0].url  # rendezvous
     finally:
         _teardown(router, servers)
 

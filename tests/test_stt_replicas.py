@@ -1,0 +1,285 @@
+"""Replicated STT tier (serve.stt_replicas, ISSUE 13) — FAST tier.
+
+The contract: N STTBatcher replicas behind utterance-affine placement;
+one crashed/wedged Whisper worker costs a warm restart and a failover,
+never a lost final and never the other replicas' utterances. Finals are
+token-identical to the single-engine reference wherever they end up
+(the same engine weights serve every replica), and the watchdog's
+stalled-tick warm restart reuses the loaded engine.
+"""
+
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from tpu_voice_agent.serve.stt import SpeechEngine
+from tpu_voice_agent.serve.stt_replicas import STTReplicaTier, current_tier
+from tpu_voice_agent.services.replicaset import rendezvous_weight
+from tpu_voice_agent.utils import chaos as chaos_mod
+from tpu_voice_agent.utils import get_metrics
+
+
+def tone(freq, dur_s, amp=0.3, sr=16_000):
+    t = np.arange(int(dur_s * sr)) / sr
+    return (amp * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return SpeechEngine(preset="whisper-test", frame_buckets=(50, 100, 200),
+                        max_new_tokens=16)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_reset():
+    chaos_mod.reset()
+    yield
+    chaos_mod.reset()
+
+
+def _counters():
+    return get_metrics().snapshot()["counters"]
+
+
+def _utt_homed_on(tier: STTReplicaTier, idx: int, base: int = 50_000) -> int:
+    """An utterance id whose rendezvous home is replica ``idx``."""
+    keys = [r.url for r in tier.replicas]
+    for u in range(base, base + 10_000):
+        if max(range(len(keys)),
+               key=lambda j: rendezvous_weight(keys[j], str(u))) == idx:
+            return u
+    raise AssertionError("no utterance hashed onto the target replica")
+
+
+def _tick_all(tier, rounds=8):
+    for _ in range(rounds):
+        for b in tier.batchers:
+            if b.healthy():
+                b.tick()
+
+
+# ------------------------------------------------------------- placement
+
+
+def test_tier_affinity_identity_and_release(engine):
+    """Finals through the tier match the single-engine reference; an
+    utterance's work stays on ONE replica (its slot lives there); release
+    forgets the sticky entry."""
+    tier = STTReplicaTier(engine, replicas=2, slots=4, autostart=False,
+                          register=False)
+    try:
+        audios = {60_001: tone(300, 0.4), 60_002: tone(440, 0.9)}
+        singles = {u: engine.transcribe(a).text for u, a in audios.items()}
+        futs = {u: tier.submit("final", u, a) for u, a in audios.items()}
+        _tick_all(tier)
+        for u, f in futs.items():
+            assert f.result(timeout=30).text == singles[u]
+        # partial + final for one utterance land on the same replica
+        u = 60_003
+        tier.submit("partial", u, tone(330, 1.0))
+        home = tier._sessions[str(u)]
+        tier.submit("final", u, tone(330, 1.0))
+        assert tier._sessions[str(u)] == home
+        _tick_all(tier)
+        tier.release(u)
+        assert str(u) not in tier._sessions
+        for b in tier.batchers:
+            assert u not in b.slot_of  # the slot is freed everywhere
+    finally:
+        tier.stop()
+
+
+# -------------------------------------------------------------- failover
+
+
+def test_final_fails_over_off_a_killed_replica(engine):
+    """The home replica dies with the final queued: the future fails over
+    to the other replica and delivers the reference transcript — zero
+    lost finals, counted."""
+    tier = STTReplicaTier(engine, replicas=2, slots=4, autostart=False,
+                          register=False)
+    try:
+        u = _utt_homed_on(tier, 0)
+        audio = tone(410, 0.7)
+        ref = engine.transcribe(audio).text
+        fo0 = _counters().get("stt.replica_failovers", 0)
+        rh0 = _counters().get("stt.replica_rehomed", 0)
+        fut = tier.submit("final", u, audio)
+        assert tier._sessions[str(u)] == tier.replicas[0].url
+        # the crash: queued work fails abruptly, like a killed process
+        tier.batchers[0].kill(RuntimeError("crashed"))
+        _tick_all(tier)
+        assert fut.result(timeout=30).text == ref
+        # the failover itself re-homed the utterance (route with the dead
+        # home excluded) — both counted, and residence is now sticky on
+        # the survivor
+        assert _counters().get("stt.replica_failovers", 0) == fo0 + 1
+        assert _counters().get("stt.replica_rehomed", 0) == rh0 + 1
+        assert tier._sessions[str(u)] == tier.replicas[1].url
+        # the NEXT submit serves straight from the new home, no extra move
+        fut2 = tier.submit("final", u, audio)
+        _tick_all(tier)
+        assert fut2.result(timeout=30).text == ref
+        assert _counters().get("stt.replica_rehomed", 0) == rh0 + 1
+    finally:
+        tier.stop()
+
+
+def test_all_replicas_down_fails_finals_sheds_partials(engine):
+    tier = STTReplicaTier(engine, replicas=2, slots=4, autostart=False,
+                          register=False)
+    try:
+        for b in tier.batchers:
+            b.kill(RuntimeError("gone"))
+        f = tier.submit("final", 61_000, tone(300, 0.4))
+        with pytest.raises(RuntimeError):
+            f.result(timeout=5)
+        p = tier.submit("partial", 61_001, tone(300, 0.4))
+        assert p.result(timeout=5) is None  # shed, not raised
+    finally:
+        tier.stop()
+
+
+# -------------------------------------------------------------- watchdog
+
+
+def test_watchdog_warm_restarts_killed_replica_and_ring_recovers(engine):
+    """The stt_replica_kill chaos drill end to end on live workers: the
+    first tick kills a replica; its final fails over and is delivered
+    (zero lost); the watchdog warm-restarts the corpse (same engine,
+    fresh batcher) and the ring returns to full health."""
+    tier = STTReplicaTier(engine, replicas=2, slots=4, probe_s=0.05,
+                          stall_s=3.0, register=False)
+    try:
+        audios = [tone(300, 0.4), tone(440, 0.9)]
+        refs = [engine.transcribe(a).text for a in audios]
+        # warm the batched decode path BEFORE arming chaos: the first tick
+        # pays the jit compile, and a compile-length tick must not read as
+        # a stalled worker in this drill
+        tier.submit("final", 61_900, audios[0]).result(timeout=60)
+        chaos_mod.configure("stt_replica_kill@1", seed=3)
+        r0 = _counters().get("stt.replica_restarts", 0)
+        futs = [tier.submit("final", 62_000 + i, a)
+                for i, a in enumerate(audios)]
+        assert [f.result(timeout=60).text for f in futs] == refs
+        deadline = time.monotonic() + 10
+        while _counters().get("stt.replica_restarts", 0) < r0 + 1:
+            assert time.monotonic() < deadline, "watchdog never restarted"
+            time.sleep(0.05)
+        deadline = time.monotonic() + 10
+        while not all(b.healthy() for b in tier.batchers):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        # the restarted replica serves again
+        u = _utt_homed_on(tier, 0, base=63_000)
+        deadline = time.monotonic() + 10
+        while tier.replicas[0].state != "up":
+            assert time.monotonic() < deadline, "ring never recovered"
+            time.sleep(0.05)
+        assert tier.submit("final", u, audios[0]).result(timeout=60).text \
+            == refs[0]
+        # the restarted corpse re-admits on its next healthy sweep (either
+        # replica may have been the chaos victim — wait, don't race it)
+        deadline = time.monotonic() + 10
+        while tier.tier_health()["healthy"] < 2:
+            assert time.monotonic() < deadline, "ring never refilled"
+            time.sleep(0.05)
+    finally:
+        tier.stop()
+
+
+def test_stalled_tick_watchdog_restarts_hung_replica(engine, monkeypatch):
+    """The stt_replica_hang drill: one tick wedges for CHAOS_HANG_S; the
+    stalled-tick watchdog ejects + warm-restarts the replica and the hung
+    final fails over — delivered well before the hang would have ended
+    badly, with zero lost finals."""
+    monkeypatch.setenv("CHAOS_HANG_S", "8")
+    tier = STTReplicaTier(engine, replicas=2, slots=4, probe_s=0.05,
+                          stall_s=0.6, register=False)
+    try:
+        audio = tone(520, 0.6)
+        ref = engine.transcribe(audio).text
+        # compile warm-up first (chaos off), then arm the hang drill
+        tier.submit("final", 63_900, audio).result(timeout=60)
+        chaos_mod.configure("stt_replica_hang@1", seed=3)
+        r0 = _counters().get("stt.replica_restarts", 0)
+        fut = tier.submit("final", 64_000, audio)
+        assert fut.result(timeout=30).text == ref
+        assert _counters().get("stt.replica_restarts", 0) >= r0 + 1
+    finally:
+        tier.stop()
+
+
+# -------------------------------------------------------------- pressure
+
+
+def test_pressure_sheds_new_utterances_off_loaded_replica(engine):
+    """A replica whose queue occupancy crosses STT_SHED_PRESSURE stops
+    receiving NEW utterances (they redirect, counted) while utterances
+    already homed there stay."""
+    tier = STTReplicaTier(engine, replicas=2, slots=2, max_pending=4,
+                          autostart=False, register=False)
+    try:
+        sticky = _utt_homed_on(tier, 0, base=65_000)
+        tier.submit("final", sticky, tone(300, 0.4))
+        # pile finals onto replica 0 until its queue is at the cap
+        extra = []
+        while len(tier.batchers[0].queue) < tier.batchers[0].max_pending:
+            u = _utt_homed_on(tier, 0, base=66_000 + len(extra) * 7)
+            if str(u) in tier._sessions:
+                u += 1  # avoid reusing an already-placed utterance
+            tier.submit("final", u, tone(330, 0.4))
+            extra.append(u)
+        tier.sweep_once()  # publishes queue occupancy as pressure
+        assert tier.replicas[0].pressure >= tier.shed_pressure
+        shed0 = _counters().get("stt.replica_shed_pressure", 0)
+        fresh = _utt_homed_on(tier, 0, base=70_000)
+        tier.submit("partial", fresh, tone(300, 1.0))
+        assert tier._sessions[str(fresh)] == tier.replicas[1].url
+        assert _counters().get("stt.replica_shed_pressure", 0) == shed0 + 1
+        # the sticky utterance never moved
+        assert tier._sessions[str(sticky)] == tier.replicas[0].url
+        _tick_all(tier, rounds=12)
+    finally:
+        tier.stop()
+
+
+# ----------------------------------------------------- voice /health HUD
+
+
+def test_voice_health_surfaces_stt_replica_ring(engine):
+    import json
+    import urllib.request
+
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.serve.stt import NullSTT
+    from tpu_voice_agent.services.voice import VoiceConfig
+    from tpu_voice_agent.services.voice import build_app as build_voice
+
+    tier = STTReplicaTier(engine, replicas=2, slots=2, autostart=False)
+    voice = AppServer(build_voice(VoiceConfig(
+        brain_url="http://127.0.0.1:1", executor_url="http://127.0.0.1:1",
+        stt_factory=lambda: NullSTT()))).__enter__()
+    try:
+        assert current_tier() is tier
+        with urllib.request.urlopen(voice.url + "/health", timeout=10) as r:
+            h = json.loads(r.read().decode())
+        assert h["stt_replicas"] == {"total": 2, "healthy": 2, "draining": 0}
+        # a killed replica leaves the ring after probe_fails_limit sweeps
+        # (the same sweep warm-restarts it; it re-admits on the NEXT one —
+        # read /health inside that window)
+        tier.batchers[0].kill(RuntimeError("x"))
+        tier.sweep_once()
+        tier.sweep_once()
+        assert tier.replicas[0].state == "down"
+        with urllib.request.urlopen(voice.url + "/health", timeout=10) as r:
+            h = json.loads(r.read().decode())
+        assert h["stt_replicas"] == {"total": 2, "healthy": 1, "draining": 0}
+        # and the warm restart re-admits it on the following sweep
+        tier.sweep_once()
+        assert tier.replicas[0].state == "up"
+    finally:
+        voice.__exit__(None, None, None)
+        tier.stop()

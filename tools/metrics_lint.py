@@ -150,6 +150,22 @@ PINNED: dict[str, str] = {
     "router.hedges_fired": "counter",
     "router.hedges_won": "counter",
     "router.drains": "counter",
+    # replicated STT tier + warm-state handoff (ISSUE 13, serve/
+    # stt_replicas.py + serve/handoff.py + services/router.py, docs/
+    # RESILIENCE.md "STT replica fault domain" / "Warm-state handoff"):
+    # the warm/cold split is the handoff's effectiveness dial (warm = KV
+    # adopted, re-home cost ~transfer; cold = the PR 10 re-prefill),
+    # shed_pressure counts gauge-driven placement redirects, the stt.*
+    # names are the STT ring's restart/failover accounting bench_handoff
+    # gates on — renaming any of these blinds its gates
+    "router.sessions_rehomed_warm": "counter",
+    "router.sessions_rehomed_cold": "counter",
+    "router.shed_pressure": "counter",
+    "stt.replicas_healthy": "gauge",
+    "stt.replica_restarts": "counter",
+    "stt.replica_failovers": "counter",
+    "handoff.sessions_adopted": "counter",
+    "handoff.tokens_adopted": "counter",
 }
 
 

@@ -70,6 +70,10 @@ class ColocatedServing:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stt_q: list[tuple[np.ndarray, Future]] = []
+        # serialized engine-plane calls (warm-state handoff export/adopt):
+        # run by step() on the worker thread, the only thread allowed to
+        # touch the engine's allocator/pool/radix bookkeeping
+        self._call_q: list[tuple[object, Future]] = []
         self._parse_futs: dict[int, Future] = {}
         self._abandoned: set[int] = set()  # tombstones applied by step()
         self._thread: threading.Thread | None = None
@@ -119,6 +123,19 @@ class ColocatedServing:
             self._work.notify()
         return fut
 
+    def submit_call(self, fn) -> "Future":
+        """Run ``fn()`` on the serving-loop thread between steps and
+        resolve the returned future with its result. The engine's host
+        bookkeeping (allocator refcounts, radix tree, pool rebinds) is
+        single-threaded by contract — the warm-state handoff's
+        export/adopt (serve.handoff) go through here instead of racing
+        ``batcher.step()`` from an HTTP executor thread."""
+        fut: Future = Future()
+        with self._work:
+            self._call_q.append((fn, fut))
+            self._work.notify()
+        return fut
+
     def abandon_parse(self, fut: Future) -> None:
         """Give up on a submitted parse (caller timed out or disconnected):
         drop its future and tombstone the request id, so overload does not
@@ -156,6 +173,8 @@ class ColocatedServing:
         with self._lock:
             stt_jobs = list(self._stt_q)
             self._stt_q.clear()
+            calls = list(self._call_q)
+            self._call_q.clear()
             tombs: set[int] = set()
             if self._abandoned:
                 tombs, self._abandoned = self._abandoned, set()
@@ -186,6 +205,17 @@ class ColocatedServing:
                 self.stats.stt_busy_ms += (time.perf_counter() - t0) * 1e3
                 self.stats.stt_jobs += 1
                 self.stats.trace.append("stt")
+            did = True
+
+        for fn, fut in calls:  # engine-plane call lane (per-job isolation)
+            # AFTER the STT priority lane: a multi-MB handoff export/adopt
+            # must not delay latency-critical transcriptions in its tick
+            try:
+                result = fn()
+            except Exception as e:
+                self._set_future(fut, exc=e)
+            else:
+                self._set_future(fut, value=result)
             did = True
 
         if self._has_decode_work():
@@ -275,7 +305,8 @@ class ColocatedServing:
         if not self._draining:
             return False
         with self._lock:
-            return (not self._stt_q and not self._parse_futs
+            return (not self._stt_q and not self._call_q
+                    and not self._parse_futs
                     and not self._has_decode_work())
 
     def drain(self, timeout_s: float = 120.0) -> None:
@@ -288,7 +319,8 @@ class ColocatedServing:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._lock:
-                idle = not self._stt_q and not self._parse_futs
+                idle = (not self._stt_q and not self._call_q
+                        and not self._parse_futs)
                 worker_alive = self._thread is not None and self._thread.is_alive()
             if idle:
                 return
@@ -353,7 +385,10 @@ class ColocatedServing:
         restart), spin up a fresh serving loop."""
         with self._lock:
             stt_jobs, self._stt_q[:] = list(self._stt_q), []
+            calls, self._call_q[:] = list(self._call_q), []
         for _, fut in stt_jobs:
+            self._set_future(fut, exc=exc)
+        for _, fut in calls:
             self._set_future(fut, exc=exc)
         if reset_batcher:
             self._fail_inflight(exc)  # also resets the suspect batcher (+epoch)
@@ -469,5 +504,6 @@ class ColocatedServing:
                 if self._thread is not None and \
                         threading.current_thread() is not self._thread:
                     return
-                if not did and not self._stt_q and not self._has_decode_work():
+                if not did and not self._stt_q and not self._call_q \
+                        and not self._has_decode_work():
                     self._work.wait(timeout=0.05)

@@ -1,0 +1,233 @@
+"""Warm-state handoff: serialize a session's warm serving state and adopt
+it on another replica, so a re-home costs ~transfer bookkeeping instead of
+a cold re-prefill (ISSUE 13; ROADMAP "cluster-scale serving tier, part 2"
+item (d); WhisperFlow's ship-the-session-state framing from PAPERS.md).
+
+What travels, per session:
+
+- the **transcript token ids** (``SessionTranscripts`` entry) — the
+  semantic payload: without it the new home renders a turn-1-style prompt
+  and the session silently loses its multi-turn context (exactly what
+  PR 10's cold re-home did);
+- the **radix chain's paged KV block bytes** — the longest cached chain
+  covering those ids, gathered straight out of the donor's pool in its
+  STORED format. KV_QUANT-aware by construction: under int8/int4 the
+  stored bytes are the quantized values and the bf16 scale planes travel
+  with them (``ops.kvquant`` keeps scales pool-indexed per block, so a
+  shipped block is values + its scale rows, nothing else to reconstruct);
+  the recipient installs the bytes verbatim — re-quantizing would change
+  them — and inserts the chain into its own radix tree behind its own
+  pinned static prefix.
+
+Adoption is ALWAYS clean-or-cold: a config mismatch (block size, KV tier,
+model dims, different static prefix), a pool under pressure, or a missing
+radix plane adopts the transcript alone and returns 0 warm tokens — the
+next turn simply cold-prefills, token-identical to having stayed home
+(tests/test_handoff.py drills the fallback per tier, including a
+mid-chain-evicted donor and a pool-pressured recipient).
+
+Wire format (``pack``/``unpack``): a magic header, one JSON header (meta +
+array specs), then the raw array bytes concatenated — no base64 bloat, no
+pickle. ``HANDOFF_KV=0`` ships the transcript WITHOUT the KV bytes: the
+measured cold-re-home baseline the handoff bench compares against (same
+token-identical semantics, full re-prefill cost).
+
+Thread contract: ``export_session``/``adopt_session`` touch the engine's
+allocator, pool, and radix tree, so they MUST run on the serving-loop
+thread — ``BatchedEngineParser`` routes them through
+``ColocatedServing.submit_call`` (the same thread that runs
+``batcher.step()``), never call them concurrently with it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from ..utils import get_metrics
+
+MAGIC = b"TVAH1\x00"
+
+
+def _dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def pack(meta: dict, arrays: dict[str, np.ndarray]) -> bytes:
+    """meta (JSON-able) + named arrays -> one self-describing blob."""
+    specs = []
+    bufs = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        specs.append({"name": name, "dtype": arr.dtype.name,
+                      "shape": list(arr.shape), "nbytes": len(raw)})
+        bufs.append(raw)
+    header = json.dumps({"meta": meta, "arrays": specs},
+                        separators=(",", ":")).encode()
+    return b"".join([MAGIC, struct.pack(">I", len(header)), header] + bufs)
+
+
+def unpack(blob: bytes) -> tuple[dict, dict[str, np.ndarray]]:
+    """Inverse of ``pack``. Raises ``ValueError`` on anything malformed —
+    adopt_session maps that to the clean cold fallback."""
+    if not blob.startswith(MAGIC) or len(blob) < len(MAGIC) + 4:
+        raise ValueError("not a handoff blob (bad magic)")
+    off = len(MAGIC)
+    (hlen,) = struct.unpack(">I", blob[off:off + 4])
+    off += 4
+    try:
+        header = json.loads(blob[off:off + hlen])
+    except json.JSONDecodeError as e:
+        raise ValueError(f"handoff header does not parse: {e}") from e
+    off += hlen
+    arrays: dict[str, np.ndarray] = {}
+    for spec in header.get("arrays", []):
+        n = int(spec["nbytes"])
+        raw = blob[off:off + n]
+        if len(raw) != n:
+            raise ValueError("handoff blob truncated")
+        arrays[spec["name"]] = np.frombuffer(
+            raw, dtype=_dtype(spec["dtype"])).reshape(spec["shape"])
+        off += n
+    return header.get("meta", {}), arrays
+
+
+# ------------------------------------------------------------------ export
+
+
+def export_session(engine, transcripts, session_id: str) -> bytes | None:
+    """Serialize one session's warm state from ``engine`` (a radix-bearing
+    ``PagedDecodeEngine``) + ``transcripts`` (``SessionTranscripts``).
+    None when the session is unknown — nothing to ship. Must run on the
+    serving-loop thread (see module docstring)."""
+    ids = transcripts.peek(session_id)
+    if not ids:
+        return None
+    radix = getattr(engine, "radix", None)
+    meta = {
+        "v": 1,
+        "session_id": session_id,
+        "ids": [int(t) for t in ids],
+        "chain_tokens": 0,
+        "prefix_tokens": 0,
+        "block_size": getattr(engine, "block_size", 0),
+        "kv_quant": getattr(engine, "kv_quant", None) or "off",
+    }
+    arrays: dict[str, np.ndarray] = {}
+    ship_kv = radix is not None and \
+        os.environ.get("HANDOFF_KV", "1") != "0"
+    if ship_kv:
+        bs = engine.block_size
+        for g, tree in enumerate(radix):
+            chain, matched = tree.match(ids)
+            pb = engine._prefix_blocks[g]
+            if matched > len(pb) * bs and chain[:len(pb)] == pb:
+                # a real session chain extending the pinned static prefix:
+                # ship only the post-prefix blocks — the recipient's own
+                # pinned root covers the prefix span byte-for-byte
+                try:
+                    k, v, ks, vs = engine.gather_chain_kv(chain[len(pb):])
+                finally:
+                    engine.allocator.free(chain)
+                meta["chain_tokens"] = matched
+                meta["prefix_tokens"] = len(pb) * bs
+                arrays = {"k": k, "v": v}
+                if ks is not None:
+                    arrays["k_scale"] = ks
+                    arrays["v_scale"] = vs
+                break
+            if chain:
+                # matched chains shorter than (or diverging from) the
+                # static prefix carry nothing worth shipping: release the
+                # match refs and fall through to a transcript-only blob
+                engine.allocator.free(chain)
+    get_metrics().inc("handoff.sessions_exported")
+    return pack(meta, arrays)
+
+
+# ------------------------------------------------------------------- adopt
+
+
+def adopt_session(engine, transcripts, blob: bytes) -> int:
+    """Install a shipped session on this replica: the transcript entry
+    always (that is the semantic payload — the next prompt must be the
+    strict token extension the donor would have rendered), the KV chain
+    when config matches and the pool can take it. Returns the KV-warm
+    token count (0 = clean cold fallback, counted). Must run on the
+    serving-loop thread (see module docstring)."""
+    m = get_metrics()
+    meta, arrays = unpack(blob)  # ValueError propagates to the caller's fence
+    session_id = meta.get("session_id")
+    ids = [int(t) for t in meta.get("ids") or []]
+    if not session_id or not ids:
+        raise ValueError("handoff blob carries no session transcript")
+    transcripts.adopt(session_id, ids)
+    m.inc("handoff.sessions_adopted")
+
+    radix = getattr(engine, "radix", None)
+    chain_tokens = int(meta.get("chain_tokens") or 0)
+    if radix is None or chain_tokens <= 0 or "k" not in arrays:
+        if chain_tokens > 0 or arrays:
+            m.inc("handoff.adopt_fallbacks")
+        return 0
+    bs = engine.block_size
+    pb = engine._prefix_blocks[0]
+    k = arrays["k"]
+    expected = list(engine.k_pool.shape[:1]) + list(engine.k_pool.shape[2:])
+    scales_ok = engine.kv_quant is None or (
+        "k_scale" in arrays and "v_scale" in arrays
+        and arrays["k_scale"].shape == k.shape[:4]
+        and arrays["v_scale"].shape == k.shape[:4])
+    compatible = (
+        meta.get("block_size") == bs
+        and meta.get("kv_quant") == (engine.kv_quant or "off")
+        and list(k.shape[:1]) + list(k.shape[2:]) == expected
+        and arrays.get("v") is not None and arrays["v"].shape == k.shape
+        and scales_ok
+        # the shipped chain extends the DONOR's static prefix; it is only
+        # adoptable behind OUR pinned root when the two prefixes agree
+        and meta.get("prefix_tokens") == len(pb) * bs
+        and ids[:len(pb) * bs] == engine.prefix_ids[:len(pb) * bs]
+        and chain_tokens == (len(pb) + k.shape[1]) * bs
+        and chain_tokens <= len(ids)
+    )
+    if not compatible:
+        m.inc("handoff.adopt_fallbacks")
+        return 0
+    try:
+        blocks = engine.adopt_chain_kv(
+            k, arrays["v"], arrays.get("k_scale"), arrays.get("v_scale"))
+    except Exception:
+        # pool pressure (PoolExhausted after radix eviction) or any other
+        # install fault: the transcript is already adopted, the next turn
+        # cold-prefills — the fallback the tests pin as token-identical
+        m.inc("handoff.adopt_fallbacks")
+        return 0
+    # adopt into the tree behind our own pinned prefix chain; the tree
+    # takes its ref per NEW node, then we drop ours — un-adopted blocks
+    # (duplicate chain, max_nodes cap) fall straight back to the free list
+    radix[0].insert(ids[:chain_tokens], pb + blocks)
+    engine.allocator.free(blocks)
+    # trust the TREE, not the install: a capacity-capped tree may have
+    # adopted nothing (its nodes at max with only pinned/referenced
+    # leaves), in which case the blocks just went back to the pool and
+    # reporting "warm" here would make the router's warm/cold split lie
+    # exactly in the pressure case it exists to expose. (On an idempotent
+    # re-adopt insert() also adds 0 nodes — but the chain already LIVES
+    # in the tree, which this probe correctly reports as warm.)
+    probe, matched = radix[0].match(ids)
+    if probe:
+        engine.allocator.free(probe)
+    if matched < chain_tokens:
+        m.inc("handoff.adopt_fallbacks")
+        return 0
+    m.inc("handoff.tokens_adopted", float(chain_tokens))
+    return chain_tokens

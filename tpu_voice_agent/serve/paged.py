@@ -233,6 +233,20 @@ def _scatter_blocks_quant(k_pool, v_pool, k_scale, v_scale, src_k, src_v,
             ksf.reshape(sshp), vsf.reshape(sshp))
 
 
+@watch_compiles("paged._scatter_scale_planes")
+@partial(jax.jit, donate_argnames=("k_scale", "v_scale"))
+def _scatter_scale_planes(k_scale, v_scale, src_k, src_v, dst_idx):
+    """Write (L, n) bf16 scale rows into the flat (L, N*bs, nkv) planes at
+    dst_idx — the scale half of a warm-handoff adoption, where the shipped
+    bytes are already quantized and must land verbatim (the quantizing
+    scatter would re-derive scales from values that are no longer fp)."""
+    L, N, bs, nkv = k_scale.shape
+    sshp = k_scale.shape
+    kf = k_scale.reshape(L, N * bs, nkv).at[:, dst_idx].set(src_k)
+    vf = v_scale.reshape(L, N * bs, nkv).at[:, dst_idx].set(src_v)
+    return kf.reshape(sshp), vf.reshape(sshp)
+
+
 @watch_compiles("paged.paged_chunk_decode_loop")
 @partial(
     jax.jit,
@@ -979,6 +993,64 @@ class PagedDecodeEngine(DecodeEngine):
 
         get_metrics().inc("radix.admission_denied")
         return False
+
+    # ------------------------------------------------------------ handoff
+
+    def gather_chain_kv(self, blocks: list[int]):
+        """Host copies of the pool KV for ``blocks``, in STORED format —
+        the warm-state handoff's export payload (serve.handoff): bf16
+        values (KV_QUANT off) or int8 bytes plus their bf16 scale planes
+        (scales travel with the block — ops.kvquant's layout contract).
+        Returns ``(k, v, k_scale | None, v_scale | None)`` shaped
+        ``(L, n, bs, nkv, hd_store)`` / ``(L, n, bs, nkv)``. Serving-loop
+        thread only (reads race the decode loop's pool rebinds otherwise)."""
+        idx = jnp.asarray(blocks, jnp.int32)
+        k = np.asarray(jax.device_get(self.k_pool[:, idx]))
+        v = np.asarray(jax.device_get(self.v_pool[:, idx]))
+        if self.kv_quant is None:
+            return k, v, None, None
+        ks = np.asarray(jax.device_get(self.k_scale[:, idx]))
+        vs = np.asarray(jax.device_get(self.v_scale[:, idx]))
+        return k, v, ks, vs
+
+    def adopt_chain_kv(self, k, v, k_scale=None, v_scale=None,
+                       group: int = 0) -> list[int]:
+        """Allocate ``n`` blocks and install already-stored-format KV rows
+        (the handoff's adopt half). Values land via the PLAIN scatter —
+        the shipped bytes are already in this pool's storage dtype, and
+        re-quantizing quantized bytes would change them — and the scale
+        planes ride their own scatter. ``PoolExhausted`` propagates (after
+        the radix-eviction retry in ``_alloc``): the caller counts the
+        clean cold fallback. Serving-loop thread only."""
+        n = int(k.shape[1])
+        if self.kv_quant is not None and (k_scale is None or v_scale is None):
+            raise ValueError("quantized pool adoption needs scale planes")
+        if tuple(np.asarray(v).shape) != tuple(np.asarray(k).shape):
+            raise ValueError("adopted v shape disagrees with k")
+        blocks = self._alloc(n, group)
+        try:
+            bs = self.block_size
+            arr = np.asarray(blocks, np.int32)
+            dst = jnp.asarray(
+                (arr[:, None] * bs
+                 + np.arange(bs, dtype=np.int32)[None, :]).reshape(-1))
+            L = int(k.shape[0])
+            src_k = jnp.asarray(np.asarray(k)).reshape(L, n * bs, *k.shape[3:])
+            src_v = jnp.asarray(np.asarray(v)).reshape(L, n * bs, *k.shape[3:])
+            self.k_pool, self.v_pool = _scatter_blocks(
+                self.k_pool, self.v_pool, src_k, src_v, dst)
+            if self.kv_quant is not None:
+                sk = jnp.asarray(np.asarray(k_scale)).reshape(L, n * bs, -1)
+                sv = jnp.asarray(np.asarray(v_scale)).reshape(L, n * bs, -1)
+                self.k_scale, self.v_scale = _scatter_scale_planes(
+                    self.k_scale, self.v_scale, sk, sv, dst)
+        except Exception:
+            # a skewed/corrupt payload must not LEAK the claim: the caller
+            # counts a clean cold fallback, and these blocks go back to
+            # the pool instead of shrinking it forever
+            self.allocator.free(blocks)
+            raise
+        return blocks
 
     def warm_restart(self) -> None:
         """Paged warm restart: throw away every slot's mutable state and the

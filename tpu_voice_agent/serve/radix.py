@@ -257,6 +257,24 @@ class RadixCache:
         walk(self.root, [])
         return out
 
+    def reclaimable_blocks(self) -> int:
+        """Blocks the eviction ladder could hand back under pressure:
+        unpinned nodes whose block the tree solely owns (refcount == 1).
+        Slight overcount when a sole-owned mid-chain node has a
+        live-referenced descendant (cascading eviction stops below it) —
+        fine for the shed-pressure signal this feeds: a warm cache is
+        HEADROOM, not saturation, and counting it as used made the router
+        shed new sessions off exactly the warmest replicas."""
+        n = 0
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if (node is not self.root and not node.pinned
+                    and self.allocator.refcount(node.block) == 1):
+                n += 1
+        return n
+
     @property
     def nodes(self) -> int:
         return self._n_nodes

@@ -56,6 +56,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import itertools
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -147,6 +148,13 @@ class STTBatcher:
         self._stop = False
         self._busy = False
         self.ticks = 0
+        # dead latch (ISSUE 13): a killed/restart-retired batcher refuses
+        # new work with an exception instead of queueing it forever — the
+        # replica tier (serve.stt_replicas) fails finals over on it
+        self.dead = False
+        # the batch currently being processed: kill() must be able to fail
+        # these futures too (a wedged worker may never resolve them)
+        self._inflight: list[_Work] = []
         # one blank decode row for dead slots (reused, never written)
         L, nh, hd = engine.cfg.dec_layers, engine.cfg.n_heads, engine.cfg.head_dim
         self._blank_row = jnp.zeros(
@@ -168,6 +176,18 @@ class STTBatcher:
             raise ValueError(f"unknown STT work kind {kind!r}")
         fut: Future = Future()
         with self._wake:
+            if self.dead:
+                # a crashed replica refuses like a closed socket: the tier
+                # re-routes the utterance (finals fail over, partials
+                # drop). Checked UNDER the lock kill() holds — a submit
+                # racing the kill must either be failed here or land in
+                # the queue kill() is about to fail, never slip into an
+                # abandoned queue no worker will ever drain.
+                try:
+                    fut.set_exception(RuntimeError("stt replica is down"))
+                except Exception:
+                    pass
+                return fut
             if kind != "final":
                 # a newer buffer for the same (kind, utterance) supersedes
                 # the queued one — decoding the stale prefix would waste a
@@ -238,9 +258,41 @@ class STTBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
 
+    def healthy(self) -> bool:
+        """Liveness for the replica tier's watchdog: not dead-latched and
+        (when autostarted) the worker thread is still running. Manually
+        ticked batchers (``autostart=False``) count healthy — the caller
+        IS the worker."""
+        if self.dead:
+            return False
+        return self._thread is None or self._thread.is_alive()
+
+    def kill(self, exc: Exception) -> None:
+        """Retire this batcher like a crashed process (the replica tier's
+        restart path, and the ``stt_replica_kill`` chaos drill): latch
+        dead, fail every queued AND in-flight future with ``exc`` so
+        waiters fail over instead of blocking out their timeout, and stop
+        the worker. A wedged worker that later wakes resolves into guarded
+        futures (``_resolve`` / the done() checks) — late results are
+        dropped, never double-delivered."""
+        with self._wake:
+            self.dead = True
+            self._stop = True
+            stale, self.queue = self.queue, []
+            inflight = list(self._inflight)
+            self._wake.notify_all()
+        for w in stale + inflight:
+            if not w.future.done():
+                try:
+                    w.future.set_exception(exc)
+                except Exception:
+                    pass  # raced a concurrent resolve/cancel
+
     # ------------------------------------------------------------ worker
 
     def _worker(self) -> None:
+        from ..utils.chaos import ChaosError, chaos_fire
+
         while True:
             with self._wake:
                 while not self.queue and not self._stop:
@@ -251,8 +303,15 @@ class STTBatcher:
                     self.queue.clear()
                     return
                 batch = self._take_batch_locked()
+                self._inflight = batch
                 self._busy = True
             try:
+                if chaos_fire("stt_replica_kill"):
+                    # drill: this replica crashes mid-tick — the batch and
+                    # queue fail abruptly, the worker exits, and the tier's
+                    # watchdog/failover must recover with zero lost finals
+                    self.kill(ChaosError("chaos: stt replica killed"))
+                    return
                 self._process(batch)
             except Exception as e:  # pragma: no cover - engine fault path
                 # per-batch isolation: a device fault fails this batch's
@@ -265,6 +324,7 @@ class STTBatcher:
                             pass  # raced a concurrent cancel
             finally:
                 with self._wake:
+                    self._inflight = []
                     self._busy = False
                     self._wake.notify_all()
 
@@ -356,6 +416,14 @@ class STTBatcher:
         return out
 
     def _process(self, batch: list[_Work]) -> None:
+        from ..utils.chaos import chaos_fire
+
+        if chaos_fire("stt_replica_hang"):
+            # drill: a wedged-but-listening replica — the worker sleeps
+            # through CHAOS_HANG_S mid-tick, ticks stop advancing, and the
+            # replica tier's stalled-tick watchdog must warm-restart it
+            # (the late wake resolves into guarded futures, harmlessly)
+            time.sleep(float(os.environ.get("CHAOS_HANG_S", "60")))
         eng = self.engine
         finals = [w for w in batch if w.kind != "partial"]
         partials = [w for w in batch if w.kind == "partial"]

@@ -1,0 +1,310 @@
+"""Replicated STT tier: N ``STTBatcher`` replicas behind connection-affine
+placement — the STT half of the replica fault domain (ISSUE 13).
+
+PR 4 concentrated every connection's transcription onto ONE shared
+``STTBatcher``: one wedged Whisper worker took every live microphone down
+with it. This tier runs ``STT_REPLICAS`` batchers over one loaded
+``SpeechEngine`` (weights are read-only and shared; each replica owns its
+own cross-KV slot pool and worker thread) behind the SAME proven ring core
+the brain tier runs (``services.replicaset.ReplicaSet`` — rendezvous
+placement, sticky residence, probe/eject/rejoin, pressure-aware shedding):
+
+- **Affinity by utterance.** Every utterance's work items (partials,
+  spec-finals, the final) must hit one replica — its incremental cross-KV
+  slot lives in that replica's pool — so placement keys on the utterance
+  id with sticky residence, and ``release`` forgets the entry when the
+  utterance closes. WhisperPipe's replicated-streaming-ASR shape
+  (PAPERS.md), with the PR 10 ring discipline underneath.
+
+- **Health = the stalled-tick watchdog.** A watchdog thread sweeps every
+  ``STT_REPLICA_PROBE_S``: a dead worker thread, a dead-latch, or ticks
+  frozen for ``STT_REPLICA_STALL_S`` while work is pending ejects the
+  replica (``apply_probe``, the shared verdict machine) and
+  **warm-restarts** it — a fresh ``STTBatcher`` over the SAME engine, so
+  the restart reuses the loaded Whisper weights and compiled programs and
+  costs milliseconds, not a model load. ``stt.replica_restarts`` counts.
+
+- **Mid-utterance failover.** The voice service's per-utterance ring
+  buffer (``StreamingSTT._buf``) IS the unacknowledged PCM tail: when an
+  utterance's home dies, the next submit re-routes it
+  (``stt.replica_rehomed``) and the new replica's slot re-anchors on the
+  buffered audio — a bounded re-encode of the tail, never a lost
+  utterance. FINALS carry their whole window and are additionally failed
+  over ONCE on an exception (``stt.replica_failovers``): a crashed
+  replica costs latency, never a lost final.
+
+- **Pressure shedding.** The watchdog publishes each replica's queue
+  occupancy as its pressure; new utterances avoid replicas at/over
+  ``STT_SHED_PRESSURE`` while any is under it
+  (``stt.replica_shed_pressure``) — the same degrade-placement-before-
+  refusing discipline the router applies with the brain gauges.
+
+The tier is duck-type compatible with ``STTBatcher`` (``submit`` /
+``release``), so ``BatchedStreamingSTT`` plugs in unchanged; the voice
+service builds it when ``STT_BATCH_ENABLE=1`` and ``STT_REPLICAS>1`` and
+surfaces ``/health.stt_replicas`` for the web HUD badge.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+from ..services.replicaset import Replica, ReplicaSet
+from ..utils import get_metrics
+from .stt_batch import STTBatcher
+
+# process-global tier handle: the voice /health handler (and the HUD badge
+# behind it) reads ring occupancy without threading the object through the
+# factory lambda — same discipline as the metrics registry
+_TIER: "STTReplicaTier | None" = None
+
+
+def current_tier() -> "STTReplicaTier | None":
+    return _TIER
+
+
+class STTReplicaTier(ReplicaSet):
+    """N ``STTBatcher`` replicas with utterance-affine placement, a
+    stalled-tick watchdog that warm-restarts wedged replicas, and final
+    failover. ``autostart=False`` builds manually-ticked batchers and no
+    watchdog (tests drive ``sweep_once``/``batcher.tick`` themselves)."""
+
+    def __init__(self, engine, replicas: int = 2, slots: int = 4, *,
+                 probe_s: float | None = None,
+                 stall_s: float | None = None,
+                 shed_pressure: float | None = None,
+                 max_pending: int | None = None,
+                 autostart: bool = True,
+                 register: bool = True):
+        if replicas < 1:
+            raise ValueError("need at least one STT replica")
+        env = os.environ.get
+        self.probe_s = probe_s if probe_s is not None else \
+            float(env("STT_REPLICA_PROBE_S", "0.25"))
+        self.stall_s = stall_s if stall_s is not None else \
+            float(env("STT_REPLICA_STALL_S", "5.0"))
+        super().__init__(
+            [f"stt-{i}" for i in range(replicas)],
+            probe_fails_limit=2,
+            shed_pressure=(shed_pressure if shed_pressure is not None
+                           else float(env("STT_SHED_PRESSURE", "0.9"))),
+            log_name="tpu_voice_agent.stt_replicas")
+        self.engine = engine
+        self.slots = slots
+        self.max_pending = max_pending
+        # unlike the router (whose event loop serializes routing), this
+        # tier is hit from the voice event loop AND batcher-worker
+        # failover callbacks concurrently — the session table needs a lock
+        self._route_lock = threading.Lock()
+        self._autostart = autostart
+        self.batchers = [self._make_batcher() for _ in range(replicas)]
+        # per-replica (last ticks seen, last progress time) for the
+        # stalled-tick verdict
+        self._seen = [(0, time.monotonic()) for _ in range(replicas)]
+        # the contract counters exist from construction (scrape-visible at
+        # zero — the breaker-gauge discipline)
+        m = get_metrics()
+        m.inc("stt.replica_restarts", 0.0)
+        m.inc("stt.replica_failovers", 0.0)
+        m.inc("stt.replica_rehomed", 0.0)
+        m.inc("stt.replica_shed_pressure", 0.0)
+        m.inc("stt.replica_ejected", 0.0)
+        m.set_gauge("stt.replicas_total", float(replicas))
+        self._update_health_gauge()
+        self._stop_evt = threading.Event()
+        self._watchdog: threading.Thread | None = None
+        if autostart:
+            self._watchdog = threading.Thread(
+                target=self._watch, name="stt-replica-watchdog", daemon=True)
+            self._watchdog.start()
+        if register:
+            global _TIER
+            _TIER = self
+
+    def _make_batcher(self) -> STTBatcher:
+        return STTBatcher(self.engine, slots=self.slots,
+                          max_pending=self.max_pending,
+                          autostart=self._autostart)
+
+    # ---------------------------------------------- replica-set hooks
+    # literal metric names (tools/metrics_lint.py pins them) — the shared
+    # core routes its accounting through these
+
+    def _update_health_gauge(self) -> None:
+        healthy = float(sum(1 for r in self.replicas if r.servable()))
+        get_metrics().set_gauge("stt.replicas_healthy", healthy)
+
+    def _on_rehome(self) -> None:
+        get_metrics().inc("stt.replica_rehomed")
+
+    def _on_shed_pressure(self) -> None:
+        get_metrics().inc("stt.replica_shed_pressure")
+
+    def _on_ejected(self, replica: Replica) -> None:
+        get_metrics().inc("stt.replica_ejected")
+
+    def _on_recovered(self, replica: Replica) -> None: ...
+
+    # ----------------------------------------------------------- watchdog
+
+    def sweep_once(self) -> None:
+        """One health sweep: liveness + stalled-tick verdict per replica
+        through the shared ``apply_probe`` machine, pressure refresh, and
+        the warm restart of anything ejected."""
+        now = time.monotonic()
+        for r in self.replicas:
+            b = self.batchers[r.idx]
+            with b._wake:
+                ticks, busy, depth = b.ticks, b._busy, len(b.queue)
+            r.pressure = depth / max(1, b.max_pending)
+            alive = b.healthy()
+            stalled = False
+            if alive:
+                last_ticks, last_t = self._seen[r.idx]
+                if ticks != last_ticks or not (busy or depth):
+                    self._seen[r.idx] = (ticks, now)
+                elif now - last_t >= self.stall_s:
+                    stalled = True
+            self.apply_probe(r, alive and not stalled, None)
+            if r.state == "down" and (not alive or stalled):
+                # warm-restart the corpse NOW (a fresh batcher over the
+                # same engine); the ring re-admits it on the next sweep's
+                # healthy verdict — restart only when THIS sweep saw it
+                # bad, so a just-restarted healthy batcher is never churned
+                self._restart(r.idx)
+        self._update_health_gauge()
+
+    def _restart(self, idx: int) -> None:
+        """Warm-restart one replica: retire the old batcher (failing its
+        queued/in-flight futures fast so waiters fail over instead of
+        timing out) and build a fresh one over the SAME engine — loaded
+        Whisper weights and compiled programs are reused, so the restart
+        is slot-pool bookkeeping, not a model load."""
+        old = self.batchers[idx]
+        old.kill(RuntimeError(
+            f"stt replica {idx} warm-restarted (dead or stalled worker)"))
+        self.batchers[idx] = self._make_batcher()
+        self._seen[idx] = (0, time.monotonic())
+        get_metrics().inc("stt.replica_restarts")
+        self._log.warning("stt replica %d warm-restarted", idx)
+
+    def _watch(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                self.sweep_once()
+            except Exception:  # pragma: no cover - watchdog must never die
+                self._log.exception("stt replica sweep failed")
+            self._stop_evt.wait(self.probe_s)
+
+    # ------------------------------------------------------------- submit
+
+    def _route(self, key: str, exclude=()) -> Replica | None:
+        with self._route_lock:
+            return self.route(key, exclude)
+
+    def _home_for(self, utt: int) -> Replica | None:
+        """Route with a dead-latch overlay: a batcher the watchdog has not
+        swept out of the ring yet is excluded NOW rather than bouncing
+        work off a corpse (the resulting forced move counts
+        stt.replica_rehomed via the route hook). Exclusions ACCUMULATE —
+        two corpses must not mask a healthy third replica."""
+        key = str(utt)
+        exclude: set[str] = set()
+        while True:
+            home = self._route(key, exclude)
+            if home is None or self.batchers[home.idx].healthy():
+                return home
+            exclude.add(home.url)
+
+    def submit(self, kind: str, utt: int, buf) -> Future:
+        """STTBatcher-compatible submit with utterance affinity. Finals are
+        wrapped with a one-shot failover: an exception from the home
+        replica (crash, kill drill, restart) resubmits the same window on
+        the next-best replica — the audio travels with the work item, so
+        the failover is a re-encode, never a loss."""
+        home = self._home_for(utt)
+        if home is None:
+            # whole tier out: shed best-effort work, fail finals (the
+            # voice handler surfaces a warn; the session itself survives)
+            fut: Future = Future()
+            if kind == "final":
+                fut.set_exception(RuntimeError("no stt replicas available"))
+            else:
+                get_metrics().inc("stt.shed_overload")
+                fut.set_result(None)
+            return fut
+        inner = self.batchers[home.idx].submit(kind, utt, buf)
+        if kind != "final":
+            return inner  # best-effort: a lost partial is latency, not data
+        outer: Future = Future()
+
+        def _relay(f: Future, failed_key: str, retry: bool) -> None:
+            try:
+                exc = f.exception()
+            except BaseException:  # cancelled upstream: mirror it
+                outer.cancel()
+                return
+            if exc is None:
+                try:
+                    outer.set_result(f.result())
+                except Exception:
+                    pass  # raced a caller-side cancel
+                return
+            if retry:
+                alt = self._route(str(utt), exclude={failed_key})
+                if alt is not None and self.batchers[alt.idx].healthy():
+                    # counted only when a resubmit actually happens — a
+                    # whole-tier outage must not read as successful
+                    # failovers on the dashboard
+                    get_metrics().inc("stt.replica_failovers")
+                    f2 = self.batchers[alt.idx].submit(kind, utt, buf)
+                    f2.add_done_callback(
+                        lambda g, k=alt.url: _relay(g, k, retry=False))
+                    return
+            try:
+                outer.set_exception(exc)
+            except Exception:
+                pass
+
+        inner.add_done_callback(lambda f, k=home.url: _relay(f, k, retry=True))
+        return outer
+
+    def release(self, utt: int) -> None:
+        """Utterance closed: free its slot wherever it lived (a re-homed
+        utterance may have touched several replicas) and drop the sticky
+        entry so rotated utterance keys don't churn the LRU."""
+        for b in self.batchers:
+            try:
+                b.release(utt)
+            except Exception:
+                pass
+        with self._route_lock:
+            self.forget_session(str(utt))
+
+    # -------------------------------------------------------------- admin
+
+    def tier_health(self) -> dict:
+        total, healthy, draining = self.health_counts()
+        return {"total": total, "healthy": healthy, "draining": draining}
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Quiesce every live replica (bench walls + shutdown hygiene)."""
+        ok = True
+        for b in self.batchers:
+            if b.healthy():
+                ok = b.drain(timeout_s) and ok
+        return ok
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5.0)
+            self._watchdog = None
+        for b in self.batchers:
+            b.stop()
+        global _TIER
+        if _TIER is self:
+            _TIER = None

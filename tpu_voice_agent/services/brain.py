@@ -183,6 +183,23 @@ class SessionTranscripts:
             while len(self._hist) > self.max_sessions:
                 self._hist.popitem(last=False)
 
+    def peek(self, session_id: str) -> list[int] | None:
+        """The session's committed transcript ids (a copy), without
+        touching LRU order — the warm-state handoff's export read."""
+        with self._lock:
+            hist = self._hist.get(session_id)
+            return list(hist) if hist is not None else None
+
+    def adopt(self, session_id: str, ids: list[int]) -> None:
+        """Install a transcript shipped from another replica (warm-state
+        handoff): the donor is authoritative at re-home time, so an older
+        local entry for the id is overwritten."""
+        with self._lock:
+            self._hist[session_id] = [int(t) for t in ids]
+            self._hist.move_to_end(session_id)
+            while len(self._hist) > self.max_sessions:
+                self._hist.popitem(last=False)
+
     def forget(self, session_id: str) -> None:
         with self._lock:
             self._hist.pop(session_id, None)
@@ -340,6 +357,74 @@ class BatchedEngineParser:
         """Active poison-quarantine entries (surfaced in /health): prompts
         whose repeated poison offenses got them refused at submit."""
         return self.batcher.quarantined()
+
+    def pressure_fractions(self) -> dict:
+        """LIVE saturation fractions for the /health ``pressure`` block
+        (the router's shed signal). Read from current scheduler/allocator
+        state, NOT the last-tick gauges: ``scheduler.batch_occupancy``
+        only rewrites inside a processed chunk, so after a burst an IDLE
+        replica's gauge stays pinned at its last busy value and the
+        router would shed new sessions off an empty replica forever.
+        Racy-but-monotone reads are fine for a shed signal."""
+        b = self.batcher
+        out = {"scheduler.batch_occupancy":
+               sum(1 for s in b.slots if s.request_id >= 0) / max(1, b.B)}
+        alloc = getattr(self.engine, "allocator", None)
+        if alloc is not None:
+            used = alloc.blocks_in_use
+            radix = getattr(self.engine, "radix", None)
+            if radix:
+                # a warm radix cache drifts raw utilization toward 1.0 BY
+                # DESIGN (released chains keep tree refs; _alloc reclaims
+                # them under pressure) — counting reclaimable cache as
+                # saturation would shed new sessions off exactly the
+                # warmest replicas, inverting placement
+                used -= sum(t.reclaimable_blocks() for t in radix)
+            out["paged.kv_pressure"] = max(0, used) / max(1, alloc.usable_blocks)
+        return out
+
+    # warm-state handoff (ISSUE 13): the router ships a re-homed session's
+    # transcript + radix-chain KV from its old home to its new one. Both
+    # halves run on the serving-loop thread (ColocatedServing.submit_call)
+    # — the allocator/radix/pool bookkeeping is single-threaded by
+    # contract — and both are best-effort: any failure is a cold re-home,
+    # never an error.
+    def export_session(self, session_id: str) -> bytes | None:
+        if self.transcripts is None:
+            return None
+        from ..serve import handoff
+
+        fut = self.runtime.submit_call(
+            lambda: handoff.export_session(self.engine, self.transcripts,
+                                           session_id))
+        try:
+            return fut.result(timeout=self.timeout_s)
+        except Exception:
+            return None
+
+    def adopt_session(self, blob: bytes) -> int:
+        if self.transcripts is None:
+            return 0
+        from ..serve import handoff
+
+        fut = self.runtime.submit_call(
+            lambda: handoff.adopt_session(self.engine, self.transcripts, blob))
+        try:
+            return int(fut.result(timeout=self.timeout_s))
+        except Exception:
+            # malformed/truncated blob (or an install fault before the
+            # per-cause counters): still a COUNTED cold fallback — an
+            # operator debugging cold re-homes must see it move, not a
+            # silently swallowed exception
+            import logging
+
+            from ..utils import get_metrics
+
+            get_metrics().inc("handoff.adopt_fallbacks")
+            logging.getLogger("tpu_voice_agent.brain").warning(
+                "handoff adoption failed; session will cold-prefill",
+                exc_info=True)
+            return 0
 
     def close(self) -> None:
         self.runtime.stop()
@@ -965,6 +1050,31 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         body["status"] = status
         body["ok"] = status != "unhealthy"
         body["slo"] = slo.state()
+        # the shed signal (ISSUE 13): the observatory's saturation signals
+        # (batch occupancy, KV utilization, admission fraction) folded to
+        # one score the router's prober reads — NEW sessions avoid
+        # replicas at/over ROUTER_SHED_PRESSURE before this replica's
+        # admission controller starts refusing. Read LIVE from the parser
+        # (pressure_fractions), not from the last-tick gauges: an idle
+        # engine's gauges freeze at their final busy value, and a frozen
+        # 1.0 would shed traffic off an empty replica forever. SLO trumps
+        # occupancy: a violated SLO is full by definition.
+        live = getattr(parser, "pressure_fractions", None)
+        fracs = {}
+        if live is not None:
+            try:
+                fracs = {k: round(float(v), 4) for k, v in live().items()}
+            except Exception:
+                fracs = {}
+        fracs["admission"] = round(
+            admission.inflight / max(1, admission.max_inflight), 4)
+        score = max(fracs.values())
+        if body["slo"] == "violated":
+            score = 1.0
+        elif body["slo"] == "at_risk":
+            score = max(score, 0.95)
+        body["pressure"] = {"score": round(score, 4), "slo": body["slo"],
+                            **fracs}
         return web.json_response(body, status=200 if body["ok"] else 503)
 
     async def parse(req: web.Request) -> web.Response:
@@ -1102,7 +1212,58 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         return web.json_response(resp.model_dump(), headers=ok_headers)
 
 
+    # warm-state handoff endpoints (ISSUE 13): the router GETs a re-homed
+    # session's serialized warm state from its old home and POSTs it to
+    # the new one (serve.handoff wire format). Parsers without the surface
+    # (rule-based, planner) answer 404 and the router counts a cold
+    # re-home — the PR 10 behavior, unchanged.
+    async def admin_handoff_get(req: web.Request) -> web.Response:
+        exporter = getattr(parser, "export_session", None)
+        if exporter is None:
+            return web.json_response({"error": "handoff_unsupported"},
+                                     status=404)
+        sid = req.match_info["session_id"]
+        loop = asyncio.get_running_loop()
+        blob = await loop.run_in_executor(None, exporter, sid)
+        if not blob:
+            return web.json_response(
+                {"error": "no_warm_state", "session_id": sid}, status=404)
+        return web.Response(body=blob,
+                            content_type="application/octet-stream")
+
+    # a shipped session is transcript ids + raw KV block bytes — tens of
+    # MB at serving dims, far past aiohttp's 1 MB default body cap. The
+    # cap stays app-wide (a 256 MB client_max_size would let /parse
+    # buffer multi-GB of hostile bodies before admission control runs);
+    # only THIS route reads the raw stream with its own bound.
+    _HANDOFF_MAX_BYTES = 256 * 1024 * 1024
+
+    async def admin_handoff_post(req: web.Request) -> web.Response:
+        adopter = getattr(parser, "adopt_session", None)
+        if adopter is None:
+            return web.json_response({"error": "handoff_unsupported"},
+                                     status=404)
+        chunks: list[bytes] = []
+        total = 0
+        while True:
+            chunk = await req.content.read(1 << 20)
+            if not chunk:
+                break
+            total += len(chunk)
+            if total > _HANDOFF_MAX_BYTES:
+                return web.json_response(
+                    {"error": "handoff_too_large",
+                     "limit_bytes": _HANDOFF_MAX_BYTES}, status=413)
+            chunks.append(chunk)
+        blob = b"".join(chunks)
+        loop = asyncio.get_running_loop()
+        adopted = await loop.run_in_executor(None, adopter, blob)
+        return web.json_response({"ok": True,
+                                  "adopted_tokens": int(adopted)})
+
     app.router.add_get("/health", health)
+    app.router.add_get("/admin/handoff/{session_id}", admin_handoff_get)
+    app.router.add_post("/admin/handoff", admin_handoff_post)
     from ..utils.tracing import (
         make_flightrecorder_handler,
         make_metrics_handler,

@@ -1,0 +1,312 @@
+"""Shared replica-set core: the ring state machine both fault tiers run.
+
+PR 10 built this machinery inside ``services/router.py`` for the brain
+tier: rendezvous placement with sticky residence, an eject/rejoin/drain
+state machine fed by health probes, per-replica passive breakers, and the
+re-home accounting that makes failover cost observable. The STT tier
+(``serve/stt_replicas.py``) needs the SAME proven core — one wedged
+Whisper batcher must leave its ring exactly like one wedged brain replica
+leaves its own — so the transport-agnostic half lives here:
+
+- ``Replica``: one member's administrative state (up | draining | drained
+  | down) with a passive ``CircuitBreaker`` overlay, probe-failure
+  counting, the serve-layer drain latch, and a ``pressure`` reading
+  (0..1 saturation fraction, fed by whichever prober owns the ring).
+- ``ReplicaSet``: placement (rendezvous over the admitting set, sticky
+  residence, LRU session table, forced-move accounting), the drain state
+  machine, and ``apply_probe`` — the eject/rejoin/latch verdict that used
+  to live inline in the router's probe loop.
+
+Pressure-driven shedding (ISSUE 13): ``shed_pressure`` arms a placement
+preference — a NEW session whose rendezvous-first choice reports pressure
+at/over the threshold (full batch, full KV pool, SLO at risk) is placed
+on the best replica still under it instead, BEFORE that replica's
+admission controller starts refusing. When every replica is over,
+placement falls back to plain rendezvous: overload degrades placement
+quality, it never turns into an error here. Sticky sessions are exempt —
+moving one costs a re-prefill, which is worse than the pressure.
+
+Metric accounting stays in the TIERS: the core invokes the ``_on_*``
+hooks below and each tier implements them with its own literal metric
+names (``router.*`` / ``stt.replica*``) — the metrics lint pins literal
+names, so the shared core must never register through an f-string.
+
+Everything here is synchronous and lock-free by design: the router calls
+it from await-free event-loop sections (the atomic-section contract the
+analyzer enforces), the STT tier from one watchdog thread plus callers
+that tolerate a stale read.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from collections import OrderedDict
+
+from ..utils.resilience import CircuitBreaker
+
+
+def rendezvous_weight(key: str, session_id: str) -> int:
+    """Rendezvous (highest-random-weight) score: deterministic per
+    (replica, session) pair, so removing a replica re-homes ONLY its own
+    sessions — each to its next-highest-weight choice."""
+    digest = hashlib.blake2b(f"{key}|{session_id}".encode(),
+                             digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+class Replica:
+    """One ring member's routing state. ``state`` is the administrative
+    machine (up | draining | drained | down); the breaker overlays
+    transport health on top of it without changing it. ``url`` is the
+    member's ring key — a base URL for HTTP tiers, a name for in-process
+    ones (the STT batcher ring)."""
+
+    __slots__ = ("idx", "url", "state", "breaker", "probe_fails",
+                 "inflight", "last_health", "drain_latched", "pressure")
+
+    def __init__(self, idx: int, url: str, breaker_threshold: int,
+                 breaker_reset_s: float):
+        self.idx = idx
+        self.url = url.rstrip("/")
+        self.state = "up"
+        # passive failure counting through the PR 1 breaker: a replica that
+        # hangs on /parse while answering /health probes still leaves the
+        # ring after breaker_threshold consecutive transport failures, and
+        # the half-open window re-discovers it without operator action
+        self.breaker = CircuitBreaker(
+            f"replica{idx}", failure_threshold=breaker_threshold,
+            reset_after_s=breaker_reset_s)
+        self.probe_fails = 0
+        self.inflight = 0
+        self.last_health: dict | None = None
+        # set when a probe has SEEN the replica's serve-layer drain latch
+        # in /health while draining/drained; its later disappearance is the
+        # evidence of a completed restart (fresh process, latch gone)
+        self.drain_latched = False
+        # saturation fraction in [0, 1] reported by the member (brain
+        # /health ``pressure.score``; STT queue depth / cap) — the shed
+        # signal placement reads BEFORE admission controllers refuse
+        self.pressure = 0.0
+
+    def admitting(self) -> bool:
+        """May receive NEW sessions (and anonymous parses)."""
+        return self.state == "up" and self.breaker.state != "open"
+
+    def servable(self) -> bool:
+        """May keep serving its EXISTING sessions (draining replicas
+        finish their own sessions' turns until ejected)."""
+        return self.state in ("up", "draining") and self.breaker.state != "open"
+
+    def describe(self) -> dict:
+        return {"url": self.url, "state": self.state,
+                "breaker": self.breaker.state, "inflight": self.inflight,
+                "probe_fails": self.probe_fails,
+                "pressure": round(self.pressure, 4)}
+
+
+class ReplicaSet:
+    """Ring state + placement; tiers subclass it and implement the metric
+    hooks with their own literal counter names.
+
+    Every mutation of routing state happens inside one call (no internal
+    waits), so an event-loop tier keeps its await-free critical sections
+    and a threaded tier serializes calls on its own one watchdog/submit
+    discipline.
+    """
+
+    def __init__(self, keys: list[str], *,
+                 probe_fails_limit: int = 2,
+                 breaker_threshold: int = 3,
+                 breaker_reset_s: float = 2.0,
+                 max_sessions: int = 4096,
+                 shed_pressure: float | None = None,
+                 log_name: str = "tpu_voice_agent.replicaset"):
+        if not keys:
+            raise ValueError("a replica set needs at least one member")
+        self.probe_fails_limit = probe_fails_limit
+        self.max_sessions = max_sessions
+        self.shed_pressure = shed_pressure
+        self.replicas = [Replica(i, k, breaker_threshold, breaker_reset_s)
+                         for i, k in enumerate(keys)]
+        self._by_url = {r.url: r for r in self.replicas}
+        # session -> home-replica key, LRU-capped; stickiness (drain, no
+        # flap-back on recovery) and the re-home accounting both live here
+        self._sessions: "OrderedDict[str, str]" = OrderedDict()
+        self._log = logging.getLogger(log_name)
+
+    # ------------------------------------------------------- metric hooks
+    # The shared core must not register metric names through f-strings
+    # (the lint pins literals), so each tier overrides these with its own.
+
+    def _on_rehome(self) -> None: ...
+
+    def _on_shed_pressure(self) -> None: ...
+
+    def _on_drain(self) -> None: ...
+
+    def _on_drain_completed(self) -> None: ...
+
+    def _on_ejected(self, replica: Replica) -> None: ...
+
+    def _on_recovered(self, replica: Replica) -> None: ...
+
+    def _update_health_gauge(self) -> None: ...
+
+    # ------------------------------------------------------------ routing
+
+    def _pick(self, session_id: str | None, exclude=(),
+              count: bool = False) -> Replica | None:
+        """Pure placement (no session-table update): rendezvous over the
+        admitting set for keyed sessions, least-inflight for anonymous
+        parses. The hedging path uses this so a hedge never re-homes.
+
+        With ``shed_pressure`` armed, members at/over the threshold are
+        avoided for new placements while at least one member is under it;
+        all-over falls back to the full set. ``count=True`` fires
+        ``_on_shed_pressure`` when the avoidance actually changed the
+        keyed choice — only ``route_ex``'s real placements pass it, so a
+        hedge probing alternatives never inflates the shed counter."""
+        cands = [r for r in self.replicas
+                 if r.admitting() and r.url not in exclude]
+        if not cands:
+            return None
+        pool = cands
+        if self.shed_pressure is not None:
+            under = [r for r in cands if r.pressure < self.shed_pressure]
+            if under and len(under) < len(cands):
+                pool = under
+        if session_id:
+            top = max(cands, key=lambda r: rendezvous_weight(r.url, session_id))
+            if pool is cands:
+                return top
+            best = max(pool, key=lambda r: rendezvous_weight(r.url, session_id))
+            if count and best is not top:
+                self._on_shed_pressure()
+            return best
+        return min(pool, key=lambda r: r.inflight)
+
+    def route_ex(self, session_id: str | None,
+                 exclude=()) -> tuple[Replica | None, str | None]:
+        """The authoritative per-request decision: sticky home while it is
+        servable, else rendezvous placement over the admitting set (which
+        IS the deterministic next-highest-weight re-home when the old home
+        left the ring). Returns ``(home, rehomed_from)`` — the second
+        element is the PREVIOUS home's key exactly when this call forced a
+        move (the caller decides whether warm state can be shipped from
+        there). Counts every forced move via ``_on_rehome``."""
+        # atomic-section: replicaset.route -- session-table read+mutate must be one event-loop step: an await between the sticky lookup and the re-home write lets a racing request route the same session elsewhere
+        rehomed_from: str | None = None
+        if session_id:
+            prev_url = self._sessions.get(session_id)
+            if prev_url is not None and prev_url not in exclude:
+                prev = self._by_url.get(prev_url)
+                if prev is not None and prev.servable():
+                    self._sessions.move_to_end(session_id)
+                    return prev, None
+        home = self._pick(session_id, exclude, count=True)
+        if home is None:
+            return None, None
+        if session_id:
+            prev_url = self._sessions.get(session_id)
+            if prev_url is not None and prev_url != home.url:
+                rehomed_from = prev_url
+                self._on_rehome()
+            self._sessions[session_id] = home.url
+            self._sessions.move_to_end(session_id)
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+        # end-atomic-section
+        return home, rehomed_from
+
+    def route(self, session_id: str | None, exclude=()) -> Replica | None:
+        return self.route_ex(session_id, exclude)[0]
+
+    def forget_session(self, session_id: str) -> None:
+        """Drop a closed session's sticky entry (the STT tier's utterance
+        keys rotate per utterance — without this the LRU churns)."""
+        self._sessions.pop(session_id, None)
+
+    # ------------------------------------------------------------- drain
+
+    # atomic-section: replicaset.ring-state -- replica state transitions (up/draining/drained) and the health gauge must commit atomically: a suspension mid-transition exposes a half-drained ring to concurrent route() calls
+    def start_drain(self, replica: Replica) -> bool:
+        """Stop placing new sessions on ``replica``; existing sessions keep
+        hitting it until in-flight reaches zero, then it is ejected."""
+        if replica.state != "up":
+            return False
+        replica.state = "draining"
+        replica.drain_latched = False  # fresh drain cycle
+        self._on_drain()
+        self._update_health_gauge()
+        self._maybe_finish_drain(replica)
+        return True
+
+    def _maybe_finish_drain(self, replica: Replica) -> None:
+        if replica.state == "draining" and replica.inflight == 0:
+            replica.state = "drained"
+            self._on_drain_completed()
+            self._update_health_gauge()
+
+    def admit(self, replica: Replica) -> None:
+        replica.state = "up"
+        replica.probe_fails = 0
+        replica.drain_latched = False
+        self._update_health_gauge()
+    # end-atomic-section
+
+    # ------------------------------------------------------------ probing
+
+    def apply_probe(self, r: Replica, ok: bool, body: dict | None) -> None:
+        """One probe's verdict: the eject/rejoin/drain-latch state machine
+        (moved verbatim from the PR 10 router's probe loop). The caller
+        owns the transport (HTTP GET, thread-liveness check) and hands the
+        result here; ``body`` is the member's health body when one exists."""
+        # atomic-section: replicaset.probe-verdict -- the eject/rejoin/drain-latch state machine must not suspend mid-way: route() must never observe a replica between two of these transitions
+        body = body if isinstance(body, dict) else {}
+        if ok:
+            r.probe_fails = 0
+            if body:
+                r.last_health = body
+            if r.state == "down":
+                # recovered (or restarted after a drain): rejoin the ring.
+                # Its old sessions stay where they re-homed (stickiness);
+                # new sessions flow here again by rendezvous weight.
+                r.state = "up"
+                r.drain_latched = False
+                self._on_recovered(r)
+            elif r.state in ("draining", "drained") and body.get("draining"):
+                r.drain_latched = True
+            elif r.state == "drained" and r.drain_latched:
+                # the rolling restart was faster than probe_fails
+                # consecutive probe windows, so the replica never read
+                # "down" — but the serve-layer drain latch we saw while it
+                # was drained is gone now, and only a FRESH process drops
+                # it: rejoin directly from drained. (A replica that never
+                # showed the latch stays drained until an explicit admit —
+                # the ring-side drain must hold for latch-less replicas.)
+                r.state = "up"
+                r.drain_latched = False
+                self._on_recovered(r)
+            elif r.state == "up" and body.get("draining"):
+                # drain issued directly at the replica: honor it here too
+                self.start_drain(r)
+        else:
+            r.probe_fails += 1
+            if r.probe_fails >= self.probe_fails_limit and r.state != "down":
+                r.state = "down"
+                self._on_ejected(r)
+                self._log.warning(
+                    "replica %s ejected after %d failed probes",
+                    r.url, r.probe_fails)
+        # end-atomic-section
+
+    # ------------------------------------------------------------- health
+
+    def health_counts(self) -> tuple[int, int, int]:
+        """(total, healthy-servable, draining) — the /health shape both
+        tiers report and both HUD badges render."""
+        total = len(self.replicas)
+        healthy = sum(1 for r in self.replicas if r.servable())
+        draining = sum(1 for r in self.replicas if r.state == "draining")
+        return total, healthy, draining

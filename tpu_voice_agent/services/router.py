@@ -59,6 +59,27 @@ Design:
   boundary. Session-committing parses are never hedged (two replicas must
   not both record the turn).
 
+- **Warm-state handoff (ISSUE 13).** ``HANDOFF_ENABLE=1``: when a forced
+  move re-homes a session and its OLD home is still reachable (a drain,
+  not a crash), the router ships the session's warm state — transcript
+  token ids plus the radix chain's paged KV block bytes, serialized by
+  ``serve.handoff`` — from the old home to the new one before forwarding
+  the parse, so the re-homed turn costs ~transfer bookkeeping instead of
+  a cold re-prefill (AND keeps its multi-turn context, which a cold
+  re-home loses). ``router.sessions_rehomed`` splits into ``_warm`` (KV
+  adopted on the new home) and ``_cold`` (crash, handoff off, donor had
+  no warm state, or the recipient fell back — always clean: the new home
+  just cold-prefills).
+
+- **Gauge-driven shedding (ISSUE 13).** Each probe carries the replica's
+  ``pressure.score`` (max of batch occupancy, KV pressure net of
+  evictable radix cache, admission inflight fraction, forced high by a
+  non-ok SLO — the observatory's saturation signals, read live). NEW sessions
+  avoid replicas at/over ``ROUTER_SHED_PRESSURE`` while any replica is
+  under it (``router.shed_pressure`` counts the redirects); sticky
+  sessions never move for pressure, and all-over falls back to plain
+  rendezvous — overload degrades placement quality instead of erroring.
+
 - **Full outage.** Every replica out of the ring → ``503 + Retry-After``,
   which the voice service already maps to the RuleBasedParser degraded
   mode: quality degrades, sessions survive.
@@ -67,21 +88,21 @@ Design:
 from __future__ import annotations
 
 import asyncio
-import hashlib
 import json
 import os
 import time
-from collections import OrderedDict
+import urllib.parse
 
 from aiohttp import web
 
 from ..utils import SLOTracker, Tracer, get_metrics, load_env_cascade, new_trace_id
 from ..utils.resilience import (
     DEADLINE_HEADER,
-    CircuitBreaker,
     Deadline,
     shed_response,
 )
+from .replicaset import Replica, ReplicaSet
+from .replicaset import rendezvous_weight as _weight  # noqa: F401 - test surface
 
 # response headers forwarded back to the caller verbatim (the brain's
 # decode-split contract the voice service folds into latency_budget, plus
@@ -97,60 +118,12 @@ class ReplicaFailed(RuntimeError):
     replica's own semantics and pass through)."""
 
 
-class Replica:
-    """One brain replica's routing state. ``state`` is the administrative
-    machine (up | draining | drained | down); the breaker overlays
-    transport health on top of it without changing it."""
-
-    __slots__ = ("idx", "url", "state", "breaker", "probe_fails",
-                 "inflight", "last_health", "drain_latched")
-
-    def __init__(self, idx: int, url: str, breaker_threshold: int,
-                 breaker_reset_s: float):
-        self.idx = idx
-        self.url = url.rstrip("/")
-        self.state = "up"
-        # passive failure counting through the PR 1 breaker: a replica that
-        # hangs on /parse while answering /health probes still leaves the
-        # ring after breaker_threshold consecutive transport failures, and
-        # the half-open window re-discovers it without operator action
-        self.breaker = CircuitBreaker(
-            f"replica{idx}", failure_threshold=breaker_threshold,
-            reset_after_s=breaker_reset_s)
-        self.probe_fails = 0
-        self.inflight = 0
-        self.last_health: dict | None = None
-        # set when a probe has SEEN the replica's serve-layer drain latch
-        # in /health while draining/drained; its later disappearance is the
-        # evidence of a completed restart (fresh process, latch gone)
-        self.drain_latched = False
-
-    def admitting(self) -> bool:
-        """May receive NEW sessions (and anonymous parses)."""
-        return self.state == "up" and self.breaker.state != "open"
-
-    def servable(self) -> bool:
-        """May keep serving its EXISTING sessions (draining replicas
-        finish their own sessions' turns until ejected)."""
-        return self.state in ("up", "draining") and self.breaker.state != "open"
-
-    def describe(self) -> dict:
-        return {"url": self.url, "state": self.state,
-                "breaker": self.breaker.state, "inflight": self.inflight,
-                "probe_fails": self.probe_fails}
-
-
-def _weight(url: str, session_id: str) -> int:
-    """Rendezvous (highest-random-weight) score: deterministic per
-    (replica, session) pair, so removing a replica re-homes ONLY its own
-    sessions — each to its next-highest-weight choice."""
-    digest = hashlib.blake2b(f"{url}|{session_id}".encode(),
-                             digest_size=8).digest()
-    return int.from_bytes(digest, "big")
-
-
-class BrainRouter:
+class BrainRouter(ReplicaSet):
     """Routing state + forwarding logic; ``build_app`` wires it to HTTP.
+    The ring state machine itself (placement, drain, probe verdicts) is
+    the shared ``services.replicaset.ReplicaSet`` core — the STT tier
+    (``serve.stt_replicas``) runs the same one — and this class owns the
+    HTTP half: probing, forwarding, hedging, failover, warm handoff.
 
     Every mutation of routing state happens between awaits on the event
     loop (route selection + session-table update + inflight accounting are
@@ -167,7 +140,10 @@ class BrainRouter:
                  parse_timeout_s: float | None = None,
                  max_sessions: int | None = None,
                  breaker_threshold: int | None = None,
-                 breaker_reset_s: float | None = None):
+                 breaker_reset_s: float | None = None,
+                 handoff_enable: bool | None = None,
+                 handoff_timeout_s: float | None = None,
+                 shed_pressure: float | None = None):
         if not replica_urls:
             raise ValueError("BRAIN_REPLICAS must name at least one replica")
         env = os.environ.get
@@ -175,30 +151,38 @@ class BrainRouter:
             float(env("ROUTER_PROBE_S", "0.5"))
         self.probe_timeout_s = probe_timeout_s if probe_timeout_s is not None \
             else float(env("ROUTER_PROBE_TIMEOUT_S", "2.0"))
-        self.probe_fails_limit = probe_fails if probe_fails is not None else \
-            int(env("ROUTER_PROBE_FAILS", "2"))
         self.hedge_ms = hedge_ms if hedge_ms is not None else \
             float(env("ROUTER_HEDGE_MS", "0"))
         self.parse_timeout_s = parse_timeout_s if parse_timeout_s is not None \
             else float(env("ROUTER_PARSE_TIMEOUT_S", "60"))
-        self.max_sessions = max_sessions if max_sessions is not None else \
-            int(env("ROUTER_SESSIONS", "4096"))
-        bt = breaker_threshold if breaker_threshold is not None else \
-            int(env("ROUTER_BREAKER_THRESHOLD", "3"))
-        br = breaker_reset_s if breaker_reset_s is not None else \
-            float(env("ROUTER_BREAKER_RESET_S", "2.0"))
-        self.replicas = [Replica(i, u, bt, br)
-                         for i, u in enumerate(replica_urls)]
-        self._by_url = {r.url: r for r in self.replicas}
-        # session -> home-replica url, LRU-capped; stickiness (drain, no
-        # flap-back on recovery) and the re-home accounting both live here
-        self._sessions: "OrderedDict[str, str]" = OrderedDict()
+        self.handoff_enable = handoff_enable if handoff_enable is not None \
+            else env("HANDOFF_ENABLE") == "1"
+        self.handoff_timeout_s = handoff_timeout_s \
+            if handoff_timeout_s is not None \
+            else float(env("HANDOFF_TIMEOUT_S", "5.0"))
+        super().__init__(
+            replica_urls,
+            probe_fails_limit=(probe_fails if probe_fails is not None
+                               else int(env("ROUTER_PROBE_FAILS", "2"))),
+            breaker_threshold=(breaker_threshold
+                               if breaker_threshold is not None
+                               else int(env("ROUTER_BREAKER_THRESHOLD", "3"))),
+            breaker_reset_s=(breaker_reset_s if breaker_reset_s is not None
+                             else float(env("ROUTER_BREAKER_RESET_S", "2.0"))),
+            max_sessions=(max_sessions if max_sessions is not None
+                          else int(env("ROUTER_SESSIONS", "4096"))),
+            shed_pressure=(shed_pressure if shed_pressure is not None
+                           else float(env("ROUTER_SHED_PRESSURE", "0.9"))),
+            log_name="tpu_voice_agent.router")
         self._http = None  # httpx.AsyncClient, created on the app's loop
         self._probe_task: asyncio.Task | None = None
         # the contract counters/gauges exist from construction (the breaker
         # gauge discipline: scrape-visible at zero, never an absent series)
         m = get_metrics()
         m.inc("router.sessions_rehomed", 0.0)
+        m.inc("router.sessions_rehomed_warm", 0.0)
+        m.inc("router.sessions_rehomed_cold", 0.0)
+        m.inc("router.shed_pressure", 0.0)
         m.inc("router.hedges_fired", 0.0)
         m.inc("router.hedges_won", 0.0)
         m.inc("router.drains", 0.0)
@@ -207,78 +191,31 @@ class BrainRouter:
         m.set_gauge("router.replicas_total", len(self.replicas))
         self._update_health_gauge()
 
-    # ------------------------------------------------------------ routing
+    # ---------------------------------------------- replica-set hooks
+    # literal metric names on purpose: tools/metrics_lint.py pins them, so
+    # the shared core routes accounting through these instead of f-strings
 
     def _update_health_gauge(self) -> None:
         get_metrics().set_gauge("router.replicas_healthy",
                                 sum(1 for r in self.replicas if r.servable()))
 
-    def _pick(self, session_id: str | None, exclude=()) -> Replica | None:
-        """Pure placement (no session-table update): rendezvous over the
-        admitting set for keyed sessions, least-inflight for anonymous
-        parses. The hedging path uses this so a hedge never re-homes."""
-        cands = [r for r in self.replicas
-                 if r.admitting() and r.url not in exclude]
-        if not cands:
-            return None
-        if session_id:
-            return max(cands, key=lambda r: _weight(r.url, session_id))
-        return min(cands, key=lambda r: r.inflight)
+    def _on_rehome(self) -> None:
+        get_metrics().inc("router.sessions_rehomed")
 
-    def route(self, session_id: str | None, exclude=()) -> Replica | None:
-        """The authoritative per-request decision: sticky home while it is
-        servable, else rendezvous placement over the admitting set (which
-        IS the deterministic next-highest-weight re-home when the old home
-        left the ring). Counts every forced move."""
-        # atomic-section: router.route -- session-table read+mutate must be one event-loop step: an await between the sticky lookup and the re-home write lets a racing request route the same session elsewhere
-        if session_id:
-            prev_url = self._sessions.get(session_id)
-            if prev_url is not None and prev_url not in exclude:
-                prev = self._by_url.get(prev_url)
-                if prev is not None and prev.servable():
-                    self._sessions.move_to_end(session_id)
-                    return prev
-        home = self._pick(session_id, exclude)
-        if home is None:
-            return None
-        if session_id:
-            prev_url = self._sessions.get(session_id)
-            if prev_url is not None and prev_url != home.url:
-                get_metrics().inc("router.sessions_rehomed")
-            self._sessions[session_id] = home.url
-            self._sessions.move_to_end(session_id)
-            while len(self._sessions) > self.max_sessions:
-                self._sessions.popitem(last=False)
-        # end-atomic-section
-        return home
+    def _on_shed_pressure(self) -> None:
+        get_metrics().inc("router.shed_pressure")
 
-    # ------------------------------------------------------------- drain
-
-    # atomic-section: router.ring-state -- replica state transitions (up/draining/drained) and the health gauge must commit atomically: a suspension mid-transition exposes a half-drained ring to concurrent route() calls
-    def start_drain(self, replica: Replica) -> bool:
-        """Stop placing new sessions on ``replica``; existing sessions keep
-        hitting it until in-flight reaches zero, then it is ejected."""
-        if replica.state != "up":
-            return False
-        replica.state = "draining"
-        replica.drain_latched = False  # fresh drain cycle
+    def _on_drain(self) -> None:
         get_metrics().inc("router.drains")
-        self._update_health_gauge()
-        self._maybe_finish_drain(replica)
-        return True
 
-    def _maybe_finish_drain(self, replica: Replica) -> None:
-        if replica.state == "draining" and replica.inflight == 0:
-            replica.state = "drained"
-            get_metrics().inc("router.drains_completed")
-            self._update_health_gauge()
+    def _on_drain_completed(self) -> None:
+        get_metrics().inc("router.drains_completed")
 
-    def admit(self, replica: Replica) -> None:
-        replica.state = "up"
-        replica.probe_fails = 0
-        replica.drain_latched = False
-        self._update_health_gauge()
-    # end-atomic-section
+    def _on_ejected(self, replica: Replica) -> None:
+        get_metrics().inc("router.replicas_ejected")
+
+    def _on_recovered(self, replica: Replica) -> None:
+        get_metrics().inc("router.replicas_recovered")
 
     # ------------------------------------------------------------ probing
 
@@ -299,44 +236,19 @@ class BrainRouter:
             ok = resp.status_code == 200 and bool(body.get("ok", True))
         except (httpx.HTTPError, OSError, ValueError, asyncio.TimeoutError):
             ok, body = False, None
-        # atomic-section: router.probe-verdict -- the eject/rejoin/drain-latch state machine runs after the probe await resolves and must not suspend again: route() must never observe a replica between two of these transitions
-        if ok:
-            r.probe_fails = 0
-            r.last_health = body
-            if r.state == "down":
-                # recovered (or restarted after a drain): rejoin the ring.
-                # Its old sessions stay where they re-homed (stickiness);
-                # new sessions flow here again by rendezvous weight.
-                r.state = "up"
-                r.drain_latched = False
-                get_metrics().inc("router.replicas_recovered")
-            elif r.state in ("draining", "drained") and body.get("draining"):
-                r.drain_latched = True
-            elif r.state == "drained" and r.drain_latched:
-                # the rolling restart was faster than probe_fails
-                # consecutive probe windows, so the replica never read
-                # "down" — but the serve-layer drain latch we saw while it
-                # was drained is gone now, and only a FRESH process drops
-                # it: rejoin directly from drained. (A replica that never
-                # showed the latch stays drained until /admin/admit — the
-                # router-side drain must hold for latch-less replicas.)
-                r.state = "up"
-                r.drain_latched = False
-                get_metrics().inc("router.replicas_recovered")
-            elif r.state == "up" and body.get("draining"):
-                # drain issued directly at the replica: honor it here too
-                self.start_drain(r)
-        else:
-            r.probe_fails += 1
-            if r.probe_fails >= self.probe_fails_limit and r.state != "down":
-                r.state = "down"
-                get_metrics().inc("router.replicas_ejected")
-                import logging
-
-                logging.getLogger("tpu_voice_agent.router").warning(
-                    "replica %s ejected after %d failed probes",
-                    r.url, r.probe_fails)
-        # end-atomic-section
+        if ok and isinstance(body, dict):
+            # the shed signal rides the probe: the replica's own saturation
+            # score (brain /health ``pressure`` block — the gauges the
+            # observatory already exports, folded to one fraction)
+            p = body.get("pressure")
+            try:
+                r.pressure = float(p.get("score", 0.0)) if isinstance(p, dict) \
+                    else 0.0
+            except (TypeError, ValueError):
+                r.pressure = 0.0
+        # the verdict state machine (eject/rejoin/drain latch) is the
+        # shared replica-set core's, unchanged from PR 10
+        self.apply_probe(r, ok, body)
 
     async def _probe_loop(self) -> None:
         while True:
@@ -492,20 +404,74 @@ class BrainRouter:
             get_metrics().inc("router.hedges_won")
         return winner
 
+    # ------------------------------------------------------------ handoff
+
+    async def _rehome_handoff(self, session_id: str, old_url: str,
+                              new: Replica, deadline: Deadline) -> bool:
+        """A forced move just happened: try to ship the session's warm
+        state (transcript ids + radix-chain KV bytes, serve.handoff) from
+        the old home to the new one, and split the re-home accounting into
+        warm/cold. Always best-effort — every failure mode (handoff off,
+        dead donor, no warm state, recipient under pool pressure, replica
+        without the endpoints) just leaves the cold re-prefill PR 10
+        already paid, never an error."""
+        warm = False
+        if self.handoff_enable:
+            warm = await self._ship_warm_state(session_id, old_url, new.url,
+                                               deadline)
+        get_metrics().inc("router.sessions_rehomed_warm" if warm
+                          else "router.sessions_rehomed_cold")
+        return warm
+
+    async def _ship_warm_state(self, session_id: str, old_url: str,
+                               new_url: str, deadline: Deadline) -> bool:
+        """GET the donor's serialized session state, POST it to the new
+        home. Bounded by HANDOFF_TIMEOUT_S and a third of the remaining
+        parse budget per hop (a hung donor must not eat the deadline the
+        failover exists to honor). True only when the recipient adopted
+        actual KV (``adopted_tokens > 0``) — a transcript-only adoption
+        keeps the turn token-identical but still pays a cold prefill."""
+        import httpx
+
+        budget = min(self.handoff_timeout_s,
+                     max(0.05, deadline.remaining_s() / 3))
+        sid = urllib.parse.quote(session_id, safe="")
+        try:
+            resp = await self._http.get(old_url + "/admin/handoff/" + sid,
+                                        timeout=budget)
+            if resp.status_code != 200 or not resp.content:
+                return False
+            resp2 = await self._http.post(
+                new_url + "/admin/handoff", content=resp.content,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=budget)
+            if resp2.status_code != 200:
+                return False
+            return int(resp2.json().get("adopted_tokens", 0)) > 0
+        except (httpx.HTTPError, OSError, ValueError, asyncio.TimeoutError):
+            return False
+
     async def forward_parse(self, raw: bytes, body: dict,
                             headers: dict) -> tuple:
-        """The full /parse policy: route → (hedged) attempt → on transport
-        failure, retry ONCE on the session's new home inside the original
-        deadline (speculative parses are discarded instead — satellite 6).
+        """The full /parse policy: route → (on a forced move, warm-state
+        handoff) → (hedged) attempt → on transport failure, retry ONCE on
+        the session's new home inside the original deadline (speculative
+        parses are discarded instead — satellite 6).
         Returns (httpx response | None, served replica | None, error str)."""
         session_id = body.get("session_id") or None
         speculative = bool(body.get("speculative"))
         deadline = (Deadline.from_headers(headers)
                     or Deadline.after(self.parse_timeout_s))
         idempotent = speculative or not session_id
-        home = self.route(session_id)
+        home, rehomed_from = self.route_ex(session_id)
         if home is None:
             return None, None, "no_replicas"
+        if rehomed_from is not None and session_id:
+            # drain/eject path of the warm handoff: the old home may still
+            # be alive (drained, awaiting restart) — ship before forwarding
+            # so the new home's very first turn admits against warm state
+            await self._rehome_handoff(session_id, rehomed_from, home,
+                                       deadline)
         # a retry can only follow a non-speculative attempt with somewhere
         # else to go; cap the first attempt at half the remaining budget in
         # that case so the retry is guaranteed to fit (mid-flight ejection
@@ -530,9 +496,15 @@ class BrainRouter:
                 return None, None, "spec_discarded"
             if deadline.expired:
                 return None, None, f"deadline_expired: {e}"
-            home2 = self.route(session_id, exclude={home.url})
+            home2, rehomed2 = self.route_ex(session_id, exclude={home.url})
             if home2 is None:
                 return None, None, "no_replicas"
+            if rehomed2 is not None and session_id:
+                # failover path of the warm handoff: the old home usually
+                # just crashed, so the GET fails fast and the move counts
+                # cold — but a hung-yet-alive donor can still ship
+                await self._rehome_handoff(session_id, rehomed2, home2,
+                                           deadline)
             get_metrics().inc("router.retries")
             try:
                 resp, served, _h = await self._attempt(

@@ -122,13 +122,24 @@ def stt_factory_from_env():
         # process-wide engine + batcher multiplexes every connection's
         # transcription work into batched dispatches (docs/PERF.md
         # "Multi-stream STT batching"); STT_BATCH_SLOTS bounds concurrent
-        # decode width. Unset keeps the historical per-connection path
+        # decode width. STT_REPLICAS>1 (ISSUE 13) runs N batcher replicas
+        # over the one loaded engine behind the connection-affine replica
+        # tier (serve.stt_replicas): a wedged/crashed Whisper worker is
+        # warm-restarted and failed over instead of taking every live
+        # microphone down. Unset keeps the historical per-connection path
         # (shared engine, one lock, B=1 dispatches) byte-identical.
         if os.environ.get("STT_BATCH_ENABLE", "") == "1":
             from ..serve.stt_batch import BatchedStreamingSTT, STTBatcher
 
             slots = int(os.environ.get("STT_BATCH_SLOTS", "4"))
-            batcher = STTBatcher(engine, slots=slots)
+            n_replicas = int(os.environ.get("STT_REPLICAS", "1"))
+            if n_replicas > 1:
+                from ..serve.stt_replicas import STTReplicaTier
+
+                batcher = STTReplicaTier(engine, replicas=n_replicas,
+                                         slots=slots)
+            else:
+                batcher = STTBatcher(engine, slots=slots)
             return lambda: BatchedStreamingSTT(
                 engine, batcher,
                 endpointer=make_endpointer(),
@@ -303,6 +314,13 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         fwd = await _brain_engine_health()
         if fwd is not None:
             body["brain"] = fwd
+        # the STT replica ring (ISSUE 13): healthy/total (+draining) for
+        # the HUD's STT badge, beside the brain replica badge it mirrors
+        from ..serve.stt_replicas import current_tier
+
+        tier = current_tier()
+        if tier is not None:
+            body["stt_replicas"] = tier.tier_health()
         # degraded still serves (that is the point) — 200 either way
         return web.json_response(body)
 

@@ -49,6 +49,20 @@ request on that app, /health probes included, like a crashed process):
                   router's passive breaker/deadline path must catch it)
     replica_slow  this request sleeps CHAOS_SLOW_S (0.25) first — the
                   tail-latency shape hedged parses (ROUTER_HEDGE_MS) cut
+
+STT replica points (ISSUE 13 — the ``stt_replica_kill``/``stt_replica_hang``
+mirrors of the brain variants, fired inside ``serve.stt_batch.STTBatcher``
+and drilled by ``benches/bench_handoff.py`` against the replicated STT
+tier ``serve.stt_replicas``):
+
+    stt_replica_kill  the batcher worker crashes mid-tick: queued and
+                      in-flight futures fail abruptly and the batcher
+                      latches dead until the tier warm-restarts it —
+                      finals must fail over with zero losses
+    stt_replica_hang  one tick sleeps CHAOS_HANG_S before decoding — a
+                      wedged-but-alive worker the tier's stalled-tick
+                      watchdog must detect and warm-restart (reusing the
+                      loaded Whisper weights)
 """
 
 from __future__ import annotations
@@ -59,7 +73,7 @@ import threading
 
 KNOWN_POINTS = ("nan_logits", "dead_fsm", "prefill_exc", "alloc_fail",
                 "stall_step", "drop_frame", "replica_kill", "replica_hang",
-                "replica_slow")
+                "replica_slow", "stt_replica_kill", "stt_replica_hang")
 
 
 class ChaosError(RuntimeError):

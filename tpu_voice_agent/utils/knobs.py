@@ -120,6 +120,16 @@ declare("VOICE_BREAKER_RESET_S", "2.0", "voice-side breaker open window", table=
 declare("BRAIN_MAX_INFLIGHT", "32", "brain admission-controller concurrent-parse cap", table=RESILIENCE)
 declare("EXECUTOR_MAX_INFLIGHT", "16", "executor admission-controller concurrent-batch cap", table=RESILIENCE)
 
+# STT replica tier + warm-state handoff (ISSUE 13)
+declare("STT_REPLICAS", "1", "STT batcher replicas behind the connection-affine tier (>1 enables it)", table=RESILIENCE)
+declare("STT_REPLICA_PROBE_S", "0.25", "STT replica watchdog sweep interval", table=RESILIENCE)
+declare("STT_REPLICA_STALL_S", "5.0", "frozen-tick seconds before an STT replica is warm-restarted", table=RESILIENCE)
+declare("STT_SHED_PRESSURE", "0.9", "queue-occupancy fraction past which new utterances avoid an STT replica", table=RESILIENCE)
+declare("HANDOFF_ENABLE", None, "1 ships warm session state (transcript + radix KV) on re-home/drain", table=RESILIENCE)
+declare("HANDOFF_TIMEOUT_S", "5.0", "per-hop budget for one warm-state handoff transfer", table=RESILIENCE)
+declare("HANDOFF_KV", "1", "0 ships the transcript WITHOUT KV bytes (the cold-re-home ablation baseline)", table=RESILIENCE)
+declare("ROUTER_SHED_PRESSURE", "0.9", "pressure score past which new sessions avoid a brain replica", table=RESILIENCE)
+
 # service wiring (documented in the RESILIENCE.md "Service wiring" table)
 declare("VOICE_PORT", "7072", "voice service listen port", table=RESILIENCE)
 declare("BRAIN_PORT", "8090", "brain service listen port", table=RESILIENCE)

@@ -33,7 +33,8 @@ const hudEl = $("hud"), hudTotal = $("hud-total"), hudBar = $("hud-bar"),
   hudSplit = $("hud-split");
 const capacityEl = $("capacity"), capacityText = $("capacity-text");
 const engineEl = $("engine"), engineStep = $("engine-step"),
-  recompileBadge = $("recompile-badge"), replicaBadge = $("replica-badge");
+  recompileBadge = $("recompile-badge"), replicaBadge = $("replica-badge"),
+  sttReplicaBadge = $("stt-replica-badge");
 const SLO_BUDGET_MS = 800;  // BASELINE voice->intent p50 target
 const HEALTH_POLL_MS = 5000;
 
@@ -126,6 +127,18 @@ async function pollHealth() {
     capacityText.className = `hud-split${over ? " over" : ""}`;
     capacityEl.hidden = false;
     showEngine(h.brain);
+    /* STT replica badge (ISSUE 13): the voice process's own Whisper
+     * batcher ring, mirroring the brain replica badge — red when a
+     * replica is out (dead, wedged, mid-warm-restart) or draining. */
+    const srep = h.stt_replicas;
+    if (srep && srep.total > 0
+        && (srep.healthy < srep.total || srep.draining > 0)) {
+      sttReplicaBadge.textContent = `stt ${srep.healthy}/${srep.total}`
+        + (srep.draining ? ` (${srep.draining} draining)` : "");
+      sttReplicaBadge.hidden = false;
+    } else {
+      sttReplicaBadge.hidden = true;
+    }
   } catch { /* a dead poll must not spam the console */ }
 }
 
