@@ -1,0 +1,327 @@
+"""Fleet telemetry drill: gray-failure detection + capacity under demotion.
+
+ISSUE 14's acceptance gates, measured against the real replicated stack
+(N rule-brain replicas behind tpu_voice_agent/services/router.py with the
+fleet detector armed, voice pointed at the router, fake-page executor,
+ScriptedSTT audio path — the same CPU harness every service-level bench
+uses). The injected fault is ``replica_degrade``: one replica latches
+persistently slow (every /parse pays ``CHAOS_SLOW_S``) while its /health
+keeps answering ok — the canonical gray failure the probe/eject machinery
+cannot see.
+
+1. **Clean capacity** — tools/swarm.py binary search for max concurrent
+   sessions at client-side SLO ok, all replicas healthy.
+2. **Detection** — the degrade latched on one replica, warmup traffic
+   spread across the ring: GATE the victim is marked gray (router
+   /health ``replicas.gray``) and the frozen flight dump carries the
+   peer-comparison evidence (rendered by ``fleetview --file``). Detection
+   latency (seconds and fleet scrape windows) is emitted.
+3. **Demoted capacity** — binary search WITH the victim gray: new
+   sessions avoid it, so capacity must hold ≥ 0.9x clean. GATE also zero
+   sticky-session re-homes (gray demotes placement, never moves anyone).
+4. **Undetected comparison** — the same degrade with ``FLEET_DETECT=0``:
+   fixed-N runs at clean capacity must FAIL the same SLO (three
+   independent runs, so a lucky rendezvous placement cannot fake a pass)
+   — the capacity the detector preserved is capacity the undetected
+   fleet does not have.
+
+Server-side SLO targets stay LOOSE while the stacks run (the services'
+own trackers must not freeze the shared flight recorder before the gray
+detector does — the dump under test is the detector's); the CLIENT
+verdict tracker reads the tight targets set just before each swarm run.
+
+Knobs: BENCH_FLEET_REPLICAS (3), BENCH_FLEET_MAX_N (12),
+BENCH_FLEET_UTTERANCES (3), BENCH_FLEET_SLOW_S (3.0),
+BENCH_FLEET_SLO_P50_MS (4000), BENCH_FLEET_SLO_P99_MS (2500 — one slow
+utterance must breach it), BENCH_FLEET_WINDOWS (3),
+BENCH_FLEET_DETECT_TIMEOUT_S (45).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log  # noqa: E402
+
+sys.path.insert(0, str(Path(_ROOT) / "tools"))
+import swarm  # noqa: E402
+
+
+def _post(url: str, body: dict, timeout_s: float = 30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _get(url: str, timeout_s: float = 5.0) -> dict:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return {}
+
+
+def _counters(url: str) -> dict:
+    return _get(url.rstrip("/") + "/metrics").get("runtime", {}) \
+        .get("counters", {})
+
+
+def _stack(prefix: str, replicas: int, *, chaos_spec: str = "",
+           fleet_detect: bool, windows: int):
+    tmp = tempfile.mkdtemp(prefix=prefix)
+    return swarm.build_local_stack(
+        tmp, brain_inflight=8, exec_inflight=8, brain_replicas=replicas,
+        chaos_spec=chaos_spec, chaos_seed=7,
+        router_kw={"probe_s": 0.2, "probe_fails": 2,
+                   "fleet_detect": fleet_detect, "fleet_windows": windows,
+                   "fleet_min_peers": 3})
+
+
+def _teardown(servers) -> None:
+    for srv in servers:
+        try:
+            srv.__exit__(None, None, None)
+        except Exception:
+            pass
+
+
+def _loose_slo() -> None:
+    # the services under test read these at build time: loose, so the
+    # ONLY flight freeze in the detected stack is fleet.gray itself
+    os.environ["SLO_TARGET_P50_MS"] = "60000"
+    os.environ["SLO_TARGET_P99_MS"] = "120000"
+
+
+def _tight_slo(p50: str, p99: str) -> None:
+    # the swarm's client verdict tracker reads these per run
+    os.environ["SLO_TARGET_P50_MS"] = p50
+    os.environ["SLO_TARGET_P99_MS"] = p99
+
+
+def _drive_until_gray(router_url: str, n_sids: int, timeout_s: float,
+                      pool: ThreadPoolExecutor) -> tuple[float, bool]:
+    """Spread parses across the ring (distinct rendezvous-keyed sessions)
+    until /health reports a gray replica; returns (seconds, detected)."""
+    t0 = time.monotonic()
+    sids = [f"fleetwarm{i}" for i in range(n_sids)]
+
+    def one(sid: str) -> None:
+        try:
+            _post(router_url + "/parse",
+                  {"text": "scroll down", "session_id": sid, "context": {}})
+        except Exception:
+            pass
+
+    while time.monotonic() - t0 < timeout_s:
+        list(pool.map(one, sids))
+        h = _get(router_url + "/health")
+        if (h.get("replicas") or {}).get("gray", 0) > 0:
+            return time.monotonic() - t0, True
+    return time.monotonic() - t0, False
+
+
+def main() -> None:
+    replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+    max_n = int(os.environ.get("BENCH_FLEET_MAX_N", "12"))
+    utterances = int(os.environ.get("BENCH_FLEET_UTTERANCES", "3"))
+    slow_s = os.environ.get("BENCH_FLEET_SLOW_S", "3.0")
+    p50 = os.environ.get("BENCH_FLEET_SLO_P50_MS", "4000")
+    p99 = os.environ.get("BENCH_FLEET_SLO_P99_MS", "2500")
+    windows = int(os.environ.get("BENCH_FLEET_WINDOWS", "3"))
+    detect_timeout = float(os.environ.get("BENCH_FLEET_DETECT_TIMEOUT_S", "45"))
+    os.environ["CHAOS_SLOW_S"] = slow_s
+    os.environ.setdefault("TS_INTERVAL_S", "0.2")
+    failures: list[str] = []
+
+    # ---------------------------------------------------- 1. clean capacity
+    _loose_slo()
+    urls, servers = _stack("bench_fleet_clean_", replicas,
+                           fleet_detect=True, windows=windows)
+    try:
+        _tight_slo(p50, p99)
+        log(f"[clean] binary-searching capacity up to {max_n} sessions "
+            f"({replicas} replicas, fleet detector armed, no fault)")
+        clean = swarm.binary_search_capacity(
+            urls["voice"], max_n=max_n, sample_urls=[urls["voice"]],
+            utterances=utterances, think_s=0.05)
+        clean_counters = _counters(urls["router"])
+    finally:
+        _teardown(servers)
+    c_clean = clean["capacity_sessions"]
+    log(f"[clean] capacity {c_clean} sessions at SLO "
+        f"(scrapes={clean_counters.get('fleet.scrapes', 0):.0f})")
+    if clean_counters.get("fleet.gray_entered", 0) > 0:
+        failures.append("a replica went gray in the CLEAN run — the "
+                        "detector false-positives under healthy load")
+
+    # ----------------------------------- 2. detection + 3. demoted capacity
+    _loose_slo()
+    urls, servers = _stack("bench_fleet_gray_", replicas,
+                           chaos_spec="replica_degrade@1",
+                           fleet_detect=True, windows=windows)
+    dump = {}
+    fleetview_ok = False
+    try:
+        c0 = _counters(urls["router"])
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            # the first parse latches its replica persistently slow; keep
+            # traffic on the whole ring so every member reports signals
+            detection_s, detected = _drive_until_gray(
+                urls["router"], n_sids=4 * replicas,
+                timeout_s=detect_timeout, pool=pool)
+        c1 = _counters(urls["router"])
+        detect_windows = c1.get("fleet.scrapes", 0) - c0.get("fleet.scrapes", 0)
+        health = _get(urls["router"] + "/health")
+        log(f"[gray] detected={detected} in {detection_s:.1f}s "
+            f"({detect_windows:.0f} scrape windows); replicas "
+            f"{health.get('replicas')}")
+        if not detected:
+            failures.append(
+                f"slow replica NOT marked gray within {detect_timeout}s")
+        # the frozen dump must carry the peer-comparison evidence
+        dump = _get(urls["router"] + "/debug/flightrecorder")
+        evidence = (dump.get("extra") or {}).get("fleet") or {}
+        if not (dump.get("frozen") and dump.get("reason") == "fleet.gray"
+                and evidence.get("replica") in urls["replicas"]
+                and len(evidence.get("peers") or {}) >= 3):
+            failures.append("flight dump missing the fleet.gray freeze or "
+                            "its peer-comparison evidence")
+        else:
+            dump_path = Path(tempfile.mkdtemp(prefix="bench_fleet_dump_")) \
+                / "fleet_gray_dump.json"
+            dump_path.write_text(json.dumps(dump))
+            view = subprocess.run(
+                [sys.executable, str(Path(_ROOT) / "tools" / "fleetview.py"),
+                 "--file", str(dump_path)], capture_output=True, text=True)
+            fleetview_ok = (view.returncode == 0
+                            and "demoted on" in view.stdout)
+            if not fleetview_ok:
+                failures.append("fleetview --file could not render the "
+                                "frozen gray dump")
+        # demoted capacity: new sessions avoid the gray replica
+        _tight_slo(p50, p99)
+        rehomed0 = _counters(urls["router"]).get("router.sessions_rehomed", 0)
+        log(f"[demoted] binary-searching capacity with the victim gray")
+        demoted = swarm.binary_search_capacity(
+            urls["voice"], max_n=max_n, sample_urls=[urls["voice"]],
+            utterances=utterances, think_s=0.05)
+        rehomed = _counters(urls["router"]).get("router.sessions_rehomed", 0) \
+            - rehomed0
+    finally:
+        _teardown(servers)
+    c_demoted = demoted["capacity_sessions"]
+    ratio = c_demoted / max(1, c_clean)
+    log(f"[demoted] capacity {c_demoted} sessions "
+        f"({ratio:.2f}x clean, bar >= 0.9) rehomed={rehomed:.0f} (bar: 0)")
+    if ratio < 0.9:
+        failures.append(f"capacity with the gray replica demoted fell to "
+                        f"{ratio:.2f}x clean (bar >= 0.9)")
+    if rehomed > 0:
+        failures.append(f"{rehomed:.0f} sticky sessions re-homed during the "
+                        "demoted run — graying must never move a session")
+
+    # ------------------------------------------- 4. undetected comparison
+    n_fix = max(2, c_clean)
+    _loose_slo()
+    urls, servers = _stack("bench_fleet_blind_", replicas,
+                           chaos_spec="replica_degrade@1",
+                           fleet_detect=False, windows=windows)
+    undet_states: list[str] = []
+    undet_p99: list[float] = []
+    try:
+        # latch the victim exactly like the detected section
+        try:
+            _post(urls["router"] + "/parse",
+                  {"text": "scroll down", "context": {}})
+        except Exception:
+            pass
+        _tight_slo(p50, p99)
+        for i in range(3):
+            run = swarm.run_swarm(urls["voice"], n_fix,
+                                  utterances=utterances, think_s=0.05,
+                                  sample_urls=[urls["voice"]])
+            undet_states.append(run["slo"]["state"])
+            if run["slo"].get("p99_ms") is not None:
+                undet_p99.append(run["slo"]["p99_ms"])
+            log(f"[undetected] run {i}: slo={run['slo']['state']} "
+                f"p99={run['slo']['p99_ms']}")
+        health_blind = _get(urls["router"] + "/health")
+    finally:
+        _teardown(servers)
+    undetected_ok_at_clean_n = all(s == "ok" for s in undet_states)
+    if (health_blind.get("replicas") or {}).get("gray", 0) > 0:
+        failures.append("FLEET_DETECT=0 stack still marked a replica gray")
+    if undetected_ok_at_clean_n:
+        failures.append(
+            f"the UNDETECTED slow replica held SLO at clean capacity "
+            f"({n_fix} sessions x3 runs) — the drill proved nothing "
+            "(raise BENCH_FLEET_SLOW_S or tighten BENCH_FLEET_SLO_P99_MS)")
+    # capacity-at-SLO the undetected fleet actually has: the demoted run
+    # held n_fix, the undetected one failed it — strictly below
+    c_undetected = n_fix if undetected_ok_at_clean_n else \
+        max(0, min(n_fix - 1, c_demoted - 1))
+
+    # ------------------------------------------------------------- verdict
+    # capacity rows ("sessions"/"ratio") and the detection rows
+    # ("fraction") are benchdiff-gated in the regressing-down direction;
+    # wall-clock detection latency is informational (quantized by the
+    # victim's own parse period, so a relative gate would flake)
+    emit("fleet_clean_capacity_sessions", float(c_clean), "sessions")
+    emit("fleet_demoted_capacity_sessions", float(c_demoted), "sessions")
+    emit("fleet_demoted_capacity_ratio", ratio, "ratio")
+    emit("fleet_undetected_capacity_sessions", float(c_undetected),
+         "sessions_undetected")  # informational: never a gated direction
+    emit("fleet_detected", 1.0 if detected else 0.0, "fraction")
+    emit("fleet_dump_evidence", 1.0 if fleetview_ok else 0.0, "fraction")
+    emit("fleet_detection_seconds", detection_s, "seconds")
+    emit("fleet_detection_windows", float(detect_windows), "windows")
+    emit("fleet_sticky_rehomes", float(rehomed), "sessions_rehomed")
+
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art = art_dir / f"BENCH_fleet_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_fleet",
+        "ts": stamp,
+        "config": {"replicas": replicas, "max_n": max_n,
+                   "utterances": utterances, "slow_s": slow_s,
+                   "windows": windows, "slo_p50_ms": p50, "slo_p99_ms": p99},
+        "fleet": {
+            "clean_capacity_sessions": c_clean,
+            "clean_probes": clean["probes"],
+            "demoted_capacity_sessions": c_demoted,
+            "demoted_probes": demoted["probes"],
+            "demoted_capacity_ratio": round(ratio, 3),
+            "detection_s": round(detection_s, 2),
+            "detection_windows": detect_windows,
+            "sticky_rehomes": rehomed,
+            "undetected_states_at_clean_n": undet_states,
+            "undetected_p99_ms": undet_p99,
+            "undetected_capacity_sessions": c_undetected,
+            "gray_evidence": (dump.get("extra") or {}).get("fleet"),
+            "fleetview_rendered": fleetview_ok,
+            "failures": failures,
+        },
+    }, indent=1))
+    log(f"artifact: {art}")
+    if failures:
+        for f in failures:
+            log(f"FAIL: {f}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

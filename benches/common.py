@@ -111,9 +111,13 @@ def snapshot_observability(service_url: str, timeout_s: float = 5.0) -> dict:
     # histograms, the compile-sentinel counters, and the live HBM ledger
     # as their own sections — empty dicts when the scraped service runs no
     # engine (rule-based brain, executor)
+    # the fleet telemetry plane (ISSUE 14) rides the same lift: gray
+    # demotion counts, scrape cadence, and outlier scores land in every
+    # artifact scraped off a router-fronted stack
     hists = m.get("runtime", {}).get("latency_ms", {})
     for section, prefix in (("engine_step", "engine.step."),
-                            ("xla", "xla."), ("hbm", "hbm.")):
+                            ("xla", "xla."), ("hbm", "hbm."),
+                            ("fleet", "fleet.")):
         sec: dict = {}
         for src in (out["runtime_gauges"], out["runtime_counters"], hists):
             sec.update({k: v for k, v in src.items() if k.startswith(prefix)})

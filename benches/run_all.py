@@ -23,7 +23,8 @@ from pathlib import Path
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_quality.py", "bench_faults.py", "bench_spec.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
-           "bench_steplog.py", "bench_router.py", "bench_handoff.py"]
+           "bench_steplog.py", "bench_router.py", "bench_handoff.py",
+           "bench_fleet.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -49,10 +50,14 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # warm-re-home regression gate (tiny engines, fixed-N drill, seconds on
 # CPU), and a PR that breaks zero-lost failover or the warm re-home's
 # prefill collapse must fail the quick table as well
+# the fleet bench stays on --quick too — it is the gray-failure-detection
+# regression gate (rule replicas, no model, trimmed search), and a PR
+# that blinds the detector or breaks gray placement demotion must fail
+# the quick table as well
 QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
                  "bench_chaos.py", "bench_steplog.py", "bench_router.py",
-                 "bench_handoff.py"]
+                 "bench_handoff.py", "bench_fleet.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_SPEC_PAGED_SESSIONS": "2", "BENCH_SPEC_PAGED_TURNS": "2",
@@ -65,7 +70,8 @@ QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_ROUTER_REPLICAS": "2",
              "BENCH_HANDOFF_STT_STREAMS": "2",
              "BENCH_HANDOFF_STT_UTTERANCES": "2",
-             "BENCH_HANDOFF_TURNS": "5"}
+             "BENCH_HANDOFF_TURNS": "5",
+             "BENCH_FLEET_MAX_N": "6", "BENCH_FLEET_UTTERANCES": "2"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -156,7 +162,7 @@ def main() -> None:
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
                             "spec", "stt", "radix", "swarm", "chaos",
                             "steplog", "engine_step", "xla", "hbm",
-                            "router", "kv_quant", "handoff"):
+                            "router", "kv_quant", "handoff", "fleet"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

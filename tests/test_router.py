@@ -241,7 +241,8 @@ def test_probe_ejects_dead_replica_and_recovery_rejoins():
             assert time.monotonic() < deadline, "prober never ejected"
             time.sleep(0.05)
         h = _get(router.url + "/health")
-        assert h["replicas"] == {"total": 2, "healthy": 1, "draining": 0}
+        assert h["replicas"] == {"total": 2, "healthy": 1, "draining": 0,
+                                 "gray": 0}
         assert h["status"] == "degraded"
         # recovery: probes succeed again -> the replica rejoins the ring
         controls[0]["dead"] = False
@@ -641,7 +642,7 @@ def test_voice_health_forwards_router_replicas(tmp_path):
     try:
         h = _get(voice.url + "/health")
         assert h["brain"]["replicas"] == {"total": 2, "healthy": 2,
-                                          "draining": 0}
+                                          "draining": 0, "gray": 0}
     finally:
         voice.__exit__(None, None, None)
         _teardown(router, servers)

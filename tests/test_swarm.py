@@ -166,8 +166,8 @@ def test_induced_overload_freezes_flight_recorder(tmp_path, monkeypatch):
         # the service detects the transition itself, on either of its two
         # surfaces: record()'s once-a-second auto-eval (a sustained
         # overload) or any /health evaluation. This burst is sub-second,
-        # so poll /health — the swarm's sampler deliberately uses the
-        # side-effect-free ?gauges=1 mode and cannot do it for us.
+        # so poll /health — the swarm's sampler deliberately reads the
+        # side-effect-free /debug/timeseries ring and cannot do it for us.
         _get_json(urls["voice"] + "/health")
         dump = _get_json(urls["voice"] + "/debug/flightrecorder")
         assert dump["frozen"] is True

@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Live fleet dashboard: one sparkline row per replica per signal.
+
+The fleet telemetry plane (ISSUE 14) gives every service a time-series
+ring (``/debug/timeseries``) and the router a peer-relative gray-failure
+detector; this tool is the operator's eyes on both — the time-resolved
+"which replica is drifting away from its peers" view a point-in-time
+``/health`` poll cannot give:
+
+    python tools/fleetview.py [--router http://127.0.0.1:8095]
+        [--watch SECS] [--width N] [--json]
+    python tools/fleetview.py --file SAVED.json
+    python tools/fleetview.py --self-test
+
+Live mode reads the router's aggregated ``/health`` (replica states:
+up / draining / drained / down, GRAY verdicts with outlier scores,
+pressure, clock skew) plus the ``/debug/replicas/timeseries`` fan-out,
+and renders per replica one sparkline per fleet signal (the same
+``FLEET_SIGNALS`` the detector scores — parse wall, SLO p99, decode
+wall, tokens/forward, KV utilization, quarantine/poison rates). Gray,
+draining, and ejected replicas are highlighted in the roster.
+
+``--file`` renders a saved body instead of polling: a frozen flight dump
+(renders the ``fleet`` peer-comparison evidence a gray freeze carries),
+a saved ``/debug/replicas/timeseries`` fan-out, or one service's
+``/debug/timeseries`` body. ``--self-test`` runs the extraction/render
+pipeline on synthetic data (wired into tier-1 via tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+_ROOT = str(Path(__file__).resolve().parents[1])
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tpu_voice_agent.services.replicaset import (  # noqa: E402
+    FLEET_SIGNALS,
+    signal_values,
+)
+
+DEFAULT_ROUTER = "http://127.0.0.1:8095"
+SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def fetch_json(url: str, timeout_s: float = 5.0) -> dict:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            body = json.loads(r.read().decode())
+        return body if isinstance(body, dict) else {}
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"[fleetview] {url}: {e}", file=sys.stderr)
+        return {}
+
+
+def sparkline(xs: list[float | None], width: int) -> str:
+    """Right-aligned sparkline over the last ``width`` values; gaps (None)
+    render as '·'. Scaled per row min..max so shape survives any unit."""
+    xs = xs[-width:]
+    vals = [x for x in xs if x is not None]
+    if not vals:
+        return "·" * min(width, max(1, len(xs)))
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    out = []
+    for x in xs:
+        if x is None:
+            out.append("·")
+        else:
+            out.append(SPARK[1 + int((x - lo) / span * (len(SPARK) - 2))])
+    return "".join(out)
+
+
+def signal_rows(samples: list[dict]) -> dict[str, list[float | None]]:
+    """Per-signal value series over a replica's samples (None where the
+    sample lacks the signal — a slow replica's sparse windows render as
+    gaps, which is itself a signal)."""
+    rows: dict[str, list[float | None]] = {name: [] for name, *_ in FLEET_SIGNALS}
+    for s in samples:
+        vals = signal_values(s)
+        for name in rows:
+            rows[name].append(vals.get(name))
+    # drop signals this replica never reported (an all-gap row is noise)
+    return {k: v for k, v in rows.items() if any(x is not None for x in v)}
+
+
+def _fmt(v: float | None) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.3g}"
+
+
+def _status_tag(detail: dict) -> str:
+    state = detail.get("state", "?")
+    if detail.get("gray"):
+        sig = detail.get("outlier_signal") or "?"
+        return (f"** GRAY ** score {detail.get('outlier_score', 0):.1f} "
+                f"on {sig}")
+    if state == "down":
+        return "** DOWN/EJECTED **"
+    if state in ("draining", "drained"):
+        return f"** {state.upper()} **"
+    return "up"
+
+
+def render_fleet(health: dict, series: dict[str, list[dict]],
+                 width: int = 48) -> str:
+    """One dashboard frame: roster header, then per replica a status line
+    plus one sparkline row per fleet signal (latest value in the margin)."""
+    lines: list[str] = []
+    reps = health.get("replicas") or {}
+    lines.append(
+        f"fleet: {reps.get('total', len(series))} replicas — "
+        f"{reps.get('healthy', '?')} healthy, {reps.get('gray', 0)} gray, "
+        f"{reps.get('draining', 0)} draining")
+    details = {d.get("url"): d for d in health.get("replica_detail") or []}
+    urls = list(details) or sorted(series)
+    for url in urls:
+        d = details.get(url, {})
+        samples = series.get(url) or []
+        lines.append("")
+        lines.append(
+            f"{url}  [{_status_tag(d)}]  pressure {_fmt(d.get('pressure'))}"
+            f"  skew {1e3 * (d.get('clock_skew_s') or 0.0):+.1f}ms")
+        rows = signal_rows(samples)
+        if not rows:
+            lines.append("  (no timeseries samples)")
+            continue
+        label_w = max(len(k) for k in rows) + 2
+        for name, xs in rows.items():
+            latest = next((x for x in reversed(xs) if x is not None), None)
+            lines.append(f"  {name.ljust(label_w)}"
+                         f"|{sparkline(xs, width)}| {_fmt(latest)}")
+    fleet = health.get("fleet") or {}
+    if fleet.get("aggregates"):
+        lines.append("")
+        lines.append("fleet aggregates (median / MAD / max):")
+        for name, agg in sorted(fleet["aggregates"].items()):
+            lines.append(f"  {name}: {_fmt(agg.get('median'))} / "
+                         f"{_fmt(agg.get('mad'))} / {_fmt(agg.get('max'))} "
+                         f"(n={agg.get('n')})")
+    return "\n".join(lines)
+
+
+def render_evidence(evidence: dict) -> str:
+    """The peer-comparison evidence a gray freeze carries: who was
+    demoted, on which signal, how far from the fleet — the dump answers
+    the "was the demotion right?" question without a re-run."""
+    lines = [
+        f"gray evidence: {evidence.get('replica')} demoted on "
+        f"{evidence.get('signal')} = {_fmt(evidence.get('value'))} "
+        f"(fleet median {_fmt(evidence.get('fleet_median'))}, "
+        f"MAD {_fmt(evidence.get('mad'))}, score "
+        f"{_fmt(evidence.get('score'))} >= {_fmt(evidence.get('threshold'))} "
+        f"for {evidence.get('windows')} windows)",
+        "peer signals at detection:",
+    ]
+    victim = evidence.get("replica")
+    for url, sig in sorted((evidence.get("peers") or {}).items()):
+        mark = " <-- GRAY" if url == victim else ""
+        pretty = " ".join(f"{k}={_fmt(v)}" for k, v in sorted(sig.items()))
+        lines.append(f"  {url}: {pretty}{mark}")
+    return "\n".join(lines)
+
+
+def render_file(body: dict, width: int = 48) -> str:
+    """Render a saved body by shape: flight dump (fleet evidence +
+    snapshot timeline), ``/debug/replicas/timeseries`` fan-out, or a
+    single service's ``/debug/timeseries``."""
+    # frozen flight dump (possibly with the fleet gray evidence)
+    if "frozen" in body:
+        lines = []
+        if body.get("frozen"):
+            lines.append(f"flight dump: frozen by {body.get('reason')}"
+                         + (f" ({body['detail']})" if body.get("detail")
+                            else ""))
+        else:
+            lines.append("flight dump: not frozen")
+        evidence = (body.get("extra") or {}).get("fleet")
+        if evidence:
+            lines.append(render_evidence(evidence))
+        snaps = body.get("metric_snapshots") or []
+        if snaps:
+            keys = sorted({k for s in snaps for k in (s.get("gauges") or {})
+                           if k.startswith(("fleet.", "router.", "ts."))})
+            lines.append(f"{len(snaps)} metric snapshots; fleet gauges:")
+            for k in keys:
+                xs = [s.get("gauges", {}).get(k) for s in snaps]
+                latest = next((x for x in reversed(xs) if x is not None), None)
+                lines.append(f"  {k.ljust(26)}|{sparkline(xs, width)}| "
+                             f"{_fmt(latest)}")
+        return "\n".join(lines)
+    # router fan-out: {"replicas": {url: timeseries body}}
+    if isinstance(body.get("replicas"), dict):
+        series = {url: (b.get("samples") or [])
+                  for url, b in body["replicas"].items()
+                  if isinstance(b, dict)}
+        return render_fleet({"replicas": {"total": len(series)}}, series,
+                            width=width)
+    # one service's own ring
+    if "samples" in body:
+        url = body.get("service", "service")
+        return render_fleet({"replicas": {"total": 1}},
+                            {url: body.get("samples") or []}, width=width)
+    return "(unrecognized file shape — expected a flight dump or a "\
+        "/debug/timeseries body)"
+
+
+def one_frame(router_url: str, width: int) -> tuple[dict, dict]:
+    health = fetch_json(router_url.rstrip("/") + "/health")
+    fan = fetch_json(router_url.rstrip("/") + "/debug/replicas/timeseries")
+    series = {url: (b.get("samples") or [])
+              for url, b in (fan.get("replicas") or {}).items()
+              if isinstance(b, dict)}
+    return health, series
+
+
+# -------------------------------------------------------------- self-test
+
+
+def _synthetic_samples(n: int, parse_ms: float, jitter: float = 0.0) -> list[dict]:
+    return [{"seq": i, "t_s": 1000.0 + i, "dt_s": 1.0,
+             "gauges": {"slo.brain.p99_ms": parse_ms * 2,
+                        "paged.kv_utilization": 0.4},
+             "rates": {"scheduler.slots_quarantined": 0.0},
+             "hist": {"brain.parse": {"ms_per": parse_ms + (i % 3) * jitter,
+                                      "per_s": 2.0}}}
+            for i in range(n)]
+
+
+def self_test() -> int:
+    # sparkline scaling + gap rendering
+    assert sparkline([1.0, 2.0, 3.0], 8) == "▁▄█"
+    assert "·" in sparkline([1.0, None, 3.0], 8)
+    assert sparkline([], 8) == "·"
+    # signal extraction from a synthetic ring sample
+    rows = signal_rows(_synthetic_samples(4, 10.0, jitter=1.0))
+    assert rows["parse_ms"][0] == 10.0 and rows["parse_p99_ms"][0] == 20.0
+    assert "kv_utilization" in rows
+    # a fleet frame: healthy + gray + down replicas, sparklines per signal
+    health = {
+        "replicas": {"total": 3, "healthy": 3, "gray": 1, "draining": 0},
+        "replica_detail": [
+            {"url": "http://r0", "state": "up", "gray": False,
+             "pressure": 0.2, "clock_skew_s": 0.001},
+            {"url": "http://r1", "state": "up", "gray": True,
+             "outlier_score": 9.3, "outlier_signal": "parse_ms",
+             "pressure": 0.3, "clock_skew_s": -0.002},
+            {"url": "http://r2", "state": "down", "gray": False,
+             "pressure": 0.0, "clock_skew_s": 0.0},
+        ],
+        "fleet": {"aggregates": {"parse_ms": {
+            "median": 10.0, "mad": 0.5, "min": 9.5, "max": 250.0, "n": 3}}},
+    }
+    series = {"http://r0": _synthetic_samples(12, 10.0, 1.0),
+              "http://r1": _synthetic_samples(12, 250.0, 5.0),
+              "http://r2": []}
+    txt = render_fleet(health, series)
+    assert "GRAY" in txt and "score 9.3" in txt and "parse_ms" in txt
+    assert "DOWN/EJECTED" in txt and "no timeseries samples" in txt
+    assert "fleet aggregates" in txt and "█" in txt
+    # file mode: a frozen gray flight dump with evidence
+    dump = {"frozen": True, "reason": "fleet.gray", "detail": "http://r1",
+            "extra": {"fleet": {
+                "replica": "http://r1", "signal": "parse_ms", "value": 250.0,
+                "fleet_median": 10.0, "mad": 0.5, "score": 48.0,
+                "threshold": 4.0, "windows": 3,
+                "peers": {"http://r0": {"parse_ms": 10.0},
+                          "http://r1": {"parse_ms": 250.0}}}},
+            "metric_snapshots": [
+                {"t_s": 1.0, "gauges": {"fleet.gray_replicas": 0.0}},
+                {"t_s": 2.0, "gauges": {"fleet.gray_replicas": 1.0}}]}
+    ftxt = render_file(dump)
+    assert "fleet.gray" in ftxt and "demoted on parse_ms" in ftxt
+    assert "<-- GRAY" in ftxt and "fleet.gray_replicas" in ftxt
+    # file mode: a saved fan-out body
+    fan = {"service": "router",
+           "replicas": {"http://r0": {"samples": series["http://r0"]}}}
+    assert "http://r0" in render_file(fan)
+    assert "unrecognized" in render_file({"bogus": 1})
+    print(txt)
+    print("fleetview self-test ok")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--router", default=DEFAULT_ROUTER)
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="refresh every SECS (0 = one frame)")
+    ap.add_argument("--width", type=int, default=48)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--file", metavar="SAVED",
+                    help="render a saved dump/timeseries body instead of polling")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        return self_test()
+    if args.file:
+        try:
+            with open(args.file) as f:
+                body = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"[fleetview] cannot read {args.file}: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(body, indent=1))
+        else:
+            print(render_file(body, width=args.width))
+        return 0
+    while True:
+        health, series = one_frame(args.router, args.width)
+        if not health and not series:
+            return 2
+        if args.json:
+            print(json.dumps({"health": health, "series": series}, indent=1))
+        else:
+            if args.watch:
+                print("\x1b[2J\x1b[H", end="")  # clear between frames
+            print(render_fleet(health, series, width=args.width))
+        if not args.watch:
+            return 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,6 +166,19 @@ PINNED: dict[str, str] = {
     "stt.replica_failovers": "counter",
     "handoff.sessions_adopted": "counter",
     "handoff.tokens_adopted": "counter",
+    # fleet telemetry plane (ISSUE 14, utils/timeseries.py + services/
+    # replicaset.py + services/router.py, docs/OBSERVABILITY.md "Fleet
+    # telemetry"): samples_buffered is the per-service ring occupancy,
+    # gray_replicas the live demotion count the HUD/bench gates read,
+    # scrapes the fleet-window cadence, outlier_score_max the worst
+    # peer-relative deviation this window, gray_entered the incident
+    # counter bench_fleet's detection gate keys on — renaming any of
+    # these blinds the gray-failure drill's verdicts
+    "ts.samples_buffered": "gauge",
+    "fleet.gray_replicas": "gauge",
+    "fleet.scrapes": "counter",
+    "fleet.outlier_score_max": "gauge",
+    "fleet.gray_entered": "counter",
 }
 
 

@@ -29,8 +29,10 @@ client-side SLOTracker** over those samples, reusing exactly the
 ``binary_search_capacity`` bisects N and reports
 **capacity = max concurrent sessions with SLO ok**.
 
-While a run is live, a sampler thread polls every service's JSON
-``/metrics`` and keeps a timeline of the saturation gauges
+While a run is live, a sampler thread drains every service's
+``/debug/timeseries?since=`` ring (the fleet telemetry plane, ISSUE 14 —
+falling back to the legacy JSON ``/metrics?gauges=1`` poll for services
+without it) and keeps a timeline of the saturation gauges
 (``scheduler.batch_occupancy``, ``paged.kv_utilization``,
 ``stt.batch_occupancy``, admission inflight fractions, breaker states).
 ``attribute_saturation`` reads that timeline back: *which resource
@@ -63,6 +65,7 @@ import json
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -165,7 +168,7 @@ def fetch_metrics_json(url: str, timeout_s: float = 5.0,
                        gauges_only: bool = False) -> dict:
     """One service's JSON /metrics. ``gauges_only`` uses the cheap
     ``?gauges=1`` mode (dict copies, no percentile sorting server-side) —
-    the sampler polls at ~3 Hz and must not load the system under test."""
+    the fallback path when a service predates /debug/timeseries."""
     q = "?gauges=1" if gauges_only else ""
     try:
         with urllib.request.urlopen(url.rstrip("/") + "/metrics" + q,
@@ -175,22 +178,83 @@ def fetch_metrics_json(url: str, timeout_s: float = 5.0,
         return {}
 
 
+def fetch_timeseries(url: str, since: int,
+                     timeout_s: float = 2.0) -> dict | str | None:
+    """One service's ``/debug/timeseries?since=`` delta body. Returns the
+    body dict, the string ``"missing"`` for a definitive 404 (the service
+    predates the endpoint — the caller may latch its legacy fallback), or
+    None for a transient failure (timeout, reset — retry next poll; a
+    loaded service mid-saturation-run must NOT get demoted to the
+    instantaneous-gauge path exactly when history matters most)."""
+    try:
+        with urllib.request.urlopen(
+                url.rstrip("/") + f"/debug/timeseries?since={since}",
+                timeout=timeout_s) as r:
+            body = json.loads(r.read().decode())
+        return body if isinstance(body, dict) and "samples" in body else None
+    except urllib.error.HTTPError as e:
+        return "missing" if e.code == 404 else None
+    except Exception:
+        return None
+
+
 class MetricsSampler:
-    """Background thread polling each service's JSON /metrics while a swarm
-    run is live; keeps a timeline of merged runtime gauges (max-merge across
-    services — in-process stacks share one registry anyway) so saturation
-    attribution can say which resource crossed the line FIRST."""
+    """Background thread keeping a gauge timeline while a swarm run is
+    live, so saturation attribution can say which resource crossed the
+    line FIRST.
+
+    Since ISSUE 14 the sampler reads each service's ``/debug/timeseries
+    ?since=`` delta (the services sample THEMSELVES on the `TS_INTERVAL_S`
+    cadence; this thread just drains the rings) — the same surface the
+    router's fleet gray-failure detector scrapes, so the bench-side
+    attribution and the production detector can never disagree about what
+    the data was. Services without the endpoint fall back to the legacy
+    ``/metrics?gauges=1`` dict-copy poll. The timeline schema is
+    unchanged: one ``{"t_s", "gauges"}`` entry per poll, gauges max-merged
+    across services."""
 
     def __init__(self, urls: list[str], interval_s: float = 0.3):
         self.urls = list(urls)
         self.interval_s = interval_s
         self.samples: list[dict] = []
+        self._since: dict[str, int] = {}
+        # only ring samples stamped at/after this moment count: the rings
+        # outlive runs, and a PRIOR probe's saturated gauges merged into
+        # this run's first timeline entry would corrupt the first-crossed
+        # attribution (refreshed in __enter__, when the run truly starts)
+        self._t0 = time.time()
+        self._legacy: set[str] = set()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def _poll_once(self) -> None:
         merged: dict = {}
         for u in self.urls:
+            if u not in self._legacy:
+                body = fetch_timeseries(u, self._since.get(u, 0))
+                if isinstance(body, dict):
+                    nxt = body.get("next_seq")
+                    if isinstance(nxt, int):
+                        self._since[u] = nxt
+                    else:
+                        self._since.setdefault(u, 0)
+                    for s in body.get("samples") or []:
+                        # the first fetch drains the ring's backlog, which
+                        # may hold a PRIOR run's saturated history — only
+                        # samples taken during THIS run belong on its
+                        # timeline (later fetches the cursor makes this a
+                        # no-op)
+                        if s.get("t_s", 0.0) < self._t0:
+                            continue
+                        for k, v in (s.get("gauges") or {}).items():
+                            if isinstance(v, (int, float)):
+                                merged[k] = max(merged.get(k, float("-inf")),
+                                                float(v))
+                    continue
+                if body == "missing":
+                    self._legacy.add(u)  # definitively absent: fall back
+                else:
+                    continue  # transient failure: retry next poll
             body = fetch_metrics_json(u, timeout_s=2.0, gauges_only=True)
             for k, v in (body.get("runtime", {}).get("gauges") or {}).items():
                 if isinstance(v, (int, float)):
@@ -203,8 +267,21 @@ class MetricsSampler:
             self._poll_once()
             self._stop.wait(self.interval_s)
         self._poll_once()  # one last sample after the load stops
+        if not self.samples:
+            # a sub-TS_INTERVAL_S run can start and finish entirely
+            # between two ring ticks; one live instantaneous snapshot
+            # keeps the attribution timeline non-empty for tiny probes
+            merged: dict = {}
+            for u in self.urls:
+                body = fetch_metrics_json(u, timeout_s=2.0, gauges_only=True)
+                for k, v in (body.get("runtime", {}).get("gauges") or {}).items():
+                    if isinstance(v, (int, float)):
+                        merged[k] = max(merged.get(k, float("-inf")), float(v))
+            if merged:
+                self.samples.append({"t_s": time.time(), "gauges": merged})
 
     def __enter__(self) -> "MetricsSampler":
+        self._t0 = time.time()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="swarm-sampler")
         self._thread.start()

@@ -178,6 +178,77 @@ def render_flight(dump: dict, width: int = 64, last: int = 0) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------- multi-service dump merging
+
+
+def apply_skew(dump: dict, skew_s: float) -> dict:
+    """Shift one member's dump onto the reference (router) wall clock.
+
+    Every service stamps spans and metric snapshots with ITS OWN
+    ``time.time()``; across hosts those clocks disagree by an unknown
+    offset, so a naive merge renders a parse that "started before" the
+    audio that caused it. The router's fleet scrape estimates each
+    member's skew NTP-style (member ``now_s`` minus the request's local
+    midpoint) and serves it beside the member's dump
+    (``/debug/replicas/flightrecorder``); subtracting it here puts all
+    members on the router's clock. Returns a shifted COPY."""
+    out = json.loads(json.dumps(dump))  # deep copy, JSON-shaped anyway
+    if not skew_s:
+        return out
+    for tr in out.get("traces") or []:
+        for sp in tr.get("spans") or []:
+            for k in ("wall_start_s", "wall_end_s"):
+                if isinstance(sp.get(k), (int, float)):
+                    sp[k] = round(sp[k] - skew_s, 6)
+    for snap in out.get("metric_snapshots") or []:
+        if isinstance(snap.get("t_s"), (int, float)):
+            snap["t_s"] = round(snap["t_s"] - skew_s, 3)
+    if isinstance(out.get("frozen_at_s"), (int, float)):
+        out["frozen_at_s"] = round(out["frozen_at_s"] - skew_s, 3)
+    return out
+
+
+def merge_flight_dumps(members: dict[str, dict]) -> dict:
+    """Merge per-member flight dumps (the router's
+    ``/debug/replicas/flightrecorder`` body shape: url -> dump, each dump
+    carrying the router-estimated ``clock_skew_s``) into ONE skew-
+    corrected dump: traces unioned by trace id (spans concatenated,
+    wall-ordered), snapshots concatenated time-ordered, the freeze header
+    from the first frozen member. Unfrozen/unreachable members contribute
+    nothing but are listed in the ``members`` roster."""
+    merged: dict = {"frozen": False, "members": {}}
+    traces: dict[str, list[dict]] = {}
+    snapshots: list[dict] = []
+    for url, dump in sorted(members.items()):
+        if not isinstance(dump, dict):
+            continue
+        skew = dump.get("clock_skew_s") or 0.0
+        merged["members"][url] = {
+            "frozen": bool(dump.get("frozen")),
+            "clock_skew_s": skew,
+            "reason": dump.get("reason"),
+        }
+        if not dump.get("frozen"):
+            continue
+        shifted = apply_skew(dump, skew)
+        if not merged["frozen"]:
+            merged.update({k: shifted.get(k) for k in
+                           ("frozen", "reason", "detail", "frozen_at_s",
+                            "extra") if shifted.get(k) is not None})
+        for tr in shifted.get("traces") or []:
+            tid = tr.get("trace_id")
+            if tid:
+                traces.setdefault(tid, []).extend(tr.get("spans") or [])
+        snapshots.extend(shifted.get("metric_snapshots") or [])
+    for spans in traces.values():
+        spans.sort(key=lambda s: s.get("wall_start_s", 0.0))
+    snapshots.sort(key=lambda s: s.get("t_s", 0.0))
+    merged["traces"] = [{"trace_id": tid, "spans": spans}
+                        for tid, spans in traces.items()]
+    merged["metric_snapshots"] = snapshots
+    return merged
+
+
 def flight_main(path: str, as_json: bool, width: int, last: int) -> int:
     try:
         with open(path) as f:
@@ -185,6 +256,10 @@ def flight_main(path: str, as_json: bool, width: int, last: int) -> int:
     except (OSError, ValueError) as e:
         print(f"[traceview] cannot read flight dump {path}: {e}", file=sys.stderr)
         return 2
+    # a saved router fan-out body ({"replicas": {url: dump, ...}}) merges
+    # onto one skew-corrected timeline; a plain dump renders as before
+    if isinstance(dump.get("replicas"), dict):
+        dump = merge_flight_dumps(dump["replicas"])
     if as_json:
         print(json.dumps(dump, indent=1))
     else:
@@ -245,6 +320,31 @@ def self_test() -> int:
     assert "scheduler.batch_occupancy=1" in ftxt
     assert render_flight({"frozen": False}).startswith(
         "(flight recorder not frozen")
+    # multi-service merge: two members with skewed clocks — the brain's
+    # dump stamped 5 s ahead must land back inside the voice window
+    voice_dump = {"frozen": True, "reason": "slo.voice.violated",
+                  "frozen_at_s": 1_700_000_001.5, "clock_skew_s": 0.0,
+                  "metric_snapshots": [{"t_s": 1_700_000_001.0, "gauges": {}}],
+                  "traces": [{"trace_id": "selftest01",
+                              "spans": _synthetic_spans()[0]}]}
+    brain_spans = apply_skew({"traces": [{"trace_id": "selftest01",
+                                          "spans": _synthetic_spans()[1]}]},
+                             -5.0)["traces"][0]["spans"]  # skewed +5 s
+    brain_dump = {"frozen": True, "reason": "breaker.exec.open",
+                  "frozen_at_s": 1_700_000_006.5, "clock_skew_s": 5.0,
+                  "metric_snapshots": [],
+                  "traces": [{"trace_id": "selftest01", "spans": brain_spans}]}
+    merged = merge_flight_dumps({"http://v": voice_dump,
+                                 "http://b": brain_dump})
+    assert merged["frozen"] and merged["reason"] == "breaker.exec.open"
+    spans_m = merged["traces"][0]["spans"]
+    # after skew correction the brain parse nests back inside the voice
+    # roundtrip instead of floating 5 s later
+    t0 = min(s["wall_start_s"] for s in spans_m)
+    t1 = max(s["wall_end_s"] for s in spans_m)
+    assert t1 - t0 < 2.0, f"skew correction failed: window {t1 - t0:.3f}s"
+    assert len(spans_m) == len(_synthetic_spans()[0]) + 1
+    assert merged["members"]["http://b"]["clock_skew_s"] == 5.0
     print(gantt)
     print("traceview self-test ok")
     return 0

@@ -892,13 +892,18 @@ def _chaos_replica_middleware():
     ``replica_kill`` latches this app dead — every later request on it
     (/parse AND the router's /health probes) gets an abrupt connection
     close, like a crashed process; ``replica_hang`` wedges one request for
-    ``CHAOS_HANG_S``; ``replica_slow`` adds ``CHAOS_SLOW_S`` of latency.
-    Points only DRAW on POST /parse so health probes never consume the
-    deterministic ``@kth`` event counting. Chaos off (the default) is one
-    dict-miss per request."""
+    ``CHAOS_HANG_S``; ``replica_slow`` adds ``CHAOS_SLOW_S`` of latency to
+    one request (the tail shape hedging cuts); ``replica_degrade`` (ISSUE
+    14, drilled by bench_fleet) LATCHES this app persistently slow — every
+    later /parse pays ``CHAOS_SLOW_S`` while /health keeps answering ok,
+    the canonical gray failure the fleet detector must catch. Points only
+    DRAW on POST /parse so health probes never consume the deterministic
+    ``@kth`` event counting. Chaos off (the default) is one dict-miss per
+    request."""
     from ..utils.chaos import chaos_fire
 
     dead = {"dead": False}
+    degraded = {"slow": False}
 
     def _drop(request: web.Request):
         # no HTTP response at all: close the TCP transport and unwind via
@@ -917,9 +922,11 @@ def _chaos_replica_middleware():
             if chaos_fire("replica_kill"):
                 dead["dead"] = True
                 _drop(request)
+            if chaos_fire("replica_degrade"):
+                degraded["slow"] = True
             if chaos_fire("replica_hang"):
                 await asyncio.sleep(float(os.environ.get("CHAOS_HANG_S", "60")))
-            elif chaos_fire("replica_slow"):
+            elif degraded["slow"] or chaos_fire("replica_slow"):
                 await asyncio.sleep(float(os.environ.get("CHAOS_SLOW_S", "0.25")))
         return await handler(request)
 
@@ -1276,6 +1283,9 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
     from ..utils.steplog import make_steplog_handler
 
     app.router.add_get("/debug/steplog", make_steplog_handler("brain"))
+    from ..utils.timeseries import attach_timeseries
+
+    attach_timeseries(app, "brain", tracer)
     app.router.add_post("/parse", parse)
     app.router.add_post("/admin/drain", admin_drain)
     return app

@@ -208,6 +208,9 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("executor", tracer))
     app.router.add_get("/debug/flightrecorder",
                        make_flightrecorder_handler("executor"))
+    from ...utils.timeseries import attach_timeseries
+
+    attach_timeseries(app, "executor", tracer)
     app.router.add_post("/execute", execute)
     app.router.add_post("/uploads", uploads)
     app.router.add_post("/close", close)

@@ -41,9 +41,137 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import statistics
+import time
 from collections import OrderedDict
 
 from ..utils.resilience import CircuitBreaker
+
+# --------------------------------------------------- fleet gray detection
+#
+# ISSUE 14: the probe/eject machinery above this line catches replicas
+# that are DEAD (failed probes, tripped breakers); nothing caught replicas
+# that are merely WRONG — slow, recompiling, KV-thrashing — while still
+# answering probes "ok". The fleet detector compares each member against
+# its PEERS on time-resolved signals read from the members' time-series
+# rings (utils.timeseries, scraped by the owning prober): a replica whose
+# signal sits a sustained median-absolute-deviation multiple away from the
+# fleet median is *gray* — demoted for NEW placements through the same
+# avoidance path pressure shedding uses, never ejected (its sticky
+# sessions keep their warm state; a wrong eject of a healthy replica
+# under fleet-wide load would be worse than the gray replica itself).
+#
+# Each signal names: how to read it out of one time-series sample, which
+# direction is "worse", and an absolute deviation floor — the MAD of a
+# tightly clustered fleet approaches 0, and without a floor a 2 ms
+# deviation on a 1 ms spread would read as a 2-sigma outlier.
+#
+#   (signal, kind, metric key, worse-direction, deviation floor)
+FLEET_SIGNALS: tuple[tuple[str, str, str, str, float], ...] = (
+    # ROUTER-observed per-replica forward wall (kind "observed": measured
+    # by the prober's own clock around each /parse forward, injected into
+    # the readings rather than read from the member's ring). This is the
+    # signal a gray replica cannot hide from: slowness in its network
+    # path, middleware, or GC never shows up in its self-reported spans,
+    # but the router's stopwatch sees all of it.
+    ("fwd_ms", "observed", "router.forward", "high", 25.0),
+    # per-replica parse wall this window (tracer-local histogram — stays
+    # per-replica even when an in-process harness shares one global
+    # registry across replicas); self-reported, so it catches compute-side
+    # degradation (recompiles, thrash) with finer attribution than fwd_ms
+    ("parse_ms", "hist", "brain.parse", "high", 5.0),
+    # the rolling SLO tail (gauge; per-process in real deployments)
+    ("parse_p99_ms", "gauge", "slo.brain.p99_ms", "high", 10.0),
+    # engine.step decode wall this window — the device-plane symptom of
+    # recompiles / jit-cache thrash (step ledger histogram)
+    ("decode_ms", "hist", "engine.step.decode", "high", 2.0),
+    # speculation health: a replica whose drafts stopped landing decodes
+    # token-by-token while its peers emit multiples per forward
+    ("tokens_per_forward", "gauge", "scheduler.tokens_per_forward", "low", 0.25),
+    # KV pool pressure: one replica evict-thrashing while peers are half
+    # empty is a placement pathology, not fleet load
+    ("kv_utilization", "gauge", "paged.kv_utilization", "high", 0.05),
+    # fault-containment churn: quarantines / prefill-fence trips per sec
+    ("quarantine_rate", "rate", "scheduler.slots_quarantined", "high", 0.2),
+    ("poison_rate", "rate", "scheduler.prefill_faults", "high", 0.2),
+)
+
+
+def signal_values(sample: dict) -> dict[str, float]:
+    """One time-series sample -> {signal: value} for every FLEET_SIGNAL
+    present in it (``tools/fleetview.py`` renders exactly these).
+    "observed" signals are the prober's own measurements and never come
+    from a member's sample."""
+    out: dict[str, float] = {}
+    for name, kind, key, _worse, _floor in FLEET_SIGNALS:
+        if kind == "gauge":
+            v = sample.get("gauges", {}).get(key)
+        elif kind == "rate":
+            v = sample.get("rates", {}).get(key)
+        elif kind == "hist":  # hist window mean
+            h = sample.get("hist", {}).get(key)
+            v = h.get("ms_per") if isinstance(h, dict) else None
+        else:  # "observed": injected by the prober, not sampled
+            continue
+        if isinstance(v, (int, float)):
+            out[name] = float(v)
+    return out
+
+
+def reduce_window(samples: list[dict]) -> dict[str, float]:
+    """A scrape window's new samples -> one signal vector (mean per
+    signal over the samples that carry it)."""
+    acc: dict[str, list[float]] = {}
+    for s in samples:
+        for name, v in signal_values(s).items():
+            acc.setdefault(name, []).append(v)
+    return {name: sum(xs) / len(xs) for name, xs in acc.items()}
+
+
+def fleet_outlier_scores(readings: dict[str, dict[str, float]],
+                         min_peers: int = 3) -> tuple[dict, dict]:
+    """Peer-relative outlier scores for one scrape window.
+
+    ``readings`` maps member key -> signal vector. Per signal, members
+    reporting it form the peer pool; with fewer than ``min_peers`` the
+    signal is skipped (a median of two cannot say WHICH one is wrong).
+    Score = worse-direction deviation from the fleet median, scaled by
+    max(MAD, floor). A member's score is its worst signal's.
+
+    Returns ``(scores, aggregates)``: scores maps member ->
+    {score, signal, value, median, mad}; aggregates maps signal ->
+    {median, mad, min, max, n} (the fleet roll-up /health and the bench
+    artifacts carry).
+    """
+    per_signal: dict[str, dict[str, float]] = {}
+    for member, sig in readings.items():
+        for name, v in sig.items():
+            per_signal.setdefault(name, {})[member] = v
+    aggregates: dict[str, dict] = {}
+    scores: dict[str, dict] = {m: {"score": 0.0, "signal": None,
+                                   "value": None, "median": None, "mad": None}
+                               for m in readings}
+    floors = {name: floor for name, _k, _key, _w, floor in FLEET_SIGNALS}
+    worse = {name: w for name, _k, _key, w, _f in FLEET_SIGNALS}
+    for name, by_member in per_signal.items():
+        xs = list(by_member.values())
+        if len(xs) < min_peers:
+            continue
+        med = statistics.median(xs)
+        mad = statistics.median(abs(x - med) for x in xs)
+        scale = max(mad, floors.get(name, 1e-9), 1e-9)
+        aggregates[name] = {"median": round(med, 4), "mad": round(mad, 4),
+                            "min": round(min(xs), 4), "max": round(max(xs), 4),
+                            "n": len(xs)}
+        for member, x in by_member.items():
+            dev = (x - med) if worse.get(name, "high") == "high" else (med - x)
+            score = max(0.0, dev) / scale
+            if score > scores[member]["score"]:
+                scores[member] = {"score": round(score, 3), "signal": name,
+                                  "value": round(x, 4),
+                                  "median": round(med, 4),
+                                  "mad": round(mad, 4)}
+    return scores, aggregates
 
 
 def rendezvous_weight(key: str, session_id: str) -> int:
@@ -63,7 +191,11 @@ class Replica:
     ones (the STT batcher ring)."""
 
     __slots__ = ("idx", "url", "state", "breaker", "probe_fails",
-                 "inflight", "last_health", "drain_latched", "pressure")
+                 "inflight", "last_health", "drain_latched", "pressure",
+                 "gray", "gray_streak", "ok_streak", "outlier_score",
+                 "outlier_signal", "gray_evidence", "gray_held_since",
+                 "signals", "signal_ages", "fwd_acc", "ts_seq",
+                 "clock_skew_s")
 
     def __init__(self, idx: int, url: str, breaker_threshold: int,
                  breaker_reset_s: float):
@@ -88,6 +220,34 @@ class Replica:
         # /health ``pressure.score``; STT queue depth / cap) — the shed
         # signal placement reads BEFORE admission controllers refuse
         self.pressure = 0.0
+        # fleet gray-failure state (ISSUE 14): gray = peer-relative
+        # outlier sustained FLEET_GRAY_WINDOWS scrape windows — demoted
+        # for new placements, never ejected; sticky sessions stay.
+        self.gray = False
+        self.gray_streak = 0
+        self.ok_streak = 0
+        self.outlier_score = 0.0
+        self.outlier_signal: str | None = None
+        self.gray_evidence: dict | None = None
+        # wall time when the gray verdict last went evidence-starved (no
+        # scoreable reading on the demoting signal); None while evidence
+        # flows — the gray-hold expiry clock
+        self.gray_held_since: float | None = None
+        # last known value + carried-window age PER SIGNAL (a slow
+        # replica produces SPARSE samples — exactly the member the
+        # detector must not lose sight of between windows; and the
+        # always-fresh gauge signals must never stomp a carried sparse
+        # one, so carry is per signal, not per vector)
+        self.signals: dict[str, float] = {}
+        self.signal_ages: dict[str, int] = {}
+        # router-observed forward walls (ms) accumulated since the last
+        # fleet window — the "observed" fwd_ms signal's raw material
+        self.fwd_acc: list[float] = []
+        # time-series delta cursor + estimated wall-clock skew vs the
+        # prober (NTP-style midpoint estimate, recorded per scrape so
+        # multi-service flight dumps can be merged on one clock)
+        self.ts_seq = 0
+        self.clock_skew_s = 0.0
 
     def admitting(self) -> bool:
         """May receive NEW sessions (and anonymous parses)."""
@@ -99,10 +259,16 @@ class Replica:
         return self.state in ("up", "draining") and self.breaker.state != "open"
 
     def describe(self) -> dict:
-        return {"url": self.url, "state": self.state,
-                "breaker": self.breaker.state, "inflight": self.inflight,
-                "probe_fails": self.probe_fails,
-                "pressure": round(self.pressure, 4)}
+        out = {"url": self.url, "state": self.state,
+               "breaker": self.breaker.state, "inflight": self.inflight,
+               "probe_fails": self.probe_fails,
+               "pressure": round(self.pressure, 4),
+               "gray": self.gray,
+               "outlier_score": round(self.outlier_score, 3),
+               "clock_skew_s": round(self.clock_skew_s, 4)}
+        if self.outlier_signal:
+            out["outlier_signal"] = self.outlier_signal
+        return out
 
 
 class ReplicaSet:
@@ -121,12 +287,23 @@ class ReplicaSet:
                  breaker_reset_s: float = 2.0,
                  max_sessions: int = 4096,
                  shed_pressure: float | None = None,
+                 gray_mad: float | None = None,
+                 gray_windows: int = 3,
+                 gray_min_peers: int = 3,
+                 gray_hold_s: float = 300.0,
                  log_name: str = "tpu_voice_agent.replicaset"):
         if not keys:
             raise ValueError("a replica set needs at least one member")
         self.probe_fails_limit = probe_fails_limit
         self.max_sessions = max_sessions
         self.shed_pressure = shed_pressure
+        # gray-failure detection (ISSUE 14): None disables it; the owning
+        # prober feeds apply_fleet_window with per-member signal vectors
+        self.gray_mad = gray_mad
+        self.gray_windows = max(1, gray_windows)
+        self.gray_min_peers = max(2, gray_min_peers)
+        self.gray_hold_s = gray_hold_s
+        self.last_fleet: dict | None = None
         self.replicas = [Replica(i, k, breaker_threshold, breaker_reset_s)
                          for i, k in enumerate(keys)]
         self._by_url = {r.url: r for r in self.replicas}
@@ -142,6 +319,14 @@ class ReplicaSet:
     def _on_rehome(self) -> None: ...
 
     def _on_shed_pressure(self) -> None: ...
+
+    def _on_shed_gray(self) -> None: ...
+
+    def _on_gray_entered(self, replica: Replica, evidence: dict) -> None: ...
+
+    def _on_gray_cleared(self, replica: Replica) -> None: ...
+
+    def _update_gray_gauge(self) -> None: ...
 
     def _on_drain(self) -> None: ...
 
@@ -163,26 +348,34 @@ class ReplicaSet:
 
         With ``shed_pressure`` armed, members at/over the threshold are
         avoided for new placements while at least one member is under it;
-        all-over falls back to the full set. ``count=True`` fires
-        ``_on_shed_pressure`` when the avoidance actually changed the
-        keyed choice — only ``route_ex``'s real placements pass it, so a
-        hedge probing alternatives never inflates the shed counter."""
+        ``gray`` members (fleet-detected peer-relative outliers, ISSUE 14)
+        are avoided through the SAME path — demotion, never an eject —
+        and all-over falls back to the full set: overload or a gray-swept
+        fleet degrades placement quality, it never turns into an error.
+        ``count=True`` fires ``_on_shed_pressure`` / ``_on_shed_gray``
+        when the avoidance actually changed the keyed choice — only
+        ``route_ex``'s real placements pass it, so a hedge probing
+        alternatives never inflates the shed counters."""
         cands = [r for r in self.replicas
                  if r.admitting() and r.url not in exclude]
         if not cands:
             return None
-        pool = cands
+        avoid = {r.url for r in cands if r.gray}
         if self.shed_pressure is not None:
-            under = [r for r in cands if r.pressure < self.shed_pressure]
-            if under and len(under) < len(cands):
-                pool = under
+            avoid |= {r.url for r in cands if r.pressure >= self.shed_pressure}
+        pool = [r for r in cands if r.url not in avoid]
+        if not pool or len(pool) == len(cands):
+            pool = cands
         if session_id:
             top = max(cands, key=lambda r: rendezvous_weight(r.url, session_id))
             if pool is cands:
                 return top
             best = max(pool, key=lambda r: rendezvous_weight(r.url, session_id))
             if count and best is not top:
-                self._on_shed_pressure()
+                if top.gray:
+                    self._on_shed_gray()
+                else:
+                    self._on_shed_pressure()
             return best
         return min(pool, key=lambda r: r.inflight)
 
@@ -227,6 +420,154 @@ class ReplicaSet:
         keys rotate per utterance — without this the LRU churns)."""
         self._sessions.pop(session_id, None)
 
+    # -------------------------------------------------- fleet gray state
+
+    def _reset_gray(self, r: Replica) -> None:
+        """A restarted/readmitted member starts with a clean slate — its
+        gray verdict described the OLD process."""
+        if r.gray:
+            r.gray = False
+            self._on_gray_cleared(r)
+        r.gray_streak = 0
+        r.ok_streak = 0
+        r.outlier_score = 0.0
+        r.outlier_signal = None
+        r.gray_evidence = None
+        r.gray_held_since = None
+        r.signals = {}
+        r.signal_ages = {}
+        r.fwd_acc = []
+        r.ts_seq = 0
+        self._update_gray_gauge()
+
+    def apply_fleet_window(self, readings: dict[str, dict[str, float]]) -> dict:
+        """One scrape window's verdict: fold fresh per-member signal
+        vectors in, score every member against its peers (MAD over the
+        ring, ``fleet_outlier_scores``), advance the gray streaks, and
+        flip the gray state symmetrically — ``gray_windows`` consecutive
+        outlier windows enter, the same count of clean windows clear.
+
+        Carry-forward is PER SIGNAL: a sparse signal (a slow replica's
+        parse wall lands only when a parse completes — exactly the member
+        the detector must not lose between windows) is carried for up to
+        ``gray_windows`` windows while the always-fresh gauge signals
+        update around it; past that it ages out of the member's vector.
+        Detection is a no-op while fewer than ``gray_min_peers`` members
+        report a signal — a median of two cannot say which one is wrong.
+        A GRAY member's recovery additionally requires live evidence on
+        the signal that demoted it: absence of data holds the verdict,
+        only measured health clears it.
+        """
+        # atomic-section: replicaset.fleet-window -- streak advancement and the gray flip must commit as one step: a suspension mid-window lets route() observe a half-applied verdict (score updated, gray flag stale)
+        if self.gray_mad is None:
+            return {}
+        pool: dict[str, dict[str, float]] = {}
+        for r in self.replicas:
+            fresh = readings.get(r.url) or {}
+            for name, v in fresh.items():
+                r.signals[name] = v
+                r.signal_ages[name] = 0
+            for name in list(r.signals):
+                if name not in fresh:
+                    r.signal_ages[name] = r.signal_ages.get(name, 0) + 1
+                    if r.signal_ages[name] > self.gray_windows:
+                        del r.signals[name]
+                        del r.signal_ages[name]
+            if r.signals and r.servable():
+                pool[r.url] = dict(r.signals)
+        scores, aggregates = fleet_outlier_scores(
+            pool, min_peers=self.gray_min_peers)
+        entered: list[str] = []
+        cleared: list[str] = []
+        for r in self.replicas:
+            verdict = scores.get(r.url)
+            if verdict is None:
+                continue  # no data this window: streaks hold
+            if r.gray and r.gray_evidence:
+                ev_sig = r.gray_evidence["signal"]
+                if ev_sig not in (pool.get(r.url) or {}) \
+                        or ev_sig not in aggregates:
+                    # the signal that demoted it was not SCORED this
+                    # window (no live reading from the member, or too few
+                    # peers reporting it): the verdict holds — recovery
+                    # needs measured health, not silence. But demotion
+                    # itself starves a traffic-borne signal like fwd_ms
+                    # (no new sessions ⇒ no forwards ⇒ no reading), so an
+                    # unbounded hold would strand a RECOVERED replica out
+                    # of placement forever: after ``gray_hold_s`` of
+                    # sustained starvation the verdict expires and the
+                    # replica rejoins — if it is still sick, the first
+                    # windows of returning traffic re-demote it.
+                    now = time.time()
+                    if r.gray_held_since is None:
+                        r.gray_held_since = now
+                    elif now - r.gray_held_since >= self.gray_hold_s:
+                        r.gray = False
+                        r.gray_evidence = None
+                        r.gray_held_since = None
+                        r.gray_streak = 0
+                        r.ok_streak = 0
+                        cleared.append(r.url)
+                        self._log.info(
+                            "replica %s gray verdict expired after %.0fs "
+                            "without scoreable evidence on %s", r.url,
+                            self.gray_hold_s, ev_sig)
+                        self._on_gray_cleared(r)
+                        self._update_gray_gauge()
+                    continue
+                r.gray_held_since = None  # evidence flows again
+            r.outlier_score = verdict["score"]
+            r.outlier_signal = verdict["signal"]
+            if verdict["score"] >= self.gray_mad:
+                r.gray_streak += 1
+                r.ok_streak = 0
+            else:
+                r.ok_streak += 1
+                r.gray_streak = 0
+            if not r.gray and r.gray_streak >= self.gray_windows:
+                r.gray = True
+                r.gray_held_since = None
+                r.gray_evidence = {
+                    "replica": r.url,
+                    "signal": verdict["signal"],
+                    "value": verdict["value"],
+                    "fleet_median": verdict["median"],
+                    "mad": verdict["mad"],
+                    "score": verdict["score"],
+                    "threshold": self.gray_mad,
+                    "windows": r.gray_streak,
+                    "peers": {u: {k: round(v, 4) for k, v in sig.items()}
+                              for u, sig in pool.items()},
+                    "aggregates": aggregates,
+                    "clock_skew_s": {x.url: round(x.clock_skew_s, 4)
+                                     for x in self.replicas},
+                }
+                entered.append(r.url)
+                self._log.warning(
+                    "replica %s marked GRAY: %s=%s vs fleet median %s "
+                    "(score %.1f x MAD >= %.1f for %d windows)",
+                    r.url, verdict["signal"], verdict["value"],
+                    verdict["median"], verdict["score"], self.gray_mad,
+                    r.gray_streak)
+                # gauge BEFORE the hook: the hook freezes the flight
+                # recorder, and the dump's final snapshot should show the
+                # fleet state the freeze is about
+                self._update_gray_gauge()
+                self._on_gray_entered(r, r.gray_evidence)
+            elif r.gray and r.ok_streak >= self.gray_windows:
+                r.gray = False
+                r.gray_evidence = None
+                r.gray_held_since = None
+                cleared.append(r.url)
+                self._log.info("replica %s recovered from gray", r.url)
+                self._on_gray_cleared(r)
+        self._update_gray_gauge()
+        self.last_fleet = {"scores": scores, "aggregates": aggregates,
+                           "gray": [r.url for r in self.replicas if r.gray],
+                           "entered": entered, "cleared": cleared}
+        # end-atomic-section
+        return self.last_fleet
+
     # ------------------------------------------------------------- drain
 
     # atomic-section: replicaset.ring-state -- replica state transitions (up/draining/drained) and the health gauge must commit atomically: a suspension mid-transition exposes a half-drained ring to concurrent route() calls
@@ -252,6 +593,7 @@ class ReplicaSet:
         replica.state = "up"
         replica.probe_fails = 0
         replica.drain_latched = False
+        self._reset_gray(replica)
         self._update_health_gauge()
     # end-atomic-section
 
@@ -271,9 +613,12 @@ class ReplicaSet:
             if r.state == "down":
                 # recovered (or restarted after a drain): rejoin the ring.
                 # Its old sessions stay where they re-homed (stickiness);
-                # new sessions flow here again by rendezvous weight.
+                # new sessions flow here again by rendezvous weight. A
+                # fresh process also sheds any gray verdict — the outlier
+                # evidence described the old one.
                 r.state = "up"
                 r.drain_latched = False
+                self._reset_gray(r)
                 self._on_recovered(r)
             elif r.state in ("draining", "drained") and body.get("draining"):
                 r.drain_latched = True
@@ -287,6 +632,7 @@ class ReplicaSet:
                 # the ring-side drain must hold for latch-less replicas.)
                 r.state = "up"
                 r.drain_latched = False
+                self._reset_gray(r)
                 self._on_recovered(r)
             elif r.state == "up" and body.get("draining"):
                 # drain issued directly at the replica: honor it here too

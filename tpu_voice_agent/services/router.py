@@ -143,10 +143,22 @@ class BrainRouter(ReplicaSet):
                  breaker_reset_s: float | None = None,
                  handoff_enable: bool | None = None,
                  handoff_timeout_s: float | None = None,
-                 shed_pressure: float | None = None):
+                 shed_pressure: float | None = None,
+                 fleet_detect: bool | None = None,
+                 fleet_mad: float | None = None,
+                 fleet_windows: int | None = None,
+                 fleet_min_peers: int | None = None,
+                 fleet_hold_s: float | None = None):
         if not replica_urls:
             raise ValueError("BRAIN_REPLICAS must name at least one replica")
         env = os.environ.get
+        # fleet gray-failure detection (ISSUE 14): the prober additionally
+        # scrapes each member's /debug/timeseries deltas and demotes
+        # sustained peer-relative outliers (services/replicaset.py)
+        if fleet_detect is None:
+            fleet_detect = env("FLEET_DETECT", "1") != "0"
+        fleet_mad = fleet_mad if fleet_mad is not None \
+            else float(env("FLEET_GRAY_MAD", "4.0"))
         self.probe_s = probe_s if probe_s is not None else \
             float(env("ROUTER_PROBE_S", "0.5"))
         self.probe_timeout_s = probe_timeout_s if probe_timeout_s is not None \
@@ -173,6 +185,13 @@ class BrainRouter(ReplicaSet):
                           else int(env("ROUTER_SESSIONS", "4096"))),
             shed_pressure=(shed_pressure if shed_pressure is not None
                            else float(env("ROUTER_SHED_PRESSURE", "0.9"))),
+            gray_mad=(fleet_mad if fleet_detect else None),
+            gray_windows=(fleet_windows if fleet_windows is not None
+                          else int(env("FLEET_GRAY_WINDOWS", "3"))),
+            gray_min_peers=(fleet_min_peers if fleet_min_peers is not None
+                            else int(env("FLEET_MIN_PEERS", "3"))),
+            gray_hold_s=(fleet_hold_s if fleet_hold_s is not None
+                         else float(env("FLEET_GRAY_HOLD_S", "300"))),
             log_name="tpu_voice_agent.router")
         self._http = None  # httpx.AsyncClient, created on the app's loop
         self._probe_task: asyncio.Task | None = None
@@ -188,6 +207,12 @@ class BrainRouter(ReplicaSet):
         m.inc("router.drains", 0.0)
         m.inc("router.retries", 0.0)
         m.inc("router.spec_discarded", 0.0)
+        m.inc("fleet.scrapes", 0.0)
+        m.inc("fleet.gray_entered", 0.0)
+        m.inc("fleet.gray_recovered", 0.0)
+        m.inc("fleet.shed_gray", 0.0)
+        m.set_gauge("fleet.gray_replicas", 0.0)
+        m.set_gauge("fleet.outlier_score_max", 0.0)
         m.set_gauge("router.replicas_total", len(self.replicas))
         self._update_health_gauge()
 
@@ -217,14 +242,101 @@ class BrainRouter(ReplicaSet):
     def _on_recovered(self, replica: Replica) -> None:
         get_metrics().inc("router.replicas_recovered")
 
+    def _on_shed_gray(self) -> None:
+        get_metrics().inc("fleet.shed_gray")
+
+    def _on_gray_entered(self, replica: Replica, evidence: dict) -> None:
+        from ..utils.tracing import get_flight_recorder, log_event
+
+        get_metrics().inc("fleet.gray_entered")
+        log_event("router", "replica_gray", replica=replica.url,
+                  signal=evidence.get("signal"),
+                  score=evidence.get("score"))
+        # the incident autopsy: freeze the flight recorder WITH the
+        # peer-comparison evidence that justified the demotion — the dump
+        # answers "why did the fleet demote this replica" from the moment
+        # of detection, not from a re-run
+        get_flight_recorder().trigger("fleet.gray", detail=replica.url,
+                                      extra={"fleet": evidence})
+
+    def _on_gray_cleared(self, replica: Replica) -> None:
+        get_metrics().inc("fleet.gray_recovered")
+
+    def _update_gray_gauge(self) -> None:
+        m = get_metrics()
+        m.set_gauge("fleet.gray_replicas",
+                    sum(1 for r in self.replicas if r.gray))
+        m.set_gauge("fleet.outlier_score_max",
+                    max((r.outlier_score for r in self.replicas),
+                        default=0.0))
+        for r in self.replicas:
+            m.set_gauge(f"fleet.outlier.{r.idx}", r.outlier_score)
+
     # ------------------------------------------------------------ probing
 
     async def probe_once(self) -> None:
-        """One active-probe sweep: every replica's /health, concurrently."""
+        """One active-probe sweep: every replica's /health, concurrently.
+        With fleet detection armed, the sweep additionally scrapes each
+        member's time-series deltas and applies the gray-failure verdict
+        (ISSUE 14) — health says *alive*, the fleet window says *right*."""
         await asyncio.gather(*(self._probe_replica(r) for r in self.replicas))
         for r in self.replicas:
             self._maybe_finish_drain(r)
         self._update_health_gauge()
+        if self.gray_mad is not None:
+            await self._fleet_scrape()
+
+    async def _fleet_scrape(self) -> None:
+        """One fleet telemetry window: pull every servable member's new
+        time-series samples (``?since=`` delta cursor per member), reduce
+        them to signal vectors, and hand the window to the shared gray
+        state machine. Also records the per-member wall-clock skew
+        estimate the multi-service dump merge needs."""
+        targets = [r for r in self.replicas if r.servable()]
+        readings_list = await asyncio.gather(
+            *(self._scrape_timeseries(r) for r in targets))
+        readings = {r.url: sig for r, sig in zip(targets, readings_list)
+                    if sig}
+        for r in targets:
+            # the router-observed forward wall rides the window as the
+            # "observed" fwd_ms signal (mean since the last window)
+            if r.fwd_acc:
+                sig = readings.setdefault(r.url, {})
+                sig["fwd_ms"] = sum(r.fwd_acc) / len(r.fwd_acc)
+                r.fwd_acc = []
+        self.apply_fleet_window(readings)
+        get_metrics().inc("fleet.scrapes")
+
+    async def _scrape_timeseries(self, r: Replica) -> dict | None:
+        """GET one member's timeseries delta; returns the window's reduced
+        signal vector (None on error / nothing new). Updates the member's
+        delta cursor and its NTP-style clock-skew estimate (server ``now_s``
+        minus the request's local midpoint)."""
+        import httpx
+
+        from .replicaset import reduce_window
+
+        try:
+            t0 = time.time()
+            resp = await self._http.get(
+                r.url + f"/debug/timeseries?since={r.ts_seq}",
+                timeout=self.probe_timeout_s)
+            t1 = time.time()
+            if resp.status_code != 200:
+                return None
+            body = resp.json()
+        except (httpx.HTTPError, OSError, ValueError, asyncio.TimeoutError):
+            return None
+        if not isinstance(body, dict):
+            return None
+        now_s = body.get("now_s")
+        if isinstance(now_s, (int, float)):
+            r.clock_skew_s = float(now_s) - (t0 + t1) / 2
+        next_seq = body.get("next_seq")
+        if isinstance(next_seq, int):
+            r.ts_seq = next_seq
+        samples = body.get("samples") or []
+        return reduce_window([s for s in samples if isinstance(s, dict)])
 
     async def _probe_replica(self, r: Replica) -> None:
         import httpx
@@ -268,12 +380,21 @@ class BrainRouter(ReplicaSet):
     async def _forward(self, replica: Replica, raw: bytes, headers: dict,
                        deadline: Deadline):
         replica.inflight += 1
+        t0 = time.perf_counter()
         try:
-            return await self._http.post(
+            resp = await self._http.post(
                 replica.url + "/parse", content=raw,
                 headers={**headers, "Content-Type": "application/json",
                          DEADLINE_HEADER: deadline.header_value()},
                 timeout=max(0.05, deadline.remaining_s()))
+            # the router-observed forward wall feeds the fleet detector's
+            # ``fwd_ms`` signal: measured on OUR clock, so a replica slow
+            # anywhere on its serving path (middleware, network, GC) is
+            # visible even when its self-reported spans look healthy
+            replica.fwd_acc.append((time.perf_counter() - t0) * 1e3)
+            if len(replica.fwd_acc) > 512:
+                del replica.fwd_acc[:256]
+            return resp
         finally:
             # atomic-section: router.inflight-release -- the inflight decrement and the drain-completion check must be one step: a suspension between them can eject a draining replica while this request still counts against it
             replica.inflight -= 1
@@ -640,15 +761,18 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
         total = len(router.replicas)
         healthy = sum(1 for r in router.replicas if r.servable())
         draining = sum(1 for r in router.replicas if r.state == "draining")
+        gray = sum(1 for r in router.replicas if r.gray)
         status = ("ok" if healthy == total
                   else "unhealthy" if healthy == 0 else "degraded")
         body = {
             "ok": healthy > 0, "service": "router", "status": status,
             "replicas": {"total": total, "healthy": healthy,
-                         "draining": draining},
+                         "draining": draining, "gray": gray},
             "replica_detail": [r.describe() for r in router.replicas],
             "slo": slo.state(),
         }
+        if router.last_fleet is not None:
+            body["fleet"] = router.last_fleet
         # the engine microscope rides along from a representative healthy
         # replica's last probe body, so the voice /health forward (and the
         # web HUD behind it) keeps its compile-sentinel / step-ledger / HBM
@@ -728,13 +852,31 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
                        make_trace_handler("router", tracer))
     app.router.add_get("/debug/replicas/trace/{trace_id}",
                        fan_out("/debug/trace/{trace_id}"))
-    app.router.add_get("/debug/replicas/flightrecorder",
-                       fan_out("/debug/flightrecorder"))
     app.router.add_get("/debug/replicas/steplog", fan_out("/debug/steplog"))
+    app.router.add_get("/debug/replicas/timeseries",
+                       fan_out("/debug/timeseries"))
+
+    async def replicas_flight(req: web.Request) -> web.Response:
+        """The flight-recorder fan-out, with each member's dump annotated
+        with the router's latest wall-clock-skew estimate for it — every
+        service's dump timestamps are its own wall clock, and the skew is
+        what lets ``traceview --flight`` merge multi-service dumps onto
+        ONE timeline (ISSUE 14 satellite)."""
+        bodies = await router.fan_out_get("/debug/flightrecorder",
+                                          req.query_string)
+        for r in router.replicas:
+            body = bodies.get(r.url)
+            if isinstance(body, dict):
+                body["clock_skew_s"] = round(r.clock_skew_s, 6)
+        return web.json_response({"service": "router", "replicas": bodies})
+
+    app.router.add_get("/debug/replicas/flightrecorder", replicas_flight)
+    from ..utils.timeseries import attach_timeseries
     from ..utils.tracing import make_flightrecorder_handler
 
     app.router.add_get("/debug/flightrecorder",
                        make_flightrecorder_handler("router"))
+    attach_timeseries(app, "router", tracer)
     return app
 
 

@@ -810,6 +810,9 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     app.router.add_get("/metrics", make_metrics_handler("voice", tracer, slo=slo))
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("voice", tracer))
     app.router.add_get("/debug/flightrecorder", make_flightrecorder_handler("voice"))
+    from ..utils.timeseries import attach_timeseries
+
+    attach_timeseries(app, "voice", tracer)
     app.router.add_get("/stream", stream)
     app.router.add_get("/", index)
     from ..web import static_dir as _sd

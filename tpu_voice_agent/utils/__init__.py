@@ -13,6 +13,7 @@ from .tracing import (
 )
 from .slo import SLOTracker
 from .steplog import StepLog, get_steplog
+from .timeseries import TimeSeriesRing, attach_timeseries
 from .compilewatch import CompileWatcher, get_compile_watcher, watch_compiles
 from .resilience import (
     DEADLINE_HEADER,
@@ -44,6 +45,8 @@ __all__ = [
     "SLOTracker",
     "StepLog",
     "get_steplog",
+    "TimeSeriesRing",
+    "attach_timeseries",
     "CompileWatcher",
     "get_compile_watcher",
     "watch_compiles",

@@ -50,6 +50,13 @@ request on that app, /health probes included, like a crashed process):
     replica_slow  this request sleeps CHAOS_SLOW_S (0.25) first — the
                   tail-latency shape hedged parses (ROUTER_HEDGE_MS) cut
 
+    replica_degrade  (ISSUE 14, drilled by ``benches/bench_fleet.py``)
+                  LATCHES the serving replica persistently slow: from the
+                  firing parse on, every /parse on that app pays
+                  CHAOS_SLOW_S while /health keeps answering ok — the
+                  canonical GRAY failure (slow, not dead) the fleet
+                  detector's peer-relative outlier scoring must demote
+
 STT replica points (ISSUE 13 — the ``stt_replica_kill``/``stt_replica_hang``
 mirrors of the brain variants, fired inside ``serve.stt_batch.STTBatcher``
 and drilled by ``benches/bench_handoff.py`` against the replicated STT
@@ -73,7 +80,8 @@ import threading
 
 KNOWN_POINTS = ("nan_logits", "dead_fsm", "prefill_exc", "alloc_fail",
                 "stall_step", "drop_frame", "replica_kill", "replica_hang",
-                "replica_slow", "stt_replica_kill", "stt_replica_hang")
+                "replica_slow", "replica_degrade", "stt_replica_kill",
+                "stt_replica_hang")
 
 
 class ChaosError(RuntimeError):

@@ -207,6 +207,17 @@ declare("FLIGHT_SNAPSHOT_S", "1.0", "metric-snapshot interval while armed", tabl
 declare("FLIGHT_SINK", None, "directory for frozen flight dumps (unset = memory only)", table=OBSERVABILITY)
 declare("TRACE_SINK", None, "JSONL path for finished trace spans (unset = ring only)", table=OBSERVABILITY)
 
+# fleet telemetry plane (ISSUE 14): per-service time-series rings + the
+# router's peer-relative gray-failure detector
+declare("TS_INTERVAL_S", "0.5", "time-series ring sample cadence per service", table=OBSERVABILITY)
+declare("TS_SAMPLES", "240", "time-series ring size (samples retained per service)", table=OBSERVABILITY)
+declare("TS_GAUGES", None, "comma list of gauge-name prefixes to sample (unset = all gauges)", table=OBSERVABILITY)
+declare("FLEET_DETECT", "1", "0 disables the router's fleet gray-failure detector", table=OBSERVABILITY)
+declare("FLEET_GRAY_MAD", "4.0", "peer-relative outlier score (MAD multiples) at/over which a window counts gray", table=OBSERVABILITY)
+declare("FLEET_GRAY_WINDOWS", "3", "consecutive outlier scrape windows before a replica enters (or clean windows before it leaves) gray", table=OBSERVABILITY)
+declare("FLEET_MIN_PEERS", "3", "members a signal needs before peer-relative scoring runs (a median of two cannot name the outlier)", table=OBSERVABILITY)
+declare("FLEET_GRAY_HOLD_S", "300", "seconds a gray verdict survives WITHOUT scoreable evidence before expiring (demotion starves traffic-borne signals; expiry bounds the capacity loss, re-detection re-demotes)", table=OBSERVABILITY)
+
 # ========================================================= infrastructure
 # deliberately undocumented: JAX bootstrap + test/bench harness plumbing,
 # not operator tuning surface (the checker rejects doc rows for these)

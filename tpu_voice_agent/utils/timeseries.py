@@ -1,0 +1,233 @@
+"""Per-service time-series rings: the fleet telemetry plane's first layer.
+
+Until now the only metrics *history* anywhere in the tree lived in a
+bench-side polling thread (``tools/swarm.py`` ``MetricsSampler``): every
+``/metrics`` scrape was an instant, so a replica that was fast one second
+and thrashing the next looked identical to a steadily healthy one at
+every single poll. This module gives each service an in-process bounded
+ring (the FlightRecorder discipline: always on, cheap to feed, immutable
+copies on read) of periodic samples taken from the Metrics registries:
+
+- **gauges** — a dict copy per sample (``TS_GAUGES`` optionally narrows
+  to a comma-separated list of name prefixes);
+- **counters as rates** — per-second deltas against the previous sample,
+  so a scraper reads "quarantines/sec" instead of a monotonic total;
+- **histograms as window means** — each latency histogram's cumulative
+  ``(sum, count)`` differenced into ``{ms_per, per_s}`` (mean ms per
+  event and events/sec over the sample window). This is what makes a
+  per-replica "parse wall this window" signal possible WITHOUT sorting a
+  percentile reservoir on the sample thread — the ring must be cheap
+  enough to run forever.
+
+Served as ``GET /debug/timeseries?since=SEQ`` on every service (voice,
+brain, executor, router): ``since`` is the delta cursor — the body's
+``next_seq`` is the value to pass on the next poll, and only samples with
+``seq >= since`` come back, so a 2 Hz poller moves a handful of small
+dicts per request. The router's fleet prober and the swarm's saturation
+sampler both read this one surface (ISSUE 14).
+
+Each service owns its OWN ring fed from the process-global registry plus
+its tracer-local one (tracer metrics win on name collisions, mirroring
+``prometheus_exposition``'s precedence): in production each service is
+its own process so the distinction is invisible, but the in-process test/
+bench stacks share one global registry across every replica — the
+tracer-local ``brain.parse`` histogram is what keeps per-replica signals
+honest there.
+
+Knobs: ``TS_INTERVAL_S`` (0.5) sample cadence, ``TS_SAMPLES`` (240) ring
+size, ``TS_GAUGES`` (unset = all) gauge-prefix filter.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .tracing import Metrics, get_metrics
+
+
+class TimeSeriesRing:
+    """Bounded ring of periodic metric samples with rate derivation.
+
+    ``sources`` are sampled in order with later registries winning name
+    collisions; by default the process-global runtime registry alone.
+    ``sample_once`` is the deterministic surface tests drive directly
+    (pass ``now_s``); ``start``/``stop`` run it on a daemon thread every
+    ``interval_s``.
+    """
+
+    def __init__(self, service: str, sources: tuple[Metrics, ...] | None = None,
+                 interval_s: float | None = None,
+                 max_samples: int | None = None,
+                 gauge_prefixes: tuple[str, ...] | None = None,
+                 clock=time.time):
+        env = os.environ.get
+        self.service = service
+        self.sources: tuple[Metrics, ...] = sources or (get_metrics(),)
+        self.interval_s = interval_s if interval_s is not None \
+            else float(env("TS_INTERVAL_S", "0.5"))
+        self.max_samples = max_samples if max_samples is not None \
+            else int(env("TS_SAMPLES", "240"))
+        if gauge_prefixes is None:
+            spec = env("TS_GAUGES") or ""
+            gauge_prefixes = tuple(p.strip() for p in spec.split(",")
+                                   if p.strip()) or None
+        self.gauge_prefixes = gauge_prefixes
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: list[dict] = []
+        self._seq = 0
+        # rate baselines: the previous sample's cumulative counter/hist
+        # state and wall time (first sample establishes them, rates {})
+        self._prev_t: float | None = None
+        self._prev_counters: dict[str, float] = {}
+        self._prev_hist: dict[str, tuple[float, int]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ feeding
+
+    def _merged_state(self) -> tuple[dict, dict, dict]:
+        """(gauges, counters, hist) merged across sources, later wins.
+        Dict copies only — this runs on the sample thread forever, so it
+        must never sort a reservoir or render anything."""
+        gauges: dict[str, float] = {}
+        counters: dict[str, float] = {}
+        hist: dict[str, tuple[float, int]] = {}
+        for src in self.sources:
+            gauges.update(src.gauges())
+            c, h = src.counter_state()
+            counters.update(c)
+            hist.update(h)
+        if self.gauge_prefixes is not None:
+            gauges = {k: v for k, v in gauges.items()
+                      if k.startswith(self.gauge_prefixes)}
+        return gauges, counters, hist
+
+    def sample_once(self, now_s: float | None = None) -> dict:
+        """Take one sample: gauge copies plus counter/histogram deltas
+        against the previous sample, appended to the ring. Returns the
+        appended sample (a copy is stored; callers may keep the return)."""
+        now = self._clock() if now_s is None else now_s
+        gauges, counters, hist = self._merged_state()
+        with self._lock:
+            dt = (now - self._prev_t) if self._prev_t is not None else 0.0
+            rates: dict[str, float] = {}
+            hist_rates: dict[str, dict] = {}
+            if dt > 0:
+                for k, v in counters.items():
+                    delta = v - self._prev_counters.get(k, 0.0)
+                    # a restarted registry (warm restart, test reset) can
+                    # step a counter backwards; a negative rate is never
+                    # what happened, so the window reads 0
+                    rates[k] = round(max(0.0, delta) / dt, 6)
+                for k, (s, c) in hist.items():
+                    ps, pc = self._prev_hist.get(k, (0.0, 0))
+                    dc = c - pc
+                    if dc > 0 and s >= ps:
+                        hist_rates[k] = {"ms_per": round((s - ps) / dc, 3),
+                                         "per_s": round(dc / dt, 6)}
+            self._prev_t = now
+            self._prev_counters = counters
+            self._prev_hist = hist
+            sample = {"seq": self._seq, "t_s": round(now, 3),
+                      "dt_s": round(dt, 3), "gauges": gauges,
+                      "rates": rates, "hist": hist_rates}
+            self._seq += 1
+            self._samples.append(sample)
+            if len(self._samples) > self.max_samples:
+                del self._samples[: len(self._samples) - self.max_samples]
+            buffered = len(self._samples)
+        get_metrics().set_gauge("ts.samples_buffered", float(buffered))
+        return sample
+
+    # ------------------------------------------------------------ reading
+
+    def since(self, seq: int) -> list[dict]:
+        """Samples with ``seq >= seq`` (the ``?since=`` delta contract).
+        Seqs are monotonic and never reused, so a cursor survives ring
+        trimming — trimmed-away samples are simply gone from the answer."""
+        with self._lock:
+            return [dict(s) for s in self._samples if s["seq"] >= seq]
+
+    def state(self, since: int = 0) -> dict:
+        """The ``/debug/timeseries`` body. ``now_s`` rides along so a
+        scraper can estimate this process's wall-clock skew (NTP-style:
+        server now vs the request's local midpoint) — the fleet prober
+        records it per member and ``traceview --flight`` applies it when
+        merging multi-service dumps."""
+        with self._lock:
+            samples = [dict(s) for s in self._samples if s["seq"] >= since]
+            next_seq = self._seq
+        return {"service": self.service, "interval_s": self.interval_s,
+                "max_samples": self.max_samples,
+                "now_s": round(time.time(), 6),
+                "next_seq": next_seq, "samples": samples}
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Start the sampler thread (idempotent). The first sample fires
+        immediately to establish the rate baseline."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self.sample_once()
+
+        def _run() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.sample_once()
+                except Exception:  # pragma: no cover - telemetry never kills
+                    pass
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name=f"ts-{self.service}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+
+def make_timeseries_handler(service: str, ring: TimeSeriesRing):
+    """aiohttp ``GET /debug/timeseries``: the ring as JSON; ``?since=SEQ``
+    returns only samples with seq >= SEQ (pass the previous body's
+    ``next_seq``)."""
+    from aiohttp import web
+
+    async def timeseries_ep(req) -> web.Response:
+        try:
+            since = int(req.query.get("since", "0"))
+        except ValueError:
+            since = 0
+        return web.json_response(ring.state(since=since))
+
+    return timeseries_ep
+
+
+def attach_timeseries(app, service: str, tracer=None) -> TimeSeriesRing:
+    """Wire a service app into the telemetry plane: build its ring
+    (global registry + the tracer-local one when given), register
+    ``GET /debug/timeseries``, and start/stop the sampler with the app —
+    the stop hook matters for in-process test stacks, which build and
+    tear down hundreds of apps per run."""
+    sources = (get_metrics(),) + ((tracer.metrics,) if tracer is not None
+                                  else ())
+    ring = TimeSeriesRing(service, sources=sources)
+    app.router.add_get("/debug/timeseries",
+                       make_timeseries_handler(service, ring))
+
+    async def _start(_app) -> None:
+        ring.start()
+
+    async def _stop(_app) -> None:
+        ring.stop()
+
+    app.on_startup.append(_start)
+    app.on_cleanup.append(_stop)
+    return ring

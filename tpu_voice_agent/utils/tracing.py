@@ -178,6 +178,17 @@ class Metrics:
         with self._lock:
             return dict(self._gauges)
 
+    def counter_state(self) -> tuple[dict[str, float], dict[str, tuple[float, int]]]:
+        """Cheap cumulative copies for rate derivation (one lock hold, no
+        reservoir sorting): the counters plus each histogram's running
+        ``(sum_ms, count)``. The time-series ring (utils.timeseries)
+        differences consecutive reads into per-second rates and window
+        means — the only way to expose latency history without putting a
+        percentile sort on a forever-running sample thread."""
+        with self._lock:
+            return (dict(self._counters),
+                    {k: (v["sum"], v["count"]) for k, v in self._hist.items()})
+
     def collisions(self) -> list[tuple[str, str, str]]:
         """(name, first_kind, other_kind) for every name registered as two
         different metric types — the runtime half of the collision lint."""
@@ -353,9 +364,14 @@ class FlightRecorder:
 
     # ------------------------------------------------------------ freezing
 
-    def trigger(self, reason: str, detail: str | None = None) -> bool:
+    def trigger(self, reason: str, detail: str | None = None,
+                extra: dict | None = None) -> bool:
         """Freeze the current rings under ``reason``. Idempotent while
-        frozen (first incident wins); returns True when this call froze."""
+        frozen (first incident wins); returns True when this call froze.
+        ``extra`` rides the dump verbatim under its own key — the fleet
+        gray-failure detector uses it to attach the peer-comparison
+        evidence (which signal, whose median, what deviation) that
+        justified freezing."""
         self.snapshot_metrics()  # the knee itself belongs in the timeline
         # the engine's step ledger rides every freeze: an overload autopsy
         # needs the device-plane timeline (stage decomposition, compile
@@ -381,6 +397,8 @@ class FlightRecorder:
                            "max_snapshots": self.max_snapshots,
                            "snapshot_interval_s": self.snapshot_interval_s},
             }
+            if extra:
+                self._frozen["extra"] = dict(extra)
             dump = self._frozen
         get_metrics().inc("flight.freezes")
         log_event("flight", "frozen", reason=reason, detail=detail,
