@@ -16,10 +16,25 @@ Every row is the standard bench JSON contract (benches/common.py).
 
 from __future__ import annotations
 
+import datetime
+import json
 import os
 import sys
+from pathlib import Path
 
-from common import checkpoints_dir, emit, log  # noqa: E402 (adds repo root to sys.path)
+from common import _ROOT, checkpoints_dir, log  # noqa: E402 (adds repo root to sys.path)
+from common import emit as _emit  # noqa: E402
+
+# every emitted row is also collected into the BENCH_quality artifact's
+# ``quality`` section (ISSUE 15 satellite: the offline eval joins the bench
+# trajectory — run_all merges the section, benchdiff gates the accuracy
+# rows' ``fraction`` unit as higher-is-better)
+SECTION: dict = {}
+
+
+def emit(metric: str, value: float, unit: str) -> None:
+    _emit(metric, value, unit)
+    SECTION[metric] = round(float(value), 4)
 
 
 def intent_rows() -> None:
@@ -234,6 +249,16 @@ def main() -> None:
     intent_rows()
     neural_rows()
     wer_rows()
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art = art_dir / f"BENCH_quality_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_quality",
+        "ts": stamp,
+        "quality": SECTION,
+    }, indent=1))
+    log(f"artifact: {art}")
 
 
 if __name__ == "__main__":

@@ -21,7 +21,8 @@ import sys
 from pathlib import Path
 
 BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
-           "bench_quality.py", "bench_faults.py", "bench_spec.py",
+           "bench_quality.py", "bench_quality_online.py", "bench_faults.py",
+           "bench_spec.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
            "bench_steplog.py", "bench_router.py", "bench_handoff.py",
            "bench_fleet.py"]
@@ -54,12 +55,23 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # regression gate (rule replicas, no model, trimmed search), and a PR
 # that blinds the detector or breaks gray placement demotion must fail
 # the quick table as well
-QUICK_BENCHES = ["bench_quality.py", "bench_faults.py", "bench_spec.py",
+# the quality-observatory online drill stays on --quick too — it is the
+# quality-regression gate (rule replicas, no model, trimmed capacity
+# probes, ~seconds of canary cadence), and a PR that blinds the golden
+# canary, breaks the quality-SLO freeze, or makes quality instrumentation
+# expensive must fail the quick table as well; the offline bench_quality
+# rows run on --quick with EVAL_BACKEND pinned to the rule parser so the
+# accuracy trajectory always has a deterministic row to gate
+QUICK_BENCHES = ["bench_quality.py", "bench_quality_online.py",
+                 "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
                  "bench_chaos.py", "bench_steplog.py", "bench_router.py",
                  "bench_handoff.py", "bench_fleet.py"]
 # env trims applied on --quick only when the operator has not pinned them
-QUICK_ENV = {"BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
+QUICK_ENV = {"EVAL_BACKEND": "rule",
+             "BENCH_QO_MAX_N": "4", "BENCH_QO_UTTERANCES": "2",
+             "BENCH_QO_DETECT_TIMEOUT_S": "30",
+             "BENCH_SPEC_UTTERANCES": "3", "BENCH_SPEC_TOKENS": "96",
              "BENCH_SPEC_PAGED_SESSIONS": "2", "BENCH_SPEC_PAGED_TURNS": "2",
              "BENCH_STT_SECONDS": "4", "BENCH_STT_STREAMS": "1,4",
              "BENCH_SWARM_MAX_N": "8", "BENCH_SWARM_UTTERANCES": "3",
@@ -162,7 +174,8 @@ def main() -> None:
                 for key in ("slo", "stage_latency_ms", "runtime_gauges",
                             "spec", "stt", "radix", "swarm", "chaos",
                             "steplog", "engine_step", "xla", "hbm",
-                            "router", "kv_quant", "handoff", "fleet"):
+                            "router", "kv_quant", "handoff", "fleet",
+                            "quality"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -100,7 +100,7 @@ def test_decode_loop_mid_batch_eos_and_ragged_budgets(engine):
     budgets = np.array([3, 16, 1, 8], dtype=np.int32)
     bos = jnp.broadcast_to(
         jnp.asarray(list(engine.bos_ids), jnp.int32)[None, :], (4, 1))
-    out_b, n_b, _ = _stt_decode_loop(
+    out_b, n_b, _, _ = _stt_decode_loop(
         engine.params, engine.cfg,
         init_self_cache(engine.cfg, 4, dtype=engine._param_dtype),
         ck, mask_b, bos, engine.suppress,
@@ -111,7 +111,7 @@ def test_decode_loop_mid_batch_eos_and_ragged_budgets(engine):
     assert (n_b <= budgets).all()
     assert n_b[2] <= 1 < n_b[1]  # ragged: row 2 parked while row 1 ran on
     for i in range(4):
-        o1, n1, _ = _stt_decode_loop(
+        o1, n1, _, _ = _stt_decode_loop(
             engine.params, engine.cfg,
             init_self_cache(engine.cfg, 1, dtype=engine._param_dtype),
             kvs[i], masks[i], bos[:1], engine.suppress,

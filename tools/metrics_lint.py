@@ -179,6 +179,25 @@ PINNED: dict[str, str] = {
     "fleet.scrapes": "counter",
     "fleet.outlier_score_max": "gauge",
     "fleet.gray_entered": "counter",
+    # quality observatory (ISSUE 15, utils/quality.py + utils/slo.py
+    # QualityTracker, docs/OBSERVABILITY.md "Quality observatory"): the
+    # online per-utterance quality signals the quality SLO floors and the
+    # fleet gray detector read — golden_accuracy is the canary's headline
+    # (bench_quality_online's detection drill keys on it), intent_margin
+    # the decode tail's masked-logit confidence, exec_success_rate the
+    # executor weak-label loop, the stt.confidence* lanes the Whisper
+    # decode readbacks, prefill_remaining_at_endpoint the streaming-prefill
+    # scoreboard — renaming any of these blinds the quality gates
+    "quality.golden_accuracy": "gauge",
+    "quality.intent_margin": "gauge",
+    "quality.exec_success_rate": "gauge",
+    "quality.degraded_rate": "gauge",
+    "quality.canary_runs": "counter",
+    "quality.intent_downgrades": "counter",
+    "stt.confidence_mean": "gauge",
+    "stt.confidence_min": "gauge",
+    "stt.confidence_repetition": "gauge",
+    "engine.prefill_remaining_at_endpoint": "gauge",
 }
 
 

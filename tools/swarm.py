@@ -83,6 +83,24 @@ COMPOUND_COMMANDS = [
     "go back and sort by price",
 ]
 
+# per-scenario quality mining (ISSUE 15): the PRIMARY intent type each
+# scripted command is designed to yield (matches the rule parser's
+# precedence — e.g. "go back and sort by price" hits the sort branch).
+# Typed scenarios score their intent events against this; a swarm run's
+# verdict then carries per-scenario type_match/degraded fractions beside
+# latency, so a capacity probe also says whether answers stayed RIGHT.
+EXPECTED_PRIMARY = {
+    "search for usb hubs": "search",
+    "scroll down": "scroll",
+    "go back": "back",
+    "take a screenshot": "screenshot",
+    "sort by price": "sort",
+    "search for mechanical keyboards": "search",
+    "search for usb hubs and take a screenshot": "search",
+    "scroll down and summarize the page": "scroll",
+    "go back and sort by price": "sort",
+}
+
 DEFAULT_URLS = {
     "voice": "http://127.0.0.1:7072",
     "brain": "http://127.0.0.1:8090",
@@ -332,13 +350,22 @@ def attribute_saturation(samples: list[dict]) -> dict:
 class Utt:
     """One utterance's client-side record."""
 
-    __slots__ = ("scenario", "lat_ms", "ok", "stages")
+    __slots__ = ("scenario", "lat_ms", "ok", "stages", "expected", "itype",
+                 "degraded")
 
-    def __init__(self, scenario: str, lat_ms: float, ok: bool, stages: dict | None):
+    def __init__(self, scenario: str, lat_ms: float, ok: bool,
+                 stages: dict | None, expected: str | None = None,
+                 itype: str | None = None, degraded: bool = False):
         self.scenario = scenario
         self.lat_ms = lat_ms
         self.ok = ok
         self.stages = stages or {}
+        # quality mining (typed scenarios): the command's designed primary
+        # intent type vs what the intent event actually carried, plus the
+        # degraded tag riding the event
+        self.expected = expected
+        self.itype = itype
+        self.degraded = degraded
 
 
 class EventLog:
@@ -381,15 +408,20 @@ class EventLog:
             self.arrived.append(time.monotonic())
         return True
 
-    def mine(self, scenario: str, t0s: list[float]) -> list[Utt]:
+    def mine(self, scenario: str, t0s: list[float],
+             texts: list[str] | None = None) -> list[Utt]:
         """Pair the i-th terminal event (intent OR error) with the i-th
         utterance start; stage splits ride the latency_budget events (same
-        order — the error path emits one too)."""
+        order — the error path emits one too). ``texts`` (typed scenarios)
+        additionally mines per-utterance quality: the intent event's first
+        type vs the command's designed primary type, plus the degraded tag."""
         terms = [(i, e) for i, e in enumerate(self.events)
                  if e["type"] in ("intent", "error")]
         budgets = [e for e in self.events if e["type"] == "latency_budget"]
         utts: list[Utt] = []
         for i, t0 in enumerate(t0s):
+            expected = (EXPECTED_PRIMARY.get(texts[i])
+                        if texts is not None and i < len(texts) else None)
             if i < len(terms):
                 idx, ev = terms[i]
                 # clamped at 0: keepalive frames can realign a scripted
@@ -397,12 +429,18 @@ class EventLog:
                 lat = max(0.0, (self.arrived[idx] - t0) * 1e3)
                 stages = budgets[i]["stages"] if i < len(budgets) else {}
                 ok = ev["type"] == "intent" and not bool(stages.get("error"))
-                utts.append(Utt(scenario, lat, ok, stages))
+                itype = None
+                if ev["type"] == "intent":
+                    intents = (ev.get("data") or {}).get("intents") or []
+                    if intents:
+                        itype = intents[0].get("type")
+                utts.append(Utt(scenario, lat, ok, stages, expected=expected,
+                                itype=itype, degraded=bool(ev.get("degraded"))))
             else:
                 # never answered inside the timeout: an error sample at the
                 # full wait — unanswered utterances must cost SLO budget
                 utts.append(Utt(scenario, (time.monotonic() - t0) * 1e3,
-                                False, None))
+                                False, None, expected=expected))
         return utts
 
 
@@ -427,7 +465,7 @@ async def _typed_round(ws, scenario: str, texts: list[str], think_s: float,
                            and lg.count("latency_budget") >= w, timeout_s)
             if think_s:
                 await asyncio.sleep(think_s)
-    return log.mine(scenario, t0s)
+    return log.mine(scenario, t0s, texts=texts)
 
 
 async def _audio_round(ws, scenario: str, n_utts: int, frames_per_final: int,
@@ -667,8 +705,29 @@ def run_swarm(voice_url: str, n_sessions: int, *, utterances: int = 4,
             if xs:
                 stage_split[key] = {"p50": _pctl(xs, 0.50), "p99": _pctl(xs, 0.99)}
         entry["stages"] = stage_split
+        # per-scenario quality mining (ISSUE 15): of the utterances whose
+        # command has a designed primary intent type, what fraction came
+        # back right — and what fraction of intent events were degraded.
+        # A capacity number that silently traded accuracy for latency now
+        # shows it in the same verdict.
+        scored = [u for u in agg["utts"] if u.expected is not None
+                  and u.itype is not None]
+        answered = [u for u in agg["utts"] if u.itype is not None]
+        if scored or answered:
+            entry["quality"] = {
+                "scored": len(scored),
+                "type_match": (round(sum(u.itype == u.expected
+                                         for u in scored) / len(scored), 4)
+                               if scored else None),
+                "degraded": (round(sum(u.degraded for u in answered)
+                                   / len(answered), 4) if answered else None),
+            }
         scen_out[sc] = entry
 
+    all_utts = [u for a in per_scenario.values() for u in a["utts"]]
+    all_scored = [u for u in all_utts
+                  if u.expected is not None and u.itype is not None]
+    all_answered = [u for u in all_utts if u.itype is not None]
     return {
         "n_sessions": n_sessions,
         "utterances": sum(len(a["utts"]) for a in per_scenario.values()),
@@ -678,6 +737,17 @@ def run_swarm(voice_url: str, n_sessions: int, *, utterances: int = 4,
         "aborted_sessions": total_aborted,
         "slo": slo.evaluate(),
         "scenarios": scen_out,
+        # run-level quality roll-up (ISSUE 15): mined from the typed
+        # scenarios' intent events against their designed primary types
+        "quality": {
+            "scored": len(all_scored),
+            "type_match": (round(sum(u.itype == u.expected
+                                     for u in all_scored) / len(all_scored), 4)
+                           if all_scored else None),
+            "degraded": (round(sum(u.degraded for u in all_answered)
+                               / len(all_answered), 4)
+                         if all_answered else None),
+        },
         "saturation": attribute_saturation(sampler.samples),
     }
 

@@ -58,6 +58,13 @@ class GenerationResult:
     # request rode (speculative decoding; 0 = no drafts landed or spec
     # off) — steps = spec_accepted + bonus/plain tokens, so per-request
     # accept effectiveness is (steps - spec_accepted) vs forwards
+    prompt_tokens: int = 0  # prompt length in tokens — with cached_tokens
+    # it yields the outstanding-prefill measurement the voice service's
+    # endpoint gauge needs (ISSUE 15 satellite)
+    quality: dict | None = None  # per-request confidence vector (ISSUE 15):
+    # masked-logit margin mean/min, entropy mean, grammar-forced fraction,
+    # decision count — None when the quality lanes are off or no decision
+    # was sampled (utils.quality.conf_summary builds it)
 
     @property
     def tokens_per_s(self) -> float:
@@ -109,6 +116,78 @@ def _mask_sample_advance(logits, fsm_state, tables: DeviceFSM, key, temperature,
     if constrained:
         fsm_state = jnp.take_along_axis(row, tok[:, None], axis=-1)[:, 0]
     return tok, fsm_state
+
+
+# margin assigned to a forced decision (one legal token: the gap is +inf;
+# the cap keeps windowed means finite and comparable across grammars)
+QUALITY_MARGIN_CAP = 30.0
+
+
+def _conf_stats(raw, state, tables: DeviceFSM, constrained: bool, logit_mask):
+    """Masked-logit confidence of ONE sampling decision per row — the
+    quality observatory's intent lanes (ISSUE 15): top1−top2 margin of the
+    masked logits, entropy of the masked softmax, and the forced flag
+    (grammar leaves a single legal token). THE one copy shared by the
+    dense/paged chunk loops and the spec verify commit (jit-inlined at
+    every call site). Pure readback arithmetic over values the loops
+    already computed — nothing feeds back into sampling, so tokens are
+    identical with the lanes on or off (tests/test_quality.py holds that
+    differentially per plane)."""
+    lg = raw.astype(jnp.float32)
+    if logit_mask is not None:
+        lg = jnp.where(logit_mask[None, :], lg, -jnp.inf)
+    if constrained:
+        row = fsm_row(tables, jnp.maximum(state, 0))
+        legal = (row >= 0) & (state >= 0)[:, None]
+        lg = jnp.where(legal, lg, -jnp.inf)
+        nlegal = jnp.sum(legal, axis=-1)
+    else:
+        nlegal = jnp.sum(jnp.isfinite(lg), axis=-1)
+    return _masked_conf(lg, nlegal)
+
+
+def _masked_conf(lg, nlegal):
+    """The reduction half of ``_conf_stats`` over ALREADY-masked f32
+    logits — the spec verify tail calls this directly on the per-position
+    masked logits it builds anyway (re-deriving the mask per position
+    would double the verify tail's vocab work)."""
+    top2 = jax.lax.top_k(lg, 2)[0]
+    margin = jnp.where(jnp.isfinite(top2[:, 1]),
+                       jnp.minimum(top2[:, 0] - top2[:, 1], QUALITY_MARGIN_CAP),
+                       QUALITY_MARGIN_CAP)
+    # a dead row (no legal token at all) carries no signal; it is fenced
+    # by the poison gate anyway — zero keeps the lane NaN-free
+    margin = jnp.where(jnp.isfinite(top2[:, 0]), margin, 0.0)
+    p = jax.nn.softmax(lg, axis=-1)
+    ent = -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-30)), 0.0),
+                   axis=-1)
+    ent = jnp.where(jnp.isfinite(top2[:, 0]), ent, 0.0)
+    return margin, ent, nlegal <= 1
+
+
+def _conf_accumulate(conf, ok, margin, ent, forced_one, forced_extra=None):
+    """Fold one decision into the per-row conf lanes ``(margin_sum,
+    margin_min, entropy_sum, forced, decisions)``. ``forced_extra`` adds
+    grammar-forced chain tokens (ff / spec positions count elsewhere)."""
+    msum, mmin, esum, forced, cnt = conf
+    msum = msum + jnp.where(ok, margin, 0.0)
+    mmin = jnp.where(ok, jnp.minimum(mmin, margin), mmin)
+    esum = esum + jnp.where(ok, ent, 0.0)
+    forced = forced + jnp.where(ok & forced_one, 1, 0)
+    if forced_extra is not None:
+        forced = forced + forced_extra
+    cnt = cnt + ok.astype(jnp.int32)
+    return msum, mmin, esum, forced, cnt
+
+
+def _conf_init(B):
+    """Fresh per-row conf lanes (margin_min starts at +inf; the host
+    readback treats inf as 'no decisions')."""
+    return (jnp.zeros((B,), jnp.float32),
+            jnp.full((B,), jnp.inf, jnp.float32),
+            jnp.zeros((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), jnp.int32))
 
 
 def _poison_gate(raw, state, state_next, active, poison, constrained: bool):
@@ -275,7 +354,8 @@ def chain_byte_cap(k, chain, cur_tok, nbytes, byte_len_table, byte_budget):
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained", "kernels",
-                     "eos_id", "pad_id", "unroll", "fwd", "max_len"),
+                     "eos_id", "pad_id", "unroll", "fwd", "max_len",
+                     "quality_lanes"),
     donate_argnames=("cache",),
 )
 def chunk_decode_loop(
@@ -311,6 +391,10 @@ def chunk_decode_loop(
     max_len: int | None = None,  # cache capacity; None = dense layout's
     # cache["k"].shape[2] (a non-dense layout MUST pass it — the staged pp
     # cache has batch at axis 2)
+    quality_lanes: bool = False,  # ISSUE 15: accumulate per-row masked-
+    # logit margin/entropy/forced lanes for the quality observatory. Pure
+    # readback arithmetic — sampling is untouched, tokens identical either
+    # way (differential-tested); False keeps the lanes as inert zeros.
 ):
     """THE decode loop: advance every active row by up to chunk_steps tokens
     entirely on device.
@@ -357,14 +441,15 @@ def chunk_decode_loop(
 
     carry0 = (cache, cur, pos, fsm_state, active, eos0, nbytes, tokens_left, out,
               jnp.zeros((B,), jnp.int32), key, jnp.zeros((), jnp.int32),
-              jnp.zeros((B,), jnp.int32))
+              jnp.zeros((B,), jnp.int32), _conf_init(B))
 
     def cond(c):
         active, step = c[4], c[11]
         return jnp.logical_and(step < chunk_steps, jnp.any(active))
 
     def body(c):
-        cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step, poison = c
+        (cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step,
+         poison, conf) = c
         # record current token for active rows
         out = out.at[jnp.arange(B), jnp.minimum(n, cap - 1)].set(
             jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, cap - 1)])
@@ -394,6 +479,10 @@ def chunk_decode_loop(
         # faulty sample; healthy rows commit exactly as before (ok==active)
         ok, poison = _poison_gate(raw, state, state_next, active, poison,
                                   constrained)
+        if quality_lanes:
+            mg, en, f1 = _conf_stats(raw, state, tables, constrained,
+                                     logit_mask)
+            conf = _conf_accumulate(conf, ok, mg, en, f1)
         state = jnp.where(ok, state_next, state)
         cur = jnp.where(ok, nxt, cur)
         pos = jnp.where(ok, pos + 1, pos)
@@ -402,10 +491,11 @@ def chunk_decode_loop(
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_len - 1) | (left <= 0)
         active = ok & ~stop
         return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key,
-                step + 1, poison)
+                step + 1, poison, conf)
 
     def ff_body(c):
-        cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step, poison = c
+        (cache, cur, pos, state, active, eos, nbytes, left, out, n, key, step,
+         poison, conf) = c
         # dead-at-entry rows must not fast-forward: ff_tokens[state] with a
         # negative state wraps to an arbitrary chain — fence them out of
         # this step's emission entirely (their result is discarded anyway)
@@ -467,6 +557,14 @@ def chunk_decode_loop(
         )
         ok, poison = _poison_gate(logits_k, s_end, state_next, active,
                                   poison, constrained)
+        if quality_lanes:
+            # the sampled decision at the chain's end, plus the emitted
+            # chain tokens themselves counted as grammar-forced (their
+            # margin is definitionally the cap; only the count matters)
+            mg, en, f1 = _conf_stats(logits_k, s_end, tables, constrained,
+                                     logit_mask)
+            conf = _conf_accumulate(conf, ok, mg, en, f1,
+                                    forced_extra=jnp.where(active, k, 0))
         state = jnp.where(ok, state_next, state)
         cur = jnp.where(ok, nxt, cur)
         pos = jnp.where(ok, pos + 1 + k, pos)
@@ -475,13 +573,14 @@ def chunk_decode_loop(
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_len - 1) | (left <= 0)
         active = ok & ~stop
         return (cache, cur, pos, state, active, eos, nbytes, left, out, n, key,
-                step + 1, poison)
+                step + 1, poison, conf)
 
-    (cache, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds, poison) = (
+    (cache, cur, pos, state, active, eos, nbytes, left, out, n, _, fwds, poison,
+     conf) = (
         jax.lax.while_loop(cond, ff_body if use_ff else body, carry0)
     )
     return (out[:, :cap], n, eos, cache, cur, pos, state, active, nbytes, left,
-            fwds, poison)
+            fwds, poison, conf)
 
 
 class DecodeEngine:
@@ -516,6 +615,10 @@ class DecodeEngine:
         # (draft K + one-pass verify). None keeps the decode path
         # byte-identical to pre-speculation; greedy constrained decode
         # routes through SpecDecoder when set (spec supersedes ff there)
+        quality_lanes: bool | None = None,  # ISSUE 15 confidence lanes in
+        # the decode loops (margin/entropy/forced readbacks). None reads
+        # QUALITY_ENABLE; tokens are identical on or off — the flag only
+        # decides whether the readback arithmetic is traced at all
     ):
         if kernels == "auto":
             # on a mesh the kernels run per-shard under shard_map (batch
@@ -575,6 +678,11 @@ class DecodeEngine:
         self.batch_slots = batch_slots
         self.decode_unroll = decode_unroll
         self.prefill_buckets = tuple(b for b in prefill_buckets if b <= max_len)
+        if quality_lanes is None:
+            from ..utils.quality import quality_lanes_enabled
+
+            quality_lanes = quality_lanes_enabled()
+        self.quality_lanes = bool(quality_lanes)
 
         key = jax.random.PRNGKey(seed)
         if mesh is not None:
@@ -903,27 +1011,33 @@ class DecodeEngine:
             return self.spec.decode_chunk(
                 cur, pos, fsm, active, nbytes, tokens_left, key,
                 temperature, byte_budget, chunk_steps)
-        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds, pois = (
-            chunk_decode_loop(
-                self.params, self.cfg, self.cache,
-                cur, pos, fsm, active, nbytes, tokens_left,
-                self.tables_ff if self.tables_ff is not None else self.tables,
-                self.byte_len_table,
-                key, jnp.float32(temperature), jnp.int32(byte_budget),
-                rules=self.rules, logit_mask=self.logit_mask,
-                nan_inject=self._take_nan_inject(),
-                chunk_steps=chunk_steps,
-                greedy=greedy, constrained=True, kernels=self.kernels,
-                eos_id=self.eos_id, pad_id=self.pad_id, unroll=self.decode_unroll,
+        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds, \
+            pois, conf = (
+                chunk_decode_loop(
+                    self.params, self.cfg, self.cache,
+                    cur, pos, fsm, active, nbytes, tokens_left,
+                    self.tables_ff if self.tables_ff is not None else self.tables,
+                    self.byte_len_table,
+                    key, jnp.float32(temperature), jnp.int32(byte_budget),
+                    rules=self.rules, logit_mask=self.logit_mask,
+                    nan_inject=self._take_nan_inject(),
+                    chunk_steps=chunk_steps,
+                    greedy=greedy, constrained=True, kernels=self.kernels,
+                    eos_id=self.eos_id, pad_id=self.pad_id,
+                    unroll=self.decode_unroll,
+                    quality_lanes=self.quality_lanes,
+                )
             )
-        )
         # forward-dispatch count for the chunk (device scalar; the batcher
         # folds it into its one combined readback): the denominator that
         # keeps tokens-per-forward gauges truthful under multi-token steps.
         # _last_poison rides the same transfer: per-row fault codes the
-        # scheduler's quarantine evicts on (0 ok / 1 NaN / 2 dead FSM)
+        # scheduler's quarantine evicts on (0 ok / 1 NaN / 2 dead FSM).
+        # _last_conf: the ISSUE 15 per-row confidence lanes (margin/entropy/
+        # forced/decisions), same readback contract — None when off.
         self._last_fwds = fwds
         self._last_poison = pois
+        self._last_conf = conf if self.quality_lanes else None
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
     def _take_nan_inject(self):
@@ -1037,7 +1151,8 @@ class DecodeEngine:
         t1 = time.perf_counter()
         self._rng, key = jax.random.split(self._rng)
         tables = self.tables_ff if (constrained and self.tables_ff is not None) else self.tables
-        buf, count, eos, self.cache, *rest = chunk_decode_loop(
+        (buf, count, eos, self.cache, _cur, _pos, _fsm, _act, _nb, _left,
+         fwds, pois_d, conf) = chunk_decode_loop(
             self.params, self.cfg, self.cache,
             tok0, jnp.full((1,), n, dtype=jnp.int32), fsm0,
             tok0 != (-1 if ignore_eos else self.eos_id),  # active
@@ -1050,14 +1165,20 @@ class DecodeEngine:
             greedy=greedy, constrained=constrained, kernels=self.kernels,
             eos_id=-1 if ignore_eos else self.eos_id,
             pad_id=self.pad_id, unroll=self.decode_unroll,
+            quality_lanes=self.quality_lanes,
         )
-        buf_h, count_h_a, eos_h, fwds_h, pois_h = jax.device_get(
-            (buf, count, eos, rest[-2], rest[-1]))
+        buf_h, count_h_a, eos_h, fwds_h, pois_h, conf_h = jax.device_get(
+            (buf, count, eos, fwds, pois_d, conf))
         count_h = int(count_h_a[0])
         out_ids = [int(t) for t in np.asarray(buf_h)[0, :count_h]]
         finished = bool(eos_h[0])
         decode_ms = (time.perf_counter() - t1) * 1e3
         pois = int(np.asarray(pois_h)[0])
+        quality = None
+        if self.quality_lanes:
+            from ..utils.quality import conf_summary
+
+            quality = conf_summary([np.asarray(x)[0] for x in conf_h], count_h)
 
         from ..utils import get_metrics
 
@@ -1081,6 +1202,8 @@ class DecodeEngine:
                    "poisoned: " + ("non-finite logits" if pois == 1
                                    else "grammar dead state")),
             forwards=int(fwds_h),
+            prompt_tokens=n,
+            quality=quality,
         )
 
     def _generate_spec(
@@ -1107,6 +1230,7 @@ class DecodeEngine:
         finished = False
         forwards = 0
         pois = 0
+        conf_acc = None
         while True:
             (out, n_c, eos, cur, pos, fsm, active, nbytes, left) = \
                 self.decode_chunk(cur, pos, fsm, active, nbytes, left, None,
@@ -1116,6 +1240,13 @@ class DecodeEngine:
             out_ids.extend(int(t) for t in np.asarray(out_h)[0, : int(n_h[0])])
             finished = finished or bool(eos_h[0])
             forwards += self.spec.last_chunk_forwards
+            lc = getattr(self, "_last_conf", None)
+            if lc is not None:
+                # per-chunk conf lanes (the spec decoder publishes host
+                # arrays): one fold rule, utils.quality.conf_fold
+                from ..utils.quality import conf_fold
+
+                conf_acc = conf_fold(conf_acc, lc)
             # the verify step carries the same per-row fault codes as the
             # chunk loops — surface them as the typed error generate() does
             lp = getattr(self, "_last_poison", None)
@@ -1134,6 +1265,11 @@ class DecodeEngine:
         m.observe_ms("engine.prefill", prefill_ms)
         m.observe_ms("engine.decode", decode_ms)
 
+        quality = None
+        if conf_acc is not None:
+            from ..utils.quality import conf_summary
+
+            quality = conf_summary([x[0] for x in conf_acc], len(out_ids))
         return GenerationResult(
             text=self.tokenizer.decode(out_ids),
             token_ids=out_ids,
@@ -1145,6 +1281,8 @@ class DecodeEngine:
                    "poisoned: " + ("non-finite logits" if pois == 1
                                    else "grammar dead state")),
             forwards=forwards,
+            prompt_tokens=n,
+            quality=quality,
         )
 
     def generate_stepwise(

@@ -39,7 +39,14 @@ import numpy as np
 from ..grammar.fsm import fsm_advance
 from ..models.llama import forward_paged
 from ..utils.compilewatch import get_compile_watcher, watch_compiles
-from .engine import DecodeEngine, _mask_sample_advance, _poison_gate
+from .engine import (
+    DecodeEngine,
+    _conf_accumulate,
+    _conf_init,
+    _conf_stats,
+    _mask_sample_advance,
+    _poison_gate,
+)
 from .radix import RadixCache
 
 
@@ -251,7 +258,8 @@ def _scatter_scale_planes(k_scale, v_scale, src_k, src_v, dst_idx):
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "chunk_steps", "greedy", "constrained",
-                     "kernels", "eos_id", "pad_id", "max_len", "kv_quant"),
+                     "kernels", "eos_id", "pad_id", "max_len", "kv_quant",
+                     "quality_lanes"),
     donate_argnames=("k_pool", "v_pool", "k_scale", "v_scale"),
 )
 def paged_chunk_decode_loop(
@@ -281,6 +289,7 @@ def paged_chunk_decode_loop(
     pad_id: int = 0,
     max_len: int | None = None,
     kv_quant: str | None = None,
+    quality_lanes: bool = False,  # ISSUE 15 conf lanes (see the dense twin)
 ):
     """chunk_decode_loop's paged twin: forward_paged per step, idle rows'
     writes parked in their group's reserved trash block via write_mask (they
@@ -315,7 +324,8 @@ def paged_chunk_decode_loop(
     carry0 = (k_pool, v_pool, k_scale, v_scale, cur, pos, fsm_state, active,
               eos0, nbytes,
               tokens_left, out, jnp.zeros((B,), jnp.int32), key,
-              jnp.zeros((), jnp.int32), jnp.zeros((B,), jnp.int32))
+              jnp.zeros((), jnp.int32), jnp.zeros((B,), jnp.int32),
+              _conf_init(B))
 
     def cond(c):
         active, step = c[7], c[14]
@@ -323,7 +333,7 @@ def paged_chunk_decode_loop(
 
     def body(c):
         (kp, vp, ksc, vsc, cur, pos, state, active, eos, nbytes, left, out, n,
-         key, step, poison) = c
+         key, step, poison, conf) = c
         out = out.at[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)].set(
             jnp.where(active, cur, out[jnp.arange(B), jnp.minimum(n, chunk_steps - 1)])
         )
@@ -349,6 +359,10 @@ def paged_chunk_decode_loop(
         )
         ok, poison = _poison_gate(raw, state, state_next, active, poison,
                                   constrained)
+        if quality_lanes:
+            mg, en, f1 = _conf_stats(raw, state, tables, constrained,
+                                     logit_mask)
+            conf = _conf_accumulate(conf, ok, mg, en, f1)
         state = jnp.where(ok, state_next, state)
         cur = jnp.where(ok, nxt, cur)
         pos = jnp.where(ok, pos + 1, pos)
@@ -357,7 +371,7 @@ def paged_chunk_decode_loop(
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_pos - 1) | (left <= 0)
         active = ok & ~stop
         return (kp, vp, ksc, vsc, cur, pos, state, active, eos, nbytes, left,
-                out, n, key, step + 1, poison)
+                out, n, key, step + 1, poison, conf)
 
     def ff_body(c):
         # the dense ff_body's paged twin: cur + its state's forced chain in
@@ -369,7 +383,7 @@ def paged_chunk_decode_loop(
         # the engine's decode_chunk grew every live row's table to cover a
         # full ff chunk before dispatch.
         (kp, vp, ksc, vsc, cur, pos, state, active, eos, nbytes, left, out, n,
-         key, step, poison) = c
+         key, step, poison, conf) = c
         # dead-at-entry fence (see the dense ff_body): a negative state
         # wraps the ff_tokens gather — poison it out before it emits
         dead_in = active & (state < 0)
@@ -432,6 +446,11 @@ def paged_chunk_decode_loop(
         )
         ok, poison = _poison_gate(logits_k, s_end, state_next, active,
                                   poison, constrained)
+        if quality_lanes:
+            mg, en, f1 = _conf_stats(logits_k, s_end, tables, constrained,
+                                     logit_mask)
+            conf = _conf_accumulate(conf, ok, mg, en, f1,
+                                    forced_extra=jnp.where(active, k, 0))
         state = jnp.where(ok, state_next, state)
         cur = jnp.where(ok, nxt, cur)
         pos = jnp.where(ok, pos + 1 + k, pos)
@@ -440,15 +459,15 @@ def paged_chunk_decode_loop(
         stop = (cur == eos_id) | (nbytes >= byte_budget) | (pos >= max_pos - 1) | (left <= 0)
         active = ok & ~stop
         return (kp, vp, ksc, vsc, cur, pos, state, active, eos, nbytes, left,
-                out, n, key, step + 1, poison)
+                out, n, key, step + 1, poison, conf)
 
     (k_pool, v_pool, k_scale, v_scale, cur, pos, state, active, eos, nbytes,
-     left, out, n, _, fwds, poison) = (
+     left, out, n, _, fwds, poison, conf) = (
         jax.lax.while_loop(cond, ff_body if use_ff else body, carry0)
     )
     return (out[:, : cap if use_ff else chunk_steps], n, eos, k_pool, v_pool,
             k_scale, v_scale, cur, pos, state, active, nbytes, left, fwds,
-            poison)
+            poison, conf)
 
 
 class PagedDecodeEngine(DecodeEngine):
@@ -898,7 +917,7 @@ class PagedDecodeEngine(DecodeEngine):
                     continue
                 self._next_pos[b] = min(self._next_pos[b] + span, self.max_len)
         out, n, eos, self.k_pool, self.v_pool, self.k_scale, self.v_scale, \
-            cur, pos, fsm, active, nbytes, left, fwds, pois = (
+            cur, pos, fsm, active, nbytes, left, fwds, pois, conf = (
                 paged_chunk_decode_loop(
                     self.params, self.cfg, self.k_pool, self.v_pool, self.block_tables,
                     cur, pos, fsm, active, nbytes, tokens_left,
@@ -913,14 +932,17 @@ class PagedDecodeEngine(DecodeEngine):
                     greedy=greedy, constrained=True, kernels=self.kernels,
                     eos_id=self.eos_id, pad_id=self.pad_id, max_len=self.max_len,
                     kv_quant=self.kv_quant,
+                    quality_lanes=self.quality_lanes,
                 )
             )
         # forward-dispatch count for the scheduler's tokens-per-forward
         # gauge (rides its combined readback) — without it the gauge is
         # silently absent on the paged layout while ff multi-emits there too.
-        # _last_poison rides the same readback (quarantine fault codes).
+        # _last_poison rides the same readback (quarantine fault codes);
+        # _last_conf the ISSUE 15 confidence lanes (None when off).
         self._last_fwds = fwds
         self._last_poison = pois
+        self._last_conf = conf if self.quality_lanes else None
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
     def spec_grow(self, span: int, active=None) -> list[int]:

@@ -308,7 +308,7 @@ class LongSessionPlanner:
             tables = (self.tables_ff
                       if batched_ff_ok and self.tables_ff is not None
                       else self.tables)
-            buf, count, eos, cache, cur, pos, _, _, _, _, _, _ = chunk_decode_loop(
+            buf, count, eos, cache, cur, pos, _, _, _, _, _, _, _ = chunk_decode_loop(
                 self.params, self.cfg, cache,
                 tok0, pos0, fsm0,
                 live & (tok0 != self.eos_id),

@@ -286,22 +286,26 @@ class PPDecodeEngine(DecodeEngine):
         # writes + full-mask attend handle any T), emitting chain tokens
         # without extra full-cache reads
         tables = self.tables_ff if self.tables_ff is not None else self.tables
-        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds, pois = chunk_decode_loop(
-            self.params, self.cfg, self.cache,
-            cur, pos, fsm, active, nbytes, tokens_left,
-            tables, self.byte_len_table,
-            key, jnp.float32(temperature), jnp.int32(byte_budget),
-            rules=None, logit_mask=self.logit_mask,
-            chunk_steps=chunk_steps,
-            greedy=greedy, constrained=True, kernels="xla",
-            eos_id=self.eos_id, pad_id=self.pad_id,
-            fwd=self._fwd, max_len=self.max_len,
-        )
+        out, n, eos, self.cache, cur, pos, fsm, active, nbytes, left, fwds, \
+            pois, conf = chunk_decode_loop(
+                self.params, self.cfg, self.cache,
+                cur, pos, fsm, active, nbytes, tokens_left,
+                tables, self.byte_len_table,
+                key, jnp.float32(temperature), jnp.int32(byte_budget),
+                rules=None, logit_mask=self.logit_mask,
+                chunk_steps=chunk_steps,
+                greedy=greedy, constrained=True, kernels="xla",
+                eos_id=self.eos_id, pad_id=self.pad_id,
+                fwd=self._fwd, max_len=self.max_len,
+                quality_lanes=self.quality_lanes,
+            )
         # forward-dispatch count: the scheduler's tokens-per-forward gauge
         # reads this off the chunk's combined device_get; _last_poison
         # carries the per-row quarantine fault codes on the same transfer
+        # (_last_conf: the ISSUE 15 confidence lanes ride it too)
         self._last_fwds = fwds
         self._last_poison = pois
+        self._last_conf = conf if self.quality_lanes else None
         return out, n, eos, cur, pos, fsm, active, nbytes, left
 
     def generate(self, *a, **kw):

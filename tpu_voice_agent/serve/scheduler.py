@@ -69,6 +69,13 @@ class _Slot:
     spec_accepts: int = 0  # draft tokens accepted for this request (spec
     # engines report per-row accept counts on the same widened readback)
     eos: bool = False
+    # ISSUE 15 conf lanes accumulated across chunks (engines report per-row
+    # margin/entropy/forced/decision lanes on the combined readback)
+    conf_msum: float = 0.0
+    conf_mmin: float = float("inf")
+    conf_esum: float = 0.0
+    conf_forced: int = 0
+    conf_cnt: int = 0
 
 
 class ContinuousBatcher:
@@ -531,13 +538,17 @@ class ContinuousBatcher:
         # for the quarantine below.
         fwds = getattr(eng, "_last_fwds", None)
         pois = getattr(eng, "_last_poison", None)
-        out_h, n_h, act_h, eos_h, pos_h, fwds_h, pois_h = (
-            np.asarray(x)
-            for x in jax.device_get(
+        conf = getattr(eng, "_last_conf", None)
+        out_h, n_h, act_h, eos_h, pos_h, fwds_h, pois_h, conf_h = (
+            jax.device_get(
                 (out, n, active, eos, pos,
                  0 if fwds is None else fwds,
-                 0 if pois is None else pois))
+                 0 if pois is None else pois,
+                 0 if conf is None else conf))
         )
+        out_h, n_h, act_h, eos_h, pos_h, fwds_h, pois_h = (
+            np.asarray(x) for x in (out_h, n_h, act_h, eos_h, pos_h, fwds_h,
+                                    pois_h))
         timer.lap("readback")
         if epoch != self._epoch:
             # the watchdog warm-restarted the engine while this step was
@@ -603,6 +614,10 @@ class ContinuousBatcher:
         # request's speculation multiplier) and ``spec_accepted``
         row_fwds = getattr(eng, "_last_row_fwds", None)
         row_accepts = getattr(eng, "_last_accepts", None)
+        # ISSUE 15 conf lanes: per-row (margin_sum, margin_min, entropy_sum,
+        # forced, decisions) folded into per-request accounting so finished
+        # results carry an honest quality vector
+        conf_arr = None if conf is None else [np.asarray(x) for x in conf_h]
 
         pois_arr = None if pois is None else pois_h
         for b in range(self.B):
@@ -631,9 +646,17 @@ class ContinuousBatcher:
                 sl.forwards += int(row_fwds[b])
             if row_accepts is not None:
                 sl.spec_accepts += int(row_accepts[b])
+            if conf_arr is not None:
+                sl.conf_msum += float(conf_arr[0][b])
+                sl.conf_mmin = min(sl.conf_mmin, float(conf_arr[1][b]))
+                sl.conf_esum += float(conf_arr[2][b])
+                sl.conf_forced += int(conf_arr[3][b])
+                sl.conf_cnt += int(conf_arr[4][b])
             if not act_h[b]:
                 # slot stopped this chunk: clean EOS, or truncation by
                 # byte/token/length budget (eos flag distinguishes them)
+                from ..utils.quality import conf_summary
+
                 self.results[sl.request_id] = GenerationResult(
                     text=self.engine.tokenizer.decode(sl.token_ids),
                     token_ids=list(sl.token_ids),
@@ -649,6 +672,10 @@ class ContinuousBatcher:
                     cached_tokens=sl.cached_tokens,
                     forwards=sl.forwards,
                     spec_accepted=sl.spec_accepts,
+                    prompt_tokens=sl.prompt_len,
+                    quality=conf_summary(
+                        (sl.conf_msum, sl.conf_mmin, sl.conf_esum,
+                         sl.conf_forced, sl.conf_cnt), len(sl.token_ids)),
                 )
                 m.inc("scheduler.requests_completed")
                 m.observe_ms("scheduler.request_total",

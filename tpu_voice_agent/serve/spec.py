@@ -77,7 +77,14 @@ from ..grammar.fsm import DeviceFSM, fsm_advance, fsm_row
 from ..models.llama import PRESETS, forward, forward_paged, init_kv_cache, init_params
 from ..utils.compilewatch import watch_compiles
 from ..utils.envcfg import env_bool, env_int, env_str
-from .engine import chain_block, chain_byte_cap, prefill_row
+from .engine import (
+    _conf_init,
+    _conf_stats,
+    _masked_conf,
+    chain_block,
+    chain_byte_cap,
+    prefill_row,
+)
 
 
 # ---------------------------------------------------------------- config
@@ -122,7 +129,8 @@ def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
                    draft_toks, dl, step_tok, blk_tok, tables: DeviceFSM,
                    byte_len_table, byte_budget, logit_mask, K: int,
                    eos_id: int, pad_id: int, max_pos,
-                   kernels: str = "xla", rules=None):
+                   kernels: str = "xla", rules=None,
+                   quality_lanes: bool = False):
     """Post-forward half of a verify step — THE one copy shared by the
     dense and paged jitted steps (jit-inlined at both call sites): FSM scan
     along the draft path, masked greedy per position, longest-prefix
@@ -145,6 +153,7 @@ def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
     _, states_rest = jax.lax.scan(sstep, fsm_state, draft_toks.T)  # (K, B)
     states = jnp.concatenate([fsm_state[None, :], states_rest], axis=0)
 
+    conf_pos: list[tuple] = []  # per-position (margin, ent, forced_one)
     if kernels == "pallas" and tables.dense_mask is not None:
         # fused verify tail (ISSUE 12): every position's grammar mask +
         # argmax in ONE Pallas call (ops.masked_argmax_block folds the
@@ -161,10 +170,24 @@ def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
         g = sharded_masked_argmax_block(
             mesh, logits, states.T, tables.dense_mask)  # (B, K+1)
         g = jnp.where((states.T >= 0), g, 0)
+        if quality_lanes:
+            # the fused kernel yields tokens, not masked logits — the conf
+            # lanes re-derive them through the compressed path per position.
+            # This re-pays part of the vocab work the kernel fused away,
+            # but the dense_mask branch only EXISTS for toy vocabs (the
+            # (S, V) mask must be small enough to materialize), so the
+            # absolute cost is bounded; teaching the kernel to emit
+            # top-2/entropy is the follow-up if a real-vocab fused tail
+            # ever lands. QUALITY_ENABLE=0 removes it entirely.
+            conf_pos = [_conf_stats(logits[:, i, :], states[i], tables,
+                                    True, logit_mask)
+                        for i in range(K + 1)]
     else:
         # target greedy per position under the SAME masks as the plain path
         # (logit_mask then grammar row) — identical argmax, one position at
-        # a time to keep the (B, V) mask footprint of the non-spec step
+        # a time to keep the (B, V) mask footprint of the non-spec step.
+        # The conf lanes reduce the SAME masked logits (engine._masked_conf)
+        # instead of re-masking per position — near-zero extra vocab work.
         gs = []
         for i in range(K + 1):
             s_i = states[i]
@@ -172,8 +195,12 @@ def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
             if logit_mask is not None:
                 lg = jnp.where(logit_mask[None, :], lg, -jnp.inf)
             row = fsm_row(tables, jnp.maximum(s_i, 0))
-            lg = jnp.where((row >= 0) & (s_i >= 0)[:, None], lg, -jnp.inf)
+            legal = (row >= 0) & (s_i >= 0)[:, None]
+            lg = jnp.where(legal, lg, -jnp.inf)
             gs.append(jnp.argmax(lg, axis=-1).astype(jnp.int32))
+            if quality_lanes:
+                conf_pos.append(_masked_conf(lg.astype(jnp.float32),
+                                             jnp.sum(legal, axis=-1)))
         g = jnp.stack(gs, axis=1)  # (B, K+1) target greedy choices
 
     # accept: d_{i+1} must equal the target's pick, never be EOS (the plain
@@ -230,15 +257,33 @@ def _verify_commit(logits, cur, pos, fsm_state, active, nbytes, tokens_left,
     stop = (new_cur == eos_id) | (nbytes >= byte_budget) \
         | (new_pos >= max_pos - 1) | (left <= 0)
     new_active = ok & ~stop
+    conf = _conf_init(active.shape[0])
+    if quality_lanes:
+        # ISSUE 15 conf lanes over the verify block: each position 0..a is
+        # one verified decision (accepted drafts ARE the target's masked
+        # greedy pick; position a is the bonus), scored at its own FSM
+        # state — the dense/paged chunk loops and this verify path share
+        # one readback contract like ``_last_fwds``. ``conf_pos`` was
+        # computed above on the masked logits the greedy pick already
+        # built; rejected positions (i > a) mask out of the fold here.
+        msum, mmin, esum, forced, cnt = conf
+        for i, (mg, en, f1) in enumerate(conf_pos):
+            sel = ok & (i <= a)
+            msum = msum + jnp.where(sel, mg, 0.0)
+            mmin = jnp.where(sel, jnp.minimum(mmin, mg), mmin)
+            esum = esum + jnp.where(sel, en, 0.0)
+            forced = forced + jnp.where(sel & f1, 1, 0)
+            cnt = cnt + sel.astype(jnp.int32)
+        conf = (msum, mmin, esum, forced, cnt)
     return (out, n_step, eos, new_cur, new_pos, new_state, new_active,
-            nbytes, left, a, dl, poison)
+            nbytes, left, a, dl, poison, conf)
 
 
 @watch_compiles("spec.spec_verify_step")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "K", "kernels", "eos_id", "pad_id",
-                     "unroll", "max_len"),
+                     "unroll", "max_len", "quality_lanes"),
     donate_argnames=("cache",),
 )
 def spec_verify_step(
@@ -265,6 +310,7 @@ def spec_verify_step(
     pad_id: int = 0,
     unroll: int = 1,
     max_len: int | None = None,
+    quality_lanes: bool = False,  # ISSUE 15 conf lanes (see engine twin)
 ):
     """ONE speculative step for every row: forward ``[cur, d_1..d_K]``,
     grammar-mask each position at its own FSM state, accept the longest
@@ -298,20 +344,20 @@ def spec_verify_step(
                            jnp.float32(jnp.nan), logits)
 
     (out, n_step, eos, new_cur, new_pos, new_state, new_active, nbytes, left,
-     a, dl, poison) = _verify_commit(
+     a, dl, poison, conf) = _verify_commit(
         logits, cur, pos, fsm_state, active, nbytes, tokens_left,
         draft_toks, dl, step_tok, blk_tok, tables, byte_len_table,
         byte_budget, logit_mask, K, eos_id, pad_id, max_len,
-        kernels=kernels, rules=rules)
+        kernels=kernels, rules=rules, quality_lanes=quality_lanes)
     return (out, n_step, eos, cache, new_cur, new_pos, new_state, new_active,
-            nbytes, left, a, dl, poison)
+            nbytes, left, a, dl, poison, conf)
 
 
 @watch_compiles("spec.paged_spec_verify_step")
 @partial(
     jax.jit,
     static_argnames=("cfg", "rules", "K", "kernels", "eos_id", "pad_id",
-                     "max_len", "kv_quant"),
+                     "max_len", "kv_quant", "quality_lanes"),
     donate_argnames=("k_pool", "v_pool", "k_scale", "v_scale"),
 )
 def paged_spec_verify_step(
@@ -346,6 +392,7 @@ def paged_spec_verify_step(
     pad_id: int = 0,
     max_len: int | None = None,
     kv_quant: str | None = None,
+    quality_lanes: bool = False,  # ISSUE 15 conf lanes (see engine twin)
 ):
     """spec_verify_step's paged twin — the batched verify mode of the paged
     chunk path (ISSUE 8): per-slot ``[cur, d_1..d_K]`` columns in ONE
@@ -381,13 +428,13 @@ def paged_spec_verify_step(
                            jnp.float32(jnp.nan), logits)
 
     (out, n_step, eos, new_cur, new_pos, new_state, new_active, nbytes, left,
-     a, dl, poison) = _verify_commit(
+     a, dl, poison, conf) = _verify_commit(
         logits, cur, pos, fsm_state, active, nbytes, tokens_left,
         draft_toks, dl, step_tok, blk_tok, tables, byte_len_table,
         byte_budget, logit_mask, K, eos_id, pad_id, max_pos,
-        kernels=kernels, rules=rules)
+        kernels=kernels, rules=rules, quality_lanes=quality_lanes)
     return (out, n_step, eos, k_pool, v_pool, k_scale, v_scale, new_cur,
-            new_pos, new_state, new_active, nbytes, left, a, dl, poison)
+            new_pos, new_state, new_active, nbytes, left, a, dl, poison, conf)
 
 
 # ---------------------------------------------------------------- drafters
@@ -752,6 +799,9 @@ class SpecDecoder:
         self.engine = engine
         self.cfg = cfg
         self.K = max(1, int(cfg.k))
+        # ISSUE 15: the verify steps carry the same conf lanes as the
+        # chunk loops (one readback contract across planes)
+        self.quality_lanes = bool(getattr(engine, "quality_lanes", False))
         self.drafter = drafter if drafter is not None else build_drafter(cfg, engine)
         self._ctx: list[list[int] | None] = [None] * engine.batch_slots
         self._prompt_len = [0] * engine.batch_slots
@@ -840,7 +890,7 @@ class SpecDecoder:
         if self.paged:
             (out, n, eosf, eng.k_pool, eng.v_pool, eng.k_scale, eng.v_scale,
              cur, pos, fsm, active,
-             nbytes, tokens_left, a, dl, pois) = paged_spec_verify_step(
+             nbytes, tokens_left, a, dl, pois, conf) = paged_spec_verify_step(
                 eng.params, eng.cfg, eng.k_pool, eng.v_pool,
                 eng.block_tables, cur, pos, fsm, active, nbytes, tokens_left,
                 jnp.asarray(dtoks, jnp.int32), jnp.asarray(dlen),
@@ -850,10 +900,10 @@ class SpecDecoder:
                 k_scale=eng.k_scale, v_scale=eng.v_scale,
                 K=self.K, kernels=eng.kernels, eos_id=eng.eos_id,
                 pad_id=eng.pad_id, max_len=eng.max_len,
-                kv_quant=eng.kv_quant)
+                kv_quant=eng.kv_quant, quality_lanes=self.quality_lanes)
         else:
             (out, n, eosf, eng.cache, cur, pos, fsm, active, nbytes,
-             tokens_left, a, dl, pois) = spec_verify_step(
+             tokens_left, a, dl, pois, conf) = spec_verify_step(
                 eng.params, eng.cfg, eng.cache, cur, pos, fsm, active,
                 nbytes, tokens_left,
                 jnp.asarray(dtoks, jnp.int32), jnp.asarray(dlen),
@@ -862,9 +912,9 @@ class SpecDecoder:
                 nan_inject=nan_inject,
                 K=self.K, kernels=eng.kernels, eos_id=eng.eos_id,
                 pad_id=eng.pad_id, unroll=eng.decode_unroll,
-                max_len=eng.max_len)
+                max_len=eng.max_len, quality_lanes=self.quality_lanes)
         return (out, n, eosf, cur, pos, fsm, active, nbytes, tokens_left,
-                a, dl, pois)
+                a, dl, pois, conf)
 
     def decode_chunk(self, cur, pos, fsm, active, nbytes, tokens_left, key,
                      temperature: float, byte_budget: int, chunk_steps: int):
@@ -895,6 +945,10 @@ class SpecDecoder:
         row_fwds = np.zeros((B,), np.int64)
         row_accepts = np.zeros((B,), np.int64)
         poison_h = np.zeros((B,), np.int32)
+        # per-row conf lanes accumulated across the chunk's verify steps
+        # (host arrays — each step pays its readback anyway); the fold
+        # rule is THE shared one, utils.quality.conf_fold
+        conf_acc = None
         for _ in range(chunk_steps):
             if not act_h.any() or self._gen != gen0:
                 break
@@ -923,7 +977,7 @@ class SpecDecoder:
                 for b in eng.spec_grow(1 + K, active=act_h):
                     tokens_left = tokens_left.at[b].set(0)
             (out, n, eosf, cur, pos, fsm, active, nbytes, tokens_left,
-             a, dl, pois) = self._verify(
+             a, dl, pois, conf) = self._verify(
                 cur, pos, fsm, active, nbytes, tokens_left, dtoks, dlen,
                 byte_budget, nan_inject)
             nan_inject = None
@@ -935,12 +989,19 @@ class SpecDecoder:
             # pos by 1, not 1+K; without the clamp the claims compound)
             prev_act = act_h
             (out_h, n_h, eos_h, cur_h, fsm_h, act_h, a_h, dl_h, pois_h,
-             pos_h) = (
-                np.asarray(x) for x in
+             pos_h, conf_h) = (
                 jax.device_get((out, n, eosf, cur, fsm, active, a, dl, pois,
-                                pos)))
+                                pos, conf)))
+            (out_h, n_h, eos_h, cur_h, fsm_h, act_h, a_h, dl_h, pois_h,
+             pos_h) = (np.asarray(x) for x in
+                       (out_h, n_h, eos_h, cur_h, fsm_h, act_h, a_h, dl_h,
+                        pois_h, pos_h))
             if self._gen != gen0:
                 break  # warm-restarted mid-step: discard, stop dispatching
+            if self.quality_lanes:
+                from ..utils.quality import conf_fold
+
+                conf_acc = conf_fold(conf_acc, conf_h)
             if self.paged:
                 eng.reconcile_coverage(pos_h)
             fwds += 1
@@ -977,6 +1038,16 @@ class SpecDecoder:
         eng._last_poison = poison_h
         eng._last_accepts = row_accepts
         eng._last_row_fwds = row_fwds
+        # the ISSUE 15 conf readback contract, spec plane: same tuple shape
+        # as the chunk loops publish, already host-side here (a chunk that
+        # ran zero verify steps publishes fresh zero lanes)
+        if self.quality_lanes:
+            eng._last_conf = tuple(
+                conf_acc if conf_acc is not None else
+                (np.zeros((B,)), np.full((B,), np.inf), np.zeros((B,)),
+                 np.zeros((B,), np.int64), np.zeros((B,), np.int64)))
+        else:
+            eng._last_conf = None
         self._steps += fwds
         self._drafted += drafted
         self._accepted += accepted

@@ -43,7 +43,8 @@ from ..models.whisper import (
 
 
 @watch_compiles("stt._stt_decode_loop")
-@partial(jax.jit, static_argnames=("cfg", "max_new", "eos_id", "pad_id", "attn_impl"),
+@partial(jax.jit, static_argnames=("cfg", "max_new", "eos_id", "pad_id",
+                                   "attn_impl", "quality_lanes"),
          donate_argnames=("self_cache",))
 def _stt_decode_loop(
     params,
@@ -59,6 +60,7 @@ def _stt_decode_loop(
     eos_id: int = 2,
     pad_id: int = 0,
     attn_impl: str = "xla",
+    quality_lanes: bool = False,
 ):
     """Greedy decode until EOS, fully on device. ONE implementation for the
     B=1 per-connection paths and the multi-stream batched plane
@@ -69,6 +71,12 @@ def _stt_decode_loop(
     live=None / max_new_each=None the behavior is exactly the historical
     single-stream loop, so the two planes cannot diverge.
 
+    ``quality_lanes`` (ISSUE 15) additionally accumulates the sampled
+    token's logprob per emitted token — (sum, min, first) per row ride the
+    same combined readback as the tokens, so STT confidence costs no extra
+    transfer and never perturbs the greedy pick (argmax of log_softmax IS
+    the argmax). False keeps the lanes as inert zeros.
+
     The decoder prompt is a (B, P) token block (the in-tree toy tokenizer
     uses a single BOS; real Whisper checkpoints need the
     <|startoftranscript|><|lang|><|task|><|notimestamps|> sequence)."""
@@ -77,13 +85,17 @@ def _stt_decode_loop(
     def pick(logits):
         if suppress is not None:
             logits = jnp.where(suppress[None, :], -jnp.inf, logits)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if not quality_lanes:
+            return tok, jnp.zeros((B,), jnp.float32)
+        lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return tok, jnp.take_along_axis(lsm, tok[:, None], axis=-1)[:, 0]
 
     pos0 = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (B, P))
     logits, self_cache = decoder_forward(
         params, cfg, bos, pos0, self_cache, cross_kv, enc_mask, attn_impl=attn_impl
     )
-    tok0 = pick(logits[:, P - 1, :])
+    tok0, lp0 = pick(logits[:, P - 1, :])
 
     budget = (jnp.full((B,), max_new, jnp.int32) if max_new_each is None
               else max_new_each.astype(jnp.int32))
@@ -91,31 +103,67 @@ def _stt_decode_loop(
     if live is not None:
         done0 = done0 | ~live
     out = jnp.full((B, max_new), pad_id, dtype=jnp.int32)
+    conf0 = (jnp.zeros((B,), jnp.float32),  # logprob sum over emitted
+             jnp.full((B,), jnp.inf, jnp.float32),  # logprob min
+             jnp.zeros((B,), jnp.float32))  # first emitted token's logprob
     carry0 = (self_cache, tok0, jnp.full((B,), P, jnp.int32), out,
-              jnp.zeros((B,), jnp.int32), done0, jnp.zeros((), jnp.int32))
+              jnp.zeros((B,), jnp.int32), done0, jnp.zeros((), jnp.int32),
+              lp0, conf0)
 
     def cond(c):
         done, step = c[5], c[6]
         return jnp.logical_and(step < max_new, ~jnp.all(done))
 
     def body(c):
-        cache, cur, pos, out, n, done, step = c
+        cache, cur, pos, out, n, done, step, cur_lp, conf = c
         live = ~done
         out = out.at[jnp.arange(B), jnp.minimum(n, max_new - 1)].set(
             jnp.where(live, cur, out[jnp.arange(B), jnp.minimum(n, max_new - 1)])
         )
+        if quality_lanes:
+            lp_sum, lp_min, lp_first = conf
+            conf = (lp_sum + jnp.where(live, cur_lp, 0.0),
+                    jnp.where(live, jnp.minimum(lp_min, cur_lp), lp_min),
+                    jnp.where(live & (n == 0), cur_lp, lp_first))
         n = n + live.astype(jnp.int32)
         logits, cache = decoder_forward(
             params, cfg, cur[:, None], pos[:, None], cache, cross_kv, enc_mask,
             attn_impl=attn_impl
         )
-        nxt = pick(logits[:, 0, :])
+        nxt, nxt_lp = pick(logits[:, 0, :])
         pos = jnp.where(live, pos + 1, pos)
         done = done | (nxt == eos_id) | (pos >= cfg.max_text_len - 1) | (n >= budget)
-        return (cache, jnp.where(live, nxt, cur), pos, out, n, done, step + 1)
+        return (cache, jnp.where(live, nxt, cur), pos, out, n, done, step + 1,
+                jnp.where(live, nxt_lp, cur_lp), conf)
 
-    self_cache, _, _, out, n, _, _ = jax.lax.while_loop(cond, body, carry0)
-    return out, n, self_cache
+    self_cache, _, _, out, n, _, _, _, conf = jax.lax.while_loop(
+        cond, body, carry0)
+    return out, n, self_cache, conf
+
+
+def finalize_stt_ids(ids: list[int], conf_row, quality_lanes: bool,
+                     final: bool):
+    """THE one post-decode tail shared by the B=1 plane (``_decode``) and
+    the batched plane (``stt_batch._process``): the ``stt_garble`` chaos
+    collapse (finals only — post-decode corruption, latency stays green)
+    and the host reduction of one row's conf lanes. Keeping this single
+    is part of the two planes' identity contract — a divergence here would
+    make them report different confidence for identical audio, which the
+    fleet detector would read as a replica quality difference. Returns
+    ``(ids, logp_mean, logp_min, logp_first, repetition)``."""
+    from ..utils.chaos import chaos_fire
+    from ..utils.quality import repetition_score
+
+    if final and ids and chaos_fire("stt_garble"):
+        ids = [ids[0]] * len(ids)
+    logp_mean = logp_min = logp_first = None
+    if quality_lanes and ids:
+        lp_sum, lp_min, lp_first = (float(x) for x in conf_row)
+        logp_mean = round(lp_sum / len(ids), 4)
+        logp_min = round(lp_min, 4) if lp_min != float("inf") else None
+        logp_first = round(lp_first, 4)
+    rep = round(repetition_score(ids), 4) if ids else None
+    return ids, logp_mean, logp_min, logp_first, rep
 
 
 @dataclass
@@ -124,6 +172,14 @@ class TranscribeResult:
     encode_ms: float
     decode_ms: float
     n_frames: int
+    # ISSUE 15 confidence lanes (None when the quality lanes are off or no
+    # token was emitted): per-token logprob mean/min, the first content
+    # token's logprob (the no-speech-margin proxy), and the host-side
+    # repetition heuristic over the emitted ids
+    logp_mean: float | None = None
+    logp_min: float | None = None
+    logp_first: float | None = None
+    repetition: float | None = None
 
 
 @watch_compiles("stt._append_cross_kv")
@@ -213,6 +269,9 @@ class SpeechEngine:
                 f"no frame bucket in {frame_buckets} fits this config's "
                 f"max_audio_frames ({self.cfg.max_audio_frames})")
         self.max_new_tokens = max_new_tokens
+        from ..utils.quality import quality_lanes_enabled
+
+        self.quality_lanes = quality_lanes_enabled()
         self.params = (
             jax.jit(partial(init_params, self.cfg))(jax.random.PRNGKey(seed))
             if init_weights else None
@@ -349,7 +408,8 @@ class SpeechEngine:
         return self._decode({"k": state.cross_k, "v": state.cross_v}, valid,
                             state.consumed_frames)
 
-    def _decode(self, cross_kv: dict, enc_mask, n_frames: int) -> TranscribeResult:
+    def _decode(self, cross_kv: dict, enc_mask, n_frames: int,
+                final: bool = False) -> TranscribeResult:
         """Shared decode tail: greedy loop over cross-KV -> transcript.
         One combined device_get; used by transcribe() and the streaming
         partial path so the two can never diverge. Decodes at the cross-KV's
@@ -358,24 +418,35 @@ class SpeechEngine:
         per-step cross-KV read). The batched plane pads its rows to
         enc_positions to mix ragged buckets in one dispatch — padding is
         masked to exact zeros, and tests/test_stt_batch.py holds the two
-        shapes token-identical differentially."""
+        shapes token-identical differentially.
+
+        ``final=True`` (transcribe, i.e. finals/spec_finals) arms the
+        ``stt_garble`` chaos point — see ``finalize_stt_ids``, the one
+        post-decode tail both planes share."""
         t0 = time.perf_counter()
         cache = init_self_cache(self.cfg, 1, dtype=self._param_dtype)
         bos = jnp.asarray(list(self.bos_ids), dtype=jnp.int32)[None, :]
-        out, n, _ = _stt_decode_loop(
+        out, n, _, conf = _stt_decode_loop(
             self.params, self.cfg, cache, cross_kv, enc_mask, bos, self.suppress,
             max_new=self.max_new_tokens, eos_id=self.eos_id, pad_id=self.pad_id,
-            attn_impl=self.kernels,
+            attn_impl=self.kernels, quality_lanes=self.quality_lanes,
         )
-        out_h, n_a = jax.device_get((out, n))
+        out_h, n_a, conf_h = jax.device_get((out, n, conf))
         n_h = int(n_a[0])
         ids = [int(t) for t in np.asarray(out_h)[0, :n_h]]
         decode_ms = (time.perf_counter() - t0) * 1e3
+        ids, logp_mean, logp_min, logp_first, rep = finalize_stt_ids(
+            ids, [np.asarray(x)[0] for x in conf_h], self.quality_lanes,
+            final)
         return TranscribeResult(
             text=self.tokenizer.decode(ids).strip(),
             encode_ms=0.0,
             decode_ms=decode_ms,
             n_frames=n_frames,
+            logp_mean=logp_mean,
+            logp_min=logp_min,
+            logp_first=logp_first,
+            repetition=rep,
         )
 
     def _encode_window(self, audio: np.ndarray):
@@ -414,7 +485,7 @@ class SpeechEngine:
         cross_kv, valid, n_frames = self._encode_window(audio)
         encode_ms = (time.perf_counter() - t0) * 1e3
 
-        res = self._decode(cross_kv, valid, n_frames)
+        res = self._decode(cross_kv, valid, n_frames, final=True)
         return dataclasses.replace(res, encode_ms=encode_ms)
 
 
@@ -489,6 +560,10 @@ class StreamingSTT:
         self._spec_final: TranscribeResult | None = None
         self._spec_at_speech = -1  # endpointer.total_speech_frames at spec time
         self._parse_done: str | None = None
+        # the delivered final's full TranscribeResult (confidence lanes
+        # included) — the voice service reads it right after the ("final",
+        # text) event to ride confidence on transcript_final (ISSUE 15)
+        self.last_final: TranscribeResult | None = None
         self._buf = np.zeros(0, dtype=np.float32)
         self._since_partial = 0.0
         # cumulative processing deficit: feed() wall time in excess of the
@@ -623,6 +698,8 @@ class StreamingSTT:
             # the pause was long enough to have been seen). None = the
             # batched plane deferred delivery to its future.
             res = self._final_result(fresh, spoken)
+            if res is not None:
+                self.last_final = res
             if res is not None and res.text:
                 events.append(("final", res.text))
             self._buf = np.zeros(0, dtype=np.float32)

@@ -74,6 +74,7 @@ from .stt import (
     TranscribeResult,
     _append_cross_kv,
     _stt_decode_loop,
+    finalize_stt_ids,
 )
 
 # work-class priority: the utterance-carrying finals first, then the
@@ -497,13 +498,15 @@ class STTBatcher:
         bos = jnp.broadcast_to(
             jnp.asarray(list(eng.bos_ids), dtype=jnp.int32)[None, :],
             (self.S, len(eng.bos_ids)))
-        out, n, _ = _stt_decode_loop(
+        out, n, _, conf = _stt_decode_loop(
             eng.params, eng.cfg, cache, cross_kv, enc_mask, bos, eng.suppress,
             live=live, max_new=eng.max_new_tokens, eos_id=eng.eos_id,
             pad_id=eng.pad_id, attn_impl=eng.kernels,
+            quality_lanes=eng.quality_lanes,
         )
-        out_h, n_h = jax.device_get((out, n))
+        out_h, n_h, conf_h = jax.device_get((out, n, conf))
         out_h, n_h = np.asarray(out_h), np.asarray(n_h)
+        conf_h = [np.asarray(x) for x in conf_h]
         decode_ms = (time.perf_counter() - t1) * 1e3
 
         m = _metrics()
@@ -514,11 +517,21 @@ class STTBatcher:
             m.inc("stt.finals_batched", float(len(finals)))
         for i, (w, _, _, n_frames) in enumerate(rows):
             ids = [int(t) for t in out_h[i, : int(n_h[i])]]
+            # the one shared post-decode tail (stt.finalize_stt_ids): the
+            # stt_garble collapse for finals + the conf-lane reduction —
+            # token- and signal-identical to the B=1 plane by construction
+            ids, logp_mean, logp_min, logp_first, rep = finalize_stt_ids(
+                ids, [c[i] for c in conf_h], eng.quality_lanes,
+                final=w.kind != "partial")
             _resolve(w.future, TranscribeResult(
                 text=eng.tokenizer.decode(ids).strip(),
                 encode_ms=encode_ms if w.kind != "partial" else 0.0,
                 decode_ms=decode_ms,
                 n_frames=n_frames,
+                logp_mean=logp_mean,
+                logp_min=logp_min,
+                logp_first=logp_first,
+                repetition=rep,
             ))
 
 
@@ -649,6 +662,8 @@ class BatchedStreamingSTT(StreamingSTT):
                 # voice handler warns), they do not silently eat the final
                 res = await asyncio.wait_for(
                     asyncio.wrap_future(fut), timeout=self.result_timeout_s)
+            if res is not None:
+                self.last_final = res
             if res is not None and res.text:
                 events.append(("final", res.text))
         return events

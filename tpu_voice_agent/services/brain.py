@@ -65,6 +65,15 @@ def _result_to_response(res) -> ParseResponse:
     note_stage("prefill_ms", round(res.prefill_ms, 3))
     note_stage("decode_ms", round(res.decode_ms, 3))
     note_stage("cached_tokens", int(getattr(res, "cached_tokens", 0)))
+    note_stage("prompt_tokens", int(getattr(res, "prompt_tokens", 0)))
+    # the ISSUE 15 confidence vector rides the same stage-note channel the
+    # prefill/decode split uses — the quality monitor and the response
+    # headers both read it off this thread
+    q = getattr(res, "quality", None)
+    if q:
+        note_stage("intent_margin", q["margin_mean"])
+        note_stage("intent_entropy", q["entropy_mean"])
+        note_stage("intent_forced_frac", q["forced_frac"])
     if res.error:
         # typed scheduler errors (serve.scheduler._err_result contract):
         # "shed: ..." is retryable overload -> 503 + Retry-After, so the
@@ -981,13 +990,77 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
     # session-keyed ones must OPT IN with two-phase turns (PlannerParser)
     spec_ok = getattr(parser, "supports_speculation", not wants_session)
 
+    # quality observatory (ISSUE 15): the per-replica monitor is bound to
+    # the TRACER-LOCAL registry so its gauges stay per-replica even in the
+    # in-process multi-replica harnesses (the fleet detector compares them
+    # across the ring via each replica's timeseries ring), plus the
+    # ``intent_downgrade`` chaos latch — this replica answers a degraded
+    # rule-fallback "unknown" plan from the firing parse on (fast, healthy-
+    # looking, quality on the floor: the fault class only the quality SLO /
+    # golden canary / gray detector can see)
+    from ..utils.quality import (
+        GoldenCanary,
+        QualityMonitor,
+        make_quality_handler,
+    )
+
+    qmon = QualityMonitor("brain", metrics=tracer.metrics)
+    # the downgrade counter exists from construction (scrape-visible at
+    # zero; this literal is what the metrics lint pins — the latch below
+    # counts through the monitor's ledger)
+    qmon.metrics.inc("quality.intent_downgrades", 0.0)
+    downgraded = {"on": False}
+
     def do_parse(preq: ParseRequest) -> ParseResponse:
+        from ..utils.chaos import chaos_fire
+
+        if downgraded["on"] or chaos_fire("intent_downgrade"):
+            downgraded["on"] = True
+            qmon._count("quality.intent_downgrades")
+            return ParseResponse(
+                intents=[Intent(type="unknown")], confidence=0.1,
+                follow_up_question="I did not catch a browser action - "
+                                   "could you rephrase?")
         if wants_session:
             if spec_ok:
                 return locked_parse(preq.text, preq.context, preq.session_id,
                                     preq.speculative)
             return locked_parse(preq.text, preq.context, preq.session_id)
         return locked_parse(preq.text, preq.context)
+
+    # golden-replay canary (ISSUE 15, QUALITY_CANARY_S > 0): replay a
+    # rotating slice of the held-out golden cases through the LIVE parser
+    # (the same do_parse the traffic and the downgrade latch go through)
+    # during idle cycles — admission-gated on this replica's own occupancy
+    # so it never steals decode steps from real traffic
+    from ..utils.knobs import knob_float
+
+    canary_occ = knob_float("QUALITY_CANARY_OCCUPANCY", 0.5)
+
+    def _canary_busy() -> bool:
+        if admission.inflight > 0:
+            return True
+        live = getattr(parser, "pressure_fractions", None)
+        if live is not None:
+            try:
+                fr = live()
+                return bool(fr) and max(fr.values()) >= canary_occ
+            except Exception:
+                return False
+        return False
+
+    canary = GoldenCanary(
+        lambda text, ctx: do_parse(ParseRequest(text=text, context=ctx)),
+        qmon, busy_fn=_canary_busy)
+
+    async def _canary_start(_app) -> None:
+        canary.start()
+
+    async def _canary_stop(_app) -> None:
+        canary.stop()
+
+    app.on_startup.append(_canary_start)
+    app.on_cleanup.append(_canary_stop)
 
     # graceful drain (ISSUE 10): POST /admin/drain latches this replica
     # draining; the router (services/router.py) sees the flag in /health,
@@ -1057,6 +1130,10 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         body["status"] = status
         body["ok"] = status != "unhealthy"
         body["slo"] = slo.state()
+        # the quality observatory block (ISSUE 15): windowed golden/margin/
+        # degraded means + the quality-SLO verdict — forwarded through the
+        # router and the voice /health to the web HUD's quality badge
+        body["quality"] = qmon.health()
         # the shed signal (ISSUE 13): the observatory's saturation signals
         # (batch occupancy, KV utilization, admission fraction) folded to
         # one score the router's prober reads — NEW sessions avoid
@@ -1197,15 +1274,28 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
             )
         finally:
             admission.release()
+        # the quality observatory's per-parse record: engine backends
+        # deposited the confidence vector as stage notes; rule/planner
+        # parses record structurally (degraded-rate window, parse counts)
+        qmon.record_intent(
+            margin=notes.get("intent_margin"),
+            entropy=notes.get("intent_entropy"),
+            forced_frac=notes.get("intent_forced_frac"),
+            downgraded=downgraded["on"],
+            text=preq.text)
         ok_headers = {"x-trace-id": trace_id}
         # the decode split as response headers: the voice service folds them
         # into the utterance's latency_budget stages so the web HUD can show
         # computed-prefill / decode / cache-absorbed-tokens, not just a flat
         # parse_ms (engine backends deposit these as stage notes; rule-based
-        # and planner parses simply have none)
+        # and planner parses simply have none). prompt_tokens rides along —
+        # with cached_tokens it is the voice-side outstanding-prefill-at-
+        # endpoint measurement; intent_margin feeds the voice HUD badge.
         for note, header in (("prefill_ms", "x-prefill-ms"),
                              ("decode_ms", "x-decode-ms"),
-                             ("cached_tokens", "x-cached-tokens")):
+                             ("cached_tokens", "x-cached-tokens"),
+                             ("prompt_tokens", "x-prompt-tokens"),
+                             ("intent_margin", "x-intent-margin")):
             if note in notes:
                 ok_headers[header] = str(notes[note])
         # (speculative implies spec_ok here — the 409 gate already fired)
@@ -1283,6 +1373,7 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
     from ..utils.steplog import make_steplog_handler
 
     app.router.add_get("/debug/steplog", make_steplog_handler("brain"))
+    app.router.add_get("/debug/quality", make_quality_handler(qmon))
     from ..utils.timeseries import attach_timeseries
 
     attach_timeseries(app, "brain", tracer)

@@ -78,6 +78,13 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
 
     # per-request /execute latency + error budget against the SLO targets
     slo = SLOTracker("executor")
+    # quality observatory (ISSUE 15): action verdicts become weak labels
+    # per intent type — the execution-feedback loop the reference never
+    # closed (a parse that "succeeded" but whose selector finds nothing is
+    # a QUALITY failure, and this is where it becomes measurable)
+    from ...utils.quality import QualityMonitor, make_quality_handler
+
+    qmon = QualityMonitor("executor", metrics=tracer.metrics)
 
     async def health(_req: web.Request) -> web.Response:
         status = "degraded" if admission.saturated else "ok"
@@ -87,6 +94,7 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
             "inflight": admission.inflight,
             "max_inflight": admission.max_inflight,
             "slo": slo.state(),
+            "quality": qmon.health(),
         })
 
     async def execute(req: web.Request) -> web.Response:
@@ -155,6 +163,9 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
             )
         finally:
             admission.release()
+        for res in results:
+            qmon.record_exec(getattr(res.intent, "type", "unknown"),
+                             bool(res.ok))
         return web.json_response(
             {
                 "session_id": session.id,
@@ -208,6 +219,7 @@ def build_app(manager: SessionManager | None = None, tracer: Tracer | None = Non
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("executor", tracer))
     app.router.add_get("/debug/flightrecorder",
                        make_flightrecorder_handler("executor"))
+    app.router.add_get("/debug/quality", make_quality_handler(qmon))
     from ...utils.timeseries import attach_timeseries
 
     attach_timeseries(app, "executor", tracer)

@@ -94,6 +94,13 @@ FLEET_SIGNALS: tuple[tuple[str, str, str, str, float], ...] = (
     # fault-containment churn: quarantines / prefill-fence trips per sec
     ("quarantine_rate", "rate", "scheduler.slots_quarantined", "high", 0.2),
     ("poison_rate", "rate", "scheduler.prefill_faults", "high", 0.2),
+    # quality observatory (ISSUE 15): a replica that is FAST BUT WRONG —
+    # golden-replay canary accuracy and the windowed intent margin are
+    # per-replica gauges off the same timeseries rings, so a degraded
+    # parser (downgrade storm, drifting quantized tier) is demoted exactly
+    # like a slow one. Low direction: smaller is worse.
+    ("golden_accuracy", "gauge", "quality.golden_accuracy", "low", 0.05),
+    ("intent_margin", "gauge", "quality.intent_margin", "low", 0.25),
 )
 
 

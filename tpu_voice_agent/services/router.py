@@ -108,7 +108,8 @@ from .replicaset import rendezvous_weight as _weight  # noqa: F401 - test surfac
 # decode-split contract the voice service folds into latency_budget, plus
 # the two-phase speculation marker and the shed backoff hint)
 _PASS_HEADERS = ("x-trace-id", "x-prefill-ms", "x-decode-ms",
-                 "x-cached-tokens", "x-speculation-pending", "retry-after")
+                 "x-cached-tokens", "x-prompt-tokens", "x-intent-margin",
+                 "x-speculation-pending", "retry-after")
 
 
 class ReplicaFailed(RuntimeError):
@@ -780,7 +781,7 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
         for r in router.replicas:
             if r.servable() and r.last_health:
                 for k in ("compile_sentinel", "last_step", "hbm",
-                          "quarantine"):
+                          "quarantine", "quality"):
                     if r.last_health.get(k) is not None:
                         body[k] = r.last_health[k]
                 body["home_replica"] = r.url
@@ -855,6 +856,9 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
     app.router.add_get("/debug/replicas/steplog", fan_out("/debug/steplog"))
     app.router.add_get("/debug/replicas/timeseries",
                        fan_out("/debug/timeseries"))
+    # the quality observatory fan-out (ISSUE 15): each replica's windowed
+    # quality state, so "which replica is wrong" is one scrape
+    app.router.add_get("/debug/replicas/quality", fan_out("/debug/quality"))
 
     async def replicas_flight(req: web.Request) -> web.Response:
         """The flight-recorder fan-out, with each member's dump annotated

@@ -253,6 +253,13 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     # the north-star SLO: voice->intent (end-of-speech processing cost —
     # STT finalize + parse; the speaker's own talking time is not latency)
     slo = SLOTracker("voice")
+    # quality observatory (ISSUE 15): STT confidence per final transcript,
+    # degraded-parse structure, and the voice-side quality-SLO verdict
+    # (tracer-local registry: per-process in production, per-app in the
+    # in-process harnesses)
+    from ..utils.quality import QualityMonitor, make_quality_handler
+
+    qmon = QualityMonitor("voice", metrics=tracer.metrics)
     # live WS session count + the measured capacity ceiling (the swarm
     # bench's max-sessions-at-SLO number, operator-pinned): the web HUD
     # renders occupancy/headroom from /health
@@ -283,7 +290,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             # BRAIN_URL points at the tier instead of one process
             brain_fwd["body"] = {
                 k: h[k] for k in ("compile_sentinel", "last_step", "hbm",
-                                  "replicas", "home_replica")
+                                  "replicas", "home_replica", "quality")
                 if h.get(k) is not None
             } or None
         except Exception:
@@ -310,6 +317,10 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             "slo": slo.state(),
             "sessions": live_sessions["n"],
             "capacity_sessions": capacity_sessions,
+            # the voice-side quality block (STT confidence windows +
+            # quality-SLO verdict); the brain's own block rides the
+            # ``brain`` forward below — the HUD badge reads both
+            "quality": qmon.health(),
         }
         fwd = await _brain_engine_health()
         if fwd is not None:
@@ -431,12 +442,21 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                 spec_supported["ok"] = True
                 spec_supported["skips"] = 0
         r = None
+        # True when the parse finished (or was fully decoded server-side)
+        # BEFORE the endpoint fired — the case where the prompt's prefill
+        # cost left the endpoint->intent path entirely (the gauge below)
+        spec_pre_parsed = False
         spec, state.spec = state.spec, None
         if spec is not None:
             stext, task = spec
             if stext == text:
                 # hit: the parse has been running since the speaker paused —
-                # usually it is already done and this await is free
+                # usually it is already done and this await is free.
+                # done-ness is captured BEFORE the await: a spec parse still
+                # mid-prefill when the endpoint fired must NOT report 0
+                # outstanding prefill below (the await would always finish
+                # by the time the flag is read, biasing the gauge to 0)
+                was_done_at_endpoint = task.done()
                 try:
                     maybe = await task
                 except asyncio.CancelledError:
@@ -456,9 +476,11 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                     # we already delivered.
                     get_metrics().inc("voice.spec_parse_hit")
                     get_metrics().inc("voice.spec_parse_commit")
+                    spec_pre_parsed = was_done_at_endpoint
                 elif maybe is not None and maybe.status_code == 200:
                     r = maybe
                     get_metrics().inc("voice.spec_parse_hit")
+                    spec_pre_parsed = was_done_at_endpoint
                 elif maybe is not None and maybe.status_code == 409:
                     # stateful backend refused speculation (run() already
                     # flipped the sticky flag); parse normally
@@ -497,6 +519,10 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             get_metrics().inc("voice.degraded_parses")
             parsed = fallback_parser.parse(text, state.context)
             degraded = True
+            # quality structure: a degraded-mode rule fallback is a quality
+            # event even though the session survived (the observatory's
+            # degraded-rate window and the fallback counter)
+            qmon.record_intent(degraded=True, rule_fallback=True, text=text)
             await send(ws, "warn", degraded=True,
                        message=f"brain unavailable ({degraded_reason}); "
                                "serving rule-based parse")
@@ -523,13 +549,32 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
             # rendered by the HUD's stage breakdown under parse
             for header, key in (("x-prefill-ms", "parse_prefill_ms"),
                                 ("x-decode-ms", "parse_decode_ms"),
-                                ("x-cached-tokens", "cached_tokens")):
+                                ("x-cached-tokens", "cached_tokens"),
+                                ("x-prompt-tokens", "prompt_tokens"),
+                                ("x-intent-margin", "intent_margin")):
                 v = r.headers.get(header)
                 if v is not None:
                     try:
                         state.stages[key] = float(v)
                     except ValueError:
                         pass
+            # outstanding un-prefilled prompt tokens when the endpoint
+            # fired (ISSUE 15 satellite — the exact measurement ROADMAP's
+            # incremental-streaming-prefill item gates on): a speculative
+            # hit/commit means the whole prompt was prefilled BEFORE the
+            # endpoint (0 outstanding); otherwise everything the KV cache
+            # did not absorb still had to be computed after end-of-speech
+            pt = state.stages.get("prompt_tokens")
+            if pt is not None:
+                remaining = 0.0 if spec_pre_parsed else max(
+                    0.0, pt - state.stages.get("cached_tokens", 0.0))
+                get_metrics().set_gauge("engine.prefill_remaining_at_endpoint",
+                                        remaining)
+            # healthy parses must feed the quality windows too — recording
+            # only the fallback path would peg the degraded-rate window at
+            # 1.0 forever after one transient blip
+            qmon.record_intent(margin=state.stages.get("intent_margin"),
+                               text=text)
         if degraded:
             state.stages["degraded"] = True
         slo.record(state.stages.get("stt_finalize_ms", 0.0) + state.stages["parse_ms"],
@@ -734,7 +779,26 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                                     stt_finalize_ms=round((t_feed1 - t_feed0) * 1e3, 3),
                                 )
                                 state.utt_t0 = None
-                                await send(ws, "transcript_final", text=text)
+                                # STT confidence rides the transcript_final
+                                # event (ISSUE 15): the streaming wrapper
+                                # published this final's full result —
+                                # logprob lanes + repetition — on the same
+                                # feed call that emitted the event
+                                conf_payload = {}
+                                lf = getattr(state.stt, "last_final", None)
+                                if lf is not None and \
+                                        getattr(lf, "repetition", None) is not None:
+                                    conf = {k: getattr(lf, k) for k in
+                                            ("logp_mean", "logp_min",
+                                             "logp_first", "repetition")
+                                            if getattr(lf, k) is not None}
+                                    conf_payload["confidence"] = conf
+                                    qmon.record_stt(
+                                        lf.logp_mean, lf.logp_min,
+                                        lf.repetition, text=text,
+                                        logp_first=lf.logp_first)
+                                await send(ws, "transcript_final", text=text,
+                                           **conf_payload)
                                 await handle_final(ws, state, text, http)
                     elif msg.type == WSMsgType.TEXT:
                         try:
@@ -810,6 +874,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     app.router.add_get("/metrics", make_metrics_handler("voice", tracer, slo=slo))
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("voice", tracer))
     app.router.add_get("/debug/flightrecorder", make_flightrecorder_handler("voice"))
+    app.router.add_get("/debug/quality", make_quality_handler(qmon))
     from ..utils.timeseries import attach_timeseries
 
     attach_timeseries(app, "voice", tracer)

@@ -70,6 +70,21 @@ tier ``serve.stt_replicas``):
                       wedged-but-alive worker the tier's stalled-tick
                       watchdog must detect and warm-restart (reusing the
                       loaded Whisper weights)
+
+Quality-fault points (ISSUE 15 — drilled by ``benches/
+bench_quality_online.py`` against the quality observatory: the service
+stays FAST and healthy-looking while its OUTPUT degrades, the failure
+class only the quality SLO / golden canary / gray detector can see):
+
+    stt_garble        corrupt a final's token ids post-decode (the whole
+                      final collapses to its first token repeated) — the
+                      transcript is garbage while every latency signal
+                      stays green; the repetition heuristic and the
+                      downstream intent quality must catch it
+    intent_downgrade  LATCHES the serving brain replica into a degraded
+                      rule-fallback answer (a single "unknown" plan) from
+                      the firing parse on — the degraded-mode fallback
+                      storm: still 200s, still fast, quality on the floor
 """
 
 from __future__ import annotations
@@ -81,7 +96,7 @@ import threading
 KNOWN_POINTS = ("nan_logits", "dead_fsm", "prefill_exc", "alloc_fail",
                 "stall_step", "drop_frame", "replica_kill", "replica_hang",
                 "replica_slow", "replica_degrade", "stt_replica_kill",
-                "stt_replica_hang")
+                "stt_replica_hang", "stt_garble", "intent_downgrade")
 
 
 class ChaosError(RuntimeError):

@@ -207,6 +207,19 @@ declare("FLIGHT_SNAPSHOT_S", "1.0", "metric-snapshot interval while armed", tabl
 declare("FLIGHT_SINK", None, "directory for frozen flight dumps (unset = memory only)", table=OBSERVABILITY)
 declare("TRACE_SINK", None, "JSONL path for finished trace spans (unset = ring only)", table=OBSERVABILITY)
 
+# quality observatory (ISSUE 15): online per-utterance quality signals,
+# the golden-replay canary, and the quality SLO floors
+declare("QUALITY_ENABLE", "1", "0 removes the quality readback lanes from the decode loops (token-identical either way)", table=OBSERVABILITY)
+declare("QUALITY_WINDOW", "64", "per-signal rolling window (utterances) behind the quality gauges", table=OBSERVABILITY)
+declare("QUALITY_CANARY_S", "0", "golden-replay canary cadence in seconds (0 = off)", table=OBSERVABILITY)
+declare("QUALITY_CANARY_SLICE", "3", "golden cases replayed per canary round (rotating slice)", table=OBSERVABILITY)
+declare("QUALITY_CANARY_OCCUPANCY", "0.5", "canary admission gate: skip the round when the replica is busier than this fraction", table=OBSERVABILITY)
+declare("QUALITY_SLO_GOLDEN_MIN", "0.7", "windowed golden-replay accuracy floor (quality SLO)", table=OBSERVABILITY)
+declare("QUALITY_SLO_EXEC_MIN", "0.5", "windowed executor action-success floor (quality SLO)", table=OBSERVABILITY)
+declare("QUALITY_SLO_MARGIN_MIN", "0", "windowed intent masked-logit-margin floor (0 = floor off; scale is model-specific)", table=OBSERVABILITY)
+declare("QUALITY_SLO_REPETITION_MAX", "0.9", "windowed STT repetition ceiling (garbled-transcript alarm)", table=OBSERVABILITY)
+declare("QUALITY_SLO_MIN_SAMPLES", "5", "below this window count a quality verdict stays ok", table=OBSERVABILITY)
+
 # fleet telemetry plane (ISSUE 14): per-service time-series rings + the
 # router's peer-relative gray-failure detector
 declare("TS_INTERVAL_S", "0.5", "time-series ring sample cadence per service", table=OBSERVABILITY)

@@ -168,3 +168,123 @@ class SLOTracker:
                 "error_rate": self.error_rate_target,
             },
         }
+
+
+class QualityTracker:
+    """The QUALITY dimension of the SLO plane (ISSUE 15): rolling windows
+    of per-utterance quality signals evaluated against floors/ceilings.
+
+    Where ``SLOTracker`` answers "is the service fast", this answers "is
+    its OUTPUT still right": each signal (golden-replay accuracy, executor
+    action success, intent masked-logit margin, STT repetition) keeps a
+    bounded window of (value, detail) samples; a windowed mean under its
+    floor (or over its ceiling) flips the verdict to ``violated`` and the
+    ok→violated edge freezes the flight recorder with the failing
+    utterances' quality vectors riding along (``extra.quality``) — the
+    autopsy answers "what did the replica actually emit", not just "when
+    did the number dip". Floors with value 0 (or None) are disarmed.
+
+    ``metrics`` defaults to the process-global registry; in-process
+    multi-replica harnesses pass their tracer-local one so per-replica
+    verdicts stay per-replica (the PR 14 timeseries discipline).
+    """
+
+    MAX_SAMPLES = 1024
+
+    def __init__(self, name: str = "quality", *,
+                 floors: dict[str, float] | None = None,
+                 ceilings: dict[str, float] | None = None,
+                 window: int | None = None,
+                 min_samples: int | None = None,
+                 metrics=None, clock=time.monotonic):
+        from .knobs import knob_int
+        from .tracing import get_metrics as _gm
+
+        self.name = name
+        self.floors = {k: v for k, v in (floors or {}).items()
+                       if v is not None and v > 0}
+        self.ceilings = {k: v for k, v in (ceilings or {}).items()
+                        if v is not None and v > 0}
+        self.window = window if window is not None \
+            else knob_int("QUALITY_WINDOW", 64)
+        self.min_samples = min_samples if min_samples is not None \
+            else knob_int("QUALITY_SLO_MIN_SAMPLES", 5)
+        self._metrics = metrics if metrics is not None else _gm()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: dict[str, deque] = {}
+        self._last_state = "ok"
+        self._last_auto_eval = 0.0
+
+    def record(self, signal: str, value: float, detail: dict | None = None) -> None:
+        """One utterance's reading for ``signal``; ``detail`` is its quality
+        vector (transcript preview, margins, scores) — what the frozen dump
+        carries as evidence when this window blows the floor."""
+        with self._lock:
+            dq = self._samples.get(signal)
+            if dq is None:
+                dq = self._samples[signal] = deque(
+                    maxlen=min(self.window, self.MAX_SAMPLES))
+            dq.append((float(value), detail))
+            due = self._clock() - self._last_auto_eval >= AUTO_EVAL_S
+            if due:
+                self._last_auto_eval = self._clock()
+        if due:
+            # outside the lock (the SLOTracker discipline): evaluate() may
+            # trigger the flight recorder on the ok->violated edge
+            self.evaluate()
+
+    def means(self) -> dict[str, float]:
+        with self._lock:
+            return {sig: sum(v for v, _ in dq) / len(dq)
+                    for sig, dq in self._samples.items() if dq}
+
+    def state(self) -> str:
+        return self.evaluate()["state"]
+
+    def evaluate(self) -> dict:
+        """Evaluate every armed signal; export ``slo.<name>.*`` gauges."""
+        with self._lock:
+            snap = {sig: list(dq) for sig, dq in self._samples.items()}
+        state = "ok"
+        reasons: list[str] = []
+        evidence: dict[str, dict] = {}
+        signals: dict[str, dict] = {}
+        for sig, xs in snap.items():
+            mean = sum(v for v, _ in xs) / len(xs) if xs else None
+            entry = {"samples": len(xs),
+                     "mean": round(mean, 4) if mean is not None else None}
+            floor = self.floors.get(sig)
+            ceiling = self.ceilings.get(sig)
+            if floor is not None:
+                entry["floor"] = floor
+            if ceiling is not None:
+                entry["ceiling"] = ceiling
+            bad = None
+            if mean is not None and len(xs) >= self.min_samples:
+                if floor is not None and mean < floor:
+                    bad = f"{sig} {mean:.3g} < floor {floor:.3g}"
+                elif ceiling is not None and mean > ceiling:
+                    bad = f"{sig} {mean:.3g} > ceiling {ceiling:.3g}"
+            if bad is not None:
+                state = "violated"
+                reasons.append(bad)
+                # the failing utterances' quality vectors: the last K
+                # samples WITH their details — the per-utterance evidence
+                # the acceptance gate requires the dump to carry
+                evidence[sig] = {
+                    "mean": round(mean, 4),
+                    "floor": floor, "ceiling": ceiling,
+                    "recent": [{"value": round(v, 4), **(d or {})}
+                               for v, d in xs[-8:]],
+                }
+            signals[sig] = entry
+        prev, self._last_state = self._last_state, state
+        if state == "violated" and prev != "violated":
+            get_flight_recorder().trigger(
+                f"slo.{self.name}.violated", detail="; ".join(reasons),
+                extra={"quality": evidence})
+        m = self._metrics
+        m.set_gauge(f"slo.{self.name}.state", float(STATES.index(state)))
+        return {"name": self.name, "state": state, "reasons": reasons,
+                "signals": signals}

@@ -34,7 +34,7 @@ const hudEl = $("hud"), hudTotal = $("hud-total"), hudBar = $("hud-bar"),
 const capacityEl = $("capacity"), capacityText = $("capacity-text");
 const engineEl = $("engine"), engineStep = $("engine-step"),
   recompileBadge = $("recompile-badge"), replicaBadge = $("replica-badge"),
-  sttReplicaBadge = $("stt-replica-badge");
+  sttReplicaBadge = $("stt-replica-badge"), qualityBadge = $("quality-badge");
 const SLO_BUDGET_MS = 800;  // BASELINE voice->intent p50 target
 const HEALTH_POLL_MS = 5000;
 
@@ -138,6 +138,20 @@ async function pollHealth() {
       sttReplicaBadge.hidden = false;
     } else {
       sttReplicaBadge.hidden = true;
+    }
+    /* quality badge (ISSUE 15): the quality observatory's SLO verdict —
+     * voice-side (STT confidence/repetition) and the brain's (golden
+     * canary accuracy, intent margin), forwarded through /health. A
+     * violated verdict means the stack is FAST BUT WRONG; the badge
+     * carries the windowed golden accuracy when the brain reports one. */
+    const vq = h.quality, bq = h.brain && h.brain.quality;
+    const qbad = (vq && vq.slo === "violated") || (bq && bq.slo === "violated");
+    if (qbad) {
+      const golden = bq && bq.golden != null ? ` golden ${(100 * bq.golden).toFixed(0)}%` : "";
+      qualityBadge.textContent = `quality violated${golden}`;
+      qualityBadge.hidden = false;
+    } else {
+      qualityBadge.hidden = true;
     }
   } catch { /* a dead poll must not spam the console */ }
 }
