@@ -1,0 +1,528 @@
+"""Fleet autopilot drill (ISSUE 16): closed-loop elastic capacity with
+zero-drop scale-down.
+
+Section 1 — **ramp**. A rule-replica stack (slowed parses, so the busy
+signal the controller reads — ``hist["brain.parse"]`` off the replicas'
+own time-series rings — is proportional to offered load) starts at 2
+replicas with an ``AutopilotController`` attached to the live router.
+``tools.swarm.run_ramp`` drives low -> high -> plateau -> low stages
+while the controller spawns and retires in-process ``AppServer`` brains.
+GATES: every stage holds SLO with **zero utterance errors and zero
+crashed sessions** (the ramp-down stages run WHILE replicas drain — a
+scale-down that drops anything fails here), the fleet actually grew at
+the plateau, **time-to-scale** (high-stage start -> first extra up
+replica) is bounded, and after the load stops the controller walks the
+fleet back to the floor and the survivor still serves cleanly.
+
+Section 2 — **pre-warmed join + the replica_join_stall drill**. One REAL
+engine replica (paged+radix ``test-tiny``) plays a session's turns, then
+the controller must grow the tier to 2 with ``replica_join_stall@1``
+armed: the first join's handoff adopt wedges (the brain chaos middleware
+holds POST /admin/handoff for CHAOS_HANG_S), the controller times the
+join out at ``AUTOPILOT_JOIN_TIMEOUT_S``, retires the stuck member, and
+the retry joins PRE-WARMED (the donor's most recent sticky session's
+radix root shipped before admit). GATES: the stall fired and was
+contained (``autopilot.join_timeouts`` >= 1, final up count = target,
+target never dropped), **no join ever admitted cold**
+(``autopilot.joins_cold`` == 0), the committed join's decision carries
+``adopted_tokens > 0`` (recorded at admit time — structurally BEFORE the
+first placed session, since joining members take no placement), and the
+first session placed on the joined member parses successfully.
+
+Both sections exit non-zero via run_all.py on gate failure, and the
+time-to-scale / zero-error-scale-down / stall-containment rows are
+benchdiff-gated.
+
+Knobs: BENCH_AUTOPILOT_HIGH_N (8), BENCH_AUTOPILOT_UTTERANCES (3),
+BENCH_AUTOPILOT_PARSE_S (0.08), BENCH_AUTOPILOT_MAX (4),
+BENCH_AUTOPILOT_TTS_BAR_S (20), BENCH_AUTOPILOT_TURNS (3),
+BENCH_AUTOPILOT_JOIN_TIMEOUT_S (4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log  # noqa: E402
+
+from tools import swarm  # noqa: E402
+
+
+def _post(url: str, body: dict, timeout_s: float = 30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read().decode())
+
+
+def _get(url: str, timeout_s: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
+
+
+def _on_loop(loop, coro, timeout_s: float = 60.0):
+    """Run a controller coroutine on the router server's own event loop —
+    the loop the router's httpx client (and so the autopilot) lives on."""
+    return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout_s)
+
+
+def _teardown(servers) -> None:
+    for srv in servers:
+        try:
+            srv.__exit__(None, None, None)
+        except Exception:
+            pass
+
+
+class _SlowRuleParser:
+    """RuleBasedParser with a fixed parse wall. The busy fraction the
+    controller steers on is measured INSIDE the parse span (chaos
+    middleware sleeps land outside it), so plain rule parses — tens of
+    microseconds — would read as a permanently idle fleet no matter the
+    session count. The deliberate in-span sleep makes offered load
+    visible to the signal under test."""
+
+    def __init__(self, delay_s: float):
+        from tpu_voice_agent.services.brain import RuleBasedParser
+
+        self._inner = RuleBasedParser()
+        self._delay_s = delay_s
+
+    def parse(self, *args, **kw):
+        time.sleep(self._delay_s)
+        return self._inner.parse(*args, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _AppSpawner:
+    """The bench's deployment half of the autopilot contract: ``spawn``
+    boots a fresh in-process AppServer brain (on the default executor —
+    AppServer.__enter__ blocks on the server thread coming up), ``retire``
+    tears it down once the ring has forgotten it."""
+
+    def __init__(self, make_app):
+        self.make_app = make_app
+        self.servers: dict[str, object] = {}
+        self.spawned = 0
+
+    async def spawn(self) -> str:
+        from tests.http_helper import AppServer
+
+        loop = asyncio.get_running_loop()
+        srv = await loop.run_in_executor(
+            None, lambda: AppServer(self.make_app()).__enter__())
+        self.servers[srv.url] = srv
+        self.spawned += 1
+        return srv.url
+
+    async def retire(self, url: str) -> None:
+        srv = self.servers.pop(url, None)
+        if srv is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: srv.__exit__(None, None, None))
+
+    def close(self) -> None:
+        for srv in list(self.servers.values()):
+            try:
+                srv.__exit__(None, None, None)
+            except Exception:
+                pass
+        self.servers.clear()
+
+
+class _PooledSpawner:
+    """Engine replicas cost a model boot, so the stall drill pre-boots
+    its joiner and hands it out of a pool; ``retire`` returns the server
+    to the pool instead of killing it — the retry after the timed-out
+    join deliberately gets the SAME replica back (chaos only wedges the
+    first adopt), proving containment is the controller's doing."""
+
+    def __init__(self, servers):
+        self.pool = list(servers)
+        self.out: dict[str, object] = {}
+
+    async def spawn(self) -> str:
+        srv = self.pool.pop(0)  # IndexError = drill over-spawned: loud
+        self.out[srv.url] = srv
+        return srv.url
+
+    async def retire(self, url: str) -> None:
+        srv = self.out.pop(url, None)
+        if srv is not None:
+            self.pool.append(srv)
+
+
+# ------------------------------------------------------------- 1. the ramp
+
+
+def ramp_section(failures: list[str]) -> dict:
+    from tpu_voice_agent.services.autopilot import AutopilotController
+    from tpu_voice_agent.services.brain import build_app as build_brain
+    from tpu_voice_agent.utils import get_metrics
+
+    high_n = int(os.environ.get("BENCH_AUTOPILOT_HIGH_N", "8"))
+    utterances = int(os.environ.get("BENCH_AUTOPILOT_UTTERANCES", "3"))
+    parse_s = float(os.environ.get("BENCH_AUTOPILOT_PARSE_S", "0.08"))
+    maxr = int(os.environ.get("BENCH_AUTOPILOT_MAX", "4"))
+    tts_bar = float(os.environ.get("BENCH_AUTOPILOT_TTS_BAR_S", "20"))
+    # loose latency targets: parses pay a deliberate wall; the SLO state
+    # still gates the error rate, and the loss gates below are exact
+    os.environ["SLO_TARGET_P50_MS"] = "60000"
+    os.environ["SLO_TARGET_P99_MS"] = "120000"
+
+    tmp = tempfile.mkdtemp(prefix="bench_autopilot_")
+    urls, servers = swarm.build_local_stack(
+        tmp, brain_inflight=16, exec_inflight=16,
+        parser=lambda: _SlowRuleParser(parse_s),
+        brain_replicas=2, router_kw={"probe_s": 0.2, "probe_fails": 2})
+    router_srv = next(s for s in servers if hasattr(s, "router"))
+    robj = router_srv.router
+    loop = router_srv._loop
+    spawner = _AppSpawner(
+        lambda: build_brain(_SlowRuleParser(parse_s), max_inflight=16))
+    c0 = get_metrics().snapshot()["counters"]
+    ap = AutopilotController(
+        robj, spawner, min_replicas=1, max_replicas=maxr,
+        interval_s=0.25, target_util=0.5, up_windows=2, down_windows=3,
+        cooldown_s=1.0, join_timeout_s=10.0, forecast_lead_s=2.0)
+
+    # replica-count timeline off the live /admin/autopilot surface — the
+    # same JSON fleetview renders, so the bench also smoke-tests it
+    timeline: list[dict] = []
+    stop = threading.Event()
+
+    def watch() -> None:
+        while not stop.is_set():
+            try:
+                b = _get(urls["router"] + "/admin/autopilot",
+                         timeout_s=2.0)["brain"]
+                timeline.append({"t": time.monotonic(),
+                                 "target": b["target"],
+                                 "actual": b["actual"],
+                                 "joining": b["joining"]})
+            except Exception:
+                pass
+            stop.wait(0.1)
+
+    watcher = threading.Thread(target=watch, daemon=True,
+                               name="autopilot-watch")
+    watcher.start()
+    marks: dict[int, float] = {}
+
+    # no abort/garbage scenarios: those burn SLO error budget by design,
+    # and this section's contract is EXACTLY zero errors during elastic churn
+    mix = {"single_shot": 2, "multi_turn": 3, "compound": 1}
+    stages = [1, high_n, high_n, 2, 2]
+    settled = False
+    after_errors = -1
+    after_crashed = -1
+    try:
+        _on_loop(loop, ap.start())
+        t_run0 = time.monotonic()
+        log(f"[ramp] stages {stages} x {utterances} utts "
+            f"(parse wall {parse_s * 1e3:.0f} ms, max {maxr} replicas)")
+        ramp = swarm.run_ramp(
+            urls["voice"], stages, sample_urls=[urls["voice"]],
+            stage_hook=lambda i, n, st: marks.setdefault(i, time.monotonic()),
+            utterances=utterances, mix=mix, think_s=0.02, timeout_s=30.0)
+        # settle: with the load gone the controller must walk the fleet
+        # back down to the floor — drains, ships, ejects, retires
+        t_settle0 = time.monotonic()
+        while time.monotonic() - t_settle0 < 45:
+            d = _get(urls["router"] + "/admin/autopilot", timeout_s=2.0)
+            b = d["brain"]
+            if (b["actual"] == 1 and b["joining"] == 0
+                    and b["draining"] == 0 and not b["retiring"]):
+                settled = True
+                break
+            time.sleep(0.25)
+        settle_s = time.monotonic() - t_settle0
+        # the survivor still serves: one clean post-scale-down run
+        after = swarm.run_swarm(urls["voice"], 2,
+                                sample_urls=[urls["voice"]],
+                                utterances=2, mix=mix, think_s=0.02)
+        after_errors = sum(s["errors"] for s in after["scenarios"].values())
+        after_crashed = after["sessions_crashed"]
+        _on_loop(loop, ap.stop())
+    finally:
+        stop.set()
+        watcher.join(timeout=5)
+        try:
+            _on_loop(loop, ap.stop(), timeout_s=10)
+        except Exception:
+            pass
+        _teardown(servers)
+        spawner.close()
+
+    c1 = get_metrics().snapshot()["counters"]
+
+    def delta(k: str) -> float:
+        return c1.get(k, 0.0) - c0.get(k, 0.0)
+
+    t_high = marks.get(0, t_run0)
+    base = next((s["actual"] for s in reversed(timeline)
+                 if s["t"] <= t_high), 2)
+    grown = [s for s in timeline if s["t"] > t_high and s["actual"] > base]
+    tts = (grown[0]["t"] - t_high) if grown else None
+    peak = max((s["actual"] for s in timeline), default=0)
+    log(f"[ramp] peak {peak} up replicas (base {base}), time-to-scale "
+        f"{'%.2fs' % tts if tts is not None else 'NEVER'}, settled="
+        f"{settled} in {settle_s:.1f}s; spawned {spawner.spawned}, "
+        f"retired {delta('autopilot.retired'):.0f}, shipped "
+        f"{delta('autopilot.sessions_shipped'):.0f} sessions; ramp errors "
+        f"{ramp['total_errors']}, crashed {ramp['total_crashed']}")
+
+    if not ramp["all_slo_ok"]:
+        failures.append("a ramp stage broke SLO — elastic capacity did not "
+                        "hold the load")
+    if ramp["total_errors"] or ramp["total_crashed"]:
+        failures.append(
+            f"ramp lost work: {ramp['total_errors']} utterance errors / "
+            f"{ramp['total_crashed']} crashed sessions — scale churn must "
+            "be invisible to clients")
+    if peak <= base:
+        failures.append(f"the fleet never grew past {base} at the plateau "
+                        "— the controller is not scaling on load")
+    if tts is None or tts > tts_bar:
+        failures.append(
+            f"time-to-scale {'unbounded' if tts is None else f'{tts:.1f}s'} "
+            f"(bar <= {tts_bar:.0f}s)")
+    if not settled:
+        failures.append("the fleet never walked back to the floor after "
+                        "the load stopped")
+    if after_errors or after_crashed:
+        failures.append(f"post-scale-down traffic failed ({after_errors} "
+                        f"errors, {after_crashed} crashed) — the survivor "
+                        "is not clean")
+    if delta("autopilot.retired") < 1:
+        failures.append("no autopilot retirement completed — the "
+                        "drain->ship->eject->retire pipeline never ran")
+
+    clean = 1.0 if (ramp["total_errors"] == 0 and ramp["total_crashed"] == 0
+                    and settled and after_errors == 0
+                    and after_crashed == 0) else 0.0
+    emit("autopilot_time_to_scale_s",
+         round(tts if tts is not None else 10 * tts_bar, 3), "s")
+    emit("autopilot_scale_down_clean", clean, "fraction")
+    emit("autopilot_ramp_peak_replicas", float(peak), "replicas")
+    return {
+        "stages": stages, "utterances": utterances,
+        "ramp": ramp, "peak_replicas": peak, "base_replicas": base,
+        "time_to_scale_s": round(tts, 3) if tts is not None else None,
+        "settled": settled, "settle_s": round(settle_s, 2),
+        "after_errors": after_errors, "after_crashed": after_crashed,
+        "spawned": spawner.spawned,
+        "retired": delta("autopilot.retired"),
+        "sessions_shipped": delta("autopilot.sessions_shipped"),
+        "scale_ups": delta("autopilot.scale_ups"),
+        "scale_downs": delta("autopilot.scale_downs"),
+        "joins_cold": delta("autopilot.joins_cold"),
+        "timeline_samples": len(timeline),
+    }
+
+
+# ---------------------------- 2. pre-warmed join + the join-stall drill
+
+
+TURNS = [
+    ("search for wireless headphones", {}),
+    ("open the second result", {"last_query": "wireless headphones"}),
+    ("sort these by price from low to high",
+     {"last_query": "wireless headphones"}),
+    ("take a screenshot", {"last_query": "wireless headphones"}),
+]
+
+
+def _engine_parser(slots: int = 2):
+    from tpu_voice_agent.serve import PagedDecodeEngine
+    from tpu_voice_agent.services.brain import (
+        BatchedEngineParser,
+        install_prompt_prefix,
+    )
+
+    eng = PagedDecodeEngine(
+        preset="test-tiny", max_len=2048, batch_slots=slots,
+        prefill_buckets=(128, 256, 512, 1024, 2048), radix_enable=True)
+    install_prompt_prefix(eng)
+    return BatchedEngineParser(eng, chunk_steps=16, session_aware=True)
+
+
+def join_section(failures: list[str]) -> dict:
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.autopilot import AutopilotController
+    from tpu_voice_agent.services.brain import build_app as build_brain
+    from tpu_voice_agent.services.router import BrainRouter, _weight
+    from tpu_voice_agent.services.router import build_app as build_router
+    from tpu_voice_agent.utils import chaos as chaos_mod
+    from tpu_voice_agent.utils import get_metrics
+
+    n_turns = max(2, int(os.environ.get("BENCH_AUTOPILOT_TURNS", "3")))
+    join_timeout = float(os.environ.get("BENCH_AUTOPILOT_JOIN_TIMEOUT_S", "4"))
+    os.environ["HANDOFF_KV"] = "1"
+    os.environ["CHAOS_HANG_S"] = "30"
+    # exactly the FIRST adopt wedges; the retry must sail through
+    chaos_mod.configure("replica_join_stall@1", seed=3)
+    parsers = [_engine_parser(), _engine_parser()]
+    donor = AppServer(build_brain(parsers[0], max_inflight=8)).__enter__()
+    joiner = AppServer(build_brain(parsers[1], max_inflight=8)).__enter__()
+    robj = BrainRouter([donor.url], probe_s=0.2, probe_fails=2,
+                       handoff_enable=True)
+    router = AppServer(build_router(robj)).__enter__()
+    loop = router._loop
+    spawner = _PooledSpawner([joiner])
+    try:
+        # warm state worth shipping: the donor's sticky session plays turns
+        sid = "apdonor0"
+        for text, ctx in TURNS[:n_turns]:
+            st, _h, _b = _post(router.url + "/parse",
+                               {"text": text, "session_id": sid,
+                                "context": ctx})
+            if st != 200:
+                failures.append(f"donor turn failed with {st}")
+                return {}
+        c0 = get_metrics().snapshot()["counters"]
+        ap = AutopilotController(
+            robj, spawner, min_replicas=2, max_replicas=2,
+            interval_s=0.2, target_util=0.6, up_windows=2, down_windows=4,
+            cooldown_s=0.5, join_timeout_s=join_timeout,
+            forecast_lead_s=2.0)
+        log(f"[join] growing 1 -> 2 with replica_join_stall@1 armed "
+            f"(join timeout {join_timeout:.0f}s, hang 30s)")
+        t0 = time.monotonic()
+        desc: dict = {}
+        while time.monotonic() - t0 < 90:
+            desc = _on_loop(loop, ap.tick_once(),
+                            timeout_s=join_timeout + 30)
+            if desc.get("brain", {}).get("actual", 0) >= 2:
+                break
+            time.sleep(0.2)
+        recover_s = time.monotonic() - t0
+        c1 = get_metrics().snapshot()["counters"]
+
+        def delta(k: str) -> float:
+            return c1.get(k, 0.0) - c0.get(k, 0.0)
+
+        joins = [d for d in ap.decisions if d["action"] == "join"]
+        aborts = [d for d in ap.decisions if d["action"] == "join_aborted"]
+        adopted = float(joins[-1]["adopted_tokens"]) if joins else 0.0
+        contained = (delta("chaos.replica_join_stall") >= 1
+                     and delta("autopilot.join_timeouts") >= 1
+                     and delta("autopilot.joins_cold") == 0
+                     and delta("autopilot.joins_prewarmed") >= 1
+                     and desc.get("brain", {}).get("actual") == 2
+                     and all(d["target"] >= 2 for d in ap.decisions))
+        log(f"[join] recovered in {recover_s:.1f}s: stalls "
+            f"{delta('chaos.replica_join_stall'):.0f}, timeouts "
+            f"{delta('autopilot.join_timeouts'):.0f}, prewarmed "
+            f"{delta('autopilot.joins_prewarmed'):.0f}, cold "
+            f"{delta('autopilot.joins_cold'):.0f}, adopted "
+            f"{adopted:.0f} tokens")
+        if delta("chaos.replica_join_stall") < 1:
+            failures.append("replica_join_stall never fired — the drill "
+                            "proved nothing")
+        if delta("autopilot.join_timeouts") < 1:
+            failures.append("the wedged join never timed out — the stuck "
+                            "member would block capacity forever")
+        if not any(d.get("reason") == "join_timeout" for d in aborts):
+            failures.append("no join_aborted/join_timeout decision was "
+                            "logged for the stalled join")
+        if delta("autopilot.joins_cold") > 0:
+            failures.append("a join admitted COLD — the stall must end in "
+                            "retire-and-retry, never a cold admit")
+        if desc.get("brain", {}).get("actual") != 2:
+            failures.append(
+                f"the retry never restored capacity (up="
+                f"{desc.get('brain', {}).get('actual')}, want 2)")
+        if any(d["target"] < 2 for d in ap.decisions):
+            failures.append("the capacity target dropped during the stall "
+                            "— containment must not shrink ambition")
+        if adopted <= 0:
+            failures.append("the committed join adopted no tokens — the "
+                            "pre-warm contract (warm root before first "
+                            "placed session) is broken")
+
+        # first PLACED session on the joined member: routes there and
+        # parses — the adopt already happened strictly before this
+        placed_ok = False
+        cached = 0.0
+        if desc.get("brain", {}).get("actual") == 2:
+            sid2 = next(
+                f"apnew{i}" for i in range(10_000)
+                if _weight(joiner.url, f"apnew{i}")
+                > _weight(donor.url, f"apnew{i}"))
+            st, hdrs, _b = _post(router.url + "/parse",
+                                 {"text": TURNS[0][0], "session_id": sid2,
+                                  "context": {}})
+            cached = float(hdrs.get("x-cached-tokens", 0.0))
+            placed_ok = (st == 200
+                         and hdrs.get("x-router-replica") == joiner.url)
+            if not placed_ok:
+                failures.append("the first session placed on the joined "
+                                "member did not parse there")
+
+        emit("autopilot_join_stall_contained",
+             1.0 if contained else 0.0, "fraction")
+        emit("autopilot_join_stall_recover_s", round(recover_s, 3), "s")
+        emit("autopilot_prewarm_adopted_tokens", adopted, "tokens")
+        emit("autopilot_prewarm_before_traffic",
+             1.0 if (adopted > 0 and placed_ok) else 0.0, "fraction")
+        return {
+            "turns": n_turns, "join_timeout_s": join_timeout,
+            "recover_s": round(recover_s, 2),
+            "stalls_fired": delta("chaos.replica_join_stall"),
+            "join_timeouts": delta("autopilot.join_timeouts"),
+            "joins_prewarmed": delta("autopilot.joins_prewarmed"),
+            "joins_cold": delta("autopilot.joins_cold"),
+            "adopted_tokens": adopted,
+            "placed_parse_cached_tokens": cached,
+            "contained": contained,
+            "decisions": ap.decisions[-12:],
+        }
+    finally:
+        chaos_mod.reset()
+        os.environ.pop("CHAOS_HANG_S", None)
+        os.environ.pop("HANDOFF_KV", None)
+        _teardown([router, donor, joiner])
+        for p in parsers:
+            p.close()
+
+
+def main() -> None:
+    # the controller's forecast input is the replicas' own rings: sample
+    # fast enough that a bench-scale ramp spans many windows
+    os.environ.setdefault("TS_INTERVAL_S", "0.25")
+    failures: list[str] = []
+    ramp = ramp_section(failures)
+    join = join_section(failures)
+
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art = art_dir / f"BENCH_autopilot_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_autopilot",
+        "ts": stamp,
+        "autopilot": {"ramp": ramp, "join": join, "failures": failures},
+    }, indent=1))
+    log(f"artifact: {art}")
+    if failures:
+        for f in failures:
+            log(f"FAIL: {f}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

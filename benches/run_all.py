@@ -25,7 +25,7 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_spec.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
            "bench_steplog.py", "bench_router.py", "bench_handoff.py",
-           "bench_fleet.py"]
+           "bench_fleet.py", "bench_autopilot.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -55,6 +55,11 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # regression gate (rule replicas, no model, trimmed search), and a PR
 # that blinds the detector or breaks gray placement demotion must fail
 # the quick table as well
+# the autopilot bench stays on --quick too — it is the elastic-capacity
+# regression gate (the ramp runs on rule replicas with no model; the
+# join-stall drill's two tiny engines are the same cost class as the
+# handoff bench), and a PR that breaks zero-drop scale-down, bounded
+# time-to-scale, or join-stall containment must fail the quick table
 # the quality-observatory online drill stays on --quick too — it is the
 # quality-regression gate (rule replicas, no model, trimmed capacity
 # probes, ~seconds of canary cadence), and a PR that blinds the golden
@@ -66,7 +71,7 @@ QUICK_BENCHES = ["bench_quality.py", "bench_quality_online.py",
                  "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
                  "bench_chaos.py", "bench_steplog.py", "bench_router.py",
-                 "bench_handoff.py", "bench_fleet.py"]
+                 "bench_handoff.py", "bench_fleet.py", "bench_autopilot.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_QO_MAX_N": "4", "BENCH_QO_UTTERANCES": "2",
@@ -83,7 +88,9 @@ QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_HANDOFF_STT_STREAMS": "2",
              "BENCH_HANDOFF_STT_UTTERANCES": "2",
              "BENCH_HANDOFF_TURNS": "5",
-             "BENCH_FLEET_MAX_N": "6", "BENCH_FLEET_UTTERANCES": "2"}
+             "BENCH_FLEET_MAX_N": "6", "BENCH_FLEET_UTTERANCES": "2",
+             "BENCH_AUTOPILOT_HIGH_N": "6", "BENCH_AUTOPILOT_UTTERANCES": "2",
+             "BENCH_AUTOPILOT_TURNS": "2"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -175,7 +182,7 @@ def main() -> None:
                             "spec", "stt", "radix", "swarm", "chaos",
                             "steplog", "engine_step", "xla", "hbm",
                             "router", "kv_quant", "handoff", "fleet",
-                            "quality"):
+                            "quality", "autopilot"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

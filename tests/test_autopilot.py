@@ -1,0 +1,683 @@
+"""Fleet autopilot (ISSUE 16): closed-loop elastic capacity.
+
+Fast-tier coverage for tpu_voice_agent/services/autopilot.py and the ring
+machinery it leans on:
+
+- scale-up joins pre-warmed: spawn -> joining -> pack/adopt via the
+  ``serve.handoff`` wire -> admit, with ``adopted_tokens`` recorded and
+  fresh gray/pressure state on the admitted member
+- respawn hygiene (satellite 1): ``add_member`` at a reused key and
+  ``admit`` both produce clean gray/outlier/pressure carry-forwards
+- JOINING members are probe-invisible: failing probes never eject them,
+  ok probes never auto-admit them cold
+- the manual-drain-vs-join slot race: an operator ``POST /admin/drain``
+  landing mid-pre-warm always wins — the controller aborts the join and
+  never admits the claimed member
+- join-stall containment (satellite 2's controller half): a pre-warm
+  that outlives ``AUTOPILOT_JOIN_TIMEOUT_S`` retires the stuck member
+  and retries WITHOUT dropping the target or admitting cold
+- the ``replica_join_stall`` chaos point wiring in the real brain app:
+  the adopt POST stalls for CHAOS_HANG_S on the armed event, exactly once
+- starved signals hold: a controller that cannot read a single fresh
+  time-series sample moves nothing, in either direction
+- cooldown blocks are decisions: an earned streak inside the cooldown
+  window lands a ``hold``/``cooldown`` entry and a counter, not a commit
+- scale-down is zero-drop: drain -> proactive warm ship -> repoint ->
+  eject at inflight==0 -> retire, with the shipped session still
+  answering 200 on its new home
+- the STT tier rides the same band controller through ``resize``
+- the race hammer (satellite 3): ramp decisions racing manual drains,
+  probe ejects and gray demotions on fake replicas — zero lost sessions,
+  cooldown spacing holds in the decision log, the manual drain's slot is
+  never re-admitted
+"""
+
+import asyncio
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from aiohttp import web
+
+from tests.http_helper import AppServer
+from tpu_voice_agent.services.autopilot import AutopilotController
+from tpu_voice_agent.services.brain import RuleBasedParser
+from tpu_voice_agent.services.brain import build_app as build_brain
+from tpu_voice_agent.services.router import BrainRouter, _weight
+from tpu_voice_agent.services.router import build_app as build_router
+from tpu_voice_agent.utils import chaos as chaos_mod
+from tpu_voice_agent.utils import get_metrics
+
+
+def _get(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _post(url: str, body: dict, timeout: float = 20.0):
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, {}
+
+
+def _post_raw(url: str, data: bytes, timeout: float = 10.0):
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/octet-stream"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def _counters() -> dict:
+    return dict(get_metrics().snapshot()["counters"])
+
+
+def _delta(before: dict, name: str) -> float:
+    return _counters().get(name, 0.0) - before.get(name, 0.0)
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def _fake_member(name: str, log: list, controls: dict):
+    """Brain-contract stand-in (the test_fleet fake plus the handoff
+    wire): ``controls["parse_ms"]`` drives the busy signal its
+    /debug/timeseries reports (busy = parse_ms x 5 req/s / 1000);
+    ``controls["pack_tokens"]`` is what its handoff pack claims to carry;
+    ``controls["adopt_stall_s"]`` wedges the adopt POST (the join-stall
+    window); ``controls["mute_ts"]`` blinds the telemetry surface."""
+    rule = RuleBasedParser()
+    seq = {"n": 0}
+
+    async def parse(req: web.Request) -> web.Response:
+        body = await req.json()
+        log.append((name, body.get("session_id")))
+        resp = rule.parse(body["text"], body.get("context") or {})
+        return web.json_response(json.loads(resp.model_dump_json()))
+
+    async def health(_req: web.Request) -> web.Response:
+        return web.json_response({"ok": True, "service": "brain"})
+
+    async def timeseries(_req: web.Request) -> web.Response:
+        if controls.get("mute_ts"):
+            raise web.HTTPNotFound()
+        # one fresh sample per scrape: deterministic windows
+        s = {"seq": seq["n"], "t_s": time.time(), "dt_s": 0.1,
+             "gauges": {}, "rates": {},
+             "hist": {"brain.parse": {"ms_per": controls.get("parse_ms", 10.0),
+                                      "per_s": 5.0}}}
+        seq["n"] += 1
+        return web.json_response({
+            "service": "brain", "interval_s": 0.1, "max_samples": 240,
+            "now_s": time.time(), "next_seq": seq["n"], "samples": [s]})
+
+    async def handoff_pack(req: web.Request) -> web.Response:
+        payload = json.dumps({"from": name, "sid": req.match_info["sid"],
+                              "tokens": int(controls.get("pack_tokens", 7))})
+        return web.Response(body=payload.encode(),
+                            content_type="application/octet-stream")
+
+    async def handoff_adopt(req: web.Request) -> web.Response:
+        raw = await req.read()
+        stall = float(controls.get("adopt_stall_s", 0.0))
+        if stall > 0:
+            await asyncio.sleep(stall)
+        try:
+            tokens = int(json.loads(raw.decode()).get("tokens", 0))
+        except (ValueError, AttributeError):
+            tokens = 0
+        return web.json_response({"ok": True, "adopted_tokens": tokens})
+
+    app = web.Application()
+    app.router.add_post("/parse", parse)
+    app.router.add_get("/health", health)
+    app.router.add_get("/debug/timeseries", timeseries)
+    app.router.add_get("/admin/handoff/{sid}", handoff_pack)
+    app.router.add_post("/admin/handoff", handoff_adopt)
+    return app
+
+
+def _ring(n: int, **router_kw):
+    logs = [[] for _ in range(n)]
+    controls = [{"parse_ms": 10.0} for _ in range(n)]
+    servers = [AppServer(_fake_member(f"r{i}", logs[i], controls[i])).__enter__()
+               for i in range(n)]
+    router_kw.setdefault("probe_s", 0.1)
+    router_kw.setdefault("fleet_windows", 2)
+    router_kw.setdefault("fleet_min_peers", 3)
+    robj = BrainRouter([s.url for s in servers], **router_kw)
+    router = AppServer(build_router(robj)).__enter__()
+    return router, servers, logs, controls, robj
+
+
+def _teardown(router, servers):
+    router.__exit__(None, None, None)
+    for s in servers:
+        try:
+            s.__exit__(None, None, None)
+        except Exception:
+            pass
+
+
+def _sid_homed_on(robj: BrainRouter, idx: int, prefix: str) -> str:
+    urls = [r.url for r in robj.replicas]
+    for i in range(10_000):
+        sid = f"{prefix}{i}"
+        if max(range(len(urls)), key=lambda j: _weight(urls[j], sid)) == idx:
+            return sid
+    raise AssertionError("no session hashed onto the target replica")
+
+
+def _wait(pred, timeout_s: float = 10.0, step_s: float = 0.05):
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(step_s)
+    return False
+
+
+class _Spawner:
+    """The duck-typed spawner over in-process fake members: each spawn
+    boots a fresh AppServer whose controls start from ``template`` (so a
+    test can pre-arm an adopt stall on the NEXT member to join)."""
+
+    def __init__(self, template: dict | None = None):
+        self.template = dict(template or {})
+        self.servers: dict[str, AppServer] = {}
+        self.logs: dict[str, list] = {}
+        self.controls: dict[str, dict] = {}
+        self.spawns = 0
+        self.retired: list[str] = []
+
+    async def spawn(self) -> str:
+        loop = asyncio.get_running_loop()
+        log: list = []
+        controls = dict(self.template)
+        name = f"spawn{self.spawns}"
+        self.spawns += 1
+        srv = await loop.run_in_executor(
+            None,
+            lambda: AppServer(_fake_member(name, log, controls)).__enter__())
+        self.servers[srv.url] = srv
+        self.logs[srv.url] = log
+        self.controls[srv.url] = controls
+        return srv.url
+
+    async def retire(self, url: str) -> None:
+        self.retired.append(url)
+        srv = self.servers.pop(url, None)
+        if srv is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, lambda: srv.__exit__(None, None, None))
+
+    def close(self) -> None:
+        for srv in list(self.servers.values()):
+            try:
+                srv.__exit__(None, None, None)
+            except Exception:
+                pass
+        self.servers.clear()
+
+
+def _mk_ap(robj, spawner, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("interval_s", 0.1)
+    kw.setdefault("target_util", 0.5)
+    kw.setdefault("up_windows", 2)
+    kw.setdefault("down_windows", 3)
+    kw.setdefault("cooldown_s", 0.05)
+    kw.setdefault("join_timeout_s", 5.0)
+    kw.setdefault("forecast_lead_s", 0.3)
+    return AutopilotController(robj, spawner, **kw)
+
+
+def _tick(router_srv, ap, timeout_s: float = 30.0) -> dict:
+    return asyncio.run_coroutine_threadsafe(
+        ap.tick_once(), router_srv._loop).result(timeout_s)
+
+
+def _on_loop(router_srv, coro, timeout_s: float = 30.0):
+    return asyncio.run_coroutine_threadsafe(coro, router_srv._loop).result(
+        timeout_s)
+
+
+# ------------------------------------------------------------ join pipeline
+
+
+def test_scale_up_prewarms_then_admits_fresh():
+    router, servers, logs, controls, robj = _ring(1)
+    spawner = _Spawner()
+    try:
+        # a sticky session gives the pre-warm a donor; the donor's pack
+        # payload is what the joiner adopts
+        controls[0]["pack_tokens"] = 9
+        st, _ = _post(router.url + "/parse",
+                      {"text": "scroll down", "session_id": "warmsrc",
+                       "context": {}})
+        assert st == 200
+        ap = _mk_ap(robj, spawner)
+        c0 = _counters()
+        controls[0]["parse_ms"] = 300.0  # busy 1.5 -> desired 3 of max 3
+        _tick(router, ap)                # streak 1: no commit yet
+        desc = _tick(router, ap)         # streak 2: commit +1, join inline
+        assert desc["brain"]["target"] == 2
+        assert desc["brain"]["actual"] == 2, desc
+        join = [d for d in ap.decisions if d["action"] == "join"]
+        assert join and join[-1]["reason"] == "prewarmed"
+        assert join[-1]["adopted_tokens"] == 9
+        assert _delta(c0, "autopilot.scale_ups") == 1
+        assert _delta(c0, "autopilot.joins_prewarmed") == 1
+        assert _delta(c0, "autopilot.joins_cold") == 0
+        # the admitted member carries zero fleet-state (satellite 1)
+        new = next(r for r in robj.replicas if r.url in spawner.servers)
+        assert new.state == "up" and not new.gray
+        assert new.pressure == 0.0 and new.gray_streak == 0
+    finally:
+        _teardown(router, servers)
+        spawner.close()
+
+
+def test_respawn_and_admit_reset_gray_and_pressure():
+    router, servers, logs, controls, robj = _ring(3)
+    try:
+        # drift r0 into gray against its peers
+        controls[0]["parse_ms"] = 300.0
+        assert _wait(lambda: robj.replicas[0].gray, 10.0), "never went gray"
+        victim = robj.replicas[0]
+        victim.pressure = 0.8  # a saturation carry-forward to shed
+
+        async def respawn():
+            old_idx = victim.idx
+            robj.start_drain(victim)
+            robj.remove_member(victim.url)
+            fresh = robj.add_member(victim.url, joining=True)
+            return old_idx, fresh
+
+        old_idx, fresh = _on_loop(router, respawn())
+        # a reused key is a brand-new member: no verdict survives the
+        # process it described (satellite 1)
+        assert fresh.idx != old_idx
+        assert not fresh.gray and fresh.pressure == 0.0
+        assert fresh.outlier_score == 0.0 and fresh.signals == {}
+        # and admit() itself wipes state stamped while joining
+        fresh.pressure = 0.5
+        fresh.gray_streak = 2
+        _on_loop(router, asyncio.sleep(0))  # settle the prober's slice
+        robj.admit(fresh)
+        assert fresh.state == "up" and fresh.pressure == 0.0
+        assert fresh.gray_streak == 0
+    finally:
+        _teardown(router, servers)
+
+
+def test_joining_member_is_probe_invisible():
+    router, servers, logs, controls, robj = _ring(
+        1, probe_s=0.05, probe_fails=2)
+    try:
+        async def add_dead():
+            return robj.add_member("http://127.0.0.1:9", joining=True)
+
+        r = _on_loop(router, add_dead())
+        # every probe of the dead url fails, yet probe_fails x probe_s
+        # later the member is still the controller's: joining, not down
+        time.sleep(0.5)
+        assert r.state == "joining"
+        assert robj._by_url.get(r.url) is r
+        # and it never took placement: an anonymous parse routes around it
+        st, _ = _post(router.url + "/parse", {"text": "scroll down",
+                                              "context": {}})
+        assert st == 200 and logs[0]
+        _on_loop(router, asyncio.sleep(0))
+        robj.remove_member(r.url)
+    finally:
+        _teardown(router, servers)
+
+
+def test_manual_drain_wins_join_race():
+    router, servers, logs, controls, robj = _ring(1)
+    spawner = _Spawner({"adopt_stall_s": 0.4, "pack_tokens": 5})
+    try:
+        st, _ = _post(router.url + "/parse",
+                      {"text": "scroll down", "session_id": "racewarm",
+                       "context": {}})
+        assert st == 200
+        ap = _mk_ap(robj, spawner, down_windows=100)
+        c0 = _counters()
+
+        async def drive() -> str:
+            ap.target = 2  # reconcile must join on the next tick
+            t = asyncio.ensure_future(ap.tick_once())
+            loop = asyncio.get_running_loop()
+            end = loop.time() + 5.0
+            while not any(r.state == "joining" for r in robj.replicas):
+                assert loop.time() < end, "join never started"
+                await asyncio.sleep(0.01)
+            j = next(r for r in robj.replicas if r.state == "joining")
+            # the operator's POST /admin/drain lands mid-pre-warm
+            assert robj.start_drain(j)
+            await t
+            return j.url
+
+        claimed = _on_loop(router, drive(), 15.0)
+        aborted = [d for d in ap.decisions if d["action"] == "join_aborted"]
+        assert aborted and aborted[-1]["reason"] == "manual_drain"
+        assert aborted[-1]["replica"] == claimed
+        assert _delta(c0, "autopilot.joins_prewarmed") == 0
+        assert _delta(c0, "autopilot.joins_cold") == 0
+        # the next tick retires the claimed member and joins a NEW one —
+        # the drained slot is never recycled into capacity
+        assert _wait(lambda: (_tick(router, ap)["brain"]["actual"] == 2
+                              and claimed not in robj._by_url), 15.0)
+        assert claimed in spawner.retired
+        joins = [d for d in ap.decisions if d["action"] == "join"]
+        assert joins and all(d["replica"] != claimed for d in joins)
+    finally:
+        _teardown(router, servers)
+        spawner.close()
+
+
+def test_join_stall_times_out_retires_and_retries():
+    router, servers, logs, controls, robj = _ring(1)
+    spawner = _Spawner({"adopt_stall_s": 3.0})
+    try:
+        controls[0]["pack_tokens"] = 6  # the donor side of the pre-warm
+        st, _ = _post(router.url + "/parse",
+                      {"text": "scroll down", "session_id": "stallwarm",
+                       "context": {}})
+        assert st == 200
+        ap = _mk_ap(robj, spawner, down_windows=100, join_timeout_s=0.4)
+        c0 = _counters()
+
+        async def arm():
+            ap.target = 2
+
+        _on_loop(router, arm())
+        desc = _tick(router, ap)  # the join wedges in the adopt POST
+        assert _delta(c0, "autopilot.join_timeouts") == 1
+        assert _delta(c0, "autopilot.joins_cold") == 0, \
+            "a stalled join must never be admitted cold"
+        aborted = [d for d in ap.decisions if d["action"] == "join_aborted"]
+        assert aborted and aborted[-1]["reason"] == "join_timeout"
+        stuck = aborted[-1]["replica"]
+        assert stuck not in robj._by_url and stuck in spawner.retired
+        assert ap.target == 2, "a stuck join must not drop the target"
+        assert desc["brain"]["actual"] == 1
+        # next tick retries against a healthy joiner and pre-warms it
+        spawner.template["adopt_stall_s"] = 0.0
+        desc = _tick(router, ap)
+        assert desc["brain"]["actual"] == 2
+        joins = [d for d in ap.decisions if d["action"] == "join"]
+        assert joins and joins[-1]["reason"] == "prewarmed"
+        assert joins[-1]["adopted_tokens"] == 6
+    finally:
+        _teardown(router, servers)
+        spawner.close()
+
+
+def test_chaos_replica_join_stall_point_fires_in_brain():
+    """The chaos wiring itself (satellite 2's brain half): the armed
+    event's adopt POST stalls for CHAOS_HANG_S, exactly once, and counts
+    under ``chaos.replica_join_stall``. The full engine-backed drill
+    (timeout -> retire -> retry -> warm admit) runs in bench_autopilot."""
+    os.environ["CHAOS_HANG_S"] = "0.4"
+    chaos_mod.configure("replica_join_stall@1", seed=7)
+    try:
+        with AppServer(build_brain(RuleBasedParser())) as srv:
+            c0 = _counters()
+            t0 = time.monotonic()
+            _post_raw(srv.url + "/admin/handoff", b"{}")
+            stalled = time.monotonic() - t0
+            assert stalled >= 0.35, f"stall never injected ({stalled:.3f}s)"
+            assert _delta(c0, "chaos.replica_join_stall") == 1
+            t0 = time.monotonic()
+            _post_raw(srv.url + "/admin/handoff", b"{}")
+            assert time.monotonic() - t0 < 0.3, "@1 fired more than once"
+            assert _delta(c0, "chaos.replica_join_stall") == 1
+    finally:
+        chaos_mod.reset()
+        os.environ.pop("CHAOS_HANG_S", None)
+
+
+# --------------------------------------------------------- band discipline
+
+
+def test_starved_signals_hold_everything():
+    router, servers, logs, controls, robj = _ring(1)
+    spawner = _Spawner()
+    try:
+        controls[0]["mute_ts"] = True    # telemetry plane dark
+        controls[0]["parse_ms"] = 500.0  # real load the controller can't see
+        ap = _mk_ap(robj, spawner)
+        c0 = _counters()
+        for _ in range(4):
+            desc = _tick(router, ap)
+        assert _delta(c0, "autopilot.holds_starved") == 4
+        assert desc["brain"]["target"] == 1
+        assert spawner.spawns == 0, "a blind controller must not act"
+        holds = [d for d in ap.decisions if d["action"] == "hold"]
+        assert holds and holds[-1]["reason"] == "starved"
+    finally:
+        _teardown(router, servers)
+        spawner.close()
+
+
+def test_cooldown_block_is_counted_and_logged():
+    router, servers, logs, controls, robj = _ring(1)
+    spawner = _Spawner()
+    try:
+        ap = _mk_ap(robj, spawner, up_windows=1, cooldown_s=60.0)
+        c0 = _counters()
+        controls[0]["parse_ms"] = 300.0
+        _tick(router, ap)  # commits +1 and arms the cooldown
+        assert ap.target == 2
+        desc = _tick(router, ap)  # streak earned again, cooldown holds it
+        assert ap.target == 2
+        assert _delta(c0, "autopilot.cooldown_blocks") >= 1
+        holds = [d for d in ap.decisions
+                 if d["action"] == "hold" and d["reason"] == "cooldown"]
+        assert holds and holds[-1]["cooldown_remaining_s"] > 0
+        assert desc["brain"]["cooldown_remaining_s"] > 50.0
+    finally:
+        _teardown(router, servers)
+        spawner.close()
+
+
+def test_scale_down_ships_warm_and_drops_nothing():
+    router, servers, logs, controls, robj = _ring(3)
+    spawner = _Spawner()
+    try:
+        # two sessions each on r0/r1, one on r2: r2 is the cheapest exit
+        sids = [_sid_homed_on(robj, 0, "a"), _sid_homed_on(robj, 0, "b"),
+                _sid_homed_on(robj, 1, "c"), _sid_homed_on(robj, 1, "d"),
+                _sid_homed_on(robj, 2, "v")]
+        for sid in sids:
+            st, _ = _post(router.url + "/parse",
+                          {"text": "scroll down", "session_id": sid,
+                           "context": {}})
+            assert st == 200
+        victim_sid, victim_url = sids[-1], robj.replicas[2].url
+        ap = _mk_ap(robj, spawner, min_replicas=2, max_replicas=3,
+                    down_windows=2)
+        assert ap.target == 3
+        c0 = _counters()
+        _tick(router, ap)         # idle fleet: down streak 1
+        _tick(router, ap)         # streak 2: commit -1, drain + ship inline
+        assert ap.target == 2
+        assert _delta(c0, "autopilot.scale_downs") == 1
+        drains = [d for d in ap.decisions if d["action"] == "drain"]
+        assert drains and drains[-1]["replica"] == victim_url
+        # the sticky session was shipped warm and repointed before eject
+        assert _delta(c0, "autopilot.sessions_shipped") == 1
+        new_home = robj._sessions[victim_sid]
+        assert new_home != victim_url
+        # zero-drop: the shipped session still answers, on its new home
+        st, _ = _post(router.url + "/parse",
+                      {"text": "go back", "session_id": victim_sid,
+                       "context": {}})
+        assert st == 200
+        served = next(i for i, s in enumerate(servers) if s.url == new_home)
+        assert any(e[1] == victim_sid for e in logs[served])
+        # the retirement tail: out of the ring only at inflight == 0
+        assert _wait(lambda: (_tick(router, ap)
+                              and victim_url not in robj._by_url), 10.0)
+        assert _delta(c0, "autopilot.retired") == 1
+        assert victim_url in spawner.retired
+        assert sum(1 for r in robj.replicas if r.state == "up") == 2
+    finally:
+        _teardown(router, servers)
+        spawner.close()
+
+
+def test_stt_tier_rides_the_band():
+    class _FakeSTT:
+        def __init__(self):
+            self.pressure = 0.0
+
+        def servable(self):
+            return True
+
+    class _FakeTier:
+        def __init__(self, n):
+            self.replicas = [_FakeSTT() for _ in range(n)]
+            self.resizes: list[int] = []
+
+        def resize(self, n):
+            self.resizes.append(n)
+            while len(self.replicas) < n:
+                self.replicas.append(_FakeSTT())
+            del self.replicas[n:]
+
+    router, servers, logs, controls, robj = _ring(1)
+    spawner = _Spawner()
+    tier = _FakeTier(1)
+    try:
+        ap = _mk_ap(robj, spawner, stt_tier=tier, up_windows=2,
+                    down_windows=2, cooldown_s=0.05)
+        for r in tier.replicas:
+            r.pressure = 0.9  # sustained over target_util
+        _tick(router, ap)
+        _tick(router, ap)
+        assert ap.stt_target == 2 and tier.resizes == [2]
+        ups = [d for d in ap.decisions
+               if d["tier"] == "stt" and d["action"] == "scale_up"]
+        assert ups and ups[-1]["reason"] == "pressure"
+        for r in tier.replicas:
+            r.pressure = 0.05  # deep under the band
+        time.sleep(0.1)  # let the cooldown lapse
+        _tick(router, ap)
+        _tick(router, ap)
+        assert ap.stt_target == 1 and tier.resizes == [2, 1]
+        assert len(tier.replicas) == 1
+    finally:
+        _teardown(router, servers)
+        spawner.close()
+
+
+# ------------------------------------------------------------- race hammer
+
+
+def test_autopilot_race_hammer():
+    """Satellite 3: the control loop at full tick rate racing live
+    traffic, a manual drain, a gray demotion and a cold replica kill.
+    Invariants: every client parse answers 200 (zero lost sessions),
+    committed scale actions respect the cooldown spacing in the decision
+    log, and the operator's drained slot is never readmitted."""
+    router, servers, logs, controls, robj = _ring(3, probe_s=0.1,
+                                                  probe_fails=2)
+    spawner = _Spawner({"pack_tokens": 4})
+    ap = AutopilotController(robj, spawner, min_replicas=1, max_replicas=6,
+                             interval_s=0.1, target_util=0.5, up_windows=2,
+                             down_windows=3, cooldown_s=0.6,
+                             join_timeout_s=5.0, forecast_lead_s=0.3)
+    _on_loop(router, ap.start())
+    statuses: list = []
+    stop = threading.Event()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            sid = f"ham{i % 6}"
+            try:
+                st, _ = _post(router.url + "/parse",
+                              {"text": "scroll down", "session_id": sid,
+                               "context": {}}, timeout=10.0)
+            except Exception as e:  # a transport-level loss IS a lost turn
+                st = f"exc:{type(e).__name__}"
+            statuses.append(st)
+            i += 1
+            time.sleep(0.03)
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    drained_url = None
+    try:
+        # phase 1: sustained high load — the controller ramps, and every
+        # streak earned inside a cooldown window lands a hold/cooldown
+        for c in controls:
+            c["parse_ms"] = 400.0
+        time.sleep(1.6)
+        # phase 2: the operator drains an up member mid-ramp
+        victim = next(r for r in robj.replicas if r.state == "up")
+        drained_url = victim.url
+        st, body = _post(router.url + "/admin/drain",
+                         {"replica": drained_url})
+        assert st == 200 and body["ok"]
+        # phase 3: a seed member drifts into gray under the same ramp
+        seed_urls = [s.url for s in servers]
+        gray_url = next(u for u in seed_urls
+                        if u != drained_url and u in robj._by_url
+                        and robj._by_url[u].state == "up")
+        controls[seed_urls.index(gray_url)]["parse_ms"] = 4000.0
+        assert _wait(lambda: (gray_url not in robj._by_url
+                              or robj._by_url[gray_url].gray), 5.0), \
+            "outlier never demoted"
+        # phase 4: a spawned member dies cold — probes must eject it while
+        # its sessions fail over
+        for url, srv in list(spawner.servers.items()):
+            spawner.servers.pop(url)
+            srv.__exit__(None, None, None)
+            break
+        time.sleep(0.8)
+        # phase 5: the load collapses — the controller shrinks back
+        for c in controls:
+            c["parse_ms"] = 10.0
+        time.sleep(2.0)
+    finally:
+        stop.set()
+        th.join(10.0)
+        _on_loop(router, ap.stop(), 15.0)
+        _teardown(router, servers)
+        spawner.close()
+    # zero lost sessions: every turn of every session answered 200 —
+    # through the ramp, the drain, the gray demotion and the kill
+    assert statuses and all(st == 200 for st in statuses), \
+        [st for st in statuses if st != 200][:5]
+    # the loop both grew and shrank capacity under the hammer
+    acts = [d for d in ap.decisions if d["tier"] == "brain"]
+    commits = [d for d in acts if d["action"] in ("scale_up", "scale_down")]
+    assert any(d["action"] == "scale_up" for d in commits)
+    assert any(d["action"] == "join" for d in acts)
+    # cooldown honored: consecutive commits are spaced by >= cooldown_s
+    for a, b in zip(commits, commits[1:]):
+        assert b["t"] - a["t"] >= 0.6 - 0.1, (a, b)
+    assert any(d["action"] == "hold" and d["reason"] == "cooldown"
+               for d in acts), "no cooldown block ever logged"
+    # the manual drain always wins its slot: never readmitted, never the
+    # target of a later join
+    r = robj._by_url.get(drained_url)
+    assert r is None or r.state in ("draining", "drained")
+    assert all(d.get("replica") != drained_url
+               for d in acts if d["action"] == "join")

@@ -554,7 +554,11 @@ def test_replica_degrade_e2e_and_fleetview_dump(monkeypatch, tmp_path):
         txt = fleetview.render_file(dump)
         assert "demoted on fwd_ms" in txt and gray_urls[0] in txt
         # the live fan-out renders too (real /debug/timeseries bodies)
-        health, series = fleetview.one_frame(router.url, 32)
+        health, series, autopilot = fleetview.one_frame(router.url, 32)
+        # no controller attached in this harness -> the panel degrades
+        assert not autopilot.get("enabled")
+        assert fleetview.render_autopilot(autopilot) == \
+            "autopilot: not attached"
         frame = fleetview.render_fleet(health, series)
         assert "GRAY" in frame and "parse_ms" in frame
     finally:

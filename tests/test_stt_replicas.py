@@ -55,7 +55,7 @@ def _utt_homed_on(tier: STTReplicaTier, idx: int, base: int = 50_000) -> int:
 
 def _tick_all(tier, rounds=8):
     for _ in range(rounds):
-        for b in tier.batchers:
+        for b in tier.batchers.values():
             if b.healthy():
                 b.tick()
 
@@ -85,7 +85,7 @@ def test_tier_affinity_identity_and_release(engine):
         _tick_all(tier)
         tier.release(u)
         assert str(u) not in tier._sessions
-        for b in tier.batchers:
+        for b in tier.batchers.values():
             assert u not in b.slot_of  # the slot is freed everywhere
     finally:
         tier.stop()
@@ -131,7 +131,7 @@ def test_all_replicas_down_fails_finals_sheds_partials(engine):
     tier = STTReplicaTier(engine, replicas=2, slots=4, autostart=False,
                           register=False)
     try:
-        for b in tier.batchers:
+        for b in tier.batchers.values():
             b.kill(RuntimeError("gone"))
         f = tier.submit("final", 61_000, tone(300, 0.4))
         with pytest.raises(RuntimeError):
@@ -169,7 +169,7 @@ def test_watchdog_warm_restarts_killed_replica_and_ring_recovers(engine):
             assert time.monotonic() < deadline, "watchdog never restarted"
             time.sleep(0.05)
         deadline = time.monotonic() + 10
-        while not all(b.healthy() for b in tier.batchers):
+        while not all(b.healthy() for b in tier.batchers.values()):
             assert time.monotonic() < deadline
             time.sleep(0.05)
         # the restarted replica serves again

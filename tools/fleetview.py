@@ -50,13 +50,14 @@ DEFAULT_ROUTER = "http://127.0.0.1:8095"
 SPARK = " ▁▂▃▄▅▆▇█"
 
 
-def fetch_json(url: str, timeout_s: float = 5.0) -> dict:
+def fetch_json(url: str, timeout_s: float = 5.0, quiet: bool = False) -> dict:
     try:
         with urllib.request.urlopen(url, timeout=timeout_s) as r:
             body = json.loads(r.read().decode())
         return body if isinstance(body, dict) else {}
     except (urllib.error.URLError, OSError, ValueError) as e:
-        print(f"[fleetview] {url}: {e}", file=sys.stderr)
+        if not quiet:
+            print(f"[fleetview] {url}: {e}", file=sys.stderr)
         return {}
 
 
@@ -149,6 +150,51 @@ def render_fleet(health: dict, series: dict[str, list[dict]],
     return "\n".join(lines)
 
 
+def render_autopilot(desc: dict) -> str:
+    """The autopilot panel (ISSUE 16): target vs actual per tier, the
+    control signals (load, forecast, streaks, cooldown), and the last
+    decisions with their reasons — the operator's answer to "why is the
+    fleet this size, and what will the controller do next"."""
+    if not desc.get("enabled"):
+        return "autopilot: not attached"
+    lines: list[str] = []
+    b = desc.get("brain") or {}
+    lines.append(
+        f"autopilot[brain]: target {b.get('target')} / actual "
+        f"{b.get('actual')} up (+{b.get('joining', 0)} joining, "
+        f"{b.get('draining', 0)} draining) in [{b.get('min')}, "
+        f"{b.get('max')}] — load {_fmt(b.get('load'))} forecast "
+        f"{_fmt(b.get('forecast'))}, streaks +{b.get('up_streak', 0)}/"
+        f"-{b.get('down_streak', 0)}, cooldown "
+        f"{_fmt(b.get('cooldown_remaining_s'))}s")
+    if b.get("retiring"):
+        lines.append(f"  retiring: {', '.join(b['retiring'])}")
+    s = desc.get("stt")
+    if s:
+        lines.append(
+            f"autopilot[stt]: target {s.get('target')} / actual "
+            f"{s.get('actual')} ({s.get('healthy')} healthy) in "
+            f"[{s.get('min')}, {s.get('max')}], streaks "
+            f"+{s.get('up_streak', 0)}/-{s.get('down_streak', 0)}, "
+            f"cooldown {_fmt(s.get('cooldown_remaining_s'))}s")
+    decisions = desc.get("decisions") or []
+    if decisions:
+        lines.append("last decisions:")
+        for d in decisions[-6:]:
+            extra = ""
+            if "adopted_tokens" in d:
+                extra = f" adopted={d['adopted_tokens']}"
+            if "replica" in d:
+                extra += f" {d['replica']}"
+            lines.append(
+                f"  [{d.get('tier')}] {d.get('action')}/{d.get('reason')} "
+                f"target {d.get('target')} actual {d.get('actual')} "
+                f"signal {_fmt(d.get('signal'))} forecast "
+                f"{_fmt(d.get('forecast'))} cooldown "
+                f"{_fmt(d.get('cooldown_remaining_s'))}s{extra}")
+    return "\n".join(lines)
+
+
 def render_evidence(evidence: dict) -> str:
     """The peer-comparison evidence a gray freeze carries: who was
     demoted, on which signal, how far from the fleet — the dump answers
@@ -189,7 +235,8 @@ def render_file(body: dict, width: int = 48) -> str:
         snaps = body.get("metric_snapshots") or []
         if snaps:
             keys = sorted({k for s in snaps for k in (s.get("gauges") or {})
-                           if k.startswith(("fleet.", "router.", "ts."))})
+                           if k.startswith(("fleet.", "router.", "ts.",
+                                            "autopilot."))})
             lines.append(f"{len(snaps)} metric snapshots; fleet gauges:")
             for k in keys:
                 xs = [s.get("gauges", {}).get(k) for s in snaps]
@@ -197,6 +244,9 @@ def render_file(body: dict, width: int = 48) -> str:
                 lines.append(f"  {k.ljust(26)}|{sparkline(xs, width)}| "
                              f"{_fmt(latest)}")
         return "\n".join(lines)
+    # a saved /admin/autopilot body (the controller's describe())
+    if "decisions" in body and "brain" in body:
+        return render_autopilot(body)
     # router fan-out: {"replicas": {url: timeseries body}}
     if isinstance(body.get("replicas"), dict):
         series = {url: (b.get("samples") or [])
@@ -213,13 +263,17 @@ def render_file(body: dict, width: int = 48) -> str:
         "/debug/timeseries body)"
 
 
-def one_frame(router_url: str, width: int) -> tuple[dict, dict]:
+def one_frame(router_url: str, width: int) -> tuple[dict, dict, dict]:
     health = fetch_json(router_url.rstrip("/") + "/health")
     fan = fetch_json(router_url.rstrip("/") + "/debug/replicas/timeseries")
     series = {url: (b.get("samples") or [])
               for url, b in (fan.get("replicas") or {}).items()
               if isinstance(b, dict)}
-    return health, series
+    # 404s (no autopilot attached) come back as {} (quiet — absence is a
+    # legitimate deployment, not an error worth a line per frame)
+    autopilot = fetch_json(router_url.rstrip("/") + "/admin/autopilot",
+                           quiet=True)
+    return health, series, autopilot
 
 
 # -------------------------------------------------------------- self-test
@@ -285,6 +339,38 @@ def self_test() -> int:
            "replicas": {"http://r0": {"samples": series["http://r0"]}}}
     assert "http://r0" in render_file(fan)
     assert "unrecognized" in render_file({"bogus": 1})
+    # the autopilot panel (ISSUE 16): live describe() body + dump gauges
+    desc = {"enabled": True,
+            "brain": {"target": 3, "actual": 2, "joining": 1, "draining": 0,
+                      "retiring": ["http://r9"], "min": 1, "max": 4,
+                      "load": 1.61, "forecast": 2.05, "up_streak": 1,
+                      "down_streak": 0, "cooldown_remaining_s": 0.4},
+            "stt": {"target": 2, "actual": 2, "healthy": 2, "min": 1,
+                    "max": 4, "up_streak": 0, "down_streak": 0,
+                    "cooldown_remaining_s": 0.0},
+            "decisions": [
+                {"t": 1.0, "tier": "brain", "action": "scale_up",
+                 "reason": "forecast", "signal": 1.5, "forecast": 2.0,
+                 "target": 3, "actual": 2, "cooldown_remaining_s": 0.0},
+                {"t": 2.0, "tier": "brain", "action": "join",
+                 "reason": "prewarmed", "signal": None, "forecast": None,
+                 "target": 3, "actual": 3, "cooldown_remaining_s": 0.4,
+                 "replica": "http://r3", "adopted_tokens": 57},
+            ]}
+    atxt = render_autopilot(desc)
+    assert "target 3 / actual 2" in atxt and "scale_up/forecast" in atxt
+    assert "join/prewarmed" in atxt and "adopted=57" in atxt
+    assert "autopilot[stt]" in atxt and "retiring: http://r9" in atxt
+    assert render_autopilot({"enabled": False}) == "autopilot: not attached"
+    assert "join/prewarmed" in render_file(desc)  # saved describe() body
+    apdump = {"frozen": True, "reason": "slo.p99", "detail": None,
+              "metric_snapshots": [
+                  {"t_s": 1.0, "gauges": {"autopilot.target_replicas": 2.0,
+                                          "autopilot.load": 0.8}},
+                  {"t_s": 2.0, "gauges": {"autopilot.target_replicas": 3.0,
+                                          "autopilot.load": 1.9}}]}
+    aptxt = render_file(apdump)
+    assert "autopilot.target_replicas" in aptxt and "autopilot.load" in aptxt
     print(txt)
     print("fleetview self-test ok")
     return 0
@@ -318,15 +404,19 @@ def main(argv: list[str] | None = None) -> int:
             print(render_file(body, width=args.width))
         return 0
     while True:
-        health, series = one_frame(args.router, args.width)
+        health, series, autopilot = one_frame(args.router, args.width)
         if not health and not series:
             return 2
         if args.json:
-            print(json.dumps({"health": health, "series": series}, indent=1))
+            print(json.dumps({"health": health, "series": series,
+                              "autopilot": autopilot}, indent=1))
         else:
             if args.watch:
                 print("\x1b[2J\x1b[H", end="")  # clear between frames
             print(render_fleet(health, series, width=args.width))
+            if autopilot.get("enabled"):
+                print()
+                print(render_autopilot(autopilot))
         if not args.watch:
             return 0
         time.sleep(args.watch)

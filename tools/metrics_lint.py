@@ -198,6 +198,31 @@ PINNED: dict[str, str] = {
     "stt.confidence_min": "gauge",
     "stt.confidence_repetition": "gauge",
     "engine.prefill_remaining_at_endpoint": "gauge",
+    # fleet autopilot (ISSUE 16, services/autopilot.py + services/
+    # router.py, docs/RESILIENCE.md "Fleet autopilot"): the control loop's
+    # decision accounting bench_autopilot gates on — joins_cold is the
+    # never-admit-cold contract (the stall drill requires it stays 0),
+    # join_timeouts the containment counter, sessions_shipped the
+    # zero-drop scale-down's proactive warm-ship count, retired the
+    # drain->ship->eject->retire completions, target/load/forecast the
+    # fleetview panel's dials, replicas_added/removed the ring-churn
+    # counters — renaming any of these blinds the elastic-capacity gates
+    "autopilot.decisions": "counter",
+    "autopilot.scale_ups": "counter",
+    "autopilot.scale_downs": "counter",
+    "autopilot.holds_starved": "counter",
+    "autopilot.cooldown_blocks": "counter",
+    "autopilot.join_timeouts": "counter",
+    "autopilot.joins_prewarmed": "counter",
+    "autopilot.joins_cold": "counter",
+    "autopilot.sessions_shipped": "counter",
+    "autopilot.retired": "counter",
+    "autopilot.target_replicas": "gauge",
+    "autopilot.load": "gauge",
+    "autopilot.forecast_load": "gauge",
+    "autopilot.stt_target_replicas": "gauge",
+    "router.replicas_added": "counter",
+    "router.replicas_removed": "counter",
 }
 
 

@@ -796,6 +796,40 @@ def binary_search_capacity(voice_url: str, *, max_n: int = 32,
     }
 
 
+def run_ramp(voice_url: str, stages: list[int], *,
+             sample_urls: list[str] | None = None,
+             stage_hook=None, **run_kw) -> dict:
+    """Sequential swarm stages at varying N — the load SHAPE elastic-
+    capacity drills need (ramp up, hold the plateau, ramp down), where the
+    capacity bisect only needs a point. Each stage is one full
+    ``run_swarm`` at that N; ``stage_hook(i, n, verdict)``, when given,
+    runs between stages (the autopilot bench snapshots replica counts
+    there). The roll-up verdict is the zero-drop contract's shape: every
+    stage's SLO state, total crashed sessions, total utterance errors —
+    a scale-down that dropped anything shows up as a non-ok stage or a
+    non-zero loss count, never silently."""
+    out: list[dict] = []
+    for i, n in enumerate(stages):
+        r = run_swarm(voice_url, n, sample_urls=sample_urls, **run_kw)
+        errors = sum(s["errors"] for s in r["scenarios"].values())
+        stage = {"stage": i, "n": n, "slo": r["slo"],
+                 "utterances": r["utterances"], "errors": errors,
+                 "sessions_crashed": r["sessions_crashed"],
+                 "wall_s": r["wall_s"], "quality": r.get("quality")}
+        out.append(stage)
+        print(f"[ramp] stage {i} n={n}: slo={r['slo']['state']} "
+              f"p99={r['slo']['p99_ms']} errors={errors} "
+              f"crashed={r['sessions_crashed']}", file=sys.stderr, flush=True)
+        if stage_hook is not None:
+            stage_hook(i, n, stage)
+    return {
+        "stages": out,
+        "all_slo_ok": all(s["slo"]["state"] == "ok" for s in out),
+        "total_errors": sum(s["errors"] for s in out),
+        "total_crashed": sum(s["sessions_crashed"] for s in out),
+    }
+
+
 # --------------------------------------------------------------- local stack
 
 
@@ -853,8 +887,12 @@ def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
         replicas = [AppServer(build_brain(make_parser(),
                                           max_inflight=brain_inflight)).__enter__()
                     for _ in range(brain_replicas)]
-        router = AppServer(build_router(BrainRouter(
-            [b.url for b in replicas], **(router_kw or {})))).__enter__()
+        robj = BrainRouter([b.url for b in replicas], **(router_kw or {}))
+        router = AppServer(build_router(robj)).__enter__()
+        # the live router OBJECT rides on its server (ISSUE 16): elastic-
+        # capacity drills attach an AutopilotController to it on the
+        # router's own loop (router_server.router / router_server._loop)
+        router.router = robj
         brain_url = router.url
         urls["router"] = router.url
         urls["replicas"] = [b.url for b in replicas]

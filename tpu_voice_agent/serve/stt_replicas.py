@@ -100,10 +100,12 @@ class STTReplicaTier(ReplicaSet):
         # failover callbacks concurrently — the session table needs a lock
         self._route_lock = threading.Lock()
         self._autostart = autostart
-        self.batchers = [self._make_batcher() for _ in range(replicas)]
+        # keyed by the member's PERMANENT idx, not list position: elastic
+        # resize (ISSUE 16) retires members, and idx is never reused
+        self.batchers = {r.idx: self._make_batcher() for r in self.replicas}
         # per-replica (last ticks seen, last progress time) for the
         # stalled-tick verdict
-        self._seen = [(0, time.monotonic()) for _ in range(replicas)]
+        self._seen = {r.idx: (0, time.monotonic()) for r in self.replicas}
         # the contract counters exist from construction (scrape-visible at
         # zero — the breaker-gauge discipline)
         m = get_metrics()
@@ -112,7 +114,6 @@ class STTReplicaTier(ReplicaSet):
         m.inc("stt.replica_rehomed", 0.0)
         m.inc("stt.replica_shed_pressure", 0.0)
         m.inc("stt.replica_ejected", 0.0)
-        m.set_gauge("stt.replicas_total", float(replicas))
         self._update_health_gauge()
         self._stop_evt = threading.Event()
         self._watchdog: threading.Thread | None = None
@@ -134,8 +135,11 @@ class STTReplicaTier(ReplicaSet):
     # core routes its accounting through these
 
     def _update_health_gauge(self) -> None:
-        healthy = float(sum(1 for r in self.replicas if r.servable()))
-        get_metrics().set_gauge("stt.replicas_healthy", healthy)
+        m = get_metrics()
+        # total rides the hook so elastic resize (ISSUE 16) keeps it honest
+        m.set_gauge("stt.replicas_total", float(len(self.replicas)))
+        m.set_gauge("stt.replicas_healthy",
+                    float(sum(1 for r in self.replicas if r.servable())))
 
     def _on_rehome(self) -> None:
         get_metrics().inc("stt.replica_rehomed")
@@ -155,8 +159,10 @@ class STTReplicaTier(ReplicaSet):
         through the shared ``apply_probe`` machine, pressure refresh, and
         the warm restart of anything ejected."""
         now = time.monotonic()
-        for r in self.replicas:
-            b = self.batchers[r.idx]
+        for r in list(self.replicas):  # resize may mutate concurrently
+            b = self.batchers.get(r.idx)
+            if b is None:  # retired between the snapshot and this sweep
+                continue
             with b._wake:
                 ticks, busy, depth = b.ticks, b._busy, len(b.queue)
             r.pressure = depth / max(1, b.max_pending)
@@ -183,7 +189,9 @@ class STTReplicaTier(ReplicaSet):
         timing out) and build a fresh one over the SAME engine — loaded
         Whisper weights and compiled programs are reused, so the restart
         is slot-pool bookkeeping, not a model load."""
-        old = self.batchers[idx]
+        old = self.batchers.get(idx)
+        if old is None:  # retired by a concurrent resize: nothing to revive
+            return
         old.kill(RuntimeError(
             f"stt replica {idx} warm-restarted (dead or stalled worker)"))
         self.batchers[idx] = self._make_batcher()
@@ -215,7 +223,10 @@ class STTReplicaTier(ReplicaSet):
         exclude: set[str] = set()
         while True:
             home = self._route(key, exclude)
-            if home is None or self.batchers[home.idx].healthy():
+            if home is None:
+                return None
+            b = self.batchers.get(home.idx)
+            if b is not None and b.healthy():
                 return home
             exclude.add(home.url)
 
@@ -226,7 +237,8 @@ class STTReplicaTier(ReplicaSet):
         the next-best replica — the audio travels with the work item, so
         the failover is a re-encode, never a loss."""
         home = self._home_for(utt)
-        if home is None:
+        hb = self.batchers.get(home.idx) if home is not None else None
+        if hb is None:
             # whole tier out: shed best-effort work, fail finals (the
             # voice handler surfaces a warn; the session itself survives)
             fut: Future = Future()
@@ -236,7 +248,7 @@ class STTReplicaTier(ReplicaSet):
                 get_metrics().inc("stt.shed_overload")
                 fut.set_result(None)
             return fut
-        inner = self.batchers[home.idx].submit(kind, utt, buf)
+        inner = hb.submit(kind, utt, buf)
         if kind != "final":
             return inner  # best-effort: a lost partial is latency, not data
         outer: Future = Future()
@@ -255,12 +267,13 @@ class STTReplicaTier(ReplicaSet):
                 return
             if retry:
                 alt = self._route(str(utt), exclude={failed_key})
-                if alt is not None and self.batchers[alt.idx].healthy():
+                ab = self.batchers.get(alt.idx) if alt is not None else None
+                if ab is not None and ab.healthy():
                     # counted only when a resubmit actually happens — a
                     # whole-tier outage must not read as successful
                     # failovers on the dashboard
                     get_metrics().inc("stt.replica_failovers")
-                    f2 = self.batchers[alt.idx].submit(kind, utt, buf)
+                    f2 = ab.submit(kind, utt, buf)
                     f2.add_done_callback(
                         lambda g, k=alt.url: _relay(g, k, retry=False))
                     return
@@ -276,7 +289,7 @@ class STTReplicaTier(ReplicaSet):
         """Utterance closed: free its slot wherever it lived (a re-homed
         utterance may have touched several replicas) and drop the sticky
         entry so rotated utterance keys don't churn the LRU."""
-        for b in self.batchers:
+        for b in list(self.batchers.values()):
             try:
                 b.release(utt)
             except Exception:
@@ -290,10 +303,52 @@ class STTReplicaTier(ReplicaSet):
         total, healthy, draining = self.health_counts()
         return {"total": total, "healthy": healthy, "draining": draining}
 
+    def resize(self, n: int) -> int:
+        """Elastic tier resize (ISSUE 16): grow to ``n`` by adding fresh
+        members over the SAME loaded engine (weights and compiled
+        programs are shared, so a joining STT member is warm by
+        construction — the brain tier's pre-warm lane has no STT
+        equivalent to pay), shrink by a zero-drop drain→flush→retire
+        pipeline per victim: stop placement (``start_drain``), flush the
+        victim batcher's queued and in-flight work, take it out of the
+        ring, then stop the worker. Sticky utterances still mid-stream
+        re-route on their next submit and re-anchor on the voice side's
+        buffered PCM tail — the documented mid-utterance failover path,
+        a bounded re-encode, never a loss. BLOCKING (the flush waits), so
+        the autopilot calls it off the event loop. Returns the new member
+        count; the floor is one replica."""
+        n = max(1, int(n))
+        with self._route_lock:
+            while len(self.replicas) < n:
+                r = self.add_member(f"stt-{self._next_idx}")
+                self.batchers[r.idx] = self._make_batcher()
+                self._seen[r.idx] = (0, time.monotonic())
+        while True:
+            with self._route_lock:
+                if len(self.replicas) <= n:
+                    break
+                # newest member retires first: the long-lived members keep
+                # the affinities (and cross-KV slots) they accumulated
+                victim = self.replicas[-1]
+                self.start_drain(victim)
+            b = self.batchers.get(victim.idx)
+            if b is not None and b.healthy():
+                b.drain(30.0)  # flush queued + in-flight work: zero-drop
+            with self._route_lock:
+                self.remove_member(victim.url)
+                b = self.batchers.pop(victim.idx, None)
+                self._seen.pop(victim.idx, None)
+            if b is not None:
+                if b.healthy():
+                    # stragglers that raced the removal: flush them too
+                    b.drain(5.0)
+                b.stop()
+        return len(self.replicas)
+
     def drain(self, timeout_s: float = 60.0) -> bool:
         """Quiesce every live replica (bench walls + shutdown hygiene)."""
         ok = True
-        for b in self.batchers:
+        for b in list(self.batchers.values()):
             if b.healthy():
                 ok = b.drain(timeout_s) and ok
         return ok
@@ -303,7 +358,7 @@ class STTReplicaTier(ReplicaSet):
         if self._watchdog is not None:
             self._watchdog.join(timeout=5.0)
             self._watchdog = None
-        for b in self.batchers:
+        for b in list(self.batchers.values()):
             b.stop()
         global _TIER
         if _TIER is self:

@@ -905,10 +905,14 @@ def _chaos_replica_middleware():
     one request (the tail shape hedging cuts); ``replica_degrade`` (ISSUE
     14, drilled by bench_fleet) LATCHES this app persistently slow — every
     later /parse pays ``CHAOS_SLOW_S`` while /health keeps answering ok,
-    the canonical gray failure the fleet detector must catch. Points only
-    DRAW on POST /parse so health probes never consume the deterministic
-    ``@kth`` event counting. Chaos off (the default) is one dict-miss per
-    request."""
+    the canonical gray failure the fleet detector must catch;
+    ``replica_join_stall`` (ISSUE 16, drilled by bench_autopilot) wedges
+    one POST /admin/handoff — the pre-warm adopt a joining replica
+    receives — for ``CHAOS_HANG_S``, the stuck-join drill the autopilot's
+    join timeout must contain. Parse-level points only DRAW on POST
+    /parse (and the join stall only on its own route) so health probes
+    never consume the deterministic ``@kth`` event counting. Chaos off
+    (the default) is one dict-miss per request."""
     from ..utils.chaos import chaos_fire
 
     dead = {"dead": False}
@@ -927,6 +931,12 @@ def _chaos_replica_middleware():
     async def chaos_mw(request: web.Request, handler):
         if dead["dead"]:
             _drop(request)
+        if request.method == "POST" and request.path == "/admin/handoff":
+            # ISSUE 16, drilled by bench_autopilot: a JOINING replica
+            # wedges during the pre-warm adopt — the autopilot's join
+            # timeout must retire it and retry, never admit it cold
+            if chaos_fire("replica_join_stall"):
+                await asyncio.sleep(float(os.environ.get("CHAOS_HANG_S", "60")))
         if request.method == "POST" and request.path == "/parse":
             if chaos_fire("replica_kill"):
                 dead["dead"] = True

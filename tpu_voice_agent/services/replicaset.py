@@ -8,14 +8,29 @@ re-home accounting that makes failover cost observable. The STT tier
 Whisper batcher must leave its ring exactly like one wedged brain replica
 leaves its own — so the transport-agnostic half lives here:
 
-- ``Replica``: one member's administrative state (up | draining | drained
-  | down) with a passive ``CircuitBreaker`` overlay, probe-failure
-  counting, the serve-layer drain latch, and a ``pressure`` reading
-  (0..1 saturation fraction, fed by whichever prober owns the ring).
+- ``Replica``: one member's administrative state (up | joining | draining
+  | drained | down) with a passive ``CircuitBreaker`` overlay, probe-
+  failure counting, the serve-layer drain latch, and a ``pressure``
+  reading (0..1 saturation fraction, fed by whichever prober owns the
+  ring).
 - ``ReplicaSet``: placement (rendezvous over the admitting set, sticky
   residence, LRU session table, forced-move accounting), the drain state
   machine, and ``apply_probe`` — the eject/rejoin/latch verdict that used
   to live inline in the router's probe loop.
+
+Elastic membership (ISSUE 16): the ring is no longer fixed at
+construction. ``add_member`` builds a BRAND-NEW ``Replica`` — never a
+recycled one, so a controller-respawned member at a reused url starts
+with fresh gray/outlier/pressure state (a stale gray verdict described
+the OLD process and would re-demote healthy new capacity) — and
+``remove_member`` takes a retired member out; its sticky sessions
+re-home lazily through ``route_ex``'s normal forced-move path, each
+counted. A member added ``joining`` takes NO traffic and is the
+CONTROLLER's alone to promote: probes record its health but never
+auto-admit it (an ok probe proves alive, not pre-warmed — admitting it
+cold at peak is the latency bomb the autopilot's pre-warm lane exists
+to avoid), and a manual drain on it always wins the race with the
+concurrent scale-up of that slot.
 
 Pressure-driven shedding (ISSUE 13): ``shed_pressure`` arms a placement
 preference — a NEW session whose rendezvous-first choice reports pressure
@@ -192,10 +207,12 @@ def rendezvous_weight(key: str, session_id: str) -> int:
 
 class Replica:
     """One ring member's routing state. ``state`` is the administrative
-    machine (up | draining | drained | down); the breaker overlays
-    transport health on top of it without changing it. ``url`` is the
-    member's ring key — a base URL for HTTP tiers, a name for in-process
-    ones (the STT batcher ring)."""
+    machine (up | joining | draining | drained | down); the breaker
+    overlays transport health on top of it without changing it. ``url``
+    is the member's ring key — a base URL for HTTP tiers, a name for
+    in-process ones (the STT batcher ring). ``joining`` (ISSUE 16) is a
+    member the autopilot spawned but has not pre-warmed/admitted yet:
+    not admitting, not servable, invisible to the probe state machine."""
 
     __slots__ = ("idx", "url", "state", "breaker", "probe_fails",
                  "inflight", "last_health", "drain_latched", "pressure",
@@ -311,8 +328,16 @@ class ReplicaSet:
         self.gray_min_peers = max(2, gray_min_peers)
         self.gray_hold_s = gray_hold_s
         self.last_fleet: dict | None = None
+        # kept for elastic membership (ISSUE 16): add_member builds every
+        # later Replica with the same breaker discipline the seed got
+        self.breaker_threshold = breaker_threshold
+        self.breaker_reset_s = breaker_reset_s
         self.replicas = [Replica(i, k, breaker_threshold, breaker_reset_s)
                          for i, k in enumerate(keys)]
+        # idx is a member's PERMANENT identity (per-idx gauges, batcher
+        # keys): monotonic, never reused — a respawned member at the same
+        # url is a NEW member with a new idx and fresh state
+        self._next_idx = len(self.replicas)
         self._by_url = {r.url: r for r in self.replicas}
         # session -> home-replica key, LRU-capped; stickiness (drain, no
         # flap-back on recovery) and the re-home accounting both live here
@@ -338,6 +363,10 @@ class ReplicaSet:
     def _on_drain(self) -> None: ...
 
     def _on_drain_completed(self) -> None: ...
+
+    def _on_member_added(self, replica: Replica) -> None: ...
+
+    def _on_member_removed(self, replica: Replica) -> None: ...
 
     def _on_ejected(self, replica: Replica) -> None: ...
 
@@ -427,14 +456,66 @@ class ReplicaSet:
         keys rotate per utterance — without this the LRU churns)."""
         self._sessions.pop(session_id, None)
 
+    # ----------------------------------------------- elastic membership
+
+    def add_member(self, key: str, *, joining: bool = False) -> Replica:
+        """Grow the ring by one BRAND-NEW member (ISSUE 16). Always a
+        fresh ``Replica`` — a controller respawning a member at a reused
+        key must get clean gray/outlier/pressure state, because every
+        carried verdict described the process that died. ``joining=True``
+        parks it outside placement until the owning controller pre-warms
+        and admits it."""
+        # atomic-section: replicaset.member-add -- ring list, url index and the health gauge must grow as one step: a suspension mid-add lets route() see a member the gauges (and _by_url) do not
+        key = key.rstrip("/")
+        if key in self._by_url:
+            raise ValueError(f"replica key {key!r} already in the ring")
+        r = Replica(self._next_idx, key, self.breaker_threshold,
+                    self.breaker_reset_s)
+        self._next_idx += 1
+        if joining:
+            r.state = "joining"
+        self.replicas.append(r)
+        self._by_url[r.url] = r
+        self._on_member_added(r)
+        self._update_health_gauge()
+        # end-atomic-section
+        self._log.info("replica %s added to the ring (%s)", r.url, r.state)
+        return r
+
+    def remove_member(self, key: str) -> Replica | None:
+        """Retire a member out of the ring. Its sticky sessions stay in
+        the table and re-home LAZILY: the next ``route_ex`` finds the old
+        home gone, picks the next-highest-weight member, and counts the
+        forced move — exactly the crash re-home path, so removal never
+        invents a second accounting. Returns the removed member (its
+        object stays valid for the caller's retirement bookkeeping) or
+        None when the key is not in the ring."""
+        # atomic-section: replicaset.member-remove -- ring list, url index and the gauges must shrink as one step: route() must never pick a member whose index entry is already gone
+        r = self._by_url.pop(key.rstrip("/"), None)
+        if r is None:
+            return None
+        self.replicas.remove(r)
+        self._on_member_removed(r)
+        self._update_health_gauge()
+        self._update_gray_gauge()
+        # end-atomic-section
+        self._log.info("replica %s removed from the ring", r.url)
+        return r
+
     # -------------------------------------------------- fleet gray state
 
     def _reset_gray(self, r: Replica) -> None:
         """A restarted/readmitted member starts with a clean slate — its
-        gray verdict described the OLD process."""
+        gray verdict described the OLD process. The PRESSURE carry-forward
+        resets here too (ISSUE 16 fix): pressure rides health probes, so a
+        fresh process inherits the dead one's last saturation reading
+        until its first probe lands — long enough for the shed path to
+        steer new sessions away from exactly the capacity a respawn just
+        added."""
         if r.gray:
             r.gray = False
             self._on_gray_cleared(r)
+        r.pressure = 0.0
         r.gray_streak = 0
         r.ok_streak = 0
         r.outlier_score = 0.0
@@ -580,8 +661,12 @@ class ReplicaSet:
     # atomic-section: replicaset.ring-state -- replica state transitions (up/draining/drained) and the health gauge must commit atomically: a suspension mid-transition exposes a half-drained ring to concurrent route() calls
     def start_drain(self, replica: Replica) -> bool:
         """Stop placing new sessions on ``replica``; existing sessions keep
-        hitting it until in-flight reaches zero, then it is ejected."""
-        if replica.state != "up":
+        hitting it until in-flight reaches zero, then it is ejected. A
+        JOINING member drains too (ISSUE 16): a manual drain must always
+        win the race against the autopilot's concurrent scale-up of that
+        slot — the controller's admit checks the state is still
+        ``joining`` and aborts the join when it is not."""
+        if replica.state not in ("up", "joining"):
             return False
         replica.state = "draining"
         replica.drain_latched = False  # fresh drain cycle
@@ -613,6 +698,17 @@ class ReplicaSet:
         result here; ``body`` is the member's health body when one exists."""
         # atomic-section: replicaset.probe-verdict -- the eject/rejoin/drain-latch state machine must not suspend mid-way: route() must never observe a replica between two of these transitions
         body = body if isinstance(body, dict) else {}
+        if r.state == "joining":
+            # a JOINING member (ISSUE 16) is the controller's alone:
+            # probes record its health body but never promote OR eject it
+            # — an ok probe proves alive, not pre-warmed (auto-admitting
+            # here would admit it cold), and a failing pre-warm is the
+            # join timeout's verdict to make, not the prober's (an eject
+            # to "down" here would let the NEXT ok probe auto-admit it
+            # cold through the recovery path).
+            if ok and body:
+                r.last_health = body
+            return
         if ok:
             r.probe_fails = 0
             if body:

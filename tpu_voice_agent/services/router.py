@@ -212,9 +212,10 @@ class BrainRouter(ReplicaSet):
         m.inc("fleet.gray_entered", 0.0)
         m.inc("fleet.gray_recovered", 0.0)
         m.inc("fleet.shed_gray", 0.0)
+        m.inc("router.replicas_added", 0.0)
+        m.inc("router.replicas_removed", 0.0)
         m.set_gauge("fleet.gray_replicas", 0.0)
         m.set_gauge("fleet.outlier_score_max", 0.0)
-        m.set_gauge("router.replicas_total", len(self.replicas))
         self._update_health_gauge()
 
     # ---------------------------------------------- replica-set hooks
@@ -222,8 +223,21 @@ class BrainRouter(ReplicaSet):
     # the shared core routes accounting through these instead of f-strings
 
     def _update_health_gauge(self) -> None:
-        get_metrics().set_gauge("router.replicas_healthy",
-                                sum(1 for r in self.replicas if r.servable()))
+        m = get_metrics()
+        # total rides the same hook so elastic membership (ISSUE 16)
+        # keeps it honest — the ring is no longer fixed at construction
+        m.set_gauge("router.replicas_total", float(len(self.replicas)))
+        m.set_gauge("router.replicas_healthy",
+                    sum(1 for r in self.replicas if r.servable()))
+
+    def _on_member_added(self, replica: Replica) -> None:
+        get_metrics().inc("router.replicas_added")
+
+    def _on_member_removed(self, replica: Replica) -> None:
+        get_metrics().inc("router.replicas_removed")
+        # the retired member's per-idx outlier gauge must not linger on
+        # dashboards as if the member still reported
+        get_metrics().set_gauge(f"fleet.outlier.{replica.idx}", 0.0)
 
     def _on_rehome(self) -> None:
         get_metrics().inc("router.sessions_rehomed")
@@ -573,6 +587,47 @@ class BrainRouter(ReplicaSet):
         except (httpx.HTTPError, OSError, ValueError, asyncio.TimeoutError):
             return False
 
+    async def prewarm_member(self, replica: Replica, budget_s: float) -> int:
+        """Pre-warm a JOINING member's radix root before it takes traffic
+        (ISSUE 16): ship the most recently active sticky session's warm
+        state — transcript ids + radix-chain KV bytes, the same
+        ``serve.handoff`` pack/adopt wire the re-home path uses — from an
+        admitting donor to the joining member. Adoption threads the
+        session's chain into the member's radix tree, so the shared
+        prompt root (and the donor session, should it ever re-home here)
+        is hot before the first placed session prefills. Returns the
+        adopted token count; 0 means nothing shippable (empty fleet, no
+        sessions yet, or handoff-less replicas — rule parsers 404 the
+        endpoints) and the CALLER decides whether a cold admit is
+        acceptable. Best-effort and bounded by ``budget_s`` per hop: a
+        wedged donor or recipient must surface as a slow join the
+        autopilot's join timeout can retire, never a hung control loop."""
+        import httpx
+
+        donor_sid = donor_url = None
+        for sid, url in reversed(self._sessions.items()):
+            d = self._by_url.get(url)
+            if d is not None and d is not replica and d.servable():
+                donor_sid, donor_url = sid, url
+                break
+        if donor_sid is None:
+            return 0
+        sid_q = urllib.parse.quote(donor_sid, safe="")
+        try:
+            resp = await self._http.get(
+                donor_url + "/admin/handoff/" + sid_q, timeout=budget_s)
+            if resp.status_code != 200 or not resp.content:
+                return 0
+            resp2 = await self._http.post(
+                replica.url + "/admin/handoff", content=resp.content,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=budget_s)
+            if resp2.status_code != 200:
+                return 0
+            return int(resp2.json().get("adopted_tokens", 0))
+        except (httpx.HTTPError, OSError, ValueError, asyncio.TimeoutError):
+            return 0
+
     async def forward_parse(self, raw: bytes, body: dict,
                             headers: dict) -> tuple:
         """The full /parse policy: route → (on a forced move, warm-state
@@ -828,6 +883,19 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
         return web.json_response({"ok": True, "replica": r.url,
                                   "state": r.state})
 
+    async def admin_autopilot(_req: web.Request) -> web.Response:
+        """The autopilot's control-loop state (ISSUE 16): target vs actual
+        per tier plus the decision log — the fleetview panel and the bench
+        assertions read this one surface. The controller registers itself
+        on the router object (``router.autopilot``); without one the
+        endpoint answers 404 so a static fleet scrapes nothing stale."""
+        ap = getattr(router, "autopilot", None)
+        if ap is None:
+            return web.json_response(
+                {"enabled": False, "detail": "no autopilot attached"},
+                status=404)
+        return web.json_response(ap.describe())
+
     def fan_out(path: str):
         async def handler(req: web.Request) -> web.Response:
             return web.json_response({
@@ -842,6 +910,7 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
     app.router.add_get("/health", health)
     app.router.add_post("/admin/drain", admin_drain)
     app.router.add_post("/admin/admit", admin_admit)
+    app.router.add_get("/admin/autopilot", admin_autopilot)
     from ..utils.tracing import make_metrics_handler, make_trace_handler
 
     app.router.add_get("/metrics", make_metrics_handler("router", tracer,

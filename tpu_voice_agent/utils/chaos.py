@@ -85,6 +85,17 @@ class only the quality SLO / golden canary / gray detector can see):
                       rule-fallback answer (a single "unknown" plan) from
                       the firing parse on — the degraded-mode fallback
                       storm: still 200s, still fast, quality on the floor
+
+Autopilot points (ISSUE 16 — drilled by ``benches/bench_autopilot.py``
+against the fleet autopilot's elastic-capacity loop):
+
+    replica_join_stall  a JOINING replica wedges during the pre-warm
+                      handoff adopt (the brain chaos middleware holds
+                      POST /admin/handoff open for CHAOS_HANG_S) — the
+                      autopilot must time the join out
+                      (AUTOPILOT_JOIN_TIMEOUT_S), retire the stuck
+                      member, and retry WITHOUT dropping the capacity
+                      target or ever admitting the member cold
 """
 
 from __future__ import annotations
@@ -96,7 +107,8 @@ import threading
 KNOWN_POINTS = ("nan_logits", "dead_fsm", "prefill_exc", "alloc_fail",
                 "stall_step", "drop_frame", "replica_kill", "replica_hang",
                 "replica_slow", "replica_degrade", "stt_replica_kill",
-                "stt_replica_hang", "stt_garble", "intent_downgrade")
+                "stt_replica_hang", "stt_garble", "intent_downgrade",
+                "replica_join_stall")
 
 
 class ChaosError(RuntimeError):

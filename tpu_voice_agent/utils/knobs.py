@@ -130,6 +130,17 @@ declare("HANDOFF_TIMEOUT_S", "5.0", "per-hop budget for one warm-state handoff t
 declare("HANDOFF_KV", "1", "0 ships the transcript WITHOUT KV bytes (the cold-re-home ablation baseline)", table=RESILIENCE)
 declare("ROUTER_SHED_PRESSURE", "0.9", "pressure score past which new sessions avoid a brain replica", table=RESILIENCE)
 
+# fleet autopilot (ISSUE 16): closed-loop elastic capacity
+declare("AUTOPILOT_MIN_REPLICAS", "1", "hard floor on the per-tier replica count — the autopilot never retires below it", table=RESILIENCE)
+declare("AUTOPILOT_MAX_REPLICAS", "4", "hard ceiling on the per-tier replica count — the autopilot never spawns above it", table=RESILIENCE)
+declare("AUTOPILOT_INTERVAL_S", "1.0", "control-loop tick interval", table=RESILIENCE)
+declare("AUTOPILOT_TARGET_UTIL", "0.6", "per-replica busy fraction the controller steers toward (capacity target = load / this)", table=RESILIENCE)
+declare("AUTOPILOT_UP_WINDOWS", "2", "consecutive over-target ticks before a scale-up commits (hysteresis)", table=RESILIENCE)
+declare("AUTOPILOT_DOWN_WINDOWS", "5", "consecutive under-target ticks before a scale-down commits (hysteresis; deliberately slower than up)", table=RESILIENCE)
+declare("AUTOPILOT_COOLDOWN_S", "5.0", "seconds after ANY committed scale action during which no further action commits (anti-oscillation)", table=RESILIENCE)
+declare("AUTOPILOT_JOIN_TIMEOUT_S", "15", "whole-join budget (spawn + pre-warm + admit); a stuck join is retired and retried, never admitted cold", table=RESILIENCE)
+declare("AUTOPILOT_FORECAST_LEAD_S", "5.0", "how far ahead the load forecast extrapolates the timeseries trend", table=RESILIENCE)
+
 # service wiring (documented in the RESILIENCE.md "Service wiring" table)
 declare("VOICE_PORT", "7072", "voice service listen port", table=RESILIENCE)
 declare("BRAIN_PORT", "8090", "brain service listen port", table=RESILIENCE)
