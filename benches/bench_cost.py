@@ -1,0 +1,210 @@
+"""Cost-observatory bench (ISSUE 17): the analytic cost model's own
+contract, in three gates.
+
+Metering that can't prove itself doesn't belong on the hot path. This
+bench runs the SAME continuous-batching workload through a tiny engine
+with the cost lanes on and off and holds three bars:
+
+- conservation: the sum of per-request resource ledgers equals the
+  engine-level CostMeter totals EXACTLY (integer equality on every
+  ledger key) — attribution that leaks flops can't bill sessions
+- capacity: tokens/s with cost lanes on ≥ 0.95x off, and the two runs
+  token-identical (the model is host integer arithmetic only — it must
+  never perturb decode)
+- the prefill-vs-decode split: the analytic partition of total spend,
+  with cached prefill split out (the radix win the cost plane prices)
+
+Plus the live roofline rows: decode-stage MFU/MBU as reconciled against
+the measured chunk walls (CPU-proxy peaks off-TPU — relative trajectory,
+not a hardware claim; docs/OBSERVABILITY.md "Cost & efficiency
+observatory").
+
+Writes ``bench_artifacts/BENCH_cost_<ts>.json`` with a ``cost`` section
+merged into run_all's combined artifact. Runs in seconds on CPU (tiny
+model, BENCH_COST_SESSIONS trims), so it rides ``--quick``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log, percentile  # noqa: E402
+
+
+def _run(batcher, prompts: list[str]) -> tuple[list, list[float], int]:
+    """Submit all, step to drain, return (results, per-chunk walls, tokens).
+
+    Per-chunk walls instead of one run wall: the capacity differential
+    pools chunk p50s across alternating on/off rounds (the bench_steplog
+    idiom) — single-run walls on a tiny CPU engine carry several percent
+    of OS jitter, which would masquerade as metering overhead."""
+    rids = [batcher.submit(p) for p in prompts]
+    walls: list[float] = []
+    while batcher.pending or any(s.request_id >= 0 for s in batcher.slots):
+        t0 = time.perf_counter()
+        batcher.step()
+        walls.append((time.perf_counter() - t0) * 1e3)
+    results = [batcher.results[r] for r in rids]
+    return results, walls, sum(r.steps for r in results)
+
+
+def main() -> None:
+    from tpu_voice_agent.serve import ContinuousBatcher, DecodeEngine
+    from tpu_voice_agent.utils import get_metrics
+    from tpu_voice_agent.utils.costmodel import LEDGER_KEYS
+
+    n_sessions = int(os.environ.get("BENCH_COST_SESSIONS", "12"))
+    max_new = int(os.environ.get("BENCH_COST_TOKENS", "48"))
+    rounds = int(os.environ.get("BENCH_COST_ROUNDS", "3"))
+
+    eng = DecodeEngine(preset="test-tiny", max_len=1024, batch_slots=3,
+                       prefill_buckets=(128, 512))
+    prompts = [f"search for item {i} and sort by price"
+               for i in range(n_sessions)]
+
+    def fresh_batcher():
+        return ContinuousBatcher(eng, chunk_steps=16, max_new_tokens=max_new)
+
+    # warmup: compile prefill + chunk loop out of the timing
+    os.environ["COST_ENABLE"] = "1"
+    b = fresh_batcher()
+    b.submit(prompts[0])
+    b.run_until_done()
+
+    # ---- conservation + the roofline rows: one metered run, then the
+    # exact integer reconciliation of per-request ledgers vs engine totals
+    b = fresh_batcher()
+    on_results, _, _ = _run(b, prompts)
+    assert b.costs is not None
+    totals = dict(b.costs.totals)
+    summed = {k: sum(r.cost[k] for r in on_results) for k in LEDGER_KEYS}
+    conserved = all(summed[k] == totals[k] for k in LEDGER_KEYS)
+    for k in LEDGER_KEYS:
+        if summed[k] != totals[k]:
+            log(f"CONSERVATION LEAK {k}: sum(requests)={summed[k]} "
+                f"!= engine={totals[k]} (delta {summed[k] - totals[k]:+d})")
+    mfu = b.costs.mfu
+    mbu = b.costs.mbu
+    mfu_prefill = b.costs.mfu_prefill
+    log(f"conservation exact={conserved}; decode mfu={mfu:.4f} "
+        f"mbu={mbu:.4f} prefill mfu={mfu_prefill:.4f}")
+
+    # the analytic split: where the workload's flops actually went
+    prefill_total = totals["prefill_flops"] + totals["prefill_cached_flops"]
+    grand = prefill_total + totals["decode_flops"]
+    prefill_frac = prefill_total / grand if grand else 0.0
+    cached_frac = (totals["prefill_cached_flops"] / prefill_total
+                   if prefill_total else 0.0)
+    log(f"split: prefill {prefill_frac:.1%} of total flops "
+        f"({cached_frac:.1%} of prefill served from cache), decode "
+        f"{1 - prefill_frac:.1%}; wasted drafts "
+        f"{totals['wasted_draft_flops']} flops")
+
+    # ---- capacity differential: alternating on/off rounds so machine
+    # drift cancels instead of masquerading as metering overhead; the
+    # verdict compares pooled per-chunk wall p50s (same token streams on
+    # both sides -> same tokens per chunk -> chunk-wall ratio IS the
+    # capacity ratio)
+    on_walls: list[float] = []
+    off_walls: list[float] = []
+    on_toks = off_toks = 0
+    off_results = None
+    for _ in range(rounds):
+        os.environ["COST_ENABLE"] = "0"
+        try:
+            off_results, walls, t = _run(fresh_batcher(), prompts)
+        finally:
+            os.environ["COST_ENABLE"] = "1"
+        off_walls += walls
+        off_toks += t
+        _, walls, t = _run(fresh_batcher(), prompts)
+        on_walls += walls
+        on_toks += t
+    p50_on = percentile(on_walls, 50)
+    p50_off = percentile(off_walls, 50)
+    tps_on = on_toks / (sum(on_walls) / 1e3)
+    tps_off = off_toks / (sum(off_walls) / 1e3)
+    ratio = p50_off / p50_on if p50_on > 0 else 0.0
+    identical = ([r.token_ids for r in on_results]
+                 == [r.token_ids for r in off_results])
+    # the off run must truly run unmetered (cost lanes skipped, no ledgers)
+    unmetered = all(r.cost is None for r in off_results)
+    log(f"capacity: chunk p50 on {p50_on:.2f} ms ({len(on_walls)} chunks) "
+        f"/ off {p50_off:.2f} ms ({len(off_walls)} chunks) -> ratio "
+        f"{ratio:.3f} (on {tps_on:.1f} / off {tps_off:.1f} tok/s), "
+        f"token_identical={identical}, off_unmetered={unmetered}")
+
+    snap = get_metrics().snapshot()
+    counter_flops = snap["counters"].get("cost.decode_flops", 0.0)
+
+    emit("cost_conservation_exact", 1.0 if conserved else 0.0, "fraction")
+    emit("cost_capacity_ratio", ratio, "ratio")
+    emit("cost_mfu_decode", mfu, "fraction")
+    emit("cost_mbu_decode", mbu, "fraction")
+    emit("cost_prefill_flops_fraction", prefill_frac, "fraction")
+    # "overhead" is deliberately outside benchdiff's gated units: it hovers
+    # at the noise floor around zero where a relative-delta gate would
+    # whipsaw — the bench's own >=0.95x exit gate holds the bar, and the
+    # gated ratio row above tracks the same quantity monotonically
+    emit("cost_capacity_overhead", max(0.0, 1.0 - ratio), "overhead")
+
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    art = art_dir / f"BENCH_cost_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_cost",
+        "config": {"sessions": n_sessions, "max_new_tokens": max_new,
+                   "rounds": rounds},
+        "rows": [
+            {"metric": "cost_conservation_exact",
+             "value": 1.0 if conserved else 0.0},
+            {"metric": "cost_capacity_ratio", "value": round(ratio, 4)},
+            {"metric": "cost_mfu_decode", "value": round(mfu, 5)},
+        ],
+        "cost": {
+            "conserved": conserved,
+            "totals": totals,
+            "engine": dict(b.costs.engine),
+            "mfu": round(mfu, 5),
+            "mbu": round(mbu, 5),
+            "mfu_prefill": round(mfu_prefill, 5),
+            "peak": b.costs.peak,
+            "prefill_flops_fraction": round(prefill_frac, 4),
+            "prefill_cached_fraction": round(cached_frac, 4),
+            "tokens_per_s_on": round(tps_on, 2),
+            "tokens_per_s_off": round(tps_off, 2),
+            "chunk_p50_ms_on": round(p50_on, 3),
+            "chunk_p50_ms_off": round(p50_off, 3),
+            "capacity_ratio": round(ratio, 4),
+            "token_identical": identical,
+            "counter_decode_flops": counter_flops,
+        },
+    }, indent=1))
+    log(f"artifact: {art}")
+
+    failed = []
+    if not conserved:
+        failed.append("per-request ledgers do not sum to engine totals")
+    if ratio < 0.95:
+        failed.append(f"cost-lanes-on capacity {ratio:.3f}x < 0.95x off")
+    if not identical:
+        failed.append("cost on/off runs not token-identical")
+    if not unmetered:
+        failed.append("COST_ENABLE=0 run still produced per-request ledgers")
+    if grand <= 0:
+        failed.append("analytic model metered zero flops over a real run")
+    for f in failed:
+        log(f"FAIL: {f}")
+    if failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,7 +38,7 @@ int4 paged engines over the same prompts: decode p50 and tokens/forward
 per tier (honest CPU wall — quantize/dequant is visible VPU work on the
 XLA CPU backend; on-chip the win is HBM bytes), plus the portable modeled
 verdicts benchdiff gates: per-step bytes-moved speedup at matched batch
-(``utils.hbmledger.decode_step_bytes``; bar ≥ 1.5× int8) and pool
+(``utils.costmodel.decode_step_bytes``; bar ≥ 1.5× int8) and pool
 capacity at a fixed byte budget (bar ≥ 1.9× int8 / ≥ 3.5× int4). A
 grammar-invalid stream from a lossy tier fails the bench.
 
@@ -278,11 +278,11 @@ def main() -> None:
     # storage tier. Wall rows are honest CPU-harness numbers (quantize/
     # dequant is extra VPU work the XLA CPU backend pays visibly; on-chip
     # the win is HBM bytes) — the PORTABLE decode-stage verdict is the
-    # modeled step-bytes speedup (utils.hbmledger.decode_step_bytes, the
+    # modeled step-bytes speedup (utils.costmodel.decode_step_bytes, the
     # same accounting docs/PERF.md's roofline uses: decode is HBM-bound,
     # wall ∝ bytes moved) and the capacity multiple at a fixed pool budget.
     from tpu_voice_agent.ops.kvquant import kv_block_bytes
-    from tpu_voice_agent.utils.hbmledger import decode_step_bytes
+    from tpu_voice_agent.utils.costmodel import decode_step_bytes
 
     kvq_prompts = prompts[: min(3, len(prompts))]
     kvq_section: dict[str, dict] = {}

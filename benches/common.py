@@ -117,9 +117,16 @@ def snapshot_observability(service_url: str, timeout_s: float = 5.0) -> dict:
     hists = m.get("runtime", {}).get("latency_ms", {})
     for section, prefix in (("engine_step", "engine.step."),
                             ("xla", "xla."), ("hbm", "hbm."),
-                            ("fleet", "fleet.")):
+                            ("fleet", "fleet."), ("cost", "cost.")):
         sec: dict = {}
         for src in (out["runtime_gauges"], out["runtime_counters"], hists):
             sec.update({k: v for k, v in src.items() if k.startswith(prefix)})
         out[section] = sec
+    # the cost observatory's roofline gauges (ISSUE 17) live under
+    # engine.* by design (they ARE engine utilization) — lift them into
+    # the cost section so every artifact carries MFU/MBU beside the spend
+    # counters
+    for k in ("engine.mfu", "engine.mbu", "engine.mfu_prefill"):
+        if k in out["runtime_gauges"]:
+            out["cost"][k] = out["runtime_gauges"][k]
     return out

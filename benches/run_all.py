@@ -25,7 +25,7 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_spec.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
            "bench_steplog.py", "bench_router.py", "bench_handoff.py",
-           "bench_fleet.py", "bench_autopilot.py"]
+           "bench_fleet.py", "bench_autopilot.py", "bench_cost.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -67,11 +67,16 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # expensive must fail the quick table as well; the offline bench_quality
 # rows run on --quick with EVAL_BACKEND pinned to the rule parser so the
 # accuracy trajectory always has a deterministic row to gate
+# the cost bench stays on --quick too — it is the efficiency-metering
+# regression gate (tiny engine, trimmed workload, seconds on CPU), and a
+# PR that breaks exact ledger conservation, makes the cost lanes change
+# tokens, or makes metering cost >5% of capacity must fail the quick table
 QUICK_BENCHES = ["bench_quality.py", "bench_quality_online.py",
                  "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
                  "bench_chaos.py", "bench_steplog.py", "bench_router.py",
-                 "bench_handoff.py", "bench_fleet.py", "bench_autopilot.py"]
+                 "bench_handoff.py", "bench_fleet.py", "bench_autopilot.py",
+                 "bench_cost.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_QO_MAX_N": "4", "BENCH_QO_UTTERANCES": "2",
@@ -90,7 +95,8 @@ QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_HANDOFF_TURNS": "5",
              "BENCH_FLEET_MAX_N": "6", "BENCH_FLEET_UTTERANCES": "2",
              "BENCH_AUTOPILOT_HIGH_N": "6", "BENCH_AUTOPILOT_UTTERANCES": "2",
-             "BENCH_AUTOPILOT_TURNS": "2"}
+             "BENCH_AUTOPILOT_TURNS": "2",
+             "BENCH_COST_SESSIONS": "6", "BENCH_COST_ROUNDS": "2"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -182,7 +188,7 @@ def main() -> None:
                             "spec", "stt", "radix", "swarm", "chaos",
                             "steplog", "engine_step", "xla", "hbm",
                             "router", "kv_quant", "handoff", "fleet",
-                            "quality", "autopilot"):
+                            "quality", "autopilot", "cost"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -554,7 +554,13 @@ def test_replica_degrade_e2e_and_fleetview_dump(monkeypatch, tmp_path):
         txt = fleetview.render_file(dump)
         assert "demoted on fwd_ms" in txt and gray_urls[0] in txt
         # the live fan-out renders too (real /debug/timeseries bodies)
-        health, series, autopilot = fleetview.one_frame(router.url, 32)
+        health, series, autopilot, costs = fleetview.one_frame(router.url, 32)
+        # the costs fan-out answers per replica; rule-based brains carry
+        # no engine meter, so every body reports the lanes off
+        cost_reps = costs["replicas"]
+        assert len(cost_reps) == 3
+        assert all(b.get("enabled") is False for b in cost_reps.values())
+        assert "[cost lanes off]" in fleetview.render_costs(costs, series)
         # no controller attached in this harness -> the panel degrades
         assert not autopilot.get("enabled")
         assert fleetview.render_autopilot(autopilot) == \

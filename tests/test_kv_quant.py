@@ -36,8 +36,8 @@ from tpu_voice_agent.services.brain import (
 )
 from tpu_voice_agent.services.prompts import render_prompt
 from tpu_voice_agent.utils import chaos, get_metrics
+from tpu_voice_agent.utils.costmodel import decode_step_bytes
 from tpu_voice_agent.utils.hbmledger import (
-    decode_step_bytes,
     engine_hbm_plan,
     measure_hbm,
 )

@@ -195,6 +195,64 @@ def render_autopilot(desc: dict) -> str:
     return "\n".join(lines)
 
 
+def render_costs(costs_fan: dict, series: dict[str, list[dict]],
+                 width: int = 48) -> str:
+    """The efficiency panel (ISSUE 17): per-replica roofline sparklines
+    (``engine.mfu`` / ``engine.mbu`` ride the same timeseries ring every
+    gauge does), the analytic meter's totals off the
+    ``/debug/replicas/costs`` fan-out, and the fleet-wide top-cost
+    sessions — the operator's answer to "where are the FLOPs going, and
+    who is spending them"."""
+    reps = costs_fan.get("replicas") or {}
+    lines = ["efficiency (analytic roofline; off-TPU peaks are a "
+             "documented CPU proxy):"]
+    top_all: list[tuple[float, str, dict]] = []
+    for url in sorted(set(reps) | set(series)):
+        body = reps.get(url) if isinstance(reps.get(url), dict) else {}
+        lines.append("")
+        if not body.get("enabled"):
+            lines.append(f"{url}  [cost lanes off]")
+        else:
+            t = body.get("totals") or {}
+            eng = body.get("engine") or {}
+            pf = (t.get("prefill_flops", 0)
+                  + t.get("prefill_cached_flops", 0))
+            total = pf + t.get("decode_flops", 0)
+            cached = t.get("prefill_cached_flops", 0) / pf if pf else 0.0
+            dec = t.get("decode_flops", 0) / total if total else 0.0
+            lines.append(
+                f"{url}  mfu {_fmt(body.get('mfu'))} mbu "
+                f"{_fmt(body.get('mbu'))} prefill-mfu "
+                f"{_fmt(body.get('mfu_prefill'))}  chunks "
+                f"{eng.get('chunks', 0)}")
+            lines.append(
+                f"  flops {total:.3g} — decode {dec:.0%}, prefill cache "
+                f"hit {cached:.0%}, wasted drafts "
+                f"{t.get('wasted_draft_flops', 0):.3g}; kv "
+                f"{t.get('kv_block_us', 0) / 1e6:.3g} block-s")
+            for sess in body.get("top_sessions") or []:
+                fl = (sess.get("prefill_flops", 0)
+                      + sess.get("decode_flops", 0))
+                top_all.append((fl, url, sess))
+        samples = series.get(url) or []
+        rows = {k: [s.get("gauges", {}).get(k) for s in samples]
+                for k in ("engine.mfu", "engine.mbu", "engine.mfu_prefill")}
+        for name, xs in rows.items():
+            if not any(x is not None for x in xs):
+                continue
+            latest = next((x for x in reversed(xs) if x is not None), None)
+            lines.append(f"  {name.ljust(20)}"
+                         f"|{sparkline(xs, width)}| {_fmt(latest)}")
+    if top_all:
+        top_all.sort(key=lambda e: e[0], reverse=True)
+        lines.append("")
+        lines.append("top-cost sessions (fleet-wide):")
+        for fl, url, sess in top_all[:8]:
+            lines.append(f"  {sess.get('session')}: {fl:.3g} flops over "
+                         f"{sess.get('utterances')} utterance(s) ({url})")
+    return "\n".join(lines)
+
+
 def render_evidence(evidence: dict) -> str:
     """The peer-comparison evidence a gray freeze carries: who was
     demoted, on which signal, how far from the fleet — the dump answers
@@ -247,13 +305,23 @@ def render_file(body: dict, width: int = 48) -> str:
     # a saved /admin/autopilot body (the controller's describe())
     if "decisions" in body and "brain" in body:
         return render_autopilot(body)
-    # router fan-out: {"replicas": {url: timeseries body}}
+    # router fan-out: {"replicas": {url: timeseries body}} — or the cost
+    # fan-out (ISSUE 17), whose per-replica bodies carry meter totals
+    # instead of ring samples
     if isinstance(body.get("replicas"), dict):
+        vals = [b for b in body["replicas"].values() if isinstance(b, dict)]
+        if any("totals" in b or "enabled" in b for b in vals):
+            return render_costs(body, {}, width=width)
         series = {url: (b.get("samples") or [])
                   for url, b in body["replicas"].items()
                   if isinstance(b, dict)}
         return render_fleet({"replicas": {"total": len(series)}}, series,
                             width=width)
+    # one service's /debug/costs body
+    if "enabled" in body and ("totals" in body or "service" in body) \
+            and "samples" not in body:
+        svc = body.get("service", "service")
+        return render_costs({"replicas": {svc: body}}, {}, width=width)
     # one service's own ring
     if "samples" in body:
         url = body.get("service", "service")
@@ -263,7 +331,7 @@ def render_file(body: dict, width: int = 48) -> str:
         "/debug/timeseries body)"
 
 
-def one_frame(router_url: str, width: int) -> tuple[dict, dict, dict]:
+def one_frame(router_url: str, width: int) -> tuple[dict, dict, dict, dict]:
     health = fetch_json(router_url.rstrip("/") + "/health")
     fan = fetch_json(router_url.rstrip("/") + "/debug/replicas/timeseries")
     series = {url: (b.get("samples") or [])
@@ -273,7 +341,11 @@ def one_frame(router_url: str, width: int) -> tuple[dict, dict, dict]:
     # legitimate deployment, not an error worth a line per frame)
     autopilot = fetch_json(router_url.rstrip("/") + "/admin/autopilot",
                            quiet=True)
-    return health, series, autopilot
+    # the cost fan-out (ISSUE 17) — quiet for the same reason: replicas
+    # predating the observatory simply have no panel
+    costs = fetch_json(router_url.rstrip("/") + "/debug/replicas/costs",
+                       quiet=True)
+    return health, series, autopilot, costs
 
 
 # -------------------------------------------------------------- self-test
@@ -371,6 +443,30 @@ def self_test() -> int:
                                           "autopilot.load": 1.9}}]}
     aptxt = render_file(apdump)
     assert "autopilot.target_replicas" in aptxt and "autopilot.load" in aptxt
+    # the efficiency panel (ISSUE 17): cost fan-out + MFU gauge sparklines
+    cost_body = {
+        "service": "brain", "enabled": True,
+        "totals": {"prefill_flops": 8e9, "prefill_cached_flops": 2e9,
+                   "decode_flops": 30e9, "decode_bytes": 5e9,
+                   "wasted_draft_flops": 1e9, "kv_block_us": 4_000_000},
+        "engine": {"weights_stream_bytes": 9e9, "fwds": 900, "chunks": 60},
+        "mfu": 0.31, "mbu": 0.62, "mfu_prefill": 0.4,
+        "top_sessions": [{"session": "s-big", "prefill_flops": 6e9,
+                          "decode_flops": 20e9, "utterances": 7}]}
+    cost_fan = {"service": "router",
+                "replicas": {"http://r0": cost_body,
+                             "http://r1": {"enabled": False}}}
+    mfu_series = {"http://r0": [
+        {"gauges": {"engine.mfu": 0.1 + 0.05 * i, "engine.mbu": 0.5}}
+        for i in range(8)]}
+    ctxt = render_costs(cost_fan, mfu_series)
+    assert "mfu 0.31" in ctxt and "engine.mfu" in ctxt and "█" in ctxt
+    assert "decode 75%" in ctxt and "cache hit 20%" in ctxt
+    assert "s-big" in ctxt and "7 utterance(s)" in ctxt
+    assert "[cost lanes off]" in ctxt
+    # file-mode shape detection: fan-out vs one service's own body
+    assert "s-big" in render_file(cost_fan)
+    assert "mfu 0.31" in render_file(cost_body)
     print(txt)
     print("fleetview self-test ok")
     return 0
@@ -404,12 +500,13 @@ def main(argv: list[str] | None = None) -> int:
             print(render_file(body, width=args.width))
         return 0
     while True:
-        health, series, autopilot = one_frame(args.router, args.width)
+        health, series, autopilot, costs = one_frame(args.router, args.width)
         if not health and not series:
             return 2
         if args.json:
             print(json.dumps({"health": health, "series": series,
-                              "autopilot": autopilot}, indent=1))
+                              "autopilot": autopilot, "costs": costs},
+                             indent=1))
         else:
             if args.watch:
                 print("\x1b[2J\x1b[H", end="")  # clear between frames
@@ -417,6 +514,10 @@ def main(argv: list[str] | None = None) -> int:
             if autopilot.get("enabled"):
                 print()
                 print(render_autopilot(autopilot))
+            if any(isinstance(b, dict) and b.get("enabled")
+                   for b in (costs.get("replicas") or {}).values()):
+                print()
+                print(render_costs(costs, series, width=args.width))
         if not args.watch:
             return 0
         time.sleep(args.watch)

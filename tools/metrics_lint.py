@@ -223,6 +223,20 @@ PINNED: dict[str, str] = {
     "autopilot.stt_target_replicas": "gauge",
     "router.replicas_added": "counter",
     "router.replicas_removed": "counter",
+    # cost & efficiency observatory (ISSUE 17, utils/costmodel.py +
+    # serve/scheduler.py + serve/stt.py, docs/OBSERVABILITY.md "Cost &
+    # efficiency observatory"): the roofline gauges bench_cost gates on
+    # (engine.mfu/mbu are THE utilization headline; mfu_prefill the
+    # prefill-stage split the disaggregation PR will consume) and the
+    # cost.* counters the timeseries ring derives spend rates from —
+    # renaming any of these blinds the efficiency gates
+    "engine.mfu": "gauge",
+    "engine.mbu": "gauge",
+    "engine.mfu_prefill": "gauge",
+    "cost.decode_flops": "counter",
+    "cost.decode_bytes": "counter",
+    "cost.stt_encoder_flops": "counter",
+    "cost.stt_decoder_flops": "counter",
 }
 
 

@@ -65,6 +65,12 @@ class GenerationResult:
     # masked-logit margin mean/min, entropy mean, grammar-forced fraction,
     # decision count — None when the quality lanes are off or no decision
     # was sampled (utils.quality.conf_summary builds it)
+    cost: dict | None = None  # per-request resource ledger (ISSUE 17):
+    # utils.costmodel.LEDGER_KEYS ints (prefill FLOPs split cached vs
+    # computed, decode FLOPs + KV bytes, wasted-draft FLOPs, KV
+    # block-microseconds held) — None when COST_ENABLE=0 or the request
+    # ran outside the continuous batcher. Errored/evicted rows still
+    # carry the cost they spent before dying (the ledger conserves).
 
     @property
     def tokens_per_s(self) -> float:

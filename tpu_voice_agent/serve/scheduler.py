@@ -70,12 +70,17 @@ class _Slot:
     # engines report per-row accept counts on the same widened readback)
     eos: bool = False
     # ISSUE 15 conf lanes accumulated across chunks (engines report per-row
-    # margin/entropy/forced/decision lanes on the combined readback)
+    # margin/entropy/forced/decision lanes on the same combined readback)
     conf_msum: float = 0.0
     conf_mmin: float = float("inf")
     conf_esum: float = 0.0
     conf_forced: int = 0
     conf_cnt: int = 0
+    # ISSUE 17 per-request resource ledger (utils.costmodel.LEDGER_KEYS,
+    # all ints): set at admission when the cost lanes are on, folded per
+    # chunk with the SAME int dict the engine meter totals — so
+    # sum(per-request ledgers) == engine totals holds exactly
+    cost: dict | None = None
 
 
 class ContinuousBatcher:
@@ -161,6 +166,14 @@ class ContinuousBatcher:
         m.inc("scheduler.slots_quarantined", 0.0)
         m.inc("scheduler.cancelled", 0.0)
         m.inc("scheduler.shed_expired", 0.0)
+        # cost & efficiency observatory (ISSUE 17): the analytic meter the
+        # per-chunk fold reconciles measured walls against. Pure host
+        # arithmetic over readbacks the chunk already paid for — the
+        # decode path is token-identical with the lanes on or off.
+        from ..utils.costmodel import CostMeter, cost_enabled
+
+        self.costs: CostMeter | None = (
+            CostMeter(engine) if cost_enabled() else None)
 
     # ------------------------------------------------------------ submit
 
@@ -272,8 +285,13 @@ class ContinuousBatcher:
 
         sl = self.slots[b]
         rid = sl.request_id
-        self.results[rid] = _err_result(error, steps=len(sl.token_ids),
-                                        prefill_ms=sl.prefill_ms)
+        res = _err_result(error, steps=len(sl.token_ids),
+                          prefill_ms=sl.prefill_ms)
+        # an evicted row still accounts the cost it spent before dying —
+        # without this the ledger would leak exactly the work the poison/
+        # cancellation burned (ISSUE 17 conservation covers errored rows)
+        res.cost = dict(sl.cost) if sl.cost is not None else None
+        self.results[rid] = res
         get_metrics().inc(counter)
         self._cleanup(rid)
         self.slots[b] = _Slot()
@@ -366,6 +384,18 @@ class ContinuousBatcher:
         t_enq = self._enqueued_at.pop(rid, t0)
         get_metrics().observe_ms("scheduler.ttft",
                                  (time.perf_counter() - t_enq) * 1e3)
+        # prefill cost fold (ISSUE 17): an exact cached-vs-computed
+        # partition of the cold-prompt cost — the same ints land in the
+        # slot ledger and the meter totals, so conservation is exact
+        if self.costs is not None:
+            computed, cached = self.costs.model.prefill_split(
+                n, sl.cached_tokens)
+            sl.cost = dict.fromkeys(
+                ("decode_flops", "decode_bytes", "wasted_draft_flops",
+                 "kv_block_us"), 0)
+            sl.cost["prefill_flops"] = computed
+            sl.cost["prefill_cached_flops"] = cached
+            self.costs.fold_prefill(computed, cached, sl.prefill_ms)
 
     # ------------------------------------------------------------ step
 
@@ -518,6 +548,7 @@ class ContinuousBatcher:
         # (non-greedy, spec off) must not re-serve the previous chunk's
         eng._last_accepts = None
         eng._last_row_fwds = None
+        eng._last_row_drafted = None
         eng._last_draft_ms = 0.0  # the step ledger's drafter carve
         self._rng, k = jax.random.split(self._rng)
         (out, n, eos, cur, pos, fsm, active,
@@ -619,11 +650,55 @@ class ContinuousBatcher:
         # results carry an honest quality vector
         conf_arr = None if conf is None else [np.asarray(x) for x in conf_h]
 
+        # cost fold (ISSUE 17): one per-row ledger dict per chunk, computed
+        # from readbacks already paid for. Positions computed: spec rows
+        # pay 1 + drafted per verify forward (worst-case verify cost —
+        # rejected drafts included, the hardware did the work); plain rows
+        # pay one position per emitted token (grammar fast-forward writes
+        # each forced token's KV through the same per-position compute).
+        # KV block-time: paged rows hold owned + shared blocks for the
+        # chunk wall; dense rows hold 1 "block" (their whole KV line).
+        costs = self.costs
+        row_drafted = getattr(eng, "_last_row_drafted", None)
+        owned = getattr(eng, "_slot_owned", None)
+        shared = getattr(eng, "_slot_shared", None)
+        chunk_us = int(round(chunk_s * 1e6))
+        chunk_flops = 0
+        chunk_kv_bytes = 0
+
         pois_arr = None if pois is None else pois_h
         for b in range(self.B):
             sl = self.slots[b]
             if sl.request_id < 0:
                 continue
+            if costs is not None and sl.cost is not None:
+                # fold BEFORE the poison branch: an evicted row's spent
+                # chunk cost must ride out on its error result
+                if row_fwds is not None and row_drafted is not None:
+                    positions = int(row_fwds[b]) + int(row_drafted[b])
+                else:
+                    positions = int(n_h[b])
+                fl, by = costs.model.decode_row(positions, int(pos_h[b]))
+                wasted = 0
+                if row_drafted is not None and row_accepts is not None:
+                    w_pos = max(0, int(row_drafted[b]) - int(row_accepts[b]))
+                    if w_pos:
+                        wasted = costs.model.decode_row(
+                            w_pos, int(pos_h[b]))[0]
+                if owned is not None and shared is not None:
+                    blocks = len(owned[b]) + len(shared[b])
+                else:
+                    blocks = 1
+                kv_us = chunk_us * blocks
+                sl.cost["decode_flops"] += fl
+                sl.cost["decode_bytes"] += by
+                sl.cost["wasted_draft_flops"] += wasted
+                sl.cost["kv_block_us"] += kv_us
+                costs.fold_row({"decode_flops": fl, "decode_bytes": by,
+                                "wasted_draft_flops": wasted,
+                                "kv_block_us": kv_us})
+                chunk_flops += fl
+                chunk_kv_bytes += by
             if pois_arr is not None and int(pois_arr[b]) > 0:
                 # poison-request quarantine: the loop fenced this row off
                 # mid-chunk (non-finite logits / dead FSM state) without
@@ -676,6 +751,7 @@ class ContinuousBatcher:
                     quality=conf_summary(
                         (sl.conf_msum, sl.conf_mmin, sl.conf_esum,
                          sl.conf_forced, sl.conf_cnt), len(sl.token_ids)),
+                    cost=dict(sl.cost) if sl.cost is not None else None,
                 )
                 m.inc("scheduler.requests_completed")
                 m.observe_ms("scheduler.request_total",
@@ -692,6 +768,16 @@ class ContinuousBatcher:
         # drafter's host share (spec engines report _last_draft_ms on the
         # same readback) is carved out of the decode segment it was
         # measured inside, so the six stages still tile the wall
+        # roofline reconciliation (ISSUE 17): the chunk's analytic FLOPs /
+        # KV bytes against the measured chunk wall -> engine.mfu /
+        # engine.mbu gauges + cost.* counters (weights stream per forward
+        # dispatch, batch-shared, metered engine-side)
+        if costs is not None:
+            try:
+                costs.chunk(chunk_flops, chunk_kv_bytes,
+                            int(fwds_h) if fwds is not None else 0, chunk_s)
+            except Exception:
+                pass  # metering must never become a serving fault
         timer.lap("release")
         timer.carve("decode", "draft", float(getattr(eng, "_last_draft_ms", 0.0)))
         timer.finish(

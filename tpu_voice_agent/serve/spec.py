@@ -944,6 +944,7 @@ class SpecDecoder:
         drafted = accepted = 0
         row_fwds = np.zeros((B,), np.int64)
         row_accepts = np.zeros((B,), np.int64)
+        row_drafted = np.zeros((B,), np.int64)
         poison_h = np.zeros((B,), np.int32)
         # per-row conf lanes accumulated across the chunk's verify steps
         # (host arrays — each step pays its readback anyway); the fold
@@ -1009,6 +1010,7 @@ class SpecDecoder:
             accepted += int(a_h.sum())
             row_fwds += prev_act.astype(np.int64)
             row_accepts += a_h.astype(np.int64)
+            row_drafted += dl_h.astype(np.int64)
             poison_h = np.maximum(poison_h, pois_h)
             self._slot_fwds += prev_act.astype(np.int64)
             self._slot_drafted += dl_h.astype(np.int64)
@@ -1038,6 +1040,9 @@ class SpecDecoder:
         eng._last_poison = poison_h
         eng._last_accepts = row_accepts
         eng._last_row_fwds = row_fwds
+        # per-row drafted counts (ISSUE 17): the cost ledger's
+        # wasted-draft lane is (drafted - accepted) x per-token FLOPs
+        eng._last_row_drafted = row_drafted
         # the ISSUE 15 conf readback contract, spec plane: same tuple shape
         # as the chunk loops publish, already host-side here (a chunk that
         # ran zero verify steps publishes fresh zero lanes)

@@ -272,6 +272,16 @@ class SpeechEngine:
         from ..utils.quality import quality_lanes_enabled
 
         self.quality_lanes = quality_lanes_enabled()
+        # STT share of the cost observatory (ISSUE 17): analytic encoder/
+        # decoder FLOPs folded per encode dispatch / decode loop — host
+        # arithmetic only, voice's /debug/costs reads cost_totals
+        from ..utils.costmodel import cost_enabled, register_stt_engine
+
+        self.cost_lanes = cost_enabled()
+        self.cost_totals = {"encoder_flops": 0, "decoder_flops": 0,
+                            "encoded_frames": 0, "decoded_tokens": 0}
+        if self.cost_lanes:
+            register_stt_engine(self)
         self.params = (
             jax.jit(partial(init_params, self.cfg))(jax.random.PRNGKey(seed))
             if init_weights else None
@@ -316,6 +326,37 @@ class SpeechEngine:
             if n_frames <= b:
                 return b
         return self.frame_buckets[-1]
+
+    # ------------------------------------------------- cost lanes (ISSUE 17)
+
+    def _fold_encoder_cost(self, n_frames: int) -> None:
+        """Analytic encoder FLOPs for one encode dispatch over ``n_frames``
+        mel frames (incremental blocks pay their lookback re-encode too —
+        the hardware did that work). Host ints + a counter inc; never on
+        the device path."""
+        if not self.cost_lanes:
+            return
+        from ..utils import get_metrics
+        from ..utils.costmodel import whisper_encoder_flops
+
+        fl = whisper_encoder_flops(self.cfg, n_frames)
+        self.cost_totals["encoder_flops"] += fl
+        self.cost_totals["encoded_frames"] += int(n_frames)
+        get_metrics().inc("cost.stt_encoder_flops", float(fl))
+
+    def _fold_decoder_cost(self, n_tokens: int, enc_len: int) -> None:
+        """Analytic decoder FLOPs for one greedy decode loop: ``n_tokens``
+        forwards (emitted + BOS prompt) cross-attending ``enc_len``
+        encoder positions."""
+        if not self.cost_lanes:
+            return
+        from ..utils import get_metrics
+        from ..utils.costmodel import whisper_decoder_flops
+
+        fl = whisper_decoder_flops(self.cfg, n_tokens, enc_len)
+        self.cost_totals["decoder_flops"] += fl
+        self.cost_totals["decoded_tokens"] += int(n_tokens)
+        get_metrics().inc("cost.stt_decoder_flops", float(fl))
 
     # ------------------------------------------------------ incremental
 
@@ -370,6 +411,7 @@ class SpeechEngine:
         keep = step // 2
         new_k = jax.lax.dynamic_slice_in_dim(kv["k"], drop, keep, axis=2)
         new_v = jax.lax.dynamic_slice_in_dim(kv["v"], drop, keep, axis=2)
+        self._fold_encoder_cost(n_window)
         return new_k, new_v, keep
 
     def incremental_feed(self, state: IncrementalState, buf: np.ndarray) -> IncrementalState:
@@ -435,6 +477,8 @@ class SpeechEngine:
         n_h = int(n_a[0])
         ids = [int(t) for t in np.asarray(out_h)[0, :n_h]]
         decode_ms = (time.perf_counter() - t0) * 1e3
+        self._fold_decoder_cost(n_h + len(self.bos_ids),
+                                max(1, int(n_frames) // 2))
         ids, logp_mean, logp_min, logp_first, rep = finalize_stt_ids(
             ids, [np.asarray(x)[0] for x in conf_h], self.quality_lanes,
             final)
@@ -472,6 +516,7 @@ class SpeechEngine:
         enc_out = encoder_forward(self.params, self.cfg, mel, attn_impl=self.kernels)
         cross_kv = compute_cross_kv(self.params, self.cfg, enc_out)
         valid = jnp.arange(enc_out.shape[1])[None, :] < max(1, n_frames // 2)
+        self._fold_encoder_cost(bucket)
         return cross_kv, valid, n_frames
 
     def transcribe(self, audio: np.ndarray) -> TranscribeResult:

@@ -263,6 +263,14 @@ class BatchedEngineParser:
         self._pending_cap = (self.transcripts.max_sessions
                              if self.transcripts is not None else 64)
         self._plock = threading.Lock()
+        # per-session resource attribution (ISSUE 17): every finished
+        # request's cost ledger folds into a session-keyed LRU — the meter
+        # /debug/costs names top-cost sessions from (and the fair-share
+        # signal the multi-tenant QoS item needs)
+        from ..utils.costmodel import SessionCostLedger
+
+        self.session_costs = (SessionCostLedger()
+                              if self.batcher.costs is not None else None)
         self.runtime.start()
         # liveness watchdog: a dead serving loop restarts with inflight
         # futures failed fast instead of silently queueing forever
@@ -299,7 +307,9 @@ class BatchedEngineParser:
     def parse(self, text: str, context: dict, session_id: str | None = None,
               speculative: bool = False) -> ParseResponse:
         if self.transcripts is None or not session_id:
-            return _result_to_response(self._decode(render_prompt(text, context)))
+            res = self._decode(render_prompt(text, context))
+            self._fold_cost(session_id, res)
+            return _result_to_response(res)
         user = SessionTranscripts.user_frame(text, context)
         with self._plock:
             pend = self._pending.pop(session_id, None)
@@ -325,6 +335,7 @@ class BatchedEngineParser:
             self.transcripts.forget(session_id)
             prompt = self.transcripts.prompt_for(session_id, text, context)
         res = self._decode(prompt)
+        self._fold_cost(session_id, res)
         resp = _result_to_response(res)  # raises on truncation: transcript
         # stays at the last committed turn (the session survives)
         if speculative:
@@ -340,6 +351,13 @@ class BatchedEngineParser:
         else:
             self.transcripts.record(session_id, prompt, res.token_ids)
         return resp
+
+    def _fold_cost(self, session_id: str | None, res) -> None:
+        """Fold a finished request's ledger into the session rollup —
+        BEFORE response conversion, so errored results (which raise in
+        _result_to_response) still attribute the cost they spent."""
+        if self.session_costs is not None and getattr(res, "cost", None):
+            self.session_costs.fold(session_id, res.cost)
 
     def _too_long(self, prompt) -> bool:
         """Token-length guard: the prompt must fit a prefill bucket AND
@@ -1036,6 +1054,11 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
                 return locked_parse(preq.text, preq.context, preq.session_id,
                                     preq.speculative)
             return locked_parse(preq.text, preq.context, preq.session_id)
+        if getattr(parser, "session_costs", None) is not None:
+            # stateless ENGINE parsers still attribute spend per session
+            # (ISSUE 17): the id rides only into the cost-ledger fold —
+            # decode keeps the pure stateless parse(text, context) contract
+            return locked_parse(preq.text, preq.context, preq.session_id)
         return locked_parse(preq.text, preq.context)
 
     # golden-replay canary (ISSUE 15, QUALITY_CANARY_S > 0): replay a
@@ -1384,6 +1407,26 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
 
     app.router.add_get("/debug/steplog", make_steplog_handler("brain"))
     app.router.add_get("/debug/quality", make_quality_handler(qmon))
+
+    async def debug_costs(request: web.Request) -> web.Response:
+        # cost & efficiency observatory (ISSUE 17): the engine meter's
+        # analytic totals + live MFU/MBU, and the per-session attribution
+        # rollup. Shape is the /debug/costs schema OBSERVABILITY.md pins.
+        meter = getattr(getattr(parser, "batcher", None), "costs", None)
+        body: dict = {"service": "brain", "enabled": meter is not None}
+        if meter is not None:
+            body.update(meter.summary())
+        sessions = getattr(parser, "session_costs", None)
+        if sessions is not None:
+            try:
+                top_n = int(request.query.get("top", "8"))
+            except ValueError:
+                top_n = 8
+            body["sessions"] = len(sessions)
+            body["top_sessions"] = sessions.top(max(1, min(top_n, 64)))
+        return web.json_response(body)
+
+    app.router.add_get("/debug/costs", debug_costs)
     from ..utils.timeseries import attach_timeseries
 
     attach_timeseries(app, "brain", tracer)

@@ -928,6 +928,9 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
     # the quality observatory fan-out (ISSUE 15): each replica's windowed
     # quality state, so "which replica is wrong" is one scrape
     app.router.add_get("/debug/replicas/quality", fan_out("/debug/quality"))
+    # the cost observatory fan-out (ISSUE 17): each replica's engine meter
+    # + per-session attribution, so "who is burning the fleet" is one scrape
+    app.router.add_get("/debug/replicas/costs", fan_out("/debug/costs"))
 
     async def replicas_flight(req: web.Request) -> web.Response:
         """The flight-recorder fan-out, with each member's dump annotated

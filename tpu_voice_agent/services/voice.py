@@ -875,6 +875,17 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     app.router.add_get("/debug/trace/{trace_id}", make_trace_handler("voice", tracer))
     app.router.add_get("/debug/flightrecorder", make_flightrecorder_handler("voice"))
     app.router.add_get("/debug/quality", make_quality_handler(qmon))
+
+    async def debug_costs(_req: web.Request) -> web.Response:
+        # the STT share of the cost observatory (ISSUE 17): summed
+        # analytic encoder/decoder FLOPs across live SpeechEngines
+        from ..utils.costmodel import cost_enabled, stt_cost_summary
+
+        return web.json_response({"service": "voice",
+                                  "enabled": cost_enabled(),
+                                  "stt": stt_cost_summary()})
+
+    app.router.add_get("/debug/costs", debug_costs)
     from ..utils.timeseries import attach_timeseries
 
     attach_timeseries(app, "voice", tracer)

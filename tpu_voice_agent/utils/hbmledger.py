@@ -144,27 +144,10 @@ def measure_hbm(engine) -> dict:
     return out
 
 
-def decode_step_bytes(cfg, batch: int, context_tokens: int,
-                      kv_quant: str | None = None,
-                      weight_quant: str | None = "int8") -> dict:
-    """Modeled HBM bytes ONE decode step moves at (batch, context) — the
-    CPU-harness proxy for the decode-stage wall (docs/PERF.md: decode is
-    HBM-bound, so step wall ∝ bytes moved). Weights stream once per step
-    for the whole batch; each live slot reads its attended KV. KV bytes
-    follow the ops.kvquant per-(position, head) layout, so the ratio
-    between tiers IS the modeled decode-stage speedup the bench kv_quant
-    rows report (benches/bench_spec.py)."""
-    from ..ops.kvquant import KV_QUANT_VBYTES, KV_SCALE_BYTES
-
-    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
-    nq, nkv, L, V = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers, cfg.vocab_size
-    wbytes = 1 if weight_quant == "int8" else 2
-    attn = d * nq * hd + 2 * d * nkv * hd + nq * hd * d
-    weights = (L * (attn + 3 * d * f) + V * d) * wbytes
-    per_pos_head = hd * KV_QUANT_VBYTES[kv_quant] + KV_SCALE_BYTES[kv_quant]
-    kv = int(2 * L * context_tokens * nkv * per_pos_head) * batch
-    return {"weights_bytes": int(weights), "kv_read_bytes": int(kv),
-            "total_bytes": int(weights + kv)}
+# decode_step_bytes moved to utils/costmodel (ISSUE 17): byte accounting
+# now lives beside the FLOP model in one source of truth. Re-exported here
+# for existing importers; new code should import from costmodel directly.
+from .costmodel import decode_step_bytes  # noqa: E402,F401
 
 
 def hbm_report(engine) -> dict:

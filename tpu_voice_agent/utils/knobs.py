@@ -242,6 +242,13 @@ declare("FLEET_GRAY_WINDOWS", "3", "consecutive outlier scrape windows before a 
 declare("FLEET_MIN_PEERS", "3", "members a signal needs before peer-relative scoring runs (a median of two cannot name the outlier)", table=OBSERVABILITY)
 declare("FLEET_GRAY_HOLD_S", "300", "seconds a gray verdict survives WITHOUT scoreable evidence before expiring (demotion starves traffic-borne signals; expiry bounds the capacity loss, re-detection re-demotes)", table=OBSERVABILITY)
 
+# cost & efficiency observatory (ISSUE 17): analytic roofline metering,
+# live MFU/MBU, per-session resource attribution
+declare("COST_ENABLE", "1", "0 removes the analytic cost lanes (per-request ledger + MFU/MBU gauges; token-identical either way)", table=OBSERVABILITY)
+declare("COST_PEAK_TFLOPS", "0", "device peak TFLOP/s override for MFU (0 = per-device-kind table, documented CPU proxy off-TPU)", table=OBSERVABILITY)
+declare("COST_PEAK_GBPS", "0", "device peak HBM GB/s override for MBU (0 = per-device-kind table, documented CPU proxy off-TPU)", table=OBSERVABILITY)
+declare("COST_SESSIONS", "256", "per-session cost-rollup LRU size in the brain", table=OBSERVABILITY)
+
 # ========================================================= infrastructure
 # deliberately undocumented: JAX bootstrap + test/bench harness plumbing,
 # not operator tuning surface (the checker rejects doc rows for these)

@@ -381,6 +381,16 @@ class FlightRecorder:
         from .steplog import get_steplog
 
         steplog = get_steplog().dump()
+        # the cost observatory summary rides every freeze too (ISSUE 17):
+        # an incident autopsy should see what the hardware was being spent
+        # on (MFU/MBU, attributed totals) at the freeze moment. Same
+        # outside-the-lock discipline; metering must never block a freeze.
+        try:
+            from .costmodel import cost_snapshot
+
+            costs = cost_snapshot()
+        except Exception:
+            costs = None
         with self._lock:
             if self._frozen is not None:
                 return False
@@ -393,6 +403,7 @@ class FlightRecorder:
                            for tid, spans in self._traces.items()],
                 "metric_snapshots": list(self._snapshots),
                 "steplog": steplog,
+                "costs": costs,
                 "config": {"max_traces": self.max_traces,
                            "max_snapshots": self.max_snapshots,
                            "snapshot_interval_s": self.snapshot_interval_s},
