@@ -1,0 +1,221 @@
+"""Abusive-tenant QoS drill: premium capacity-at-SLO with a hostile
+neighbor vs a clean premium-only mix (ISSUE 18).
+
+The tenancy plane's whole claim is *isolation*: one abusive tenant must
+not take premium sessions out of SLO. This bench drills exactly that
+against a REAL engine-backed brain — a paged+radix `test-tiny` engine
+behind the continuous batcher with ``TENANT_CLASSES`` armed, so the
+fair-share admission, slot caps, token-bucket gate, and chunk-boundary
+preemption under test are the actual serving plane's:
+
+- **clean run**: N premium sessions (``single_shot@premium``) on a fresh
+  stack; their ok-fraction and p50 define the premium capacity-at-SLO
+  baseline.
+- **abusive run**: the same N premium sessions PLUS an abuser dealing
+  bursts of multi-turn traffic (``multi_turn@abuser``) into a lane with
+  weight 1, a 1-slot cap and a 2 rps token bucket. The abuser's overflow
+  must be *throttled* (shed with Retry-After -> the voice tier degrades
+  those turns to the rule parser), never errored, and premium capacity
+  must hold.
+
+Verdict bars:
+
+- ``premium capacity ratio (abusive / clean) >= 0.9`` — the isolation
+  headline. Capacity-at-SLO is ``ok_fraction * min(1, p50_bar / p50)``:
+  errors and p50 degradation both spend it.
+- ``abuser throttle rate > 0`` — the capacity gate actually fired (counted
+  by the pinned ``tenant.throttled``); an abuser that was never throttled
+  at this load means the token bucket is disarmed.
+
+SLO thresholds are widened for the CPU harness exactly like bench_chaos
+(identical for both runs — the verdict is the RATIO, not the absolute).
+
+Knobs: BENCH_TENANCY_PREMIUM_N (6), BENCH_TENANCY_ABUSE_N (6),
+BENCH_TENANCY_UTTERANCES (3), BENCH_TENANCY_CLASSES (the registry below),
+BENCH_TENANCY_SLOTS (4), BENCH_TENANCY_SLO_P50_MS (8000).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log, snapshot_observability  # noqa: E402
+
+sys.path.insert(0, str(Path(_ROOT) / "tools"))
+import swarm  # noqa: E402
+
+# premium gets 4x the fair share and three of the four slots; the abuser
+# lane is pinned to one slot and a 2 rps bucket — the capacity gate this
+# drill exists to prove
+DEFAULT_CLASSES = "premium:4:slots=3:p50=8000,abuser:1:slots=1:rps=2"
+
+
+def _engine_parser(slots: int):
+    """The system under drill: paged+radix tiny engine behind the
+    continuous batcher — the plane serve/tenancy.py actually governs."""
+    from tpu_voice_agent.serve import PagedDecodeEngine
+    from tpu_voice_agent.services.brain import (
+        BatchedEngineParser,
+        install_prompt_prefix,
+    )
+
+    eng = PagedDecodeEngine(
+        preset="test-tiny", max_len=2048, batch_slots=slots,
+        prefill_buckets=(128, 256, 512, 1024, 2048), radix_enable=True)
+    install_prompt_prefix(eng)
+    return BatchedEngineParser(eng, chunk_steps=16, session_aware=True)
+
+
+def _debug_tenants(brain_url: str) -> dict:
+    try:
+        with urllib.request.urlopen(brain_url + "/debug/costs", timeout=5) as r:
+            return json.loads(r.read().decode()).get("tenants") or {}
+    except Exception as e:  # pragma: no cover - diagnostics only
+        return {"error": str(e)}
+
+
+def _run(label: str, mix: dict[str, int], n: int, utterances: int,
+         slots: int) -> dict:
+    tmp = tempfile.mkdtemp(prefix=f"bench_tenancy_{label}_")
+    parser = _engine_parser(slots)
+    # chaos explicitly OFF (empty spec, not None): an exported CHAOS_FAULTS
+    # must not poison the isolation ratio
+    urls, servers = swarm.build_local_stack(
+        tmp, brain_inflight=16, exec_inflight=16, parser=parser,
+        chaos_spec="", parse_timeout_s=20.0)
+    try:
+        log(f"[{label}] {n} sessions, mix {mix}")
+        verdict = swarm.run_swarm(urls["voice"], n, mix=mix,
+                                  utterances=utterances, think_s=0.05,
+                                  sample_urls=list(urls.values()))
+        verdict["tenants"] = _debug_tenants(urls["brain"])
+        verdict["observability"] = snapshot_observability(urls["brain"])
+        return verdict
+    finally:
+        for srv in servers:
+            srv.__exit__(None, None, None)
+        parser.close()
+
+
+def _lane_rollup(verdict: dict, suffix: str) -> dict:
+    """Aggregate the per-scenario entries of one tenant's lane."""
+    utts = errors = 0
+    p50s: list[float] = []
+    for sc, ent in (verdict.get("scenarios") or {}).items():
+        if not sc.endswith(suffix):
+            continue
+        utts += ent["utterances"]
+        errors += ent["errors"]
+        if ent.get("lat_p50_ms") is not None:
+            p50s.append(ent["lat_p50_ms"])
+    return {"utterances": utts, "errors": errors,
+            "p50_ms": (max(p50s) if p50s else None)}
+
+
+def _capacity_at_slo(roll: dict, p50_bar: float) -> float:
+    """The premium headline scalar: ok-fraction, discounted linearly once
+    p50 blows past the bar — a run that stays error-free by queueing
+    premium behind the abuser must not score as isolated."""
+    if not roll["utterances"]:
+        return 0.0
+    ok = 1.0 - roll["errors"] / roll["utterances"]
+    p50 = roll["p50_ms"]
+    if p50 is not None and p50 > p50_bar:
+        ok *= p50_bar / p50
+    return ok
+
+
+def main() -> None:
+    premium_n = int(os.environ.get("BENCH_TENANCY_PREMIUM_N", "6"))
+    abuse_n = int(os.environ.get("BENCH_TENANCY_ABUSE_N", "6"))
+    utterances = int(os.environ.get("BENCH_TENANCY_UTTERANCES", "3"))
+    slots = int(os.environ.get("BENCH_TENANCY_SLOTS", "4"))
+    classes = os.environ.get("BENCH_TENANCY_CLASSES", DEFAULT_CLASSES)
+    p50_bar = float(os.environ.get("BENCH_TENANCY_SLO_P50_MS", "8000"))
+    # the registry must be armed BEFORE the batcher is constructed — the
+    # plane is wired (or not) at ContinuousBatcher init
+    os.environ["TENANT_CLASSES"] = classes
+    os.environ.setdefault("SLO_TARGET_P50_MS", str(int(p50_bar)))
+    os.environ.setdefault("SLO_TARGET_P99_MS", "30000")
+
+    clean = _run("clean", {"single_shot@premium": 1}, premium_n,
+                 utterances, slots)
+    abusive = _run("abusive",
+                   {"single_shot@premium": premium_n,
+                    "multi_turn@abuser": abuse_n},
+                   premium_n + abuse_n, utterances, slots)
+
+    prem_clean = _lane_rollup(clean, "@premium")
+    prem_abuse = _lane_rollup(abusive, "@premium")
+    abuser = _lane_rollup(abusive, "@abuser")
+    cap_clean = _capacity_at_slo(prem_clean, p50_bar)
+    cap_abuse = _capacity_at_slo(prem_abuse, p50_bar)
+    ratio = (cap_abuse / cap_clean) if cap_clean else 0.0
+
+    counters = abusive.get("observability", {}).get("runtime_counters", {}) or {}
+    throttled = counters.get("tenant.throttled", 0.0)
+    preemptions = counters.get("tenant.preemptions", 0.0)
+    throttle_rate = throttled / max(1, abuser["utterances"])
+    abuser_ok = (1.0 - abuser["errors"] / abuser["utterances"]) \
+        if abuser["utterances"] else 0.0
+
+    log(f"premium capacity clean={cap_clean:.3f} abusive={cap_abuse:.3f} "
+        f"ratio={ratio:.2f} (bar >= 0.90); abuser throttled {throttled:.0f}x "
+        f"(rate {throttle_rate:.2f}), ok-fraction {abuser_ok:.2f}, "
+        f"preemptions {preemptions:.0f}")
+
+    emit("tenancy_premium_clean_capacity", cap_clean, "fraction")
+    emit("tenancy_premium_capacity_ratio", round(ratio, 4), "ratio")
+    emit("tenancy_abuser_throttle_rate", round(throttle_rate, 4), "rate")
+    emit("tenancy_abuser_ok_fraction", round(abuser_ok, 4), "fraction")
+    emit("tenancy_preemptions", float(preemptions), "preemptions")
+
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art = art_dir / f"BENCH_tenancy_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_tenancy",
+        "ts": stamp,
+        "config": {"premium_n": premium_n, "abuse_n": abuse_n,
+                   "utterances": utterances, "slots": slots,
+                   "classes": classes, "p50_bar_ms": p50_bar},
+        "tenancy": {
+            "premium_clean": prem_clean,
+            "premium_abusive": prem_abuse,
+            "abuser": abuser,
+            "capacity_clean": round(cap_clean, 4),
+            "capacity_abusive": round(cap_abuse, 4),
+            "capacity_ratio": round(ratio, 4),
+            "bar": 0.90,
+            "throttled": throttled,
+            "throttle_rate": round(throttle_rate, 4),
+            "abuser_ok_fraction": round(abuser_ok, 4),
+            "preemptions": preemptions,
+            "lanes": (abusive.get("tenants") or {}).get("lanes"),
+            "clean_scenarios": clean.get("scenarios"),
+            "abusive_scenarios": abusive.get("scenarios"),
+        },
+    }, indent=1))
+    log(f"artifact: {art}")
+    failed = False
+    if ratio < 0.90:
+        log(f"FAIL: premium capacity ratio {ratio:.2f} below the 0.90 bar")
+        failed = True
+    if throttled < 1:
+        log("FAIL: abuser was never throttled — the capacity gate is "
+            "disarmed at a load that must trip it")
+        failed = True
+    if failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

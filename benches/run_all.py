@@ -25,7 +25,8 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_spec.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
            "bench_steplog.py", "bench_router.py", "bench_handoff.py",
-           "bench_fleet.py", "bench_autopilot.py", "bench_cost.py"]
+           "bench_fleet.py", "bench_autopilot.py", "bench_cost.py",
+           "bench_tenancy.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -71,12 +72,16 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # regression gate (tiny engine, trimmed workload, seconds on CPU), and a
 # PR that breaks exact ledger conservation, makes the cost lanes change
 # tokens, or makes metering cost >5% of capacity must fail the quick table
+# the tenancy bench stays on --quick too — it is the tenant-isolation
+# regression gate (tiny engine, two fixed-N swarm runs, seconds on CPU),
+# and a PR that lets an abusive tenant starve premium sessions or disarms
+# the token-bucket capacity gate must fail the quick table as well
 QUICK_BENCHES = ["bench_quality.py", "bench_quality_online.py",
                  "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
                  "bench_chaos.py", "bench_steplog.py", "bench_router.py",
                  "bench_handoff.py", "bench_fleet.py", "bench_autopilot.py",
-                 "bench_cost.py"]
+                 "bench_cost.py", "bench_tenancy.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_QO_MAX_N": "4", "BENCH_QO_UTTERANCES": "2",
@@ -96,7 +101,9 @@ QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_FLEET_MAX_N": "6", "BENCH_FLEET_UTTERANCES": "2",
              "BENCH_AUTOPILOT_HIGH_N": "6", "BENCH_AUTOPILOT_UTTERANCES": "2",
              "BENCH_AUTOPILOT_TURNS": "2",
-             "BENCH_COST_SESSIONS": "6", "BENCH_COST_ROUNDS": "2"}
+             "BENCH_COST_SESSIONS": "6", "BENCH_COST_ROUNDS": "2",
+             "BENCH_TENANCY_PREMIUM_N": "3", "BENCH_TENANCY_ABUSE_N": "3",
+             "BENCH_TENANCY_UTTERANCES": "2"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -188,7 +195,7 @@ def main() -> None:
                             "spec", "stt", "radix", "swarm", "chaos",
                             "steplog", "engine_step", "xla", "hbm",
                             "router", "kv_quant", "handoff", "fleet",
-                            "quality", "autopilot", "cost"):
+                            "quality", "autopilot", "cost", "tenancy"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -253,6 +253,40 @@ def render_costs(costs_fan: dict, series: dict[str, list[dict]],
     return "\n".join(lines)
 
 
+def render_tenants(costs_fan: dict, series: dict[str, list[dict]],
+                   width: int = 48) -> str:
+    """The tenant panel (ISSUE 18): per-lane occupancy/fairness off the
+    ``tenants`` section the cost fan-out carries when a brain's tenancy
+    plane is on, plus the ``tenant.token_share.*`` gauge sparklines from
+    the same timeseries ring every panel reads — the operator's answer to
+    "who is holding the slots, and is the fair share actually fair"."""
+    reps = costs_fan.get("replicas") or {}
+    lines = ["tenants (QoS lanes):"]
+    for url in sorted(reps):
+        body = reps.get(url) if isinstance(reps.get(url), dict) else {}
+        lanes = (body.get("tenants") or {}).get("lanes") or {}
+        if not lanes:
+            continue
+        lines.append(f"{url}")
+        for name, ln in sorted(lanes.items()):
+            p50 = ln.get("p50_ms")
+            lines.append(
+                f"  {name.ljust(12)} w={ln.get('weight')} active "
+                f"{ln.get('active')} queued {ln.get('queued')} tokens "
+                f"{ln.get('tokens')} throttled {ln.get('throttled')} "
+                f"preempt {ln.get('preemptions')}"
+                + (f" p50 {p50:.0f}ms" if p50 is not None else ""))
+        samples = series.get(url) or []
+        shares = sorted({k for s in samples for k in (s.get("gauges") or {})
+                         if k.startswith("tenant.token_share.")})
+        for k in shares:
+            xs = [s.get("gauges", {}).get(k) for s in samples]
+            latest = next((x for x in reversed(xs) if x is not None), None)
+            lines.append(f"  {k.removeprefix('tenant.').ljust(24)}"
+                         f"|{sparkline(xs, width)}| {_fmt(latest)}")
+    return "\n".join(lines) if len(lines) > 1 else ""
+
+
 def render_evidence(evidence: dict) -> str:
     """The peer-comparison evidence a gray freeze carries: who was
     demoted, on which signal, how far from the fleet — the dump answers
@@ -467,6 +501,22 @@ def self_test() -> int:
     # file-mode shape detection: fan-out vs one service's own body
     assert "s-big" in render_file(cost_fan)
     assert "mfu 0.31" in render_file(cost_body)
+    # the tenant panel (ISSUE 18): lanes off the cost fan-out + share rings
+    cost_body["tenants"] = {"lanes": {
+        "premium": {"weight": 3.0, "vtime": 120.0, "active": 2, "queued": 1,
+                    "tokens": 900, "throttled": 0, "preemptions": 0,
+                    "p50_ms": 80.0},
+        "free": {"weight": 1.0, "vtime": 350.0, "active": 1, "queued": 4,
+                 "tokens": 350, "throttled": 12, "preemptions": 2,
+                 "p50_ms": None}}, "ledgers": {}}
+    share_series = {"http://r0": [
+        {"gauges": {"tenant.token_share.premium": 0.6 + 0.02 * i}}
+        for i in range(8)]}
+    ttxt = render_tenants(cost_fan, share_series)
+    assert "premium" in ttxt and "throttled 12" in ttxt and "preempt 2" in ttxt
+    assert "token_share.premium" in ttxt and "█" in ttxt
+    assert render_tenants({"replicas": {"http://r1": {"enabled": False}}},
+                          {}) == ""
     print(txt)
     print("fleetview self-test ok")
     return 0
@@ -518,6 +568,10 @@ def main(argv: list[str] | None = None) -> int:
                    for b in (costs.get("replicas") or {}).values()):
                 print()
                 print(render_costs(costs, series, width=args.width))
+            tpanel = render_tenants(costs, series, width=args.width)
+            if tpanel:
+                print()
+                print(tpanel)
         if not args.watch:
             return 0
         time.sleep(args.watch)

@@ -237,6 +237,15 @@ PINNED: dict[str, str] = {
     "cost.decode_bytes": "counter",
     "cost.stt_encoder_flops": "counter",
     "cost.stt_decoder_flops": "counter",
+    # multi-tenant QoS plane (ISSUE 18, serve/tenancy.py + serve/
+    # scheduler.py, docs/OBSERVABILITY.md "Multi-tenant QoS plane"): the
+    # isolation signals bench_tenancy and the swarm drills read — throttle
+    # and preemption volume are the abuse-containment evidence, and the
+    # requeue-rotation counter is the aging bound's only witness
+    "tenant.lanes": "gauge",
+    "tenant.throttled": "counter",
+    "tenant.preemptions": "counter",
+    "scheduler.requeue_rotations": "counter",
 }
 
 

@@ -45,6 +45,12 @@ Usage (against a running stack; benches/bench_swarm.py boots one for you):
         [--mix single_shot=4,multi_turn=2,paced_audio=1] [--json]
     python tools/swarm.py --search --max-n 64   # the capacity bisect
 
+A mix key may carry a QoS lane: ``single_shot@premium=4,compound@free=2``
+runs those sessions with a ``tenant`` control frame dealt right after
+connect (ISSUE 18 — pair with ``TENANT_CLASSES`` on the brain stack).
+The full ``scenario@tenant`` key labels the verdict rollup, so per-tenant
+latency/error splits come out of the standard per-scenario report.
+
 The audio scenarios assume the swarm stack's ``ScriptedSTT`` cadence
 (a final every ``--frames-per-final`` frames); against a real-STT stack
 prefer the typed scenarios or feed real speech.
@@ -513,20 +519,27 @@ async def run_session(client, voice_url: str, scenario: str, cfg: dict) -> dict:
     utts: list[Utt] = []
     warns = 0
     aborted = 0
+    # tenant-tagged deal (ISSUE 18): a ``scenario@tenant`` mix key runs the
+    # base scenario inside that QoS lane. The full key stays the Utt label,
+    # so every per-scenario rollup splits per (scenario, tenant) for free.
+    label = scenario
+    scenario, _, tenant = scenario.partition("@")
     ws_url = voice_url.replace("http", "ws", 1) + "/stream"
     async with client.ws_connect(ws_url, max_msg_size=8 * 1024 * 1024) as ws:
+        if tenant:
+            await ws.send_json({"type": "tenant", "tenant": tenant})
         if scenario == "single_shot":
             for i in range(n):
-                utts += await _typed_round(ws, scenario, [COMMANDS[i % len(COMMANDS)]],
+                utts += await _typed_round(ws, label, [COMMANDS[i % len(COMMANDS)]],
                                            think, timeout)
         elif scenario == "multi_turn":
             # one conversation, n turns on the same convo_id (the connection)
             utts += await _typed_round(
-                ws, scenario, [COMMANDS[i % len(COMMANDS)] for i in range(n)],
+                ws, label, [COMMANDS[i % len(COMMANDS)] for i in range(n)],
                 think, timeout)
         elif scenario == "compound":
             utts += await _typed_round(
-                ws, scenario,
+                ws, label,
                 [COMPOUND_COMMANDS[i % len(COMPOUND_COMMANDS)] for i in range(n)],
                 think, timeout)
         elif scenario == "barge_in":
@@ -537,13 +550,13 @@ async def run_session(client, voice_url: str, scenario: str, cfg: dict) -> dict:
                 # must run exactly its configured utterance count
                 pair = [COMMANDS[(i + j) % len(COMMANDS)]
                         for j in range(min(2, n - i))]
-                utts += await _typed_round(ws, scenario, pair, think, timeout,
+                utts += await _typed_round(ws, label, pair, think, timeout,
                                            overlap=True)
                 if think:
                     await asyncio.sleep(think)
         elif scenario in ("paced_audio", "unpaced_audio"):
             frame_s = cfg["frame_s"] if scenario == "paced_audio" else 0.0
-            utts += await _audio_round(ws, scenario, n, fpf, frame_s, think,
+            utts += await _audio_round(ws, label, n, fpf, frame_s, think,
                                        timeout)
         elif scenario == "garbage":
             for i in range(n):
@@ -554,7 +567,7 @@ async def run_session(client, voice_url: str, scenario: str, cfg: dict) -> dict:
                 glog = EventLog()
                 await glog.wait(ws, lambda lg: lg.count("warn") >= 2, timeout)
                 warns += glog.count("warn")
-                utts += await _typed_round(ws, scenario,
+                utts += await _typed_round(ws, label,
                                            [COMMANDS[i % len(COMMANDS)]],
                                            think, timeout)
         elif scenario == "abort":
@@ -567,11 +580,11 @@ async def run_session(client, voice_url: str, scenario: str, cfg: dict) -> dict:
                 await ws.send_bytes(SILENCE_FRAME)
             await asyncio.sleep(min(0.05, think or 0.05))
             aborted += 1
-            utts.append(Utt(scenario, (time.monotonic() - t0) * 1e3, False, None))
+            utts.append(Utt(label, (time.monotonic() - t0) * 1e3, False, None))
             # close without reading the backlog — a real client crash
         else:
             raise ValueError(f"unknown scenario {scenario!r}")
-    return {"scenario": scenario, "utts": utts, "warns": warns,
+    return {"scenario": label, "utts": utts, "warns": warns,
             "aborted": aborted}
 
 
@@ -585,7 +598,8 @@ def _deal_scenarios(n_sessions: int, mix: dict[str, int]) -> list[str]:
     round-robin so a bisect probe at tiny N still mixes behaviors."""
     mix = {k: int(w) for k, w in mix.items() if int(w) > 0}
     for name in mix:
-        if name not in SCENARIOS:
+        # a mix key may carry a QoS lane suffix: ``scenario@tenant``
+        if name.split("@", 1)[0] not in SCENARIOS:
             raise ValueError(f"unknown scenario {name!r} in mix")
     if not mix:
         raise ValueError("empty scenario mix")

@@ -121,6 +121,10 @@ class ParseRequest(BaseModel):
     # commit/rollback) or refuse with 409 speculation_unsupported rather
     # than record a turn that may be discarded.
     speculative: bool = False
+    # tenant QoS tag (ISSUE 18): names the request's fair-share lane when
+    # the brain's tenancy plane is on; absent/unknown tags fall into the
+    # default class. Ignored entirely when TENANT_CLASSES is unset.
+    tenant: str | None = Field(default=None, max_length=64)
 
 
 class ParseResponse(BaseModel):

@@ -102,18 +102,23 @@ class ColocatedServing:
             self._work.notify()
         return fut
 
-    def submit_parse(self, prompt: str, deadline=None) -> "Future[GenerationResult]":
+    def submit_parse(self, prompt: str, deadline=None,
+                     tenant=None) -> "Future[GenerationResult]":
         """``deadline`` (utils.resilience.Deadline, optional) rides into the
         batcher: expired-in-queue requests shed at dequeue and in-flight
         ones cancel at chunk boundaries (the x-deadline-ms propagation now
-        reaches INSIDE the inference plane, not just the HTTP seams)."""
+        reaches INSIDE the inference plane, not just the HTTP seams).
+        ``tenant`` (ISSUE 18) tags the request's QoS lane the same way."""
         fut: Future = Future()
+        # the tenant kwarg is only forwarded when set: duck-typed batchers
+        # that predate the QoS plane keep working untagged
+        kw = {"tenant": tenant} if tenant is not None else {}
         with self._work:
-            rid = self.batcher.submit(prompt, deadline=deadline)
+            rid = self.batcher.submit(prompt, deadline=deadline, **kw)
             fut.request_id = rid  # lets abandon_parse find the request again
             if rid in self.batcher.results:
-                # refused at submit (quarantined prompt): resolve now — no
-                # decode step will ever run to harvest it
+                # refused at submit (quarantined prompt / throttled tenant):
+                # resolve now — no decode step will ever run to harvest it
                 self._set_future(fut, value=self.batcher.results.pop(rid))
                 return fut
             self._parse_futs[rid] = fut

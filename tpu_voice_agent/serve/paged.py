@@ -583,6 +583,10 @@ class PagedDecodeEngine(DecodeEngine):
         # host token ids of the request occupying each slot (radix insert
         # at release needs prompt + generated ids; None when radix is off)
         self._slot_ids: list[list[int] | None] = [None] * self.batch_slots
+        # tenant radix namespace per slot (ISSUE 18): the scheduler sets it
+        # before admission; match/insert salt their keys with it. Empty
+        # (tenancy off) keeps every radix path byte-identical.
+        self._slot_ns: dict[int, str] = {}
         # speculative decoding (ISSUE 8): deferred from the parent ctor —
         # the SpecDecoder reads the paged surface (pool/tables/trash) that
         # only exists now. Greedy batched chunks route through it; rejected
@@ -775,10 +779,15 @@ class PagedDecodeEngine(DecodeEngine):
         None``) takes the parent path untouched."""
         if self.radix is None:
             return super().prefill_slot(ids, slot)
+        # capture the incoming tenant namespace across the release below
+        # (release pops it — it belongs to the PREVIOUS occupant there)
+        ns = self._slot_ns.get(slot)
         self.release_slot(slot)
+        if ns is not None:
+            self._slot_ns[slot] = ns
         ids = list(ids)
         g = self._group(slot)
-        chain, matched = self.radix[g].match(ids)
+        chain, matched = self.radix[g].match(ids, ns=ns)
         bucket = None
         P, tail = matched, None
         if matched:
@@ -801,6 +810,11 @@ class PagedDecodeEngine(DecodeEngine):
                 matched = 0
         if not matched:
             logits = super().prefill_slot(ids, slot)
+            # the parent prefill releases the slot once more on entry, which
+            # pops the namespace again — reinstate it for this occupant's
+            # insert-at-release
+            if ns is not None:
+                self._slot_ns[slot] = ns
             self._slot_ids[slot] = ids
             return logits
         # the hit is accounted only HERE — a bucket fallback above must not
@@ -969,8 +983,18 @@ class PagedDecodeEngine(DecodeEngine):
                 self._next_pos[b] = min(self._next_pos[b] + span, self.max_len)
         return starved
 
+    def set_slot_ns(self, slot: int, ns: str | None) -> None:
+        """Install the tenant radix namespace for the slot's NEXT admission
+        (the scheduler calls this right before ``prefill_slot``; the
+        namespace rides until the occupant's release inserts its chain)."""
+        if ns is None:
+            self._slot_ns.pop(slot, None)
+        else:
+            self._slot_ns[slot] = ns
+
     def release_slot(self, slot: int, generated_ids: list[int] | None = None,
                      ok: bool = True) -> None:
+        ns = self._slot_ns.pop(slot, None)
         if self._slot_owned[slot] or self._slot_shared[slot]:
             if (ok and self.radix is not None and generated_ids is not None
                     and self._slot_ids[slot] is not None
@@ -990,7 +1014,7 @@ class PagedDecodeEngine(DecodeEngine):
                 # radix-cached blocks can contain a rejected draft token.
                 ids = self._slot_ids[slot] + [int(t) for t in generated_ids]
                 blocks = self._slot_shared[slot] + self._slot_owned[slot]
-                self.radix[self._group(slot)].insert(ids, blocks)
+                self.radix[self._group(slot)].insert(ids, blocks, ns=ns)
             self.allocator.free(self._slot_owned[slot])
             self.allocator.free(self._slot_shared[slot])
             self._slot_owned[slot] = []
@@ -1088,9 +1112,12 @@ class PagedDecodeEngine(DecodeEngine):
                 self.allocator.reserve(self._prefix_blocks[g])
         if self.radix is not None:
             max_nodes = self.radix[0].max_nodes
+            ns_quota = self.radix[0].ns_quota
             self.radix = [RadixCache(self.allocator, self.block_size, group=g,
                                      max_nodes=max_nodes)
                           for g in range(self.dp)]
+            for rc in self.radix:
+                rc.ns_quota = ns_quota  # tenant quotas survive warm restart
             full = len(self.prefix_ids) // self.block_size
             if full:
                 for g in range(self.dp):
@@ -1102,6 +1129,7 @@ class PagedDecodeEngine(DecodeEngine):
         self._covered = [0] * self.batch_slots
         self._next_pos = [0] * self.batch_slots
         self._slot_ids = [None] * self.batch_slots
+        self._slot_ns.clear()
         self.block_tables = jnp.zeros(
             (self.batch_slots, self.max_blocks), jnp.int32)
         self._pressure_until = 0.0

@@ -44,15 +44,20 @@ class RadixNode:
     ``block_size`` token ids whose KV the block holds; the path from the
     root spells the full token prefix."""
 
-    __slots__ = ("key", "block", "children", "parent", "last_use", "pinned")
+    __slots__ = ("key", "block", "children", "parent", "last_use", "pinned",
+                 "ns")
 
     def __init__(self, key, block, parent, pinned: bool = False):
-        self.key = key  # tuple[int, ...] | None (root)
+        self.key = key  # tuple[int, ...] | (ns, tuple) | None (root)
         self.block = block  # pool block id | None (root)
         self.children: dict[tuple, "RadixNode"] = {}
         self.parent = parent
         self.last_use = 0
         self.pinned = pinned
+        # tenant namespace (ISSUE 18): None = shared; set = the node's key
+        # is salted ``(ns, ids)`` and its block counts against the owning
+        # tenant's quota
+        self.ns: str | None = None
 
 
 class RadixCache:
@@ -81,6 +86,12 @@ class RadixCache:
         self.root = RadixNode(None, None, None, pinned=True)
         self._n_nodes = 0
         self._clock = itertools.count(1)
+        # tenant namespaces (ISSUE 18): per-ns adopted-node counts and an
+        # optional quota lookup (the scheduler installs the tenancy plane's
+        # ``block_quota``). With no namespaces in play both stay empty and
+        # every path below is byte-identical to the pre-tenancy tree.
+        self.ns_quota = None  # callable: ns -> block quota (0 = unlimited)
+        self._ns_nodes: dict[str, int] = {}
         # host-side stats (the scheduler exports them as radix.* gauges;
         # event counters increment the metrics registry at event time)
         self.lookups = 0
@@ -91,11 +102,17 @@ class RadixCache:
 
     # ------------------------------------------------------------ admission
 
-    def match(self, ids: list[int]) -> tuple[list[int], int]:
+    def match(self, ids: list[int], ns: str | None = None
+              ) -> tuple[list[int], int]:
         """Longest-prefix match at block granularity. Returns the matched
         block chain (every block ref'd for the caller) and the matched
         token count. Always leaves >= 1 token unmatched: admission needs a
         last REAL token to take first-sample logits from.
+
+        With ``ns`` set (ISSUE 18) the walk prefers the tenant's salted
+        nodes and crosses into plain-key nodes only when they are pinned
+        (the static prefix stays shared across tenants); another tenant's
+        unpinned chain is never served.
 
         Only ``lookups`` is counted here — the caller reports the hit via
         ``record_hit`` once the chain is actually USED (an admission that
@@ -108,7 +125,12 @@ class RadixCache:
         blocks: list[int] = []
         limit = max(0, (len(ids) - 1) // bs)
         for i in range(limit):
-            child = node.children.get(tuple(ids[i * bs:(i + 1) * bs]))
+            kt = tuple(ids[i * bs:(i + 1) * bs])
+            child = node.children.get((ns, kt)) if ns is not None else None
+            if child is None:
+                c = node.children.get(kt)
+                if c is not None and (ns is None or c.pinned):
+                    child = c
             if child is None:
                 break
             child.last_use = t
@@ -129,13 +151,19 @@ class RadixCache:
 
     # ------------------------------------------------------------ insertion
 
-    def insert(self, ids: list[int], blocks: list[int]) -> int:
+    def insert(self, ids: list[int], blocks: list[int],
+               ns: str | None = None) -> int:
         """Adopt a released request's chain: ``ids`` is its full token
         history (prompt + generated), ``blocks`` the in-order pool blocks
         covering it. Only FULL blocks are inserted (a partial tail block
         will be rewritten by whoever re-prefills past it). Existing nodes
         are kept (the caller's duplicate block is freed by the caller's own
-        release); new nodes take one tree ref. Returns adopted count."""
+        release); new nodes take one tree ref. With ``ns`` set (ISSUE 18)
+        new nodes are salted into the tenant's namespace, an overlap with
+        the pinned static chain rides the shared nodes, and a tenant over
+        its block quota evicts its OWN least-recent leaves first — nothing
+        evictable of its own means adoption is refused, so one tenant's
+        churn never lands on another's warm chains. Returns adopted count."""
         bs = self.block_size
         t = next(self._clock)
         node = self.root
@@ -143,9 +171,25 @@ class RadixCache:
         adopted = 0
         evicted_for_capacity = False
         for i in range(full):
-            key = tuple(ids[i * bs:(i + 1) * bs])
+            kt = tuple(ids[i * bs:(i + 1) * bs])
+            if ns is not None:
+                plain = node.children.get(kt)
+                if plain is not None and plain.pinned:
+                    # the shared static prefix is never duplicated per tenant
+                    plain.last_use = t
+                    node = plain
+                    continue
+                key = (ns, kt)
+            else:
+                key = kt
             child = node.children.get(key)
             if child is None:
+                if ns is not None and self.ns_quota is not None:
+                    q = self.ns_quota(ns)
+                    if q > 0 and self._ns_nodes.get(ns, 0) >= q:
+                        # block quota: the owner's own LRU leaves pay first
+                        if not self.evict(1, ns=ns):
+                            break  # nothing of its own evictable: refuse
                 if self._n_nodes >= self.max_nodes:
                     # ONE batched eviction per insert call (evict walks the
                     # whole tree to build its LRU heap — per-block evict(1)
@@ -154,9 +198,12 @@ class RadixCache:
                         break  # at capacity with nothing evictable
                     evicted_for_capacity = True
                 child = RadixNode(key, blocks[i], node)
+                child.ns = ns
                 self.allocator.ref([blocks[i]])
                 node.children[key] = child
                 self._n_nodes += 1
+                if ns is not None:
+                    self._ns_nodes[ns] = self._ns_nodes.get(ns, 0) + 1
                 self.inserts += 1
                 adopted += 1
             child.last_use = t
@@ -190,17 +237,19 @@ class RadixCache:
                 and not node.pinned
                 and self.allocator.refcount(node.block) == 1)
 
-    def evict(self, need: int) -> int:
+    def evict(self, need: int, ns: str | None = None) -> int:
         """Free up to ``need`` blocks from least-recently-used unreferenced
         leaves (cascading: a parent whose last child left becomes a
-        candidate). Returns how many blocks were actually freed — 0 when
+        candidate). With ``ns`` set only that namespace's nodes are
+        candidates (quota enforcement — a tenant's churn eats its own cache
+        first). Returns how many blocks were actually freed — 0 when
         everything left is pinned or referenced by a live slot."""
         heap: list[tuple[int, int, RadixNode]] = []
         stack = [self.root]
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
-            if self._evictable(n):
+            if self._evictable(n) and (ns is None or n.ns == ns):
                 heapq.heappush(heap, (n.last_use, id(n), n))
         freed = 0
         while heap and freed < need:
@@ -213,9 +262,11 @@ class RadixCache:
             del parent.children[n.key]
             self.allocator.free([n.block])
             self._n_nodes -= 1
+            if n.ns is not None:
+                self._ns_nodes[n.ns] = max(0, self._ns_nodes.get(n.ns, 1) - 1)
             self.evictions += 1
             freed += 1
-            if self._evictable(parent):
+            if self._evictable(parent) and (ns is None or parent.ns == ns):
                 heapq.heappush(heap, (parent.last_use, id(parent), parent))
         if freed:
             from ..utils import get_metrics
@@ -234,6 +285,7 @@ class RadixCache:
             self.allocator.free([n.block])
         self.root.children.clear()
         self._n_nodes = 0
+        self._ns_nodes.clear()
 
     # ------------------------------------------------------------ stats
 
@@ -252,7 +304,8 @@ class RadixCache:
                     out.append(list(ids))
                 return
             for child in node.children.values():
-                walk(child, ids + list(child.key))
+                kt = child.key[1] if child.ns is not None else child.key
+                walk(child, ids + list(kt))
 
         walk(self.root, [])
         return out

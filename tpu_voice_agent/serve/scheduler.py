@@ -174,6 +174,32 @@ class ContinuousBatcher:
 
         self.costs: CostMeter | None = (
             CostMeter(engine) if cost_enabled() else None)
+        # multi-tenant QoS plane (ISSUE 18): constructed only when the
+        # TENANT_CLASSES knob is set — unset keeps every path below
+        # byte-identical to the single-tenant scheduler (pop(0) admission,
+        # no preemption, unsalted radix keys)
+        from .tenancy import TenancyPlane, tenancy_enabled
+
+        self.tenancy = TenancyPlane() if tenancy_enabled() else None
+        self._tenant: dict[int, str | None] = {}   # rid -> wire tenant tag
+        self._prompt_src: dict[int, object] = {}   # rid -> prompt (preempt requeue)
+        self._preempted: dict[int, int] = {}       # rid -> preemption count
+        self._preempt_on = os.environ.get("TENANT_PREEMPT", "1") != "0"
+        # satellite fix (ISSUE 18): a pool-starved head requeue ages out —
+        # after SCHED_REQUEUE_MAX head retries the oversized waiter rotates
+        # to the back so smaller requests queued behind it get an attempt
+        self._requeues: dict[int, int] = {}
+        self._requeue_max = int(os.environ.get("SCHED_REQUEUE_MAX", "8"))
+        m.inc("scheduler.requeue_rotations", 0.0)
+        if self.tenancy is not None:
+            m.inc("tenant.throttled", 0.0)
+            m.inc("tenant.preemptions", 0.0)
+            # per-tenant radix namespaces: the trees charge over-quota
+            # inserts to the owning tenant's own leaves (serve.radix)
+            radix = getattr(engine, "radix", None)
+            if radix is not None:
+                for rc in radix:
+                    rc.ns_quota = self.tenancy.block_quota
 
     # ------------------------------------------------------------ submit
 
@@ -193,6 +219,12 @@ class ContinuousBatcher:
         self._prompt_fp.clear()
         self._pool_wait.clear()
         self._nan_slots.clear()
+        self._tenant.clear()
+        self._prompt_src.clear()
+        self._preempted.clear()
+        self._requeues.clear()
+        if self.tenancy is not None:
+            self.tenancy.reset_occupancy()
         self.results.clear()
         self.slots = [_Slot() for _ in range(self.B)]
         self.active = jnp.zeros_like(self.active)
@@ -200,7 +232,7 @@ class ContinuousBatcher:
         for b in range(self.B):
             self.engine.release_slot(b, ok=False)
 
-    def submit(self, prompt, deadline=None) -> int:
+    def submit(self, prompt, deadline=None, tenant=None) -> int:
         """Queue one request. ``prompt`` is a string, or a pre-tokenized
         ``list[int]`` — the session-aware brain path builds turn N's ids as
         the literal turn N-1 ids + generated ids + new-frame ids, so the
@@ -208,8 +240,11 @@ class ContinuousBatcher:
         text is not id-stable: grammar-constrained decoding may emit
         non-canonical BPE pieces). ``deadline`` (utils.resilience.Deadline,
         optional) arms queue-expiry shedding and mid-decode cancellation.
-        A quarantined prompt (repeat poison offender) is refused here with
-        a typed error, before it can occupy queue or slot."""
+        ``tenant`` (ISSUE 18) tags the request's QoS lane when the tenancy
+        plane is on; a rate-limited lane is refused here with the retryable
+        ``shed:`` prefix (503 + Retry-After at the brain — throttled, not
+        errored). A quarantined prompt (repeat poison offender) is refused
+        with a typed error, before it can occupy queue or slot."""
         rid = self._next_id
         self._next_id += 1
         fp = self._fingerprint(prompt)
@@ -223,6 +258,17 @@ class ContinuousBatcher:
                 f"quarantined: {off['reason']} x{off['count']} "
                 f"(prompt {off['preview']!r})")
             return rid
+        if self.tenancy is not None:
+            if not self.tenancy.admit(tenant):
+                from ..utils import get_metrics
+
+                get_metrics().inc("tenant.throttled")
+                self.results[rid] = _err_result(
+                    f"shed: tenant {self.tenancy.resolve(tenant)} rate-limited")
+                return rid
+            self._tenant[rid] = tenant
+            self._prompt_src[rid] = prompt
+            self.tenancy.on_queue(tenant)
         self._prompt_fp[rid] = fp
         if deadline is not None:
             self._deadline[rid] = deadline
@@ -273,6 +319,10 @@ class ContinuousBatcher:
         self._deadline.pop(rid, None)
         self._prompt_fp.pop(rid, None)
         self._pool_wait.pop(rid, None)
+        self._tenant.pop(rid, None)
+        self._prompt_src.pop(rid, None)
+        self._preempted.pop(rid, None)
+        self._requeues.pop(rid, None)
 
     def _evict_slot(self, b: int, error: str, counter: str) -> None:
         """Evict ONE in-flight slot with a typed error: deactivate the
@@ -293,6 +343,10 @@ class ContinuousBatcher:
         res.cost = dict(sl.cost) if sl.cost is not None else None
         self.results[rid] = res
         get_metrics().inc(counter)
+        if self.tenancy is not None:
+            t = self._tenant.get(rid)
+            self.tenancy.on_release(t)
+            self.tenancy.fold_cost(t, res.cost)
         self._cleanup(rid)
         self.slots[b] = _Slot()
         self.active = self.active.at[b].set(False)
@@ -313,6 +367,9 @@ class ContinuousBatcher:
                 del self.pending[i]
                 self.results[rid] = _err_result(f"cancelled: {reason}")
                 get_metrics().inc("scheduler.cancelled")
+                if self.tenancy is not None:
+                    self.tenancy.on_dequeue(self._tenant.get(rid),
+                                            admitted=False)
                 self._cleanup(rid)
                 return True
         for b in range(self.B):
@@ -320,6 +377,42 @@ class ContinuousBatcher:
                 self._evict_slot(b, f"cancelled: {reason}", "scheduler.cancelled")
                 return True
         return False
+
+    def _preempt_slot(self, b: int) -> None:
+        """Chunk-boundary preemption (ISSUE 18): vacate ONE over-budget slot
+        for a starved lane, through the same release seam cancellation uses
+        — but preempted-not-errored. The slot's prompt+generated chain is
+        inserted into its tenant's radix namespace (``ok=True`` release),
+        the spent cost folds into the tenant ledger, and the ORIGINAL prompt
+        requeues at the head: greedy decode is deterministic, so
+        re-admission replays the same stream as a warm prefill off its own
+        chain — resume is a warm admission, and the request's result arrives
+        late instead of failing. Bounded to one preemption per request so a
+        tight pool can never livelock two lanes trading the same slot."""
+        from ..utils import get_metrics
+
+        sl = self.slots[b]
+        rid = sl.request_id
+        t = self._tenant.get(rid)
+        prompt = self._prompt_src.get(rid)
+        if prompt is None:  # no requeue source — leave the slot alone
+            return
+        self._preempted[rid] = self._preempted.get(rid, 0) + 1
+        if self.tenancy is not None:
+            self.tenancy.fold_cost(t, sl.cost)
+            self.tenancy.on_release(t)
+            self.tenancy.on_queue(t)
+            self.tenancy.note_preemption(t)
+        get_metrics().inc("tenant.preemptions")
+        # warm release: prompt+generated adopted by the tenant's namespace,
+        # so the re-admission's prefill is served from cache
+        self.engine.release_slot(b, generated_ids=sl.token_ids)
+        self.slots[b] = _Slot()
+        self.active = self.active.at[b].set(False)
+        self._active_h[b] = False
+        self._nan_slots.discard(b)
+        self._enqueued_at[rid] = time.perf_counter()
+        self.pending.insert(0, (rid, prompt))
 
     def _free_slot(self, act: np.ndarray) -> int | None:
         for b in range(self.B):
@@ -333,6 +426,13 @@ class ContinuousBatcher:
         wasted FLOPs at 32 slots) and reuse the engine's shared-prefix KV
         when the prompt starts with it."""
         eng = self.engine
+        if self.tenancy is not None:
+            # tenant radix namespace (ISSUE 18): the slot's cache chains are
+            # salted with the resolved class name so one tenant's churn
+            # cannot evict another's warm chains (serve.radix)
+            setns = getattr(eng, "set_slot_ns", None)
+            if setns is not None:
+                setns(slot, self.tenancy.resolve(self._tenant.get(rid)))
         t0 = time.perf_counter()
         ids = (eng.tokenizer.encode(prompt, bos=True)
                if isinstance(prompt, str) else [int(t) for t in prompt])
@@ -442,11 +542,35 @@ class ContinuousBatcher:
                 if dl is not None and dl.expired:
                     self._evict_slot(b, "cancelled: deadline expired mid-decode",
                                      "scheduler.cancelled")
+        plane = self.tenancy
+        if (plane is not None and self._preempt_on and self.pending
+                and self._free_slot(act) is None):
+            # over-budget preemption (ISSUE 18): all slots busy while a
+            # poorer lane starves — vacate the richest lane's slot at this
+            # chunk boundary (at most one per step; see _preempt_slot)
+            victim = plane.over_budget_victim(
+                [(b, self._tenant.get(self.slots[b].request_id))
+                 for b in range(self.B)
+                 if self.slots[b].request_id >= 0 and act[b]
+                 and self.slots[b].token_ids
+                 and self._preempted.get(self.slots[b].request_id, 0) < 1],
+                [self._tenant.get(r) for r, _ in self.pending])
+            if victim is not None:
+                self._preempt_slot(victim)
         while self.pending:
             slot = self._free_slot(act)
             if slot is None:
                 break
-            rid, prompt = self.pending.pop(0)
+            if plane is None:
+                rid, prompt = self.pending.pop(0)
+            else:
+                # weighted fair-share admission: smallest-vtime lane with
+                # slot-cap headroom wins, FIFO within a lane (tenancy.pick)
+                idx = plane.pick(
+                    [self._tenant.get(r) for r, _ in self.pending])
+                if idx is None:
+                    break  # every waiter's lane is at its slot cap
+                rid, prompt = self.pending.pop(idx)
             n_attempted += 1
             dl = self._deadline.get(rid)
             if dl is not None and dl.expired:
@@ -456,6 +580,8 @@ class ContinuousBatcher:
                 # occupies a decode slot
                 self.results[rid] = _err_result("shed: deadline expired in queue")
                 m.inc("scheduler.shed_expired")
+                if plane is not None:
+                    plane.on_dequeue(self._tenant.get(rid), admitted=False)
                 self._cleanup(rid)
                 continue
             try:
@@ -464,6 +590,9 @@ class ContinuousBatcher:
                 n_admitted += 1
                 admit_prefill_ms += self.slots[slot].prefill_ms
                 self._pool_wait.pop(rid, None)
+                self._requeues.pop(rid, None)
+                if plane is not None:
+                    plane.on_dequeue(self._tenant.get(rid), admitted=True)
                 # chaos drill arming (no-ops with chaos off): NaN logits on
                 # this slot's next chunk / FSM state forced dead
                 if chaos_fire("nan_logits"):
@@ -487,9 +616,23 @@ class ContinuousBatcher:
                         or (dl is not None and dl.expired)):
                     self.results[rid] = _err_result(f"shed: {e}")
                     m.inc("scheduler.shed_pool")
+                    if plane is not None:
+                        plane.on_dequeue(self._tenant.get(rid), admitted=False)
                     self._cleanup(rid)
                 else:
-                    self.pending.insert(0, (rid, prompt))
+                    n_req = self._requeues.get(rid, 0) + 1
+                    if n_req > self._requeue_max and self.pending:
+                        # aging bound (ISSUE 18 satellite): an oversized
+                        # prompt requeued at the head SCHED_REQUEUE_MAX
+                        # times rotates to the back, so the small requests
+                        # stuck behind it get their admission attempt
+                        # instead of starving indefinitely
+                        self._requeues[rid] = 0
+                        self.pending.append((rid, prompt))
+                        m.inc("scheduler.requeue_rotations")
+                    else:
+                        self._requeues[rid] = n_req
+                        self.pending.insert(0, (rid, prompt))
                 break  # stop admitting; let the live batch drain blocks
             except Exception as e:
                 if isinstance(e, _DeviceFault):
@@ -509,6 +652,8 @@ class ContinuousBatcher:
                 if not isinstance(e, ValueError):
                     m.inc("scheduler.prefill_faults")
                     self._record_offense(rid, f"prefill {type(e).__name__}")
+                if plane is not None:
+                    plane.on_dequeue(self._tenant.get(rid), admitted=False)
                 self._cleanup(rid)
 
         # drop enqueue stamps with no pending entry left (requests admitted
@@ -518,6 +663,11 @@ class ContinuousBatcher:
             live = {r for r, _ in self.pending}
             for r in [r for r in self._enqueued_at if r not in live]:
                 del self._enqueued_at[r]
+                if plane is not None and r in self._tenant:
+                    # colocate tombstoning filtered this rid out of pending
+                    # directly — the lane's queued count must not leak
+                    plane.on_dequeue(self._tenant.pop(r), admitted=False)
+                    self._prompt_src.pop(r, None)
 
         timer.lap("admit")
         # prefill compute was measured INSIDE the admission segment
@@ -628,6 +778,10 @@ class ContinuousBatcher:
             from .radix import record_radix_gauges
 
             record_radix_gauges(radix)
+        if plane is not None:
+            # tenant.* occupancy/share/SLO gauges ride the TS rings and the
+            # fleet plane automatically once set here (ISSUE 18)
+            plane.export_gauges()
         # live HBM ledger tick (throttled to HBM_LEDGER_S inside — the
         # jax.live_arrays walk must not run per chunk); plan-vs-measured
         # drift is an alarm, never a serving fault
@@ -671,6 +825,10 @@ class ContinuousBatcher:
             sl = self.slots[b]
             if sl.request_id < 0:
                 continue
+            if plane is not None:
+                # advance the lane's virtual-token clock by the row's
+                # emitted tokens (tokens / weight — the fair-share currency)
+                plane.charge(self._tenant.get(sl.request_id), int(n_h[b]))
             if costs is not None and sl.cost is not None:
                 # fold BEFORE the poison branch: an evicted row's spent
                 # chunk cost must ride out on its error result
@@ -756,6 +914,12 @@ class ContinuousBatcher:
                 m.inc("scheduler.requests_completed")
                 m.observe_ms("scheduler.request_total",
                              (time.perf_counter() - sl.start_s) * 1e3)
+                if plane is not None:
+                    t = self._tenant.get(sl.request_id)
+                    plane.on_release(t)
+                    plane.fold_cost(t, sl.cost)
+                    plane.observe_latency(
+                        t, (time.perf_counter() - sl.start_s) * 1e3)
                 self._cleanup(sl.request_id)
                 self.slots[b] = _Slot()
                 # paged engines free the blocks; with radix reuse on, the
@@ -797,6 +961,10 @@ class ContinuousBatcher:
             import math
 
             per_req = math.ceil(self.max_new_tokens / self.chunk_steps) + 1
+            if self.tenancy is not None:
+                # a preempted request re-admits and may replay its full
+                # budget once (one preemption per rid, _preempt_slot)
+                per_req *= 2
             max_chunks = per_req * (len(self.pending) + self.B) + self.B
         for _ in range(max_chunks):
             if not self.pending and not any(s.request_id >= 0 for s in self.slots):

@@ -107,6 +107,7 @@ class _Work:
     buf: np.ndarray  # utterance audio so far (host copy, caller-owned)
     future: Future
     seq: int  # FIFO tiebreak within a priority class
+    tenant: str | None = None  # QoS lane tag (ISSUE 18; None = default lane)
 
 
 @dataclass
@@ -161,6 +162,12 @@ class STTBatcher:
         self._blank_row = jnp.zeros(
             (L, 1, engine.cfg.enc_positions, nh, hd), engine._param_dtype)
         _metrics().set_gauge("stt.batch_slots", float(slots))
+        # tenant fair lanes (ISSUE 18): with TENANT_CLASSES set, batch
+        # intake orders by lane vtime FIRST, then the finals>spec>partials
+        # priority — so one chatty tenant's partials can't crowd another's
+        # out of the S-wide batch. Off (None) = exact pre-tenancy sort key.
+        from .tenancy import FairLanes, tenancy_enabled
+        self.lanes: FairLanes | None = FairLanes() if tenancy_enabled() else None
         self._thread: threading.Thread | None = None
         if autostart:
             self._thread = threading.Thread(
@@ -169,7 +176,8 @@ class STTBatcher:
 
     # ------------------------------------------------------------ submit
 
-    def submit(self, kind: str, utt: int, buf: np.ndarray) -> Future:
+    def submit(self, kind: str, utt: int, buf: np.ndarray,
+               tenant: str | None = None) -> Future:
         """Enqueue one transcription work item; the future resolves to a
         TranscribeResult (or None when the item was superseded / shed /
         carried no complete block yet)."""
@@ -221,7 +229,7 @@ class STTBatcher:
                     if w.kind == "partial" and w.utt == utt:
                         self.queue.remove(w)
                         _resolve(w.future, None)
-            self.queue.append(_Work(kind, utt, buf, fut, self._seq))
+            self.queue.append(_Work(kind, utt, buf, fut, self._seq, tenant))
             self._seq += 1
             _metrics().set_gauge("stt.queue_depth", float(len(self.queue)))
             self._wake.notify()
@@ -339,8 +347,20 @@ class STTBatcher:
         return len(batch)
 
     def _take_batch_locked(self) -> list[_Work]:
-        self.queue.sort(key=lambda w: (_PRIORITY[w.kind], w.seq))
+        lanes = self.lanes
+        if lanes is None:
+            self.queue.sort(key=lambda w: (_PRIORITY[w.kind], w.seq))
+        else:
+            # lane rank first (smallest vtime = poorest tenant), THEN the
+            # pre-tenancy key — intra-lane order is exactly the old one
+            self.queue.sort(
+                key=lambda w: (lanes.rank(w.tenant), _PRIORITY[w.kind], w.seq))
         batch, self.queue = self.queue[: self.S], self.queue[self.S:]
+        if lanes is not None:
+            for w in batch:
+                # charge by audio seconds: a 30 s final costs its lane more
+                # fairness credit than a 1 s partial
+                lanes.charge(w.tenant, max(0.25, len(w.buf) / 16000.0))
         _metrics().set_gauge("stt.queue_depth", float(len(self.queue)))
         return batch
 
@@ -548,6 +568,9 @@ class BatchedStreamingSTT(StreamingSTT):
         super().__init__(engine, **kw)
         self.batcher = batcher
         self.result_timeout_s = result_timeout_s
+        # QoS lane tag for this connection's work (ISSUE 18); the voice
+        # service sets it from the ``tenant`` control frame
+        self.tenant: str | None = None
         self._utt = next(_UTT_IDS)
         self._ready: collections.deque = collections.deque()
         self._spec_future: tuple[int, int, Future] | None = None
@@ -559,7 +582,8 @@ class BatchedStreamingSTT(StreamingSTT):
     def _start_speculation(self, spoken: int, events: list) -> None:
         self._spec_final = None
         self._spec_at_speech = spoken
-        fut = self.batcher.submit("spec_final", self._utt, self._buf.copy())
+        fut = self.batcher.submit(
+            "spec_final", self._utt, self._buf.copy(), tenant=self.tenant)
         self._spec_future = (spoken, self._utt, fut)
 
         def _cb(f, utt=self._utt, spoken=spoken):
@@ -572,7 +596,8 @@ class BatchedStreamingSTT(StreamingSTT):
         fut.add_done_callback(_cb)
 
     def _emit_partial(self, events: list) -> None:
-        fut = self.batcher.submit("partial", self._utt, self._buf.copy())
+        fut = self.batcher.submit(
+            "partial", self._utt, self._buf.copy(), tenant=self.tenant)
 
         def _cb(f, utt=self._utt):
             try:
@@ -612,7 +637,8 @@ class BatchedStreamingSTT(StreamingSTT):
             if sf is not None and sf[0] == spoken and sf[1] == self._utt:
                 fut = sf[2]  # in flight for exactly this frozen content
             else:
-                fut = self.batcher.submit("final", self._utt, self._buf.copy())
+                fut = self.batcher.submit(
+            "final", self._utt, self._buf.copy(), tenant=self.tenant)
         self._spec_future = None
         if self._defer_final:
             self._pending_final = (fut, res)

@@ -230,7 +230,8 @@ class STTReplicaTier(ReplicaSet):
                 return home
             exclude.add(home.url)
 
-    def submit(self, kind: str, utt: int, buf) -> Future:
+    def submit(self, kind: str, utt: int, buf,
+               tenant: str | None = None) -> Future:
         """STTBatcher-compatible submit with utterance affinity. Finals are
         wrapped with a one-shot failover: an exception from the home
         replica (crash, kill drill, restart) resubmits the same window on
@@ -248,7 +249,7 @@ class STTReplicaTier(ReplicaSet):
                 get_metrics().inc("stt.shed_overload")
                 fut.set_result(None)
             return fut
-        inner = hb.submit(kind, utt, buf)
+        inner = hb.submit(kind, utt, buf, tenant=tenant)
         if kind != "final":
             return inner  # best-effort: a lost partial is latency, not data
         outer: Future = Future()
@@ -273,7 +274,7 @@ class STTReplicaTier(ReplicaSet):
                     # whole-tier outage must not read as successful
                     # failovers on the dashboard
                     get_metrics().inc("stt.replica_failovers")
-                    f2 = ab.submit(kind, utt, buf)
+                    f2 = ab.submit(kind, utt, buf, tenant=tenant)
                     f2.add_done_callback(
                         lambda g, k=alt.url: _relay(g, k, retry=False))
                     return

@@ -288,7 +288,8 @@ class BatchedEngineParser:
         # the next chunk boundary instead of burning the slot's budget
         ctx = current_request_context()
         fut = self.runtime.submit_parse(
-            prompt, deadline=ctx.deadline if ctx is not None else None)
+            prompt, deadline=ctx.deadline if ctx is not None else None,
+            tenant=getattr(ctx, "tenant", None))
         if ctx is not None:
             ctx.on_cancel(lambda: self.runtime.cancel_parse(fut))
         try:
@@ -1255,8 +1256,11 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         # scheduler and collects the decode canceller, so a client that
         # disconnects (CancelledError below) aborts its in-flight decode at
         # the next chunk boundary instead of burning the slot for a dead
-        # socket
-        ctx = RequestContext(deadline)
+        # socket. The tenant tag (ISSUE 18) rides the same handle: body
+        # field first (the voice service sets it), x-tenant header as the
+        # router/raw-HTTP fallback.
+        ctx = RequestContext(
+            deadline, tenant=preq.tenant or req.headers.get("x-tenant"))
 
         def run_admitted(preq: ParseRequest) -> ParseResponse:
             # queue_ms: arrival -> worker-thread start (thread pool + engine
@@ -1424,6 +1428,12 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
                 top_n = 8
             body["sessions"] = len(sessions)
             body["top_sessions"] = sessions.top(max(1, min(top_n, 64)))
+        # tenant rollup (ISSUE 18): per-lane occupancy/fairness state plus
+        # the session ledgers re-rolled by tenant class — absent entirely
+        # when the tenancy plane is off
+        tenancy = getattr(getattr(parser, "batcher", None), "tenancy", None)
+        if tenancy is not None:
+            body["tenants"] = tenancy.snapshot()
         return web.json_response(body)
 
     app.router.add_get("/debug/costs", debug_costs)

@@ -776,6 +776,10 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
         fwd_headers = dict(headers)
         if DEADLINE_HEADER in req.headers:
             fwd_headers[DEADLINE_HEADER] = req.headers[DEADLINE_HEADER]
+        if "x-tenant" in req.headers:
+            # tenant QoS tag (ISSUE 18): the body field rides the raw bytes
+            # automatically; the header fallback must be forwarded by hand
+            fwd_headers["x-tenant"] = req.headers["x-tenant"]
         with tracer.span("route_parse", trace_id=trace_id) as sp:
             resp, served, err = await router.forward_parse(
                 raw, body if isinstance(body, dict) else {}, fwd_headers)

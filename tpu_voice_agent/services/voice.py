@@ -201,6 +201,11 @@ class ClientState:
         # anything that changes what the final parse would see (new spec
         # text, context_update, reset)
         self.spec: tuple[str, asyncio.Task] | None = None
+        # tenant QoS tag (ISSUE 18): set by the `tenant` control frame (or
+        # a context_update carrying one) and dealt into every /parse this
+        # connection makes, plus the STT batcher's fair lanes. None = the
+        # default class.
+        self.tenant: str | None = None
 
     def drop_spec(self) -> None:
         if self.spec is not None:
@@ -344,11 +349,18 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         """One budgeted /parse roundtrip (no events, no side effects —
         callable speculatively). Returns the httpx response; raises
         BreakerOpenError/DeadlineExpired/transport errors."""
+        json_body = {"text": text, "session_id": state.convo_id,
+                     "context": state.context, "speculative": speculative}
+        headers = {"x-trace-id": state.trace_id}
+        if state.tenant:
+            # tenant QoS tag (ISSUE 18): body field for the brain, header
+            # for router placement — both only when the client set one
+            json_body["tenant"] = state.tenant
+            headers["x-tenant"] = state.tenant
         return await post_with_resilience(
             http, cfg.brain_url + "/parse",
-            json_body={"text": text, "session_id": state.convo_id,
-                       "context": state.context, "speculative": speculative},
-            headers={"x-trace-id": state.trace_id},
+            json_body=json_body,
+            headers=headers,
             deadline=deadline or Deadline.after(cfg.parse_timeout_s),
             policy=retry_policy,
             breaker=brain_breaker,
@@ -812,6 +824,16 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                             # an in-flight speculative parse saw the OLD context
                             state.drop_spec()
                             await send(ws, "info", message="context updated")
+                        elif ctype == "tenant":
+                            # QoS lane tag (ISSUE 18): rides every /parse
+                            # from here on and re-lanes this connection's
+                            # STT work. Unknown names degrade to the
+                            # default class at the plane, so no validation
+                            # round-trip is needed here.
+                            state.tenant = str(ctrl.get("tenant") or "") or None
+                            if hasattr(state.stt, "tenant"):
+                                state.stt.tenant = state.tenant
+                            await send(ws, "info", message="tenant set")
                         elif ctype == "text":
                             # typed command path: same pipeline minus STT
                             text = str(ctrl.get("text") or "")

@@ -461,6 +461,11 @@ class SessionCostLedger:
                    reverse=True)
         return items[:n]
 
+    def snapshot(self) -> dict[str, dict]:
+        """All entries, keyed as folded (tenant ledgers key by class name)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._sessions.items()}
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._sessions)

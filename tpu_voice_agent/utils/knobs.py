@@ -100,6 +100,9 @@ declare("CHAOS_HANG_S", "60", "how long an injected replica_hang holds /parse op
 declare("CHAOS_SLOW_S", "0.25", "added latency of an injected replica_slow parse", table=RESILIENCE)
 declare("QUARANTINE_AFTER", "2", "poison offenses before a prompt fingerprint is refused", table=RESILIENCE)
 declare("SCHED_POOL_WAIT_S", "1.0", "pool-backpressure wait before a request sheds", table=RESILIENCE)
+declare("SCHED_REQUEUE_MAX", "8", "head requeues a pool-starved admission gets before rotating to the queue back (aging bound: one oversized prompt must not starve everything behind it)", table=RESILIENCE)
+declare("TENANT_CLASSES", None, "tenant QoS registry `name:weight[:slots=N][:blocks=N][:rps=F][:p50=MS]`, comma-separated (unset = tenancy plane off, single-tenant paths token-identical)", table=RESILIENCE)
+declare("TENANT_PREEMPT", "1", "0 disables chunk-boundary preemption of over-budget tenants (fair-share admission and rate limits stay on)", table=RESILIENCE)
 declare("RADIX_PRESSURE_S", "2.0", "session-cache admission denial window after PoolExhausted", table=RESILIENCE)
 declare("ENGINE_STALL_S", "30", "stalled-step threshold for the warm-restart watchdog", table=RESILIENCE)
 declare("BRAIN_REPLICAS", None, "comma-separated brain replica base URLs (router tier; required)", table=RESILIENCE)

@@ -113,8 +113,13 @@ class RequestContext:
     ``cancel()`` is idempotent and thread-safe; a callback registered
     after cancellation fires immediately (no lost-wakeup window)."""
 
-    def __init__(self, deadline: "Deadline | None" = None):
+    def __init__(self, deadline: "Deadline | None" = None,
+                 tenant: str | None = None):
         self.deadline = deadline
+        # tenant QoS tag (ISSUE 18): rides the same thread-local seam the
+        # deadline does, so the batcher backend can lane the request
+        # without widening every parse signature
+        self.tenant = tenant
         self._lock = threading.Lock()
         self._cancelled = False
         self._cbs: list = []
