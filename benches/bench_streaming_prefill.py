@@ -1,0 +1,233 @@
+"""Streaming-prefill bench (ISSUE 19): the two planes that move prompt
+prefill off the endpoint path, each held to its own bar.
+
+- batch-mate isolation (chunked prefill): a long cold prompt admitted
+  with ``PREFILL_CHUNK_TOKENS`` set must not stall a decoding batch-mate
+  the way the one-shot barrier admission does. Measured directly: the
+  worst single scheduler-step wall while an admission is in flight,
+  barrier vs chunked — the barrier's worst step CONTAINS the whole
+  bucket-padded prefill forward, the chunked one only a single chunk.
+  Both runs must stay token-identical (the differential the tier-1
+  tests gate; here it guards the measurement too).
+- endpoint prefill debt (prefix feeds): replaying utterances word by
+  word through the voice service's ``_PrefixFeedTracker`` and feeding
+  each committed prefix as a prefill-only admission must leave the
+  endpoint's real parse nearly warm — prompt tokens still un-prefilled
+  at the endpoint (the ``engine.prefill_remaining_at_endpoint``
+  scoreboard) collapse vs the feed-less engine, with identical output.
+
+Writes ``bench_artifacts/BENCH_streaming_prefill_<ts>.json`` with a
+``prefill`` section merged into run_all's combined artifact. Tiny model,
+seconds on CPU (BENCH_SPF_* trims), so it rides ``--quick``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log, percentile  # noqa: E402
+
+BUCKETS = (128, 256, 512, 1024, 2048)
+
+
+def _mixed_run(eng, victim: str, aggressor: str, max_new: int,
+               chunk_tokens: int | None):
+    """Victim decodes for two chunks, then the aggressor's cold prompt is
+    admitted into the live batch. Returns (results, walls of every step
+    from the aggressor's submit to the drain) — the max of those walls is
+    the stall the victim experienced."""
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+
+    if chunk_tokens:
+        os.environ["PREFILL_CHUNK_TOKENS"] = str(chunk_tokens)
+    else:
+        os.environ.pop("PREFILL_CHUNK_TOKENS", None)
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=max_new)
+    rid_v = b.submit(victim)
+    b.step()  # admit the victim (its own prefill is outside the window)
+    b.step()  # one pure decode chunk
+    rid_a = b.submit(aggressor)
+    walls: list[float] = []
+    while b.pending or any(s.request_id >= 0 for s in b.slots):
+        t0 = time.perf_counter()
+        b.step()
+        walls.append((time.perf_counter() - t0) * 1e3)
+    return [b.results[rid_v], b.results[rid_a]], walls
+
+
+def _long_text(i: int, words: int) -> str:
+    verbs = ["search for", "filter", "sort", "compare", "summarize"]
+    items = ["wireless noise cancelling headphones", "mechanical keyboards",
+             "ultrawide monitors", "ergonomic office chairs",
+             "portable solar chargers"]
+    parts = []
+    j = 0
+    while sum(len(p.split()) for p in parts) < words:
+        parts.append(f"{verbs[(i + j) % len(verbs)]} "
+                     f"{items[(i * 3 + j) % len(items)]} under "
+                     f"{100 + 10 * ((i + j) % 7)} dollars then")
+        j += 1
+    return " ".join(" ".join(parts).split()[:words])
+
+
+def _feed_drill(eng, texts: list[str], max_new: int, feeds_on: bool):
+    """Replay each utterance word by word through the tracker; feed every
+    committed prefix (when feeds_on); parse the final. Returns per-
+    utterance (remaining, prompt_tokens, token_ids)."""
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+    from tpu_voice_agent.services.prompts import render_prompt
+    from tpu_voice_agent.services.voice import _PrefixFeedTracker
+
+    os.environ.pop("PREFILL_CHUNK_TOKENS", None)
+    out = []
+    for text in texts:
+        b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=max_new)
+        if feeds_on:
+            tr = _PrefixFeedTracker(k=3, min_chars=8)
+            words = text.split()
+            for j in range(1, len(words) + 1):
+                commit = tr.observe(" ".join(words[:j]))
+                if commit:
+                    b.feed_prefix(render_prompt(commit, {}))
+        r = b.generate_many([render_prompt(text, {})])[0]
+        assert r.error is None, r.error
+        remaining = max(0.0, float(r.prompt_tokens) - float(r.cached_tokens))
+        out.append((remaining, r.prompt_tokens, r.token_ids))
+    return out
+
+
+def main() -> None:
+    from tpu_voice_agent.serve import PagedDecodeEngine
+    from tpu_voice_agent.services.brain import install_prompt_prefix
+    from tpu_voice_agent.services.prompts import render_prompt
+    from tpu_voice_agent.utils import get_metrics
+
+    rounds = int(os.environ.get("BENCH_SPF_ROUNDS", "3"))
+    utterances = int(os.environ.get("BENCH_SPF_UTTERANCES", "4"))
+    max_new = int(os.environ.get("BENCH_SPF_TOKENS", "24"))
+    chunk = int(os.environ.get("BENCH_SPF_CHUNK", "64"))
+
+    # ---- plane 1: chunked-admission batch-mate isolation (radix off, no
+    # pinned prefix: the whole rendered prompt is cold compute every run)
+    eng = PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=2,
+                            prefill_buckets=BUCKETS, radix_enable=False)
+    victim = render_prompt("take a screenshot of this page", {})
+    aggressor = render_prompt(_long_text(0, 40), {})
+    # warmup: compile the barrier bucket, the (1, C) chunk forward, and
+    # the decode loop out of the timed rounds
+    _mixed_run(eng, victim, aggressor, 4, None)
+    _mixed_run(eng, victim, aggressor, 4, chunk)
+
+    barrier_stalls: list[float] = []
+    chunked_stalls: list[float] = []
+    chunked_results = barrier_results = None
+    for _ in range(rounds):
+        barrier_results, walls = _mixed_run(eng, victim, aggressor,
+                                            max_new, None)
+        barrier_stalls.append(max(walls))
+        chunked_results, walls = _mixed_run(eng, victim, aggressor,
+                                            max_new, chunk)
+        chunked_stalls.append(max(walls))
+    identical = ([r.token_ids for r in barrier_results]
+                 == [r.token_ids for r in chunked_results])
+    stall_barrier = percentile(barrier_stalls, 50)
+    stall_chunked = percentile(chunked_stalls, 50)
+    stall_ratio = stall_barrier / stall_chunked if stall_chunked > 0 else 0.0
+    log(f"isolation: worst step during admission barrier {stall_barrier:.1f}"
+        f" ms / chunked({chunk}) {stall_chunked:.1f} ms -> "
+        f"{stall_ratio:.2f}x, token_identical={identical}")
+
+    # ---- plane 2: endpoint prefill debt with feeds on vs off (radix on,
+    # pinned static prefix — the production shape; long utterances so the
+    # user-text tail is real work, not a handful of tokens)
+    texts = [_long_text(i + 1, 60) for i in range(utterances)]
+    snap0 = get_metrics().counter_state()[0]
+    eng_fed = PagedDecodeEngine(preset="test-tiny", max_len=2048,
+                                batch_slots=2, prefill_buckets=BUCKETS,
+                                radix_enable=True)
+    install_prompt_prefix(eng_fed)
+    fed = _feed_drill(eng_fed, texts, max_new, feeds_on=True)
+    eng_cold = PagedDecodeEngine(preset="test-tiny", max_len=2048,
+                                 batch_slots=2, prefill_buckets=BUCKETS,
+                                 radix_enable=True)
+    install_prompt_prefix(eng_cold)
+    cold = _feed_drill(eng_cold, texts, max_new, feeds_on=False)
+    snap1 = get_metrics().counter_state()[0]
+
+    rem_fed = sum(r for r, _, _ in fed) / len(fed)
+    rem_cold = sum(r for r, _, _ in cold) / len(cold)
+    warm_frac = sum(1.0 - r / p for r, p, _ in fed) / len(fed)
+    feed_identical = [t for _, _, t in fed] == [t for _, _, t in cold]
+    feeds = snap1.get("prefill.feeds", 0) - snap0.get("prefill.feeds", 0)
+    committed = (snap1.get("prefill.feeds_committed", 0)
+                 - snap0.get("prefill.feeds_committed", 0))
+    shed = (snap1.get("prefill.feeds_shed", 0)
+            - snap0.get("prefill.feeds_shed", 0))
+    log(f"endpoint debt: remaining fed {rem_fed:.0f} / cold {rem_cold:.0f} "
+        f"tokens (warm fraction {warm_frac:.3f}); feeds {feeds} "
+        f"({committed} committed, {shed} shed), "
+        f"token_identical={feed_identical}")
+
+    emit("streaming_prefill_stall_ratio", stall_ratio, "x")
+    emit("streaming_prefill_warm_fraction", warm_frac, "fraction")
+    emit("streaming_prefill_remaining_fed", rem_fed, "tokens")
+    emit("streaming_prefill_remaining_cold", rem_cold, "tokens")
+
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    art = art_dir / f"BENCH_streaming_prefill_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_streaming_prefill",
+        "config": {"rounds": rounds, "utterances": utterances,
+                   "max_new_tokens": max_new, "chunk_tokens": chunk},
+        "rows": [
+            {"metric": "streaming_prefill_stall_ratio",
+             "value": round(stall_ratio, 3)},
+            {"metric": "streaming_prefill_warm_fraction",
+             "value": round(warm_frac, 4)},
+        ],
+        "prefill": {
+            "stall_barrier_ms": round(stall_barrier, 3),
+            "stall_chunked_ms": round(stall_chunked, 3),
+            "stall_ratio": round(stall_ratio, 3),
+            "chunk_tokens": chunk,
+            "token_identical_chunked": identical,
+            "endpoint_remaining_fed": round(rem_fed, 1),
+            "endpoint_remaining_cold": round(rem_cold, 1),
+            "warm_fraction_fed": round(warm_frac, 4),
+            "token_identical_fed": feed_identical,
+            "feeds": feeds,
+            "feeds_committed": committed,
+            "feeds_shed": shed,
+        },
+    }, indent=1))
+    log(f"artifact: {art}")
+
+    failed = []
+    if not identical:
+        failed.append("chunked admission not token-identical to barrier")
+    if not feed_identical:
+        failed.append("fed parses not token-identical to feed-less engine")
+    if stall_ratio < 1.2:
+        failed.append(f"chunked admission stall ratio {stall_ratio:.2f}x "
+                      "< 1.2x — chunking no longer isolates batch-mates")
+    if rem_fed >= rem_cold:
+        failed.append(f"feeds left {rem_fed:.0f} tokens of endpoint debt "
+                      f">= feed-less {rem_cold:.0f} — feeds warm nothing")
+    if committed <= 0:
+        failed.append("no feed completed a prefill-only admission")
+    for f in failed:
+        log(f"FAIL: {f}")
+    if failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

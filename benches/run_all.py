@@ -26,7 +26,7 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
            "bench_steplog.py", "bench_router.py", "bench_handoff.py",
            "bench_fleet.py", "bench_autopilot.py", "bench_cost.py",
-           "bench_tenancy.py"]
+           "bench_tenancy.py", "bench_streaming_prefill.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -76,12 +76,18 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # regression gate (tiny engine, two fixed-N swarm runs, seconds on CPU),
 # and a PR that lets an abusive tenant starve premium sessions or disarms
 # the token-bucket capacity gate must fail the quick table as well
+# the streaming-prefill bench stays on --quick too — it is the warm-start
+# regression gate (tiny engines, trimmed rounds/utterances, seconds on
+# CPU), and a PR that breaks chunked-admission batch-mate isolation or
+# lets prefix feeds stop collapsing the endpoint's prefill debt must
+# fail the quick table as well
 QUICK_BENCHES = ["bench_quality.py", "bench_quality_online.py",
                  "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
                  "bench_chaos.py", "bench_steplog.py", "bench_router.py",
                  "bench_handoff.py", "bench_fleet.py", "bench_autopilot.py",
-                 "bench_cost.py", "bench_tenancy.py"]
+                 "bench_cost.py", "bench_tenancy.py",
+                 "bench_streaming_prefill.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_QO_MAX_N": "4", "BENCH_QO_UTTERANCES": "2",
@@ -103,7 +109,9 @@ QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_AUTOPILOT_TURNS": "2",
              "BENCH_COST_SESSIONS": "6", "BENCH_COST_ROUNDS": "2",
              "BENCH_TENANCY_PREMIUM_N": "3", "BENCH_TENANCY_ABUSE_N": "3",
-             "BENCH_TENANCY_UTTERANCES": "2"}
+             "BENCH_TENANCY_UTTERANCES": "2",
+             "BENCH_SPF_ROUNDS": "2", "BENCH_SPF_UTTERANCES": "2",
+             "BENCH_SPF_TOKENS": "16"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -195,7 +203,8 @@ def main() -> None:
                             "spec", "stt", "radix", "swarm", "chaos",
                             "steplog", "engine_step", "xla", "hbm",
                             "router", "kv_quant", "handoff", "fleet",
-                            "quality", "autopilot", "cost", "tenancy"):
+                            "quality", "autopilot", "cost", "tenancy",
+                            "prefill"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

@@ -246,6 +246,20 @@ PINNED: dict[str, str] = {
     "tenant.throttled": "counter",
     "tenant.preemptions": "counter",
     "scheduler.requeue_rotations": "counter",
+    # incremental streaming prefill (ISSUE 19, serve/scheduler.py +
+    # services/voice.py + services/router.py, docs/OBSERVABILITY.md
+    # "Incremental streaming prefill"): the feed/chunk volume counters
+    # bench_streaming_prefill gates on, plus the scoreboard gauge — the
+    # prefill debt left at endpoint that the whole feature exists to
+    # drive to zero. Renaming any of these blinds the warm-start gates.
+    "prefill.chunked_admissions": "counter",
+    "prefill.chunks": "counter",
+    "prefill.feeds": "counter",
+    "prefill.feeds_committed": "counter",
+    "prefill.feeds_shed": "counter",
+    "voice.feeds_sent": "counter",
+    "voice.feeds_reaped": "counter",
+    "router.feeds_discarded": "counter",
 }
 
 

@@ -121,6 +121,13 @@ class ParseRequest(BaseModel):
     # commit/rollback) or refuse with 409 speculation_unsupported rather
     # than record a turn that may be discarded.
     speculative: bool = False
+    # incremental streaming prefill (ISSUE 19): the voice service sets this
+    # when streaming a STABILIZED PARTIAL PREFIX mid-utterance. The brain
+    # answers with a prefill-only admission — cache warming, never a decode,
+    # never a transcript commit — or 409 prefix_feed_unsupported so the
+    # caller latches feeds off. Best-effort by contract: the engine sheds
+    # feeds whenever real work is waiting.
+    prefix_feed: bool = False
     # tenant QoS tag (ISSUE 18): names the request's fair-share lane when
     # the brain's tenancy plane is on; absent/unknown tags fall into the
     # default class. Ignored entirely when TENANT_CLASSES is unset.

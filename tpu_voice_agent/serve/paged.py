@@ -56,6 +56,28 @@ class PoolExhausted(RuntimeError):
     (XlaRuntimeError also subclasses RuntimeError)."""
 
 
+class _ChunkedPrefill:
+    """Cursor of one in-flight chunked admission (ISSUE 19): host state
+    between ``begin_chunked_prefill`` and the final ``chunked_prefill_step``.
+    All pool blocks are already allocated and the slot's table row set —
+    only the suffix forwards remain, one ``(1, C)`` dispatch per step."""
+
+    __slots__ = ("slot", "ids", "suffix", "P", "C", "n_chunks", "j",
+                 "step_ms", "total_ms")
+
+    def __init__(self, slot: int, ids: list[int], suffix: list[int],
+                 P: int, C: int, n_chunks: int):
+        self.slot = slot
+        self.ids = ids
+        self.suffix = suffix
+        self.P = P              # tokens served from cached KV (chain/prefix)
+        self.C = C              # PREFILL_CHUNK_TOKENS
+        self.n_chunks = n_chunks
+        self.j = 0              # chunks completed
+        self.step_ms = 0.0      # last chunk's compute wall (steplog carve)
+        self.total_ms = 0.0     # accumulated compute (prefill_ms at finish)
+
+
 class BlockAllocator:
     """Host-side free-list allocator with refcounts (prefix blocks are
     shared across slots). ``n_groups`` partitions the pool into equal
@@ -587,6 +609,13 @@ class PagedDecodeEngine(DecodeEngine):
         # before admission; match/insert salt their keys with it. Empty
         # (tenancy off) keeps every radix path byte-identical.
         self._slot_ns: dict[int, str] = {}
+        # slots mid-way through a chunked prefill (ISSUE 19): their owned
+        # blocks exist but the slot is NOT decoding — decode_chunk's
+        # worst-case growth claim and reconcile_coverage must both skip
+        # them (growth would bleed the pool for a row that cannot decode
+        # yet; reconcile would clamp _next_pos against the row's parked
+        # device position)
+        self._mid_prefill: set[int] = set()
         # speculative decoding (ISSUE 8): deferred from the parent ctor —
         # the SpecDecoder reads the paged surface (pool/tables/trash) that
         # only exists now. Greedy batched chunks route through it; rejected
@@ -859,6 +888,140 @@ class PagedDecodeEngine(DecodeEngine):
             )
         return logits
 
+    # ------------------------------------------------- chunked prefill
+
+    def begin_chunked_prefill(self, ids: list[int], slot: int,
+                              chunk_tokens: int) -> "_ChunkedPrefill | None":
+        """Start a chunked admission (ISSUE 19): same decision tree as
+        ``prefill_slot`` — radix chain match, static-prefix tail, block
+        layout — but instead of one barrier ``(1, bucket)`` forward, the
+        suffix is split into ``chunk_tokens``-sized pieces the scheduler
+        advances one per step (``chunked_prefill_step``), interleaved with
+        batch-mates' decode chunks. All blocks are allocated HERE, so the
+        step calls can never raise PoolExhausted mid-admission; an evicted
+        mid-prefill slot releases everything through the ordinary
+        ``release_slot(ok=False)`` seam (no radix insert of a half-computed
+        chain: ``_slot_ids`` is only set at the final chunk).
+
+        Returns None when chunking cannot represent the prompt (padded
+        span past max_len, or nothing left to compute) — the caller falls
+        back to the one-shot ``prefill_slot`` path, which buckets (and
+        errors) independently."""
+        ns = self._slot_ns.get(slot)
+        self.release_slot(slot)
+        if ns is not None:
+            self._slot_ns[slot] = ns
+        ids = list(ids)
+        g = self._group(slot)
+        chain: list[int] = []
+        P, tail = 0, None
+        radix_hit = False
+        if self.radix is not None:
+            chain, matched = self.radix[g].match(ids, ns=ns)
+            P = matched
+            radix_hit = matched > 0
+            if matched:
+                P0 = len(self.prefix_ids)
+                if (self._prefix_tail is not None and P0 > matched
+                        and len(ids) > P0
+                        and chain == self._prefix_blocks[g][: len(chain)]
+                        and ids[:P0] == self.prefix_ids):
+                    # same static-prefix-tail special case as prefill_slot
+                    P, tail = P0, self._prefix_tail
+        if not P:
+            if chain:
+                self.allocator.free(chain)
+                chain = []
+            suffix0 = self._split_prefix(ids)
+            if suffix0 is not None and self.prefix_ids:
+                # shared-prefix hit without a (longer) radix chain: the
+                # pinned prefix full blocks + dense sub-block tail, the
+                # byte-for-byte _prefill_suffix layout
+                P, tail = len(self.prefix_ids), self._prefix_tail
+                chain = list(self._prefix_blocks[g][: P // self.block_size])
+                self.allocator.ref(chain)
+        suffix = ids[P:]
+        m = len(suffix)
+        C = int(chunk_tokens)
+        if m <= 0 or C <= 0:
+            if chain:
+                self.allocator.free(chain)
+            return None
+        n_chunks = -(-m // C)
+        span = n_chunks * C
+        if P + span > self.max_len:
+            if chain:
+                self.allocator.free(chain)
+            return None
+        bs = self.block_size
+        full = len(chain)
+        n_owned = -(-(P + span) // bs) - full
+        try:
+            owned = self._alloc(n_owned, g)
+        except PoolExhausted:
+            if chain:
+                self.allocator.free(chain)
+            raise
+        if radix_hit:
+            # committed to serving from the cached chain: account the hit
+            # only now (same post-alloc commit point as prefill_slot)
+            self.radix[g].record_hit(P)
+        self._slot_shared[slot], self._slot_owned[slot] = list(chain), owned
+        self._set_table_row(slot, list(chain) + owned)
+        self._covered[slot] = (full + n_owned) * bs
+        if tail is not None:
+            R = P - full * bs
+            dst = jnp.asarray(owned[0] * bs + np.arange(R, dtype=np.int32))
+            self._scatter_pool(tail["k"], tail["v"], dst)
+        self._next_pos[slot] = len(ids)
+        self._mid_prefill.add(slot)
+        return _ChunkedPrefill(slot=slot, ids=ids, suffix=suffix, P=P, C=C,
+                               n_chunks=n_chunks)
+
+    def chunked_prefill_step(self, cur: "_ChunkedPrefill"):
+        """Run ONE ``(1, C)`` prefill chunk of an admission started by
+        ``begin_chunked_prefill``. Returns the final-token logits row when
+        the last chunk lands (the scheduler's ``_first_token`` tail takes
+        over), else None. Earlier chunks' KV is read through the slot's
+        block table with the same pow2-bucketed gather the chain admission
+        uses, so compile count stays log-bounded at one token-dim (C)."""
+        slot, C, bs = cur.slot, cur.C, self.block_size
+        start = cur.j * C
+        seg = cur.suffix[start:start + C]
+        tokens = np.full((1, C), self.pad_id, dtype=np.int32)
+        tokens[0, : len(seg)] = seg
+        positions = (cur.P + start + np.arange(C, dtype=np.int32))[None, :]
+        need = -(-(cur.P + start + C) // bs)
+        gb = 1
+        while gb < need:
+            gb *= 2
+        gb = min(gb, self.max_blocks)
+        t0 = time.perf_counter()
+        logits, self.k_pool, self.v_pool, self.k_scale, self.v_scale = \
+            forward_paged(
+                self.params, self.cfg, jnp.asarray(tokens),
+                jnp.asarray(positions),
+                self.k_pool, self.v_pool, self.block_tables[slot][None],
+                rules=self.rules, attn_impl="xla",
+                fresh_block=False, gather_blocks=gb,
+                k_scale=self.k_scale, v_scale=self.v_scale,
+                kv_quant=self.kv_quant,
+            )
+        cur.step_ms = (time.perf_counter() - t0) * 1e3
+        cur.total_ms += cur.step_ms
+        cur.j += 1
+        if cur.j < cur.n_chunks:
+            return None
+        self._mid_prefill.discard(slot)
+        self._last_prefill_compute_ms = cur.total_ms
+        self._last_cached_tokens = cur.P
+        self._slot_ids[slot] = cur.ids
+        if self.spec is not None:
+            # drafter seeding at admission, same hook as the one-shot paths
+            self.spec.on_admit(slot, cur.ids)
+        r = len(cur.suffix) - start
+        return logits[:, r - 1, :]
+
     # ------------------------------------------------------------ decode
 
     def reconcile_coverage(self, pos_h) -> None:
@@ -869,7 +1032,7 @@ class PagedDecodeEngine(DecodeEngine):
         every table covered max_len — the dense worst-case footprint this
         engine exists to avoid."""
         for b in range(self.batch_slots):
-            if self._slot_owned[b]:
+            if self._slot_owned[b] and b not in self._mid_prefill:
                 self._next_pos[b] = min(self._next_pos[b], int(pos_h[b]))
 
     def _grow(self, slot: int, upto: int) -> None:
@@ -920,6 +1083,12 @@ class PagedDecodeEngine(DecodeEngine):
              if self.tables_ff is not None else 0)
         span = chunk_steps * (1 + W)
         for b in range(self.batch_slots):
+            if b in self._mid_prefill:
+                # chunked admission underway (ISSUE 19): the row is not
+                # decoding — its blocks are fully allocated already and a
+                # worst-case growth claim here would bleed the pool every
+                # chunk with nothing to reconcile it back
+                continue
             if self._slot_owned[b]:  # request in flight on this slot
                 try:
                     self._grow(b, self._next_pos[b] + span + 1)
@@ -974,6 +1143,8 @@ class PagedDecodeEngine(DecodeEngine):
         isolation as the plain chunk's ladder."""
         starved = []
         for b in range(self.batch_slots):
+            if b in self._mid_prefill:
+                continue  # chunked admission underway — not decoding
             if self._slot_owned[b] and (active is None or active[b]):
                 try:
                     self._grow(b, self._next_pos[b] + span + 1)
@@ -994,6 +1165,10 @@ class PagedDecodeEngine(DecodeEngine):
 
     def release_slot(self, slot: int, generated_ids: list[int] | None = None,
                      ok: bool = True) -> None:
+        # an evicted mid-chunked-prefill slot releases through here too:
+        # its half-computed chain never inserts (_slot_ids unset until the
+        # final chunk), and the mid-prefill mark must not survive the slot
+        self._mid_prefill.discard(slot)
         ns = self._slot_ns.pop(slot, None)
         if self._slot_owned[slot] or self._slot_shared[slot]:
             if (ok and self.radix is not None and generated_ids is not None
@@ -1130,6 +1305,7 @@ class PagedDecodeEngine(DecodeEngine):
         self._next_pos = [0] * self.batch_slots
         self._slot_ids = [None] * self.batch_slots
         self._slot_ns.clear()
+        self._mid_prefill.clear()
         self.block_tables = jnp.zeros(
             (self.batch_slots, self.max_blocks), jnp.int32)
         self._pressure_until = 0.0

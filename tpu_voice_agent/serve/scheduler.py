@@ -191,6 +191,23 @@ class ContinuousBatcher:
         self._requeues: dict[int, int] = {}
         self._requeue_max = int(os.environ.get("SCHED_REQUEUE_MAX", "8"))
         m.inc("scheduler.requeue_rotations", 0.0)
+        # incremental streaming prefill (ISSUE 19): PREFILL_CHUNK_TOKENS
+        # splits any prompt admission into chunked prefills interleaved
+        # with decode chunks (paged engines only — duck-typed on
+        # begin_chunked_prefill); unset keeps the one-shot barrier prefill
+        # byte-identical. _admitting maps a reserved slot (request_id set,
+        # active False — _free_slot skips it) to its (cursor, enqueue_ts).
+        pc = os.environ.get("PREFILL_CHUNK_TOKENS")
+        self._prefill_chunk = int(pc) if pc else 0
+        self._admitting: dict[int, tuple[object, float]] = {}
+        if self._prefill_chunk:
+            m.inc("prefill.chunked_admissions", 0.0)
+            m.inc("prefill.chunks", 0.0)
+        # prefix-feed counters (ISSUE 19) exist from construction, same
+        # scrape-at-zero discipline as the containment counters above
+        m.inc("prefill.feeds", 0.0)
+        m.inc("prefill.feeds_committed", 0.0)
+        m.inc("prefill.feeds_shed", 0.0)
         if self.tenancy is not None:
             m.inc("tenant.throttled", 0.0)
             m.inc("tenant.preemptions", 0.0)
@@ -223,6 +240,7 @@ class ContinuousBatcher:
         self._prompt_src.clear()
         self._preempted.clear()
         self._requeues.clear()
+        self._admitting.clear()
         if self.tenancy is not None:
             self.tenancy.reset_occupancy()
         self.results.clear()
@@ -352,6 +370,10 @@ class ContinuousBatcher:
         self.active = self.active.at[b].set(False)
         self._active_h[b] = False
         self._nan_slots.discard(b)
+        # a slot evicted mid-chunked-prefill (ISSUE 19) drops its cursor;
+        # release below frees the admission's blocks (no radix insert —
+        # the engine only marks the chain insertable at the final chunk)
+        self._admitting.pop(b, None)
         self.engine.release_slot(b, ok=False)
 
     def cancel(self, rid: int, reason: str = "client gone") -> bool:
@@ -420,11 +442,18 @@ class ContinuousBatcher:
                 return b
         return None
 
-    def _admit(self, slot: int, rid: int, prompt: str) -> None:
+    def _admit(self, slot: int, rid: int, prompt: str) -> bool:
         """Prefill ONE slot's cache line (cost independent of batch width —
         round 1 prefilled the full (B, bucket) batch per admission, 32×
         wasted FLOPs at 32 slots) and reuse the engine's shared-prefix KV
-        when the prompt starts with it."""
+        when the prompt starts with it.
+
+        Returns True when a CHUNKED admission was started instead (ISSUE
+        19, PREFILL_CHUNK_TOKENS set, long prompt, engine supports it):
+        the slot is reserved — request_id set, active stays False — and
+        ``_advance_admissions`` runs one prefill chunk per step until the
+        final chunk lands, so a 1k-token cold prompt never head-of-line-
+        blocks batch-mates' decode chunks behind a barrier prefill."""
         eng = self.engine
         if self.tenancy is not None:
             # tenant radix namespace (ISSUE 18): the slot's cache chains are
@@ -437,7 +466,37 @@ class ContinuousBatcher:
         ids = (eng.tokenizer.encode(prompt, bos=True)
                if isinstance(prompt, str) else [int(t) for t in prompt])
         n = len(ids)
+        C = self._prefill_chunk
+        if C > 0 and n > C:
+            begin = getattr(eng, "begin_chunked_prefill", None)
+            if begin is not None:
+                cursor = begin(ids, slot, C)
+                if cursor is not None:
+                    sl = self.slots[slot]
+                    sl.request_id = rid
+                    sl.token_ids = []
+                    sl.start_s = t0
+                    sl.prompt_len = n
+                    sl.eos = False
+                    # the enqueue stamp travels with the cursor: TTFT still
+                    # covers queue wait + every interleaved prefill chunk
+                    self._admitting[slot] = (
+                        cursor, self._enqueued_at.pop(rid, t0))
+                    from ..utils import get_metrics as _gm
+
+                    _gm().inc("prefill.chunked_admissions")
+                    return True
         last_logits = eng.prefill_slot(ids, slot)
+        self._finish_admission(slot, rid, n, last_logits, t0,
+                               self._enqueued_at.pop(rid, t0))
+        return False
+
+    def _finish_admission(self, slot: int, rid: int, n: int, last_logits,
+                          t0: float, t_enq: float) -> None:
+        """The admission tail shared by one-shot and chunked prefills: the
+        fused grammar-mask first-token sample, per-slot device state, slot
+        bookkeeping, TTFT, and the prefill cost fold."""
+        eng = self.engine
         self._rng, k = jax.random.split(self._rng)
         start_state = jnp.full((1,), self.engine.fsm.start, dtype=jnp.int32)
         t_fm = time.perf_counter()
@@ -481,7 +540,6 @@ class ContinuousBatcher:
         # headline metric (WhisperFlow/WhisperKit report it first-class).
         from ..utils import get_metrics
 
-        t_enq = self._enqueued_at.pop(rid, t0)
         get_metrics().observe_ms("scheduler.ttft",
                                  (time.perf_counter() - t_enq) * 1e3)
         # prefill cost fold (ISSUE 17): an exact cached-vs-computed
@@ -496,6 +554,119 @@ class ContinuousBatcher:
             sl.cost["prefill_flops"] = computed
             sl.cost["prefill_cached_flops"] = cached
             self.costs.fold_prefill(computed, cached, sl.prefill_ms)
+
+    def _advance_admissions(self, act: np.ndarray) -> tuple[int, int, float]:
+        """Advance every in-flight chunked admission by ONE prefill chunk
+        (ISSUE 19). A slot whose final chunk lands finishes admission and
+        goes active for this step's decode chunk; earlier chunks cost one
+        bounded ``(1, C)`` dispatch each, interleaved with batch-mates'
+        decode chunks instead of stalling them behind a barrier prefill.
+        Returns (completed, chunks_stepped, compute_ms) for the step
+        ledger's admit/prefill accounting."""
+        if not self._admitting:
+            return 0, 0, 0.0
+        from ..utils import get_metrics
+        from ..utils.chaos import chaos_fire
+
+        m = get_metrics()
+        eng = self.engine
+        done, stepped, pf_ms = 0, 0, 0.0
+        for slot in sorted(self._admitting):
+            cursor, t_enq = self._admitting[slot]
+            rid = self.slots[slot].request_id
+            try:
+                last_logits = eng.chunked_prefill_step(cursor)
+            except Exception as e:
+                if isinstance(e, _DeviceFault):
+                    raise  # corrupted engine: never per-request (see step)
+                # per-request chunk fence: the admission fails alone, its
+                # blocks release through the ordinary eviction seam
+                if not isinstance(e, ValueError):
+                    self._record_offense(rid, f"prefill {type(e).__name__}")
+                self._evict_slot(slot, str(e), "scheduler.prefill_faults")
+                continue
+            stepped += 1
+            pf_ms += cursor.step_ms
+            m.inc("prefill.chunks")
+            if last_logits is None:
+                continue
+            self._admitting.pop(slot, None)
+            self._finish_admission(slot, rid, self.slots[slot].prompt_len,
+                                   last_logits, self.slots[slot].start_s,
+                                   t_enq)
+            act[slot] = True
+            done += 1
+            # chaos drill arming matches the one-shot admission path
+            if chaos_fire("nan_logits"):
+                self._nan_slots.add(slot)
+            if chaos_fire("dead_fsm"):
+                self.fsm = self.fsm.at[slot].set(-1)
+        return done, stepped, pf_ms
+
+    # ------------------------------------------------------------ feeds
+
+    def feed_prefix(self, prompt, tenant=None) -> dict:
+        """Prefill-only admission (ISSUE 19 prefix feed): render ``prompt``
+        through a transiently borrowed free slot, commit the computed
+        chain into the radix tree, and release — all inside one call on
+        the serving-loop thread, so no decode slot is ever held across a
+        step. The radix re-extension makes an incremental feed O(new
+        tokens): each feed's prefill starts from the longest cached prefix
+        (usually the previous feed's chain), and the eventual real parse
+        admits warm with ``prefill_remaining ≈ 0``.
+
+        Best-effort and sheddable BY DESIGN — live work always wins: a
+        feed sheds when real requests are queued, when no slot is free,
+        or when the pool is exhausted, and a shed feed costs the caller
+        nothing but the prefill-ahead it was trying to buy. ``tenant``
+        salts the cached chain into the lane's radix namespace (ISSUE 18),
+        so fed chains count against that tenant's block quota."""
+        from ..utils import get_metrics
+
+        m = get_metrics()
+        m.inc("prefill.feeds")
+        eng = self.engine
+        if getattr(eng, "radix", None) is None:
+            return {"ok": False, "reason": "radix_off"}
+        if self.pending:
+            m.inc("prefill.feeds_shed")
+            return {"ok": False, "reason": "busy"}
+        slot = self._free_slot(self._active_h)
+        if slot is None:
+            m.inc("prefill.feeds_shed")
+            return {"ok": False, "reason": "no_slot"}
+        if self.tenancy is not None:
+            setns = getattr(eng, "set_slot_ns", None)
+            if setns is not None:
+                setns(slot, self.tenancy.resolve(tenant))
+        ids = (eng.tokenizer.encode(prompt, bos=True)
+               if isinstance(prompt, str) else [int(t) for t in prompt])
+        try:
+            eng.prefill_slot(ids, slot)
+        except PoolExhausted:
+            try:
+                eng.release_slot(slot, ok=False)
+            except Exception:
+                pass
+            m.inc("prefill.feeds_shed")
+            return {"ok": False, "reason": "pool_exhausted"}
+        except Exception as e:
+            if isinstance(e, _DeviceFault):
+                raise
+            try:
+                eng.release_slot(slot, ok=False)
+            except Exception:
+                pass
+            return {"ok": False, "reason": f"{type(e).__name__}: {e}"}
+        cached = int(getattr(eng, "_last_cached_tokens", 0))
+        # generated_ids=[] (not None): release's ok-path radix insert fires
+        # with the fed prompt alone — the tree adopts its full blocks, so
+        # everything is either cached or freed before this call returns
+        # (zero leaked refcounts by construction)
+        eng.release_slot(slot, generated_ids=[], ok=True)
+        m.inc("prefill.feeds_committed")
+        return {"ok": True, "prompt_tokens": len(ids),
+                "cached_tokens": cached}
 
     # ------------------------------------------------------------ step
 
@@ -585,20 +756,22 @@ class ContinuousBatcher:
                 self._cleanup(rid)
                 continue
             try:
-                self._admit(slot, rid, prompt)
-                act[slot] = True
-                n_admitted += 1
-                admit_prefill_ms += self.slots[slot].prefill_ms
+                chunked = self._admit(slot, rid, prompt)
                 self._pool_wait.pop(rid, None)
                 self._requeues.pop(rid, None)
                 if plane is not None:
                     plane.on_dequeue(self._tenant.get(rid), admitted=True)
-                # chaos drill arming (no-ops with chaos off): NaN logits on
-                # this slot's next chunk / FSM state forced dead
-                if chaos_fire("nan_logits"):
-                    self._nan_slots.add(slot)
-                if chaos_fire("dead_fsm"):
-                    self.fsm = self.fsm.at[slot].set(-1)
+                if not chunked:
+                    act[slot] = True
+                    n_admitted += 1
+                    admit_prefill_ms += self.slots[slot].prefill_ms
+                    # chaos drill arming (no-ops with chaos off): NaN logits
+                    # on this slot's next chunk / FSM state forced dead (a
+                    # chunked admission arms at its final chunk instead)
+                    if chaos_fire("nan_logits"):
+                        self._nan_slots.add(slot)
+                    if chaos_fire("dead_fsm"):
+                        self.fsm = self.fsm.at[slot].set(-1)
             except PoolExhausted as e:
                 # pool-pressure degradation ladder (stage 3; stages 1-2 —
                 # radix cold-leaf eviction and session-cache admission
@@ -668,6 +841,15 @@ class ContinuousBatcher:
                     # directly — the lane's queued count must not leak
                     plane.on_dequeue(self._tenant.pop(r), admitted=False)
                     self._prompt_src.pop(r, None)
+
+        # chunked admissions (ISSUE 19): one interleaved prefill chunk per
+        # in-flight admission per step — the admit/prefill ledger stages
+        # show the decode isolation directly (prefill time lands in the
+        # carved prefill stage, never inside batch-mates' decode segment)
+        adm_done, adm_stepped, adm_pf_ms = self._advance_admissions(act)
+        n_admitted += adm_done
+        n_attempted += adm_stepped
+        admit_prefill_ms += adm_pf_ms
 
         timer.lap("admit")
         # prefill compute was measured INSIDE the admission segment
@@ -825,6 +1007,14 @@ class ContinuousBatcher:
             sl = self.slots[b]
             if sl.request_id < 0:
                 continue
+            if b in self._admitting:
+                # mid-chunked-admission: the slot owns a request but its
+                # device row is not active yet, so this chunk's readback
+                # (act/n/eos/pos) carries junk for it — the "slot stopped"
+                # branch below would release a request that never started
+                # decoding. The admission loop owns this slot until its
+                # final chunk lands.
+                continue
             if plane is not None:
                 # advance the lane's virtual-token clock by the row's
                 # emitted tokens (tokens / weight — the fair-share currency)
@@ -961,6 +1151,10 @@ class ContinuousBatcher:
             import math
 
             per_req = math.ceil(self.max_new_tokens / self.chunk_steps) + 1
+            if self._prefill_chunk:
+                # a chunked admission spends up to ceil(max_len / C) steps
+                # landing prefill chunks before its first decode chunk
+                per_req += math.ceil(self.engine.max_len / self._prefill_chunk)
             if self.tenancy is not None:
                 # a preempted request re-admits and may replay its full
                 # budget once (one preemption per rid, _preempt_slot)

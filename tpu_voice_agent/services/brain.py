@@ -353,6 +353,47 @@ class BatchedEngineParser:
             self.transcripts.record(session_id, prompt, res.token_ids)
         return resp
 
+    # incremental streaming prefill (ISSUE 19): a prefix-feed request warms
+    # the session's radix chain from a stabilized STT partial WITHOUT taking
+    # a decode slot or advancing the transcript. The prompt renders through
+    # the SAME prompt_for path a real parse uses, so the fed chain is a
+    # token-exact prefix of the eventual final's prompt up to the point the
+    # partial and final diverge — the radix tree's block-aligned match
+    # absorbs exactly the shared part and ignores the rest. Best-effort by
+    # contract: the scheduler sheds feeds whenever real work is waiting.
+    supports_prefix_feed = True
+
+    def feed_prefix(self, text: str, context: dict,
+                    session_id: str | None = None) -> dict:
+        from concurrent.futures import CancelledError
+
+        from ..utils.resilience import current_request_context
+
+        if self.transcripts is not None and session_id:
+            prompt = self.transcripts.prompt_for(session_id, text, context)
+        else:
+            prompt = render_prompt(text, context)
+        if self._too_long(prompt):
+            return {"ok": False, "reason": "too_long"}
+        ctx = current_request_context()
+        tenant = getattr(ctx, "tenant", None)
+        fut = self.runtime.submit_call(
+            lambda: self.batcher.feed_prefix(prompt, tenant=tenant))
+        if ctx is not None:
+            # WS teardown / context reset fires the cancellation chain: a
+            # not-yet-started feed is dropped on the floor (fut.cancel); one
+            # already prefilling completes-and-commits, which is harmless —
+            # the chain is plain reusable cache, nothing holds a slot
+            ctx.on_cancel(fut.cancel)
+        try:
+            return fut.result(timeout=self.timeout_s)
+        except CancelledError:
+            return {"ok": False, "reason": "cancelled"}
+        except TimeoutError:
+            return {"ok": False, "reason": "timeout"}
+        except Exception as e:
+            return {"ok": False, "reason": f"{type(e).__name__}: {e}"}
+
     def _fold_cost(self, session_id: str | None, res) -> None:
         """Fold a finished request's ledger into the session rollup —
         BEFORE response conversion, so errored results (which raise in
@@ -1231,6 +1272,17 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
                  "detail": "session-keyed backend commits turns; parse at final"},
                 status=409, headers=headers,
             )
+        if preq.prefix_feed and not getattr(parser, "supports_prefix_feed",
+                                            False):
+            # prefix feeds (ISSUE 19) only make sense against an engine
+            # batcher with a prefill-only admission path; other backends
+            # refuse fast and the voice service latches feeds off for the
+            # connection (mirroring the speculation 409 above)
+            return web.json_response(
+                {"error": "prefix_feed_unsupported",
+                 "detail": "backend has no prefill-only admission path"},
+                status=409, headers=headers,
+            )
 
         def shed(reason: str, retry_after_s: float = 1.0) -> web.Response:
             return shed_response("brain", reason, headers=headers,
@@ -1261,6 +1313,37 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         # router/raw-HTTP fallback.
         ctx = RequestContext(
             deadline, tenant=preq.tenant or req.headers.get("x-tenant"))
+
+        if preq.prefix_feed:
+            # prefill-only admission (ISSUE 19): cache warming, not a parse
+            # — no decode, no transcript commit, no quality record. A shed
+            # ({"ok": False, ...}) is a 200: the feed contract is
+            # best-effort and the voice service never retries one.
+            def run_feed() -> dict:
+                if deadline is not None and deadline.expired:
+                    raise DeadlineExpired("budget consumed while queued")
+                push_request_context(ctx)
+                try:
+                    return parser.feed_prefix(preq.text, preq.context,
+                                              preq.session_id)
+                finally:
+                    pop_request_context()
+
+            try:
+                out = await loop.run_in_executor(parse_pool, run_feed)
+            except asyncio.CancelledError:
+                ctx.cancel()
+                raise
+            except DeadlineExpired:
+                return shed("deadline_expired", retry_after_s=0)
+            except Exception as e:
+                return web.json_response(
+                    {"error": "llm_error", "detail": str(e)[:500]},
+                    status=500, headers=headers)
+            finally:
+                admission.release()
+            return web.json_response({"prefix_feed": True, **out},
+                                     headers=headers)
 
         def run_admitted(preq: ParseRequest) -> ParseResponse:
             # queue_ms: arrival -> worker-thread start (thread pool + engine

@@ -637,9 +637,14 @@ class BrainRouter(ReplicaSet):
         Returns (httpx response | None, served replica | None, error str)."""
         session_id = body.get("session_id") or None
         speculative = bool(body.get("speculative"))
+        # prefix feed (ISSUE 19): best-effort cache warming. It follows
+        # session affinity (the warmed chain must live on the session's
+        # home) but is never hedged — a hedge would prefill a replica the
+        # final will never visit — and never retried/replayed (below)
+        feed = bool(body.get("prefix_feed"))
         deadline = (Deadline.from_headers(headers)
                     or Deadline.after(self.parse_timeout_s))
-        idempotent = speculative or not session_id
+        idempotent = (speculative or not session_id) and not feed
         home, rehomed_from = self.route_ex(session_id)
         if home is None:
             return None, None, "no_replicas"
@@ -653,7 +658,7 @@ class BrainRouter(ReplicaSet):
         # else to go; cap the first attempt at half the remaining budget in
         # that case so the retry is guaranteed to fit (mid-flight ejection
         # usually fails over much faster than this cap)
-        can_retry = (not speculative
+        can_retry = (not speculative and not feed
                      and any(r.admitting() and r.url != home.url
                              for r in self.replicas))
         remaining = deadline.remaining_s()
@@ -671,6 +676,12 @@ class BrainRouter(ReplicaSet):
                 # here could interleave with that re-routed final
                 get_metrics().inc("router.spec_discarded")
                 return None, None, "spec_discarded"
+            if feed:
+                # a feed whose home died is worthless on any other replica
+                # (the warmed chain must live where the final will land) —
+                # discard, never replay; the final just cold-prefills
+                get_metrics().inc("router.feeds_discarded")
+                return None, None, "feed_discarded"
             if deadline.expired:
                 return None, None, f"deadline_expired: {e}"
             home2, rehomed2 = self.route_ex(session_id, exclude={home.url})
@@ -799,6 +810,15 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
                     {"error": "speculation_discarded",
                      "detail": "home replica failed mid-speculation; "
                                "parse at final"},
+                    status=409, headers=headers)
+            if err == "feed_discarded":
+                # same contract for a lost prefix feed (ISSUE 19): a lost
+                # optimization, not an outage — 409 keeps the voice-side
+                # breaker closed for the real parses that still work
+                return web.json_response(
+                    {"error": "prefix_feed_discarded",
+                     "detail": "home replica failed mid-feed; "
+                               "final will cold-prefill"},
                     status=409, headers=headers)
             # full outage / failed failover: the one 503 + Retry-After
             # shed contract — voice degrades to the rule parser and the

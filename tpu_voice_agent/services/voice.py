@@ -206,12 +206,35 @@ class ClientState:
         # connection makes, plus the STT batcher's fair lanes. None = the
         # default class.
         self.tenant: str | None = None
+        # incremental streaming prefill (ISSUE 19, PREFIX_FEED_ENABLE=1):
+        # the stability tracker over STT partials (attached by the stream
+        # handler when the knob is on) plus the single in-flight feed task.
+        # At most ONE feed per connection is ever in flight; a newer
+        # committed prefix supersedes a queued one (feed_pending).
+        self.feed_tracker = None
+        self.feed_task: asyncio.Task | None = None
+        self.feed_pending: str | None = None
 
     def drop_spec(self) -> None:
         if self.spec is not None:
             task = self.spec[1]
             self.spec = None
             _reap(task)
+
+    def drop_feed(self) -> None:
+        """Reap the in-flight prefix feed (ISSUE 19 satellite): WS
+        teardown / reset / context change cancels the feed task, and the
+        cancellation rides the PR 7 RequestContext chain into the brain —
+        a not-yet-admitted feed is dropped there; one already prefilling
+        completes and its chain stays as plain reusable cache (nothing
+        holds a slot or a refcount past the call)."""
+        if self.feed_pending is not None:
+            self.feed_pending = None
+        if self.feed_task is not None:
+            task = self.feed_task
+            self.feed_task = None
+            _reap(task)
+            get_metrics().inc("voice.feeds_reaped")
 
 
 def _reap(task: "asyncio.Task") -> None:
@@ -224,6 +247,84 @@ def _reap(task: "asyncio.Task") -> None:
     else:
         task.add_done_callback(lambda t: t.cancelled() or t.exception())
         task.cancel()
+
+
+class _PrefixFeedTracker:
+    """Longest-stable-prefix commit over a stream of STT partials
+    (ISSUE 19). ``observe(partial)`` returns the newly committable prefix,
+    or None when nothing new stabilized. A prefix commits once it has
+    survived K consecutive partials character-identically, trimmed back to
+    the last whitespace boundary (a mid-word prefix tokenizes differently
+    from the final's full word, wasting the fed KV), and only when it grew
+    by >= min_chars since the last commit (each commit costs a /parse
+    roundtrip + a prefill-only admission). A RETRACTION — STT revising
+    text already committed — resets the baseline: the fed chain stays in
+    the radix tree as cache for whatever prefix still matches, and the
+    re-stabilized transcript simply re-commits; the brain-side radix match
+    falls back to the longest still-valid cached prefix token-identically.
+    """
+
+    def __init__(self, k: int = 3, min_chars: int = 8):
+        self.k = max(1, int(k))
+        self.min_chars = max(1, int(min_chars))
+        self._recent: list[str] = []
+        self.committed = ""
+
+    def observe(self, partial: str) -> str | None:
+        self._recent.append(partial)
+        if len(self._recent) > self.k:
+            self._recent.pop(0)
+        if len(self._recent) < self.k:
+            return None
+        stable = self._recent[0]
+        for p in self._recent[1:]:
+            n = min(len(stable), len(p))
+            i = 0
+            while i < n and stable[i] == p[i]:
+                i += 1
+            stable = stable[:i]
+        # word-boundary trim: a prefix the NEWEST partial continues without
+        # a space ends mid-word — drop the fragment (it would tokenize
+        # differently from the final's full word). One the newest partial
+        # follows with whitespace (or ends at) is word-complete as-is.
+        latest = self._recent[-1]
+        if (len(stable) < len(latest) and not latest[len(stable)].isspace()
+                and not stable[-1:].isspace()):
+            cut = stable.rfind(" ")
+            if cut <= 0:
+                return None
+            stable = stable[:cut]
+        stable = stable.rstrip()
+        if not stable:
+            return None
+        if not stable.startswith(self.committed):
+            self.committed = ""  # retraction: re-baseline, see docstring
+        if len(stable) - len(self.committed) < self.min_chars:
+            return None
+        self.committed = stable
+        return stable
+
+    def reset(self) -> None:
+        self._recent.clear()
+        self.committed = ""
+
+
+def _prefill_remaining(stages: dict, spec_pre_parsed: bool,
+                       degraded: bool) -> float:
+    """Outstanding un-prefilled prompt tokens when the endpoint fired —
+    the scoreboard ISSUE 19 gates on, computed for EVERY utterance:
+    a speculative parse that finished before the endpoint left nothing
+    outstanding (0); an engine parse reports prompt_tokens minus whatever
+    the KV cache absorbed; a degraded/headerless parse (rule fallback,
+    planner backend) had no engine prefill pending at the endpoint by
+    definition (0, not unrecorded — the old gauge skipped exactly the
+    cold utterances this measurement exists to expose)."""
+    if spec_pre_parsed:
+        return 0.0
+    pt = stages.get("prompt_tokens")
+    if degraded or pt is None:
+        return 0.0
+    return max(0.0, float(pt) - float(stages.get("cached_tokens", 0.0)))
 
 
 def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> web.Application:
@@ -376,6 +477,76 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
     # never latches.
     RESPEC_AFTER = int(os.environ.get("VOICE_RESPEC_AFTER", "25"))
     spec_supported = {"ok": True, "skips": 0}
+
+    # incremental streaming prefill (ISSUE 19, PREFIX_FEED_ENABLE=1):
+    # stream stabilized partial prefixes to the brain as prefill-only
+    # feeds WHILE the user is still speaking, so the endpoint fires
+    # against an already-warm radix chain and the gauge above reads ~0
+    # even for cold (non-speculative) utterances. Unset keeps every
+    # touched path byte-identical: no tracker, no tasks, no requests.
+    feed_enable = os.environ.get("PREFIX_FEED_ENABLE", "") == "1"
+    feed_k = int(os.environ.get("PREFIX_FEED_STABLE_K", "3"))
+    feed_min_chars = int(os.environ.get("PREFIX_FEED_MIN_CHARS", "8"))
+    # sticky across the app like spec_supported, but with no re-probe: a
+    # backend that answered prefix_feed_unsupported will not grow a
+    # prefill-only admission path mid-run
+    feed_supported = {"ok": True}
+    if feed_enable:
+        get_metrics().inc("voice.feeds_sent", 0.0)
+        get_metrics().inc("voice.feeds_reaped", 0.0)
+
+    async def feed_prefix_send(state: ClientState, text: str, http) -> None:
+        """Fire one coalesced prefill-only feed. Deliberately a raw post,
+        NOT post_with_resilience: a feed is a lost optimization on any
+        failure — it must never retry, never burn the brain breaker's
+        budget (that budget belongs to the real parses), and never surface
+        an error to the user. It still refuses to fire while the circuit
+        is anything but closed: a struggling brain gets real work only."""
+        if not feed_enable or not feed_supported["ok"]:
+            return
+        if brain_breaker.state != "closed":
+            return
+        if state.feed_task is not None:
+            state.feed_pending = text  # coalesce: newest commit wins
+            return
+
+        async def run(text: str) -> None:
+            json_body = {"text": text, "session_id": state.convo_id,
+                         "context": state.context, "prefix_feed": True}
+            headers = {"x-trace-id": state.trace_id}
+            if state.tenant:
+                json_body["tenant"] = state.tenant
+                headers["x-tenant"] = state.tenant
+            get_metrics().inc("voice.feeds_sent")
+            try:
+                r = await http.post(cfg.brain_url + "/parse", json=json_body,
+                                    headers=headers,
+                                    timeout=cfg.parse_timeout_s)
+                if r.status_code == 409:
+                    # only the brain's own refusal latches; the router's
+                    # feed_discarded 409 (home died mid-feed) is transient
+                    try:
+                        latch = (r.json().get("error")
+                                 == "prefix_feed_unsupported")
+                    except Exception:
+                        latch = False
+                    if latch:
+                        feed_supported["ok"] = False
+            except asyncio.CancelledError:
+                raise
+            except (httpx.HTTPError, OSError, RuntimeError):
+                pass  # best-effort: the final will just cold-prefill
+            finally:
+                if state.feed_task is asyncio.current_task():
+                    state.feed_task = None
+                # chain the coalesced commit (drop_feed cleared it if the
+                # connection is tearing down, so a cancelled feed never
+                # respawns)
+                nxt, state.feed_pending = state.feed_pending, None
+                if nxt is not None:
+                    await feed_prefix_send(state, nxt, http)
+
+        state.feed_task = asyncio.ensure_future(run(text))
 
     async def speculate(state: ClientState, text: str, http) -> None:
         """Start parsing the provisional transcript inside the endpoint's
@@ -570,18 +741,6 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                         state.stages[key] = float(v)
                     except ValueError:
                         pass
-            # outstanding un-prefilled prompt tokens when the endpoint
-            # fired (ISSUE 15 satellite — the exact measurement ROADMAP's
-            # incremental-streaming-prefill item gates on): a speculative
-            # hit/commit means the whole prompt was prefilled BEFORE the
-            # endpoint (0 outstanding); otherwise everything the KV cache
-            # did not absorb still had to be computed after end-of-speech
-            pt = state.stages.get("prompt_tokens")
-            if pt is not None:
-                remaining = 0.0 if spec_pre_parsed else max(
-                    0.0, pt - state.stages.get("cached_tokens", 0.0))
-                get_metrics().set_gauge("engine.prefill_remaining_at_endpoint",
-                                        remaining)
             # healthy parses must feed the quality windows too — recording
             # only the fallback path would peg the degraded-rate window at
             # 1.0 forever after one transient blip
@@ -589,6 +748,14 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                                text=text)
         if degraded:
             state.stages["degraded"] = True
+        # outstanding un-prefilled prompt tokens when the endpoint fired —
+        # recorded for EVERY utterance (ISSUE 19 satellite: the old gauge
+        # only fired on non-degraded engine parses that returned the
+        # prompt-tokens header, under-reporting exactly the cold utterances
+        # the streaming-prefill work targets); see _prefill_remaining
+        get_metrics().set_gauge("engine.prefill_remaining_at_endpoint",
+                                _prefill_remaining(state.stages,
+                                                   spec_pre_parsed, degraded))
         slo.record(state.stages.get("stt_finalize_ms", 0.0) + state.stages["parse_ms"],
                    ok=True)
         state.slo_open_t0 = None
@@ -690,6 +857,9 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
         ws = web.WebSocketResponse(max_msg_size=8 * 1024 * 1024)
         await ws.prepare(req)
         state = ClientState(cfg.stt_factory())
+        if feed_enable:
+            state.feed_tracker = _PrefixFeedTracker(k=feed_k,
+                                                    min_chars=feed_min_chars)
         live_sessions["n"] += 1
         get_metrics().set_gauge("voice.live_sessions", live_sessions["n"])
         try:
@@ -772,6 +942,15 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                         for kind, text in events:
                             if kind == "partial":
                                 await send(ws, "transcript_partial", text=text)
+                                if state.feed_tracker is not None:
+                                    # ISSUE 19: a prefix that survived K
+                                    # partials streams to the brain as a
+                                    # prefill-only feed while the user is
+                                    # still speaking
+                                    commit = state.feed_tracker.observe(text)
+                                    if commit:
+                                        await feed_prefix_send(state, commit,
+                                                               http)
                             elif kind == "spec_final":
                                 # speaker paused: parse the provisional
                                 # transcript while the endpoint window runs out
@@ -791,6 +970,15 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                                     stt_finalize_ms=round((t_feed1 - t_feed0) * 1e3, 3),
                                 )
                                 state.utt_t0 = None
+                                if state.feed_tracker is not None:
+                                    # utterance over: the next partial
+                                    # stream is fresh text, and a feed
+                                    # still in flight would only race the
+                                    # real parse for engine time (its
+                                    # already-committed chains stay as
+                                    # cache the parse is about to hit)
+                                    state.feed_tracker.reset()
+                                    state.drop_feed()
                                 # STT confidence rides the transcript_final
                                 # event (ISSUE 15): the streaming wrapper
                                 # published this final's full result —
@@ -823,6 +1011,11 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                             state.context.update(ctrl.get("data") or {})
                             # an in-flight speculative parse saw the OLD context
                             state.drop_spec()
+                            # so did an in-flight prefix feed — its prompt
+                            # rendered the stale context dict (ISSUE 19)
+                            state.drop_feed()
+                            if state.feed_tracker is not None:
+                                state.feed_tracker.reset()
                             await send(ws, "info", message="context updated")
                         elif ctype == "tenant":
                             # QoS lane tag (ISSUE 18): rides every /parse
@@ -868,6 +1061,9 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                             state.utt_t0 = None
                             state.slo_open_t0 = None
                             state.drop_spec()
+                            state.drop_feed()
+                            if state.feed_tracker is not None:
+                                state.feed_tracker.reset()
                             await send(ws, "info", message="state reset")
                         else:
                             await send(ws, "warn", message=f"unknown control type {ctype!r}")
@@ -875,6 +1071,7 @@ def build_app(cfg: VoiceConfig | None = None, tracer: Tracer | None = None) -> w
                         break
             finally:
                 state.drop_spec()
+                state.drop_feed()  # WS teardown reaps the in-flight feed
                 closer = getattr(state.stt, "close", None)
                 if closer is not None:
                     closer()  # batched plane: free the utterance's slot

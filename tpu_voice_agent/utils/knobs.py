@@ -197,6 +197,12 @@ declare("VOICE_SPEC_SILENCE_MS", "120", "silence before a speculative parse fire
 declare("VOICE_EARLY_CLOSE_MS", "240", "extra silence before the endpoint closes early on a spec hit", table=PERF)
 declare("VOICE_RESPEC_AFTER", "25", "transcript-growth chars that restart an in-flight speculation", table=PERF)
 
+# incremental streaming prefill (ISSUE 19): prefix feeds + chunked prefill
+declare("PREFIX_FEED_ENABLE", None, "1 streams stabilized STT partial prefixes to the brain as prefill-only feeds (unset = off, every touched path token-identical)", table=PERF)
+declare("PREFIX_FEED_STABLE_K", "3", "consecutive partials a transcript prefix must survive before it is fed", table=PERF)
+declare("PREFIX_FEED_MIN_CHARS", "8", "minimum committed-prefix growth (chars) before another feed fires", table=PERF)
+declare("PREFILL_CHUNK_TOKENS", None, "split prompt admissions into this many-token prefill chunks interleaved with decode chunks (unset = one-shot barrier prefill, byte-identical path)", table=PERF)
+
 # ========================================================= observability
 # docs/OBSERVABILITY.md — SLO tracker, step ledger, sentinel, HBM ledger,
 # flight recorder, trace sinks
