@@ -1,0 +1,377 @@
+"""Prefill/decode disaggregation bench (ISSUE 20): three bars.
+
+- decode isolation: the worst single scheduler-step wall a decoding
+  victim sees while a long cold prompt is admitted. Colocated, the
+  barrier admission's step CONTAINS the whole bucket-padded prefill
+  forward; disaggregated, the prefill ran on a pool replica whose step
+  latency nobody awaits and the decode home only pays adopt + tail
+  prefill — its worst wall must be >= 3x better, token-identically.
+- capacity at SLO: binary-search the largest number of concurrent long
+  cold admissions a decode replica absorbs while its victim's worst
+  step wall holds an SLO derived from the disaggregated admission cost.
+  Disaggregated capacity must be >= colocated (ratio >= 1.0x).
+- the prefill-kill drill, over real HTTP: a disaggregated router stack
+  (decode replica + prefill replica) serves a long cold parse while
+  ``prefill_replica_kill`` drops the KV stream mid-flight — the parse
+  must still answer 200 with the SAME body as a plain stack, the
+  fallback must be counted, and BOTH engines must end block-balanced
+  (zero leaks on either side of the torn stream).
+
+Writes ``bench_artifacts/BENCH_disagg_<ts>.json`` with a ``disagg``
+section merged into run_all's combined artifact. Tiny model, CPU-sized
+(BENCH_DISAGG_* trims), so it rides ``--quick``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import _ROOT, emit, log, percentile  # noqa: E402
+
+BUCKETS = (128, 256, 512, 1024, 2048)
+
+
+def _post(url: str, body: dict, timeout_s: float = 60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return (resp.status, dict(resp.headers),
+                json.loads(resp.read().decode()))
+
+
+def _engine(slots: int = 2):
+    from tpu_voice_agent.serve import PagedDecodeEngine
+    from tpu_voice_agent.services.brain import install_prompt_prefix
+
+    eng = PagedDecodeEngine(preset="test-tiny", max_len=2048,
+                            batch_slots=slots, prefill_buckets=BUCKETS,
+                            radix_enable=True)
+    install_prompt_prefix(eng)
+    return eng
+
+
+def _long_text(i: int, words: int) -> str:
+    verbs = ["search for", "filter", "sort", "compare", "summarize"]
+    items = ["wireless noise cancelling headphones", "mechanical keyboards",
+             "ultrawide monitors", "ergonomic office chairs",
+             "portable solar chargers"]
+    parts: list[str] = []
+    j = 0
+    while sum(len(p.split()) for p in parts) < words:
+        parts.append(f"{verbs[(i + j) % len(verbs)]} "
+                     f"{items[(i * 3 + j) % len(items)]} under "
+                     f"{100 + 10 * ((i + j) % 7)} dollars then")
+        j += 1
+    return " ".join(" ".join(parts).split()[:words])
+
+
+def _prewarm(pf_eng, dec_eng, prompt: str) -> int:
+    """Stream ``prompt``'s chain from the prefill engine into the decode
+    engine's radix (prefill_export -> StreamAdopter), exactly the wire the
+    router pumps. Returns adopted tokens (0 = nothing warmed)."""
+    from tpu_voice_agent.serve import handoff
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+
+    blobs: list[bytes] = []
+    out = ContinuousBatcher(pf_eng, chunk_steps=8,
+                            max_new_tokens=4).prefill_export(
+        prompt, stream_blocks=2, emit=blobs.append)
+    if not out.get("ok") or not blobs:
+        return 0
+    ad = handoff.StreamAdopter(dec_eng)
+    try:
+        for blob in blobs:
+            ad.feed(blob)
+        r = ad.feed(handoff.pack_kv_end(None, {"ok": True}))
+        return int(r.get("adopted_tokens", 0))
+    except ValueError:
+        return 0
+
+
+def _admit_run(eng, victim: str, aggressors: list[str], max_new: int):
+    """Victim decodes for two chunks, then every aggressor is submitted;
+    returns ([victim result, *aggressor results], step walls from the
+    first aggressor submit to the drain)."""
+    from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+
+    b = ContinuousBatcher(eng, chunk_steps=8, max_new_tokens=max_new)
+    rid_v = b.submit(victim)
+    b.step()
+    b.step()
+    rids = [b.submit(a) for a in aggressors]
+    walls: list[float] = []
+    while b.pending or any(s.request_id >= 0 for s in b.slots):
+        t0 = time.perf_counter()
+        b.step()
+        walls.append((time.perf_counter() - t0) * 1e3)
+    return [b.results[rid_v]] + [b.results[r] for r in rids], walls
+
+
+def _balanced(eng) -> bool:
+    pb = len(eng._prefix_blocks[0])
+    nodes = eng.radix[0].nodes
+    return eng.allocator.blocks_in_use == pb + (nodes - pb)
+
+
+def isolation_section(rounds: int, words: int, max_new: int,
+                      failures: list[str]) -> dict:
+    """Plane 1: worst decode-step wall while admitting, colocated barrier
+    vs disaggregated prewarmed — token-identical."""
+    from tpu_voice_agent.services.prompts import render_prompt
+
+    os.environ.pop("PREFILL_CHUNK_TOKENS", None)
+    colo, pf, dec = _engine(), _engine(), _engine()
+    victim = render_prompt("take a screenshot of this page", {})
+
+    # warmup: compile the barrier bucket, the chunk forward, the adopt
+    # scatter, and the decode loop outside the timed rounds
+    w = render_prompt(_long_text(90, words), {})
+    _admit_run(colo, victim, [w], 4)
+    _prewarm(pf, dec, w)
+    _admit_run(dec, victim, [w], 4)
+
+    colo_walls: list[float] = []
+    disagg_walls: list[float] = []
+    identical = True
+    warmed = 0
+    for i in range(rounds):
+        agg = render_prompt(_long_text(i, words), {})
+        colo_res, walls = _admit_run(colo, victim, [agg], max_new)
+        colo_walls.append(max(walls))
+        warmed += 1 if _prewarm(pf, dec, agg) > 0 else 0
+        dis_res, walls = _admit_run(dec, victim, [agg], max_new)
+        disagg_walls.append(max(walls))
+        if [r.token_ids for r in colo_res] != [r.token_ids for r in dis_res]:
+            identical = False
+    colo_worst = percentile(colo_walls, 50)
+    disagg_worst = percentile(disagg_walls, 50)
+    ratio = colo_worst / disagg_worst if disagg_worst > 0 else 0.0
+    log(f"[isolation] worst step while admitting: colocated barrier "
+        f"{colo_worst:.1f} ms vs disagg prewarmed {disagg_worst:.1f} ms -> "
+        f"{ratio:.2f}x (bar >= 3x); prewarmed {warmed}/{rounds} rounds, "
+        f"token_identical={identical}")
+    if not identical:
+        failures.append("disaggregated outputs diverged from colocated")
+    if warmed < rounds:
+        failures.append(f"only {warmed}/{rounds} rounds prewarmed — the "
+                        "KV stream is not landing")
+    if ratio < 3.0:
+        failures.append(f"isolation ratio {ratio:.2f}x < 3x — the decode "
+                        "replica still pays the barrier prefill")
+    if not (_balanced(colo) and _balanced(pf) and _balanced(dec)):
+        failures.append("isolation engines ended block-unbalanced")
+    return {"colocated_worst_step_ms": round(colo_worst, 3),
+            "disagg_worst_step_ms": round(disagg_worst, 3),
+            "isolation_ratio": round(ratio, 3),
+            "token_identical": identical,
+            "slo_seed_ms": disagg_worst}
+
+
+def capacity_section(max_n: int, words: int, max_new: int, slo_seed_ms: float,
+                     failures: list[str]) -> dict:
+    """Plane 2: binary-search capacity-at-SLO. The SLO is what the
+    disaggregated single-admission wall comfortably holds (2x plane 1's
+    median, floored) — the colocated stack must then absorb FEWER
+    concurrent cold admissions before a victim step blows through it."""
+    from tpu_voice_agent.services.prompts import render_prompt
+
+    os.environ.pop("PREFILL_CHUNK_TOKENS", None)
+    slo_ms = max(10.0, 2.0 * slo_seed_ms)
+    victim = render_prompt("scroll down", {})
+    colo, pf, dec = _engine(max_n + 1), _engine(), _engine(max_n + 1)
+    # warmup the new batch width on both stacks
+    w = render_prompt(_long_text(80, words), {})
+    _admit_run(colo, victim, [w], 4)
+    _prewarm(pf, dec, w)
+    _admit_run(dec, victim, [w], 4)
+
+    trial = [0]
+
+    def holds(mode: str, n: int) -> bool:
+        trial[0] += 1
+        aggs = [render_prompt(_long_text(100 * trial[0] + j, words), {})
+                for j in range(n)]
+        eng = colo if mode == "colo" else dec
+        if mode == "disagg":
+            for a in aggs:
+                _prewarm(pf, dec, a)
+        res, walls = _admit_run(eng, victim, aggs, max_new)
+        if any(r.error for r in res):
+            return False
+        return max(walls) <= slo_ms
+
+    def capacity(mode: str) -> int:
+        lo, hi = 0, max_n  # invariant: holds(lo), not holds(hi+1)-ish
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if holds(mode, mid):
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    cap_colo = capacity("colo")
+    cap_disagg = capacity("disagg")
+    ratio = cap_disagg / cap_colo if cap_colo > 0 else float(cap_disagg)
+    log(f"[capacity] admissions held at SLO {slo_ms:.1f} ms: colocated "
+        f"{cap_colo} vs disagg {cap_disagg} (of {max_n} max) -> "
+        f"{ratio:.2f}x (bar >= 1x)")
+    if cap_disagg < cap_colo:
+        failures.append(f"disagg capacity {cap_disagg} < colocated "
+                        f"{cap_colo} at the same SLO")
+    if cap_disagg == 0:
+        failures.append("disagg held ZERO admissions at its own SLO")
+    return {"slo_ms": round(slo_ms, 3), "max_n": max_n,
+            "capacity_colocated": cap_colo, "capacity_disagg": cap_disagg,
+            "capacity_ratio": round(ratio, 3)}
+
+
+def kill_drill_section(words: int, failures: list[str]) -> dict:
+    """Plane 3: the chaos drill over real HTTP. A disaggregated stack's
+    prefill replica dies mid-KV-stream; the parse must answer 200 with
+    the same body a plain stack produces, the fallback must be counted,
+    both engines must end balanced."""
+    from tests.http_helper import AppServer
+    from tpu_voice_agent.services.brain import BatchedEngineParser
+    from tpu_voice_agent.services.brain import build_app as build_brain
+    from tpu_voice_agent.services.router import BrainRouter
+    from tpu_voice_agent.services.router import build_app as build_router
+    from tpu_voice_agent.utils import chaos, get_metrics
+
+    text = _long_text(7, words)
+
+    # the control body: the same parse through a plain one-replica stack
+    ctrl_parser = BatchedEngineParser(_engine(), chunk_steps=8,
+                                      session_aware=True)
+    ctrl_rep = AppServer(build_brain(ctrl_parser, max_inflight=4)).__enter__()
+    ctrl_robj = BrainRouter([ctrl_rep.url], probe_s=0.2)
+    ctrl_router = AppServer(build_router(ctrl_robj)).__enter__()
+    try:
+        st, _h, ctrl_body = _post(ctrl_router.url + "/parse",
+                                  {"text": text, "session_id": "drill",
+                                   "context": {}})
+        assert st == 200
+    finally:
+        ctrl_router.__exit__(None, None, None)
+        ctrl_rep.__exit__(None, None, None)
+        ctrl_parser.close()
+
+    dec_parser = BatchedEngineParser(_engine(), chunk_steps=8,
+                                     session_aware=True)
+    pf_parser = BatchedEngineParser(_engine(), chunk_steps=8,
+                                    session_aware=True)
+    dec_rep = AppServer(build_brain(dec_parser, max_inflight=4)).__enter__()
+    pf_rep = AppServer(build_brain(pf_parser, max_inflight=4)).__enter__()
+    robj = BrainRouter([dec_rep.url, pf_rep.url + "#prefill"], disagg=True,
+                       disagg_min_tokens=16, disagg_stream_blocks=1,
+                       probe_s=0.2)
+    router = AppServer(build_router(robj)).__enter__()
+    c0 = get_metrics().snapshot()["counters"]
+    chaos.configure("prefill_replica_kill@2")  # die before frame write #2
+    try:
+        st, _h, body = _post(router.url + "/parse",
+                             {"text": text, "session_id": "drill",
+                              "context": {}})
+        errors = 0 if st == 200 else 1
+        c1 = get_metrics().snapshot()["counters"]
+        fired = c1.get("chaos.prefill_replica_kill", 0) \
+            - c0.get("chaos.prefill_replica_kill", 0)
+        fallbacks = c1.get("disagg.fallbacks", 0) \
+            - c0.get("disagg.fallbacks", 0)
+        admissions = c1.get("disagg.admissions", 0) \
+            - c0.get("disagg.admissions", 0)
+        identical = body == ctrl_body
+        # both sides settled synchronously (the parse already returned):
+        # balance is checkable immediately
+        dec_ok = _balanced(dec_parser.engine)
+        pf_ok = _balanced(pf_parser.engine)
+        log(f"[kill] prefill_replica_kill mid-stream: status={st} "
+            f"fired={fired:.0f} admissions={admissions:.0f} "
+            f"fallbacks={fallbacks:.0f} token_identical={identical} "
+            f"balanced dec={dec_ok} pf={pf_ok}")
+        if errors:
+            failures.append(f"kill drill parse answered {st}, not 200")
+        if fired < 1:
+            failures.append("chaos point never fired — the drill measured "
+                            "nothing")
+        if admissions < 1:
+            failures.append("long cold parse never took the disagg "
+                            "admission path")
+        if fallbacks < 1:
+            failures.append("prefill death was not counted as a "
+                            "disagg.fallback")
+        if not identical:
+            failures.append("kill-drill parse body diverged from the "
+                            "plain stack")
+        if not (dec_ok and pf_ok):
+            failures.append("kill drill leaked blocks "
+                            f"(decode balanced={dec_ok}, "
+                            f"prefill balanced={pf_ok})")
+        return {"status": st, "chaos_fired": int(fired),
+                "admissions": int(admissions), "fallbacks": int(fallbacks),
+                "token_identical": identical,
+                "decode_balanced": dec_ok, "prefill_balanced": pf_ok}
+    finally:
+        chaos.reset()
+        router.__exit__(None, None, None)
+        for r in (dec_rep, pf_rep):
+            try:
+                r.__exit__(None, None, None)
+            except Exception:
+                pass
+        dec_parser.close()
+        pf_parser.close()
+
+
+def main() -> None:
+    rounds = int(os.environ.get("BENCH_DISAGG_ROUNDS", "3"))
+    words = int(os.environ.get("BENCH_DISAGG_PROMPT_WORDS", "120"))
+    max_new = int(os.environ.get("BENCH_DISAGG_TOKENS", "24"))
+    max_n = int(os.environ.get("BENCH_DISAGG_MAX_N", "3"))
+
+    failures: list[str] = []
+    iso = isolation_section(rounds, words, max_new, failures)
+    cap = capacity_section(max_n, words, max_new, iso.pop("slo_seed_ms"),
+                           failures)
+    kill = kill_drill_section(words, failures)
+
+    emit("disagg_isolation_ratio", iso["isolation_ratio"], "x")
+    emit("disagg_capacity_ratio", cap["capacity_ratio"], "x")
+    emit("disagg_colocated_worst_step_ms", iso["colocated_worst_step_ms"],
+         "ms")
+    emit("disagg_worst_step_ms", iso["disagg_worst_step_ms"], "ms")
+
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    art_dir = Path(_ROOT) / "bench_artifacts"
+    art_dir.mkdir(exist_ok=True)
+    art = art_dir / f"BENCH_disagg_{stamp}.json"
+    art.write_text(json.dumps({
+        "bench": "bench_disagg",
+        "config": {"rounds": rounds, "prompt_words": words,
+                   "max_new_tokens": max_new, "max_n": max_n},
+        "rows": [
+            {"metric": "disagg_isolation_ratio",
+             "value": iso["isolation_ratio"]},
+            {"metric": "disagg_capacity_ratio",
+             "value": cap["capacity_ratio"]},
+        ],
+        "disagg": {**iso, **cap, "kill_drill": kill},
+    }, indent=1))
+    log(f"artifact: {art}")
+
+    for f in failures:
+        log(f"FAIL: {f}")
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,8 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
            "bench_radix.py", "bench_swarm.py", "bench_chaos.py",
            "bench_steplog.py", "bench_router.py", "bench_handoff.py",
            "bench_fleet.py", "bench_autopilot.py", "bench_cost.py",
-           "bench_tenancy.py", "bench_streaming_prefill.py"]
+           "bench_tenancy.py", "bench_streaming_prefill.py",
+           "bench_disagg.py"]
 # --quick: the fast subset (quality rows always run — they skip cleanly
 # when no checkpoint is configured; the heavy latency benches are dropped;
 # the fault drill stays — it is service-level, no model, seconds on CPU;
@@ -81,13 +82,19 @@ BENCHES = ["bench_batch.py", "bench_stt.py", "bench_grounding.py",
 # CPU), and a PR that breaks chunked-admission batch-mate isolation or
 # lets prefix feeds stop collapsing the endpoint's prefill debt must
 # fail the quick table as well
+# the disagg bench stays on --quick too — it is the prefill/decode-
+# disaggregation regression gate (tiny engines, trimmed rounds and a
+# fixed small capacity search, ~minutes on CPU), and a PR that makes the
+# decode pool pay barrier prefills again, breaks KV-stream token
+# identity, or leaks blocks on the prefill-kill drill must fail the
+# quick table as well
 QUICK_BENCHES = ["bench_quality.py", "bench_quality_online.py",
                  "bench_faults.py", "bench_spec.py",
                  "bench_stt.py", "bench_radix.py", "bench_swarm.py",
                  "bench_chaos.py", "bench_steplog.py", "bench_router.py",
                  "bench_handoff.py", "bench_fleet.py", "bench_autopilot.py",
                  "bench_cost.py", "bench_tenancy.py",
-                 "bench_streaming_prefill.py"]
+                 "bench_streaming_prefill.py", "bench_disagg.py"]
 # env trims applied on --quick only when the operator has not pinned them
 QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_QO_MAX_N": "4", "BENCH_QO_UTTERANCES": "2",
@@ -111,7 +118,9 @@ QUICK_ENV = {"EVAL_BACKEND": "rule",
              "BENCH_TENANCY_PREMIUM_N": "3", "BENCH_TENANCY_ABUSE_N": "3",
              "BENCH_TENANCY_UTTERANCES": "2",
              "BENCH_SPF_ROUNDS": "2", "BENCH_SPF_UTTERANCES": "2",
-             "BENCH_SPF_TOKENS": "16"}
+             "BENCH_SPF_TOKENS": "16",
+             "BENCH_DISAGG_ROUNDS": "2", "BENCH_DISAGG_TOKENS": "16",
+             "BENCH_DISAGG_MAX_N": "2"}
 
 
 def _parse_rows(stdout: str) -> list[dict]:
@@ -204,7 +213,7 @@ def main() -> None:
                             "steplog", "engine_step", "xla", "hbm",
                             "router", "kv_quant", "handoff", "fleet",
                             "quality", "autopilot", "cost", "tenancy",
-                            "prefill"):
+                            "prefill", "disagg"):
                     if key in body:
                         entry[key] = body[key]
         summary["benches"][name] = entry

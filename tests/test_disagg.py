@@ -1,0 +1,296 @@
+"""Prefill/decode disaggregation (ISSUE 20) — FAST tier.
+
+Three planes, bottom-up:
+
+- the multi-part frame wire (sequence-numbered, CRC-checked, torn-tail
+  tolerant) shared by the warm re-home blob and the KV stream
+- the KV stream itself: a prefill engine's ``prefill_export`` feeding a
+  decode engine's ``StreamAdopter`` must leave the decode side serving
+  TOKEN-IDENTICAL output from adopted cache, and every failure (death
+  mid-stream, tier mismatch) must close clean-or-cold with balanced
+  block accounting on both engines
+- router placement: ``url#role`` tags and ``ROUTER_PREFILL_REPLICAS``
+  build the pools, sticky placement excludes prefill members (with the
+  degraded-beats-error fallback), and ``ROUTER_DISAGG`` unset leaves
+  every touched structure byte-identical to the pre-disagg build
+"""
+
+import pytest
+
+from tpu_voice_agent.serve import PagedDecodeEngine
+from tpu_voice_agent.serve import handoff
+from tpu_voice_agent.serve.scheduler import ContinuousBatcher
+from tpu_voice_agent.services.brain import install_prompt_prefix
+from tpu_voice_agent.services.prompts import render_prompt
+from tpu_voice_agent.services.router import BrainRouter
+from tpu_voice_agent.utils import get_metrics
+
+BUCKETS = (128, 256, 512, 1024, 2048)
+
+PROMPT_TEXT = ("search for wireless noise cancelling headphones under two "
+               "hundred dollars and sort the results by customer rating "
+               "then open the second result and add it to the cart")
+
+
+def _counters():
+    return get_metrics().snapshot()["counters"]
+
+
+def _paged(kv_quant=None, **kw):
+    eng = PagedDecodeEngine(preset="test-tiny", max_len=2048, batch_slots=2,
+                            prefill_buckets=BUCKETS, radix_enable=True,
+                            kv_quant=kv_quant, **kw)
+    install_prompt_prefix(eng)
+    return eng
+
+
+def _assert_balanced(eng):
+    pb = len(eng._prefix_blocks[0])
+    nodes = eng.radix[0].nodes
+    assert eng.allocator.blocks_in_use == pb + (nodes - pb)
+
+
+def _prompt(_eng=None):
+    """A prompt long enough to stream several chunks past the pinned
+    prefix (the interesting disagg case): a fat context payload stands in
+    for a long cold transcript."""
+    ctx = {"last_query": "usb c hub", "page": "results",
+           "history": [f"step {i}: compared item number {i} against the "
+                       "shortlist and kept the cheaper one"
+                       for i in range(12)]}
+    return render_prompt(PROMPT_TEXT, ctx)
+
+
+# ------------------------------------------------------------- frame wire
+
+
+def test_frame_roundtrip_incremental_and_torn_tail():
+    payloads = [b"alpha", b"", b"x" * 3000]
+    wire = b"".join(handoff.frame_pack(i, p, final=(i == 2))
+                    for i, p in enumerate(payloads))
+    # feed byte-at-a-time: frames pop exactly when complete, the partial
+    # tail is never an error
+    buf, got = b"", []
+    for i in range(len(wire)):
+        buf += wire[i:i + 1]
+        frames, buf = handoff.frame_feed(buf)
+        got.extend(frames)
+    assert buf == b""
+    assert [(s, p) for s, p, _ in got] == list(enumerate(payloads))
+    assert [f for _, _, f in got] == [False, False, True]
+    # a torn tail (mid-frame cut) stays pending, no frames lost before it
+    frames, rest = handoff.frame_feed(wire[:-4])
+    assert len(frames) == 2  # the third frame is incomplete, not an error
+    assert rest != b"" and wire.endswith(rest + wire[-4:])
+
+
+def test_frame_corruption_raises():
+    good = handoff.frame_pack(0, b"payload", final=True)
+    with pytest.raises(ValueError, match="magic"):
+        handoff.frame_feed(b"XXXXXX" + good[6:])
+    flipped = bytearray(good)
+    flipped[-1] ^= 0xFF  # corrupt payload byte -> CRC mismatch
+    with pytest.raises(ValueError, match="CRC"):
+        handoff.frame_feed(bytes(flipped))
+
+
+def test_deframe_rejects_reorder_truncation_and_bad_final():
+    blob = bytes(range(256)) * 20
+    parts = handoff.frame_split(blob, 1000)
+    assert len(parts) > 3
+    assert handoff.deframe(b"".join(parts)) == blob
+    # reordered parts: sequence numbers expose the swap
+    swapped = parts[:]
+    swapped[0], swapped[1] = swapped[1], swapped[0]
+    with pytest.raises(ValueError, match="out of order"):
+        handoff.deframe(b"".join(swapped))
+    # truncated body: a torn tail must not reassemble
+    with pytest.raises(ValueError, match="torn tail"):
+        handoff.deframe(b"".join(parts)[:-3])
+    # FINAL frame missing entirely (stream cut between frames)
+    with pytest.raises(ValueError, match="FINAL"):
+        handoff.deframe(b"".join(parts[:-1]))
+    with pytest.raises(ValueError, match="no handoff frames"):
+        handoff.deframe(b"")
+
+
+# --------------------------------------------------------- the KV stream
+
+
+def test_export_stream_adopt_token_identical():
+    """THE disagg differential: a prefill engine exports the chain in
+    streamed segments, a decode engine adopts them, and the decode-side
+    parse is token-identical to a cold control — served from adopted KV
+    (cached_tokens covers the streamed chain), blocks balanced on BOTH
+    engines."""
+    pf, dec, control = _paged(), _paged(), _paged()
+    prompt = _prompt(pf)
+    blobs = []
+    pf_batcher = ContinuousBatcher(pf, chunk_steps=16, max_new_tokens=8)
+    out = pf_batcher.prefill_export(prompt, stream_blocks=2,
+                                    emit=blobs.append, stream_id="s1")
+    assert out["ok"], out
+    assert out["segments"] == len(blobs) >= 2  # chunk-pipelined, not 1-shot
+    assert out["chain_tokens"] > len(pf.prefix_ids)
+    _assert_balanced(pf)  # exporter committed its own radix copy, no leak
+
+    ad = handoff.StreamAdopter(dec)
+    for blob in blobs:
+        r = ad.feed(blob)
+        assert r["ok"] and not r["final"]
+    adopted = ad.feed(handoff.pack_kv_end("s1", {"ok": True}))
+    assert adopted["final"] and adopted["adopted_tokens"] > 0
+    assert dec.radix[0].cached_tokens(
+        dec.tokenizer.encode(prompt, bos=True)) \
+        >= adopted["adopted_tokens"]
+    _assert_balanced(dec)
+
+    run = ContinuousBatcher(dec, chunk_steps=16, max_new_tokens=24)
+    moved = run.generate_many([prompt])[0]
+    cold = ContinuousBatcher(control, chunk_steps=16,
+                             max_new_tokens=24).generate_many([prompt])[0]
+    assert moved.error is None and cold.error is None
+    assert moved.token_ids == cold.token_ids
+    assert moved.cached_tokens >= adopted["adopted_tokens"]  # KV was SERVED
+    _assert_balanced(dec)
+
+
+def test_mid_stream_death_partial_adopt_clean_or_cold():
+    """The prefill replica dies mid-stream (only some segments arrived):
+    abandon commits the partial frontier as ordinary warm cache, frees
+    every ref (zero leaks), and the decode-side parse is still
+    token-identical to cold."""
+    pf, dec, control = _paged(), _paged(), _paged()
+    prompt = _prompt(pf)
+    blobs = []
+    ContinuousBatcher(pf, chunk_steps=16, max_new_tokens=8).prefill_export(
+        prompt, stream_blocks=1, emit=blobs.append)
+    assert len(blobs) >= 2
+    before = _counters().get("disagg.streams_aborted", 0)
+    ad = handoff.StreamAdopter(dec)
+    ad.feed(blobs[0])  # only the first segment lands, then the wire dies
+    assert ad.abandon() == 0
+    assert _counters().get("disagg.streams_aborted", 0) == before + 1
+    _assert_balanced(dec)  # partial chain is tree-owned or freed, no limbo
+    moved = ContinuousBatcher(dec, chunk_steps=16,
+                              max_new_tokens=24).generate_many([prompt])[0]
+    cold = ContinuousBatcher(control, chunk_steps=16,
+                             max_new_tokens=24).generate_many([prompt])[0]
+    assert moved.token_ids == cold.token_ids
+    _assert_balanced(dec)
+    # a closed adopter refuses further feeds (late frames after the kill)
+    with pytest.raises(ValueError):
+        ad.feed(blobs[1])
+
+
+def test_tier_mismatch_stream_aborts_clean():
+    """Donor int8, decode-side bf16: the first segment is incompatible —
+    the adopter self-abandons, raises for the caller's fallback, and the
+    decode engine stays balanced and cold-correct."""
+    pf, dec = _paged("int8"), _paged(None)
+    prompt = _prompt(pf)
+    blobs = []
+    ContinuousBatcher(pf, chunk_steps=16, max_new_tokens=8).prefill_export(
+        prompt, stream_blocks=2, emit=blobs.append)
+    assert blobs
+    ad = handoff.StreamAdopter(dec)
+    with pytest.raises(ValueError, match="incompatible"):
+        ad.feed(blobs[0])
+    assert ad.closed
+    _assert_balanced(dec)
+    r = ContinuousBatcher(dec, chunk_steps=16,
+                          max_new_tokens=16).generate_many([prompt])[0]
+    assert r.error is None
+
+
+def test_out_of_order_segment_aborts():
+    """A skipped segment (start_block ahead of the frontier) must abort:
+    adopting a gapped chain would serve wrong KV."""
+    pf, dec = _paged(), _paged()
+    prompt = _prompt(pf)
+    blobs = []
+    ContinuousBatcher(pf, chunk_steps=16, max_new_tokens=8).prefill_export(
+        prompt, stream_blocks=1, emit=blobs.append)
+    assert len(blobs) >= 2
+    ad = handoff.StreamAdopter(dec)
+    with pytest.raises(ValueError, match="incompatible|out of order"):
+        ad.feed(blobs[1])  # second segment first
+    _assert_balanced(dec)
+
+
+# ------------------------------------------------------- router placement
+
+
+def test_role_tags_and_prefill_env_build_the_pools(monkeypatch):
+    monkeypatch.setenv("ROUTER_PREFILL_REPLICAS", "http://pf2,http://pf2")
+    r = BrainRouter(["http://d0", "http://pf1#prefill", "http://d1#decode"],
+                    disagg=True)
+    roles = {m.url: m.role for m in r.replicas}
+    assert roles == {"http://d0": "both", "http://pf1": "prefill",
+                     "http://d1": "decode", "http://pf2": "prefill"}
+    assert r.exclude_roles == {"prefill"}
+    # sticky placement never lands on a prefill member
+    for i in range(40):
+        home = r.route(f"sess-{i}")
+        assert home is not None and home.role != "prefill"
+    # the prefill picker only returns prefill members, least-inflight
+    pf = r._pick_prefill(exclude=set())
+    assert pf is not None and pf.role == "prefill"
+    assert r._pick_prefill(exclude={"http://pf1", "http://pf2"}) is None
+
+
+def test_all_prefill_ring_still_serves():
+    """Degraded beats error: if role filtering would empty the ring,
+    every member serves (same contract as all-over-pressure)."""
+    r = BrainRouter(["http://pf1#prefill", "http://pf2#prefill"],
+                    disagg=True)
+    assert r.route("s") is not None
+
+
+def test_probe_role_refines_but_both_never_clears_a_tag():
+    r = BrainRouter(["http://a#prefill", "http://b"], disagg=True)
+    a = r._by_url["http://a"]
+    b = r._by_url["http://b"]
+    # a member that never set BRAIN_ROLE reports the "both" default — it
+    # must NOT clear the router-side tag
+    r.apply_probe(a, True, {"status": "ok", "role": "both"})
+    assert a.role == "prefill"
+    r.apply_probe(b, True, {"status": "ok", "role": "decode"})
+    assert b.role == "decode"
+    r.apply_probe(b, True, {"status": "ok", "role": "prefill"})
+    assert b.role == "prefill"
+
+
+def test_uncached_estimate_cold_sticky_rehomed():
+    r = BrainRouter(["http://d0"], disagg=True)
+    body = {"text": "w" * 400, "context": {}}
+    cold = r._uncached_estimate("s1", body)
+    assert cold >= 100  # ~len/4: a long cold prompt clears the gate
+    # sticky with a warm cache: only the delta plus the new turn counts
+    import httpx
+    r._sessions["s1"] = "http://d0"
+    r._note_session_tokens("s1", "http://d0", httpx.Response(
+        200, headers={"x-prompt-tokens": "600", "x-cached-tokens": "590"}))
+    sticky = r._uncached_estimate("s1", body)
+    assert sticky < cold + 20 and sticky >= 10
+    # re-homed (recorded home differs): the whole transcript re-prefills
+    r._sessions["s1"] = "http://elsewhere"
+    rehomed = r._uncached_estimate("s1", body)
+    assert rehomed >= 600
+
+
+def test_disagg_unset_is_byte_identical():
+    """ROUTER_DISAGG unset: no role exclusion, no session-token tracking,
+    every disagg counter absent/zero, members all report role 'both' —
+    the pre-disagg router, exactly."""
+    import os
+    assert os.environ.get("ROUTER_DISAGG") is None
+    r = BrainRouter(["http://d0", "http://d1"])
+    assert r.disagg is False
+    assert r.exclude_roles == set()
+    assert all(m.role == "both" for m in r.replicas)
+    assert r._session_tokens == {}
+    # describe() carries no role key for "both" members (wire unchanged)
+    assert all("role" not in m.describe() for m in r.replicas)
+    stats = r.disagg_stats()
+    assert stats["enabled"] is False

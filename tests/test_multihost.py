@@ -65,6 +65,47 @@ def test_uneven_hosts_straddling_tp_group_refused():
         multihost_mesh(dp=2, tp=4, devices=fakes)
 
 
+def test_mesh_equivalent_to_make_mesh_single_host():
+    """Single-host degeneracy (ISSUE 20): with every device on one process,
+    multihost_mesh must produce the SAME device grid as parallel.mesh's
+    make_mesh — same axis names, same device at every (dp, tp) coordinate —
+    so call sites can swap one for the other without resharding anything."""
+    from tpu_voice_agent.parallel.mesh import make_mesh
+
+    mh = multihost_mesh(dp=2, tp=4)
+    base = make_mesh(dp=2, tp=4)
+    assert mh.shape == base.shape
+    assert mh.axis_names == base.axis_names
+    assert [d.id for d in mh.devices.flatten()] == \
+        [d.id for d in base.devices.flatten()]
+
+
+def test_mesh_dp_over_hosts_tp_inside_host_layout():
+    """The layout math with 2 fake hosts x 4 devices: dp must cross hosts
+    (one dp row per host, host-pure) and tp must stay inside a host, even
+    when the input device list arrives shuffled."""
+    import random
+
+    class _Dev:  # hashable (Mesh keys on device identity; SimpleNamespace
+        def __init__(self, process_index, id):  # defines __eq__ and is not)
+            self.process_index, self.id = process_index, id
+
+    fakes = [_Dev(h, i) for h in range(2) for i in range(4)]
+    random.Random(7).shuffle(fakes)  # ordering must come from the sort
+    mesh = multihost_mesh(dp=2, tp=4, devices=fakes)
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    grid = mesh.devices
+    # each tp row is host-pure, and dp row h holds host h's devices
+    for h, row in enumerate(grid):
+        assert {d.process_index for d in row} == {h}
+        assert [d.id for d in row] == [0, 1, 2, 3]  # local order kept
+    # dp=4 tp=2 also works: two tp groups per host, still host-pure
+    grid2 = multihost_mesh(dp=4, tp=2, devices=fakes).devices
+    for row in grid2:
+        assert len({d.process_index for d in row}) == 1
+    assert [r[0].process_index for r in grid2] == [0, 0, 1, 1]
+
+
 def test_process_info_shape():
     info = process_info()
     assert info["process_count"] == 1

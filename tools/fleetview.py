@@ -121,14 +121,26 @@ def render_fleet(health: dict, series: dict[str, list[dict]],
         f"fleet: {reps.get('total', len(series))} replicas — "
         f"{reps.get('healthy', '?')} healthy, {reps.get('gray', 0)} gray, "
         f"{reps.get('draining', 0)} draining")
+    dz = health.get("disagg") or {}
+    if dz.get("enabled"):
+        # the per-pool roll-up (ISSUE 20): the disaggregated fleet's
+        # prefill vs decode split, live export queue, KV stream rate
+        pf, dec = dz.get("prefill") or {}, dz.get("decode") or {}
+        lines.append(
+            f"disagg: prefill {pf.get('admitting', 0)}/{pf.get('total', 0)}"
+            f" admitting (queue {pf.get('queue_depth', 0)}), decode "
+            f"{dec.get('admitting', 0)}/{dec.get('total', 0)} admitting, "
+            f"{_fmt(dz.get('streamed_blocks_per_s'))} KV blocks/s")
     details = {d.get("url"): d for d in health.get("replica_detail") or []}
     urls = list(details) or sorted(series)
     for url in urls:
         d = details.get(url, {})
         samples = series.get(url) or []
         lines.append("")
+        role = f"  role={d['role']}" if d.get("role") else ""
         lines.append(
-            f"{url}  [{_status_tag(d)}]  pressure {_fmt(d.get('pressure'))}"
+            f"{url}  [{_status_tag(d)}]{role}"
+            f"  pressure {_fmt(d.get('pressure'))}"
             f"  skew {1e3 * (d.get('clock_skew_s') or 0.0):+.1f}ms")
         rows = signal_rows(samples)
         if not rows:
@@ -169,6 +181,14 @@ def render_autopilot(desc: dict) -> str:
         f"{_fmt(b.get('cooldown_remaining_s'))}s")
     if b.get("retiring"):
         lines.append(f"  retiring: {', '.join(b['retiring'])}")
+    p = desc.get("prefill")
+    if p:
+        lines.append(
+            f"autopilot[prefill]: target {p.get('target')} / actual "
+            f"{p.get('actual')} ({p.get('servable')} servable, queue "
+            f"{p.get('queue_depth', 0)}), streaks +{p.get('up_streak', 0)}/"
+            f"-{p.get('down_streak', 0)}, cooldown "
+            f"{_fmt(p.get('cooldown_remaining_s'))}s")
     s = desc.get("stt")
     if s:
         lines.append(
@@ -412,12 +432,18 @@ def self_test() -> int:
              "pressure": 0.2, "clock_skew_s": 0.001},
             {"url": "http://r1", "state": "up", "gray": True,
              "outlier_score": 9.3, "outlier_signal": "parse_ms",
+             "role": "prefill",
              "pressure": 0.3, "clock_skew_s": -0.002},
             {"url": "http://r2", "state": "down", "gray": False,
              "pressure": 0.0, "clock_skew_s": 0.0},
         ],
         "fleet": {"aggregates": {"parse_ms": {
             "median": 10.0, "mad": 0.5, "min": 9.5, "max": 250.0, "n": 3}}},
+        "disagg": {"enabled": True, "min_tokens": 256, "stream_blocks": 4,
+                   "streamed_blocks_per_s": 12.5,
+                   "prefill": {"total": 1, "admitting": 1, "queue_depth": 2,
+                               "urls": ["http://r1"]},
+                   "decode": {"total": 2, "admitting": 2}},
     }
     series = {"http://r0": _synthetic_samples(12, 10.0, 1.0),
               "http://r1": _synthetic_samples(12, 250.0, 5.0),
@@ -426,6 +452,10 @@ def self_test() -> int:
     assert "GRAY" in txt and "score 9.3" in txt and "parse_ms" in txt
     assert "DOWN/EJECTED" in txt and "no timeseries samples" in txt
     assert "fleet aggregates" in txt and "█" in txt
+    # the disagg roll-up (ISSUE 20): per-pool line + per-replica role tag
+    assert "disagg: prefill 1/1 admitting (queue 2)" in txt
+    assert "decode 2/2 admitting" in txt and "KV blocks/s" in txt
+    assert "role=prefill" in txt
     # file mode: a frozen gray flight dump with evidence
     dump = {"frozen": True, "reason": "fleet.gray", "detail": "http://r1",
             "extra": {"fleet": {
@@ -451,6 +481,9 @@ def self_test() -> int:
                       "retiring": ["http://r9"], "min": 1, "max": 4,
                       "load": 1.61, "forecast": 2.05, "up_streak": 1,
                       "down_streak": 0, "cooldown_remaining_s": 0.4},
+            "prefill": {"target": 2, "actual": 1, "servable": 1,
+                        "queue_depth": 3, "up_streak": 2, "down_streak": 0,
+                        "cooldown_remaining_s": 1.5},
             "stt": {"target": 2, "actual": 2, "healthy": 2, "min": 1,
                     "max": 4, "up_streak": 0, "down_streak": 0,
                     "cooldown_remaining_s": 0.0},
@@ -467,6 +500,8 @@ def self_test() -> int:
     assert "target 3 / actual 2" in atxt and "scale_up/forecast" in atxt
     assert "join/prewarmed" in atxt and "adopted=57" in atxt
     assert "autopilot[stt]" in atxt and "retiring: http://r9" in atxt
+    assert "autopilot[prefill]: target 2 / actual 1" in atxt
+    assert "queue 3" in atxt
     assert render_autopilot({"enabled": False}) == "autopilot: not attached"
     assert "join/prewarmed" in render_file(desc)  # saved describe() body
     apdump = {"frozen": True, "reason": "slo.p99", "detail": None,

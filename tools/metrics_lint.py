@@ -260,6 +260,28 @@ PINNED: dict[str, str] = {
     "voice.feeds_sent": "counter",
     "voice.feeds_reaped": "counter",
     "router.feeds_discarded": "counter",
+    # prefill/decode disaggregation (ISSUE 20, services/router.py +
+    # serve/scheduler.py + serve/handoff.py, docs/OBSERVABILITY.md
+    # "Prefill/decode disaggregation"): the admission/fallback pair is
+    # bench_disagg's clean-or-cold evidence, the export/adopt volume
+    # counters witness the KV stream actually moving, and the pool
+    # gauges drive fleetview's per-pool roll-up and the autopilot's
+    # prefill band. Renaming any of these blinds the disagg gates.
+    "disagg.admissions": "counter",
+    "disagg.fallbacks": "counter",
+    "disagg.feeds_routed": "counter",
+    "disagg.spec_routed": "counter",
+    "disagg.frames_streamed": "counter",
+    "disagg.tokens_prewarmed": "counter",
+    "disagg.exports": "counter",
+    "disagg.exports_shed": "counter",
+    "disagg.blocks_streamed": "counter",
+    "disagg.segments_adopted": "counter",
+    "disagg.streams_aborted": "counter",
+    "disagg.prefill_replicas": "gauge",
+    "disagg.decode_replicas": "gauge",
+    "disagg.prefill_queue": "gauge",
+    "autopilot.prefill_target_replicas": "gauge",
 }
 
 

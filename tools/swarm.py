@@ -851,7 +851,8 @@ def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
                       exec_inflight: int = 8, frames_per_final: int = 4,
                       parser=None, chaos_spec: str | None = None,
                       chaos_seed: int = 0, parse_timeout_s: float = 10.0,
-                      brain_replicas: int = 1, router_kw: dict | None = None):
+                      brain_replicas: int = 1, router_kw: dict | None = None,
+                      prefill_replicas: int = 0):
     """voice + brain + executor on real sockets, wired for swarm runs:
     rule-based brain (or the given parser), fake-page executor, ScriptedSTT
     audio path. ``chaos_spec`` arms the in-process deterministic fault
@@ -867,6 +868,11 @@ def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
     then be a zero-arg FACTORY (each replica needs its own instance) or
     None for per-replica rule parsers; ``router_kw`` passes through to
     ``BrainRouter``. The urls dict gains ``router`` and ``replicas`` keys.
+
+    ``prefill_replicas > 0`` (ISSUE 20) boots that many EXTRA brains as a
+    disaggregated prefill pool: their urls reach the router role-tagged
+    (``url#prefill``) and ``disagg=True`` is implied unless ``router_kw``
+    says otherwise. The urls dict gains ``prefill_replicas``.
 
     Returns (urls dict, servers list) — callers __exit__ the servers.
     Shared by benches/bench_swarm.py, benches/bench_chaos.py,
@@ -901,7 +907,15 @@ def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
         replicas = [AppServer(build_brain(make_parser(),
                                           max_inflight=brain_inflight)).__enter__()
                     for _ in range(brain_replicas)]
-        robj = BrainRouter([b.url for b in replicas], **(router_kw or {}))
+        pf_replicas = [AppServer(build_brain(make_parser(),
+                                             max_inflight=brain_inflight)
+                                 ).__enter__()
+                       for _ in range(prefill_replicas)]
+        kw = dict(router_kw or {})
+        if pf_replicas:
+            kw.setdefault("disagg", True)
+        robj = BrainRouter([b.url for b in replicas]
+                           + [b.url + "#prefill" for b in pf_replicas], **kw)
         router = AppServer(build_router(robj)).__enter__()
         # the live router OBJECT rides on its server (ISSUE 16): elastic-
         # capacity drills attach an AutopilotController to it on the
@@ -910,7 +924,9 @@ def build_local_stack(tmp_dir: str, *, brain_inflight: int = 8,
         brain_url = router.url
         urls["router"] = router.url
         urls["replicas"] = [b.url for b in replicas]
-        servers += [router] + replicas
+        if pf_replicas:
+            urls["prefill_replicas"] = [b.url for b in pf_replicas]
+        servers += [router] + replicas + pf_replicas
     else:
         brain = AppServer(build_brain(parser or RuleBasedParser(),
                                       max_inflight=brain_inflight)).__enter__()

@@ -32,6 +32,19 @@ pickle. ``HANDOFF_KV=0`` ships the transcript WITHOUT the KV bytes: the
 measured cold-re-home baseline the handoff bench compares against (same
 token-identical semantics, full re-prefill cost).
 
+Multi-part frames (ISSUE 20): ``frame_pack``/``frame_feed`` wrap any
+payload in a sequence-numbered, CRC-checked frame so a body can travel as
+an INCREMENTAL stream instead of one contiguous blob — the disagg KV
+stream ships one frame per chain segment while later prefill chunks are
+still computing, and ``HANDOFF_FRAMED=1`` ships the warm re-home blob in
+framed parts over the same wire. ``frame_feed`` is torn-tail-tolerant
+(complete frames parse off the front, a partial trailing frame waits for
+more bytes); a corrupt or reordered stream raises ``ValueError``, which
+every adopter maps to the clean cold fallback. ``pack_kv_segment`` +
+``StreamAdopter`` are the two ends of the disagg stream: the prefill
+replica gathers and packs chain segments behind its compute frontier, the
+decode replica adopts them behind its pinned root as they arrive.
+
 Thread contract: ``export_session``/``adopt_session`` touch the engine's
 allocator, pool, and radix tree, so they MUST run on the serving-loop
 thread — ``BatchedEngineParser`` routes them through
@@ -44,12 +57,19 @@ from __future__ import annotations
 import json
 import os
 import struct
+import zlib
 
 import numpy as np
 
 from ..utils import get_metrics
 
 MAGIC = b"TVAH1\x00"
+
+# multi-part frame wire (ISSUE 20): magic + (seq, payload nbytes, flags,
+# crc32(payload)) + payload. FINAL marks the last frame of a stream.
+FRAME_MAGIC = b"TVAF1\x00"
+_FRAME_HDR = struct.Struct(">IIBI")
+FRAME_FINAL = 0x01
 
 
 def _dtype(name: str):
@@ -231,3 +251,236 @@ def adopt_session(engine, transcripts, blob: bytes) -> int:
         return 0
     m.inc("handoff.tokens_adopted", float(chain_tokens))
     return chain_tokens
+
+
+# ------------------------------------------------------------------ frames
+
+
+def frame_pack(seq: int, payload: bytes, final: bool = False) -> bytes:
+    """Wrap one payload in a sequence-numbered, CRC-checked frame."""
+    flags = FRAME_FINAL if final else 0
+    return b"".join([
+        FRAME_MAGIC,
+        _FRAME_HDR.pack(int(seq), len(payload), flags,
+                        zlib.crc32(payload) & 0xFFFFFFFF),
+        payload,
+    ])
+
+
+def frame_feed(buf: bytes) -> tuple[list[tuple[int, bytes, bool]], bytes]:
+    """Incremental frame parser: returns (complete frames as
+    ``(seq, payload, final)``, leftover tail bytes). A partial trailing
+    frame is NOT an error — it stays in the tail for the next feed (torn-
+    tail tolerance). A bad magic or CRC raises ``ValueError``: the stream
+    is corrupt, not merely incomplete."""
+    frames: list[tuple[int, bytes, bool]] = []
+    off = 0
+    hdr = len(FRAME_MAGIC) + _FRAME_HDR.size
+    while len(buf) - off >= hdr:
+        if buf[off:off + len(FRAME_MAGIC)] != FRAME_MAGIC:
+            raise ValueError("not a handoff frame (bad magic)")
+        seq, n, flags, crc = _FRAME_HDR.unpack(
+            buf[off + len(FRAME_MAGIC):off + hdr])
+        if len(buf) - off - hdr < n:
+            break
+        payload = buf[off + hdr:off + hdr + n]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise ValueError(f"handoff frame {seq} fails CRC")
+        frames.append((seq, payload, bool(flags & FRAME_FINAL)))
+        off += hdr + n
+    return frames, buf[off:]
+
+
+def frame_split(blob: bytes, chunk_bytes: int) -> list[bytes]:
+    """One contiguous blob -> framed parts (the ``HANDOFF_FRAMED`` warm
+    re-home wire). The last part carries the FINAL flag."""
+    chunk = max(1, int(chunk_bytes))
+    parts = [blob[i:i + chunk] for i in range(0, len(blob), chunk)] or [b""]
+    return [frame_pack(i, p, final=(i == len(parts) - 1))
+            for i, p in enumerate(parts)]
+
+
+def deframe(body: bytes) -> bytes:
+    """Reassemble a fully-buffered framed body into the original blob.
+    Raises ``ValueError`` on a torn tail, reordered or repeated sequence
+    numbers, or a missing/misplaced FINAL flag — the adopt endpoints map
+    that to the clean cold fallback, never an install of torn bytes."""
+    frames, rest = frame_feed(body)
+    if rest:
+        raise ValueError("handoff frame stream has a torn tail")
+    if not frames:
+        raise ValueError("no handoff frames")
+    for i, (seq, _, _) in enumerate(frames):
+        if seq != i:
+            raise ValueError(f"handoff frames out of order (seq {seq} at "
+                             f"position {i})")
+    if not frames[-1][2] or any(final for _, _, final in frames[:-1]):
+        raise ValueError("handoff frame stream FINAL flag misplaced")
+    return b"".join(payload for _, payload, _ in frames)
+
+
+# ------------------------------------------------------- disagg KV stream
+
+
+def pack_kv_segment(engine, ids: list[int], seg_blocks: list[int],
+                    start_block: int, stream_id: str | None = None) -> bytes:
+    """Gather + pack ONE streamed chain segment (disagg prefill→decode,
+    ISSUE 20): ``seg_blocks`` are in-order pool blocks covering chain
+    positions ``[start_block, start_block + len(seg_blocks))`` of the full
+    block chain for ``ids`` (the pinned static prefix occupies positions
+    ``[0, prefix_blocks)`` and never travels — the decode side's own root
+    covers that span). Must run on the serving-loop thread."""
+    bs = engine.block_size
+    pb = engine._prefix_blocks[0]
+    k, v, ks, vs = engine.gather_chain_kv(seg_blocks)
+    meta = {
+        "v": 1,
+        "kind": "kv_seg",
+        "stream": stream_id,
+        "ids": [int(t) for t in ids],
+        "start_block": int(start_block),
+        "prefix_tokens": len(pb) * bs,
+        "block_size": bs,
+        "kv_quant": getattr(engine, "kv_quant", None) or "off",
+    }
+    arrays = {"k": k, "v": v}
+    if ks is not None:
+        arrays["k_scale"] = ks
+        arrays["v_scale"] = vs
+    return pack(meta, arrays)
+
+
+def pack_kv_end(stream_id: str | None, summary: dict) -> bytes:
+    """The stream's explicit end-of-stream marker: a tiny array-less blob
+    carrying the exporter's totals. Its frame rides the FINAL flag, so a
+    torn stream is distinguishable from a short one."""
+    return pack({"v": 1, "kind": "kv_end", "stream": stream_id,
+                 **summary}, {})
+
+
+class StreamAdopter:
+    """Adopt-behind-the-frontier accumulator for ONE disagg KV stream.
+
+    Each ``feed`` installs one ``kv_seg`` blob's blocks into the pool
+    (``adopt_chain_kv`` scatter — the transfer/scatter work that overlaps
+    the donor's still-running prefill); the radix insert happens ONCE at
+    close, covering whatever frontier actually arrived. Ref discipline:
+    every adopted block keeps OUR allocator ref until close, so mid-stream
+    radix eviction can never free (and the pool can never reuse) a block a
+    later segment extends. Close is always zero-leak: ``finish`` (clean
+    ``kv_end``) and ``abandon`` (torn stream, shed, mismatch) both insert
+    the partial chain best-effort — a shorter warm prefix is still correct
+    cache — then free our refs. Thread contract: every method runs on the
+    serving-loop thread, like adopt_session."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.ids: list[int] | None = None
+        self.blocks: list[int] = []
+        self.closed = False
+
+    @property
+    def tokens(self) -> int:
+        """Warm full-block frontier, pinned prefix included."""
+        pb = self.engine._prefix_blocks[0]
+        return (len(pb) + len(self.blocks)) * self.engine.block_size
+
+    def feed(self, blob: bytes) -> dict:
+        """Install one stream blob. ``kv_seg`` → adopt its blocks behind
+        the current frontier; ``kv_end`` → commit the chain into the radix
+        tree and close. Raises ``ValueError`` on any mismatch AFTER
+        closing itself clean (caller maps it to the cold fallback)."""
+        if self.closed:
+            raise ValueError("disagg stream already closed")
+        eng = self.engine
+        try:
+            meta, arrays = unpack(blob)
+        except ValueError:
+            self.abandon()
+            raise
+        kind = meta.get("kind")
+        if kind == "kv_end":
+            adopted = self.finish()
+            return {"ok": True, "adopted_tokens": adopted, "final": True}
+        bs = eng.block_size
+        pb = eng._prefix_blocks[0]
+        ids = [int(t) for t in meta.get("ids") or []]
+        k = arrays.get("k")
+        expected = list(eng.k_pool.shape[:1]) + list(eng.k_pool.shape[2:])
+        scales_ok = eng.kv_quant is None or (
+            "k_scale" in arrays and "v_scale" in arrays
+            and arrays["k_scale"].shape == k.shape[:4]
+            and arrays["v_scale"].shape == k.shape[:4])
+        compatible = (
+            kind == "kv_seg"
+            and getattr(eng, "radix", None) is not None
+            and k is not None and k.shape[1] > 0
+            and meta.get("block_size") == bs
+            and meta.get("kv_quant") == (eng.kv_quant or "off")
+            and list(k.shape[:1]) + list(k.shape[2:]) == expected
+            and arrays.get("v") is not None and arrays["v"].shape == k.shape
+            and scales_ok
+            # the shipped chain extends the DONOR's static prefix; it only
+            # lands behind OUR pinned root when the two prefixes agree
+            and meta.get("prefix_tokens") == len(pb) * bs
+            and ids[:len(pb) * bs] == eng.prefix_ids[:len(pb) * bs]
+            # segments must extend the frontier contiguously, in order
+            and meta.get("start_block") == len(pb) + len(self.blocks)
+            and (len(pb) + len(self.blocks) + int(k.shape[1])) * bs
+            < len(ids)
+            and (self.ids is None or ids == self.ids)
+        )
+        if not compatible:
+            self.abandon()
+            raise ValueError("disagg segment incompatible or out of order")
+        try:
+            newb = eng.adopt_chain_kv(
+                k, arrays["v"], arrays.get("k_scale"), arrays.get("v_scale"))
+        except Exception as e:
+            # pool pressure (PoolExhausted after eviction) or install
+            # fault: keep what already landed, close clean
+            self.abandon()
+            raise ValueError(f"disagg adopt failed: {type(e).__name__}") \
+                from e
+        self.ids = ids
+        self.blocks.extend(newb)
+        get_metrics().inc("disagg.segments_adopted")
+        return {"ok": True, "adopted_tokens": self.tokens,
+                "blocks": len(newb), "final": False}
+
+    def _close(self) -> int:
+        """Insert whatever frontier arrived, release our refs, report the
+        tree-verified warm token count (the 'trust the TREE' probe from
+        adopt_session). Idempotent; zero leaked blocks by construction."""
+        if self.closed:
+            return 0
+        self.closed = True
+        eng = self.engine
+        blocks, self.blocks = self.blocks, []
+        if not blocks or self.ids is None:
+            return 0
+        m = get_metrics()
+        pb = eng._prefix_blocks[0]
+        tokens = (len(pb) + len(blocks)) * eng.block_size
+        try:
+            eng.radix[0].insert(self.ids[:tokens], pb + blocks)
+        finally:
+            eng.allocator.free(blocks)
+        matched = eng.radix[0].cached_tokens(self.ids)
+        if matched < tokens:
+            m.inc("handoff.adopt_fallbacks")
+            return 0
+        m.inc("handoff.tokens_adopted", float(tokens))
+        return tokens
+
+    def finish(self) -> int:
+        """Clean end-of-stream commit. Returns warm token count."""
+        return self._close()
+
+    def abandon(self) -> int:
+        """Torn stream / mismatch / shed: best-effort partial commit (a
+        shorter warm prefix is still token-identical cache), refs freed.
+        Always reports 0 — the caller treats the stream as fallen back."""
+        get_metrics().inc("disagg.streams_aborted")
+        self._close()
+        return 0

@@ -1217,6 +1217,15 @@ class PagedDecodeEngine(DecodeEngine):
 
     # ------------------------------------------------------------ handoff
 
+    def slot_chain_blocks(self, slot: int) -> list[int]:
+        """The in-order pool block chain covering ``slot``'s context —
+        shared (pinned prefix / radix-matched) blocks first, then owned
+        blocks. Valid mid-chunked-prefill too: a block is fully WRITTEN
+        only once the compute frontier has passed it, which is the
+        disagg exporter's job to track (ISSUE 20 streams only blocks
+        behind the frontier). Serving-loop thread only."""
+        return list(self._slot_shared[slot]) + list(self._slot_owned[slot])
+
     def gather_chain_kv(self, blocks: list[int]):
         """Host copies of the pool KV for ``blocks``, in STORED format —
         the warm-state handoff's export payload (serve.handoff): bf16

@@ -140,6 +140,29 @@ class RadixCache:
             self.allocator.ref(blocks)
         return blocks, len(blocks) * bs
 
+    def cached_tokens(self, ids: list[int], ns: str | None = None) -> int:
+        """Ref-free probe: how many leading tokens of ``ids`` a ``match``
+        would serve right now. Takes no allocator refs, bumps no LRU
+        clocks, counts no lookup — a pure observation used by the disagg
+        stream adopter's post-insert verification (ISSUE 20) where the
+        match/free churn of a real lookup would perturb eviction order."""
+        bs = self.block_size
+        node = self.root
+        matched = 0
+        limit = max(0, (len(ids) - 1) // bs)
+        for i in range(limit):
+            kt = tuple(ids[i * bs:(i + 1) * bs])
+            child = node.children.get((ns, kt)) if ns is not None else None
+            if child is None:
+                c = node.children.get(kt)
+                if c is not None and (ns is None or c.pinned):
+                    child = c
+            if child is None:
+                break
+            matched += bs
+            node = child
+        return matched
+
     def record_hit(self, matched: int) -> None:
         """Account a matched chain the engine COMMITTED to (cache-served
         tokens, not merely matchable ones)."""

@@ -668,6 +668,113 @@ class ContinuousBatcher:
         return {"ok": True, "prompt_tokens": len(ids),
                 "cached_tokens": cached}
 
+    def prefill_export(self, prompt, *, stream_blocks: int = 4, emit=None,
+                       stream_id=None, tenant=None) -> dict:
+        """Prefill-only admission that EXPORTS the computed chain (disagg,
+        ISSUE 20): ``feed_prefix`` generalized to arbitrary prompts on a
+        prefill-pool replica, chunk-pipelined so transfer overlaps
+        compute. Runs the prompt through a transiently borrowed slot via
+        ``begin_chunked_prefill`` (chunk = ``stream_blocks`` pool blocks);
+        after each chunk, every newly COMPLETE full block behind the
+        compute frontier is gathered (``gather_chain_kv``) and handed to
+        ``emit`` as one packed ``kv_seg`` blob — the first segments ship
+        while later chunks still prefill. The chain then commits into the
+        LOCAL radix tree too (``release_slot(generated_ids=[], ok=True)``,
+        the feed_prefix zero-leak idiom), so a repeat export is pure cache.
+
+        Shipped blocks stop at ``(len(ids) - 1) // block_size`` — the
+        admission-side ``match`` limit — so the decode home can serve
+        every streamed token. Sheds exactly like feed_prefix (busy /
+        no_slot / pool_exhausted / radix_off); any shed or fault after
+        segments were emitted leaves the receiver a torn stream, which
+        the adopter commits partially — clean-or-cold by construction.
+        Serving-loop thread only."""
+        from ..utils import get_metrics
+
+        from .handoff import pack_kv_segment
+
+        m = get_metrics()
+        m.inc("disagg.exports")
+        eng = self.engine
+        if getattr(eng, "radix", None) is None:
+            m.inc("disagg.exports_shed")
+            return {"ok": False, "reason": "radix_off"}
+        if self.pending:
+            m.inc("disagg.exports_shed")
+            return {"ok": False, "reason": "busy"}
+        slot = self._free_slot(self._active_h)
+        if slot is None:
+            m.inc("disagg.exports_shed")
+            return {"ok": False, "reason": "no_slot"}
+        if self.tenancy is not None:
+            setns = getattr(eng, "set_slot_ns", None)
+            if setns is not None:
+                setns(slot, self.tenancy.resolve(tenant))
+        ids = (eng.tokenizer.encode(prompt, bos=True)
+               if isinstance(prompt, str) else [int(t) for t in prompt])
+        bs = eng.block_size
+        pb = len(eng._prefix_blocks[0])
+        ship_cap = (len(ids) - 1) // bs
+        n_ship = max(1, int(stream_blocks))
+        sent = pb
+        segments = 0
+
+        def _ship(upto: int, final: bool) -> None:
+            nonlocal sent, segments
+            upto = min(int(upto), ship_cap)
+            if emit is None or upto <= sent:
+                return
+            if not final and upto - sent < n_ship:
+                return  # accumulate until a full segment's worth is ready
+            chain = eng.slot_chain_blocks(slot)
+            blob = pack_kv_segment(eng, ids, chain[sent:upto], sent,
+                                   stream_id=stream_id)
+            emit(blob)
+            m.inc("disagg.blocks_streamed", float(upto - sent))
+            sent = upto
+            segments += 1
+
+        try:
+            cur = eng.begin_chunked_prefill(ids, slot, n_ship * bs)
+            if cur is None:
+                # short suffix / mostly cached: one-shot, single segment
+                eng.prefill_slot(ids, slot)
+            else:
+                logits = None
+                while logits is None:
+                    logits = eng.chunked_prefill_step(cur)
+                    frontier = cur.P + min(cur.j * cur.C, len(cur.suffix))
+                    _ship(frontier // bs, final=False)
+        except PoolExhausted:
+            try:
+                eng.release_slot(slot, ok=False)
+            except Exception:
+                pass
+            m.inc("disagg.exports_shed")
+            return {"ok": False, "reason": "pool_exhausted",
+                    "segments": segments}
+        except Exception as e:
+            if isinstance(e, _DeviceFault):
+                raise
+            try:
+                eng.release_slot(slot, ok=False)
+            except Exception:
+                pass
+            m.inc("disagg.exports_shed")
+            return {"ok": False, "reason": f"{type(e).__name__}: {e}",
+                    "segments": segments}
+        cached = int(getattr(eng, "_last_cached_tokens", 0))
+        try:
+            _ship(ship_cap, final=True)
+        except Exception:
+            # a dead emit sink mid-final is the receiver's torn stream,
+            # not our leak: commit the chain locally regardless
+            pass
+        eng.release_slot(slot, generated_ids=[], ok=True)
+        return {"ok": True, "prompt_tokens": len(ids),
+                "cached_tokens": cached, "chain_tokens": sent * bs,
+                "segments": segments}
+
     # ------------------------------------------------------------ step
 
     def step(self) -> None:

@@ -148,6 +148,15 @@ class AutopilotController:
         self._stt_up_streak = 0
         self._stt_down_streak = 0
         self._stt_cooldown_until = 0.0
+        # prefill pool side-channel (ISSUE 20): the disaggregated fleet's
+        # prefill members are sized on their OWN band — export-queue
+        # depth / member pressure, not the decode tier's parse-busy
+        # signal — with their own streaks and cooldown
+        self.prefill_target = sum(1 for r in router.replicas
+                                  if r.role == "prefill")
+        self._prefill_up_streak = 0
+        self._prefill_down_streak = 0
+        self._prefill_cooldown_until = 0.0
         # contract counters/gauges exist from construction (the breaker
         # gauge discipline: scrape-visible at zero, never absent)
         m = get_metrics()
@@ -166,6 +175,9 @@ class AutopilotController:
         m.set_gauge("autopilot.forecast_load", 0.0)
         if stt_tier is not None:
             m.set_gauge("autopilot.stt_target_replicas", float(self.stt_target))
+        if getattr(router, "disagg", False):
+            m.set_gauge("autopilot.prefill_target_replicas",
+                        float(self.prefill_target))
         # the /admin/autopilot surface finds the controller here
         router.autopilot = self
 
@@ -210,7 +222,7 @@ class AutopilotController:
 
         busy = 0.0
         fresh = 0
-        for r in [x for x in self.router.replicas if x.servable()]:
+        for r in [x for x in self._brain_members() if x.servable()]:
             since = self._cursors.get(r.url, 0)
             try:
                 resp = await self.router._http.get(
@@ -266,8 +278,9 @@ class AutopilotController:
     def _record(self, tier: str, action: str, reason: str, *,
                 signal: float | None = None, forecast: float | None = None,
                 target: int, actual: int, **extra) -> dict:
-        cooldown_until = self._cooldown_until if tier == "brain" \
-            else self._stt_cooldown_until
+        cooldown_until = {"brain": self._cooldown_until,
+                          "prefill": self._prefill_cooldown_until,
+                          }.get(tier, self._stt_cooldown_until)
         d = {"t": round(time.time(), 3), "tier": tier, "action": action,
              "reason": reason,
              "signal": None if signal is None else round(signal, 4),
@@ -285,10 +298,18 @@ class AutopilotController:
                   cooldown_remaining_s=d["cooldown_remaining_s"])
         return d
 
+    def _brain_members(self) -> list[Replica]:
+        """The DECODE-tier members the brain band governs. Prefill-pool
+        members (ISSUE 20) are excluded everywhere the brain band
+        measures, counts or retires — they are sized by their own band —
+        and with disagg off every member's role is "both", so this is the
+        whole ring, byte-identical to the pre-disagg controller."""
+        return [r for r in self.router.replicas if r.role != "prefill"]
+
     def _actual(self) -> int:
         """Capacity the ring has or is actively acquiring: up + joining.
         Draining/drained/down members are spent capacity on their way out."""
-        return sum(1 for r in self.router.replicas
+        return sum(1 for r in self._brain_members()
                    if r.state in ("up", "joining"))
 
     def _decide(self, desired: int, busy: float, forecast: float) -> None:
@@ -434,7 +455,7 @@ class AutopilotController:
         ship its sticky sessions' warm state to their next homes, and
         queue it for retirement (which completes only at inflight==0)."""
         router = self.router
-        ups = [r for r in router.replicas if r.state == "up"]
+        ups = [r for r in self._brain_members() if r.state == "up"]
         if len(ups) <= self.min:
             return
         sessions_of = {r.url: 0 for r in ups}
@@ -480,7 +501,7 @@ class AutopilotController:
                       if r.state == "joining")
         if actual < self.target and joining == 0:
             await self._join_one()
-        elif sum(1 for r in self.router.replicas if r.state == "up") \
+        elif sum(1 for r in self._brain_members() if r.state == "up") \
                 > self.target:
             await self._scale_down_one()
 
@@ -534,6 +555,108 @@ class AutopilotController:
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, tier.resize, self.stt_target)
 
+    # -------------------------------------------------------- prefill pool
+
+    async def _tick_prefill(self) -> None:
+        """The disaggregated prefill pool (ISSUE 20) rides the same band
+        controller on its own streaks. Signal = max(mean member pressure,
+        live export-queue depth per servable member / 2) — the queue is
+        what the decode pool's warm admissions stall behind, and it is
+        router-local state, so this band never starves when the
+        timeseries plane does. The pool only shrinks to one member (a
+        disaggregated fleet with an empty pool silently degrades every
+        long admission to a decode-side barrier prefill), and an empty
+        pool is the operator's choice — the controller never conjures
+        one from nothing."""
+        router = self.router
+        if not getattr(router, "disagg", False):
+            return
+        pool = [r for r in router.replicas if r.role == "prefill"]
+        if not pool:
+            return
+        servable = [r for r in pool if r.servable()]
+        m = get_metrics()
+        meanp = (sum(r.pressure for r in servable) / len(servable)) \
+            if servable else 0.0
+        depth = getattr(router, "_disagg_inflight", 0)
+        qsig = depth / (2.0 * max(1, len(servable)))
+        sig = max(meanp, min(1.0, qsig))
+        if sig >= self.target_util:
+            self._prefill_up_streak += 1
+            self._prefill_down_streak = 0
+        elif sig < self.target_util / 2:
+            self._prefill_down_streak += 1
+            self._prefill_up_streak = 0
+        else:
+            self._prefill_up_streak = self._prefill_down_streak = 0
+        want_up = (self._prefill_up_streak >= self.up_windows
+                   and self.prefill_target < self.max)
+        want_down = (self._prefill_down_streak >= self.down_windows
+                     and self.prefill_target > 1)
+        if want_up or want_down:
+            now = time.monotonic()
+            if now < self._prefill_cooldown_until:
+                m.inc("autopilot.cooldown_blocks")
+                self._record("prefill", "hold", "cooldown", signal=sig,
+                             target=self.prefill_target, actual=len(pool))
+            else:
+                self.prefill_target += 1 if want_up else -1
+                m.inc("autopilot.scale_ups" if want_up
+                      else "autopilot.scale_downs")
+                self._record("prefill",
+                             "scale_up" if want_up else "scale_down",
+                             "queue" if want_up else "underutilized",
+                             signal=sig, target=self.prefill_target,
+                             actual=len(pool))
+                self._prefill_up_streak = self._prefill_down_streak = 0
+                self._prefill_cooldown_until = now + self.cooldown_s
+                m.set_gauge("autopilot.prefill_target_replicas",
+                            float(self.prefill_target))
+        ups = [r for r in pool if r.state == "up"]
+        if len(ups) < self.prefill_target:
+            await self._join_prefill()
+        elif len(ups) > self.prefill_target:
+            # cheapest exit: idlest member, newest first — no sessions to
+            # ship (nothing ever sticks to a prefill member); the shared
+            # retirement pipeline completes at inflight == 0
+            victim = min(ups, key=lambda r: (r.inflight, -r.idx))
+            if router.start_drain(victim):
+                self._retiring.add(victim.url)
+                self._record("prefill", "drain", "scale_down",
+                             target=self.prefill_target, actual=len(ups),
+                             replica=victim.url)
+                router._maybe_finish_drain(victim)
+
+    async def _join_prefill(self) -> None:
+        """Prefill scale-up: spawn (role-aware when the spawner supports
+        it), tag, admit — no joining/pre-warm pipeline, because a prefill
+        member holds no sessions and its whole job IS cold prefills:
+        admitting it cold is admitting it ready."""
+        router = self.router
+        try:
+            try:
+                url = await self.spawner.spawn(role="prefill")
+            except TypeError:
+                # a role-blind spawner (the duck-typed contract's floor)
+                url = await self.spawner.spawn()
+        except Exception:
+            self._record("prefill", "join_aborted", "spawn_failed",
+                         target=self.prefill_target,
+                         actual=sum(1 for r in router.replicas
+                                    if r.role == "prefill"))
+            return
+        try:
+            member = router.add_member(url)
+        except ValueError:
+            await self._spawner_retire(url)
+            return
+        member.role = "prefill"
+        self._record("prefill", "join", "ready",
+                     target=self.prefill_target,
+                     actual=sum(1 for r in router.replicas
+                                if r.role == "prefill" and r.state == "up"),
+                     replica=member.url)
+
     # --------------------------------------------------------------- tick
 
     async def tick_once(self) -> dict:
@@ -550,6 +673,7 @@ class AutopilotController:
             self._record("brain", "hold", "starved", target=self.target,
                          actual=self._actual())
             await self._finish_retirements()
+            await self._tick_prefill()
             await self._tick_stt()
             return self.describe()
         now = time.monotonic()
@@ -565,7 +689,7 @@ class AutopilotController:
         demand = max(busy, forecast)
         desired = int(math.ceil(demand / max(self.target_util, 1e-6))) \
             if demand > 1e-9 else self.min
-        ups = [r for r in self.router.replicas if r.state == "up"]
+        ups = [r for r in self._brain_members() if r.state == "up"]
         shed = self.router.shed_pressure
         if ups and shed is not None:
             meanp = sum(r.pressure for r in ups) / len(ups)
@@ -576,6 +700,7 @@ class AutopilotController:
         desired = max(self.min, min(self.max, desired))
         self._decide(desired, busy, forecast)
         await self._reconcile()
+        await self._tick_prefill()
         await self._tick_stt()
         return self.describe()
 
@@ -583,9 +708,10 @@ class AutopilotController:
 
     def describe(self) -> dict:
         router = self.router
-        up = sum(1 for r in router.replicas if r.state == "up")
-        joining = sum(1 for r in router.replicas if r.state == "joining")
-        draining = sum(1 for r in router.replicas
+        brain = self._brain_members()
+        up = sum(1 for r in brain if r.state == "up")
+        joining = sum(1 for r in brain if r.state == "joining")
+        draining = sum(1 for r in brain
                        if r.state in ("draining", "drained"))
         out = {
             "enabled": True,
@@ -603,6 +729,19 @@ class AutopilotController:
             "stt": None,
             "decisions": self.decisions[-16:],
         }
+        if getattr(router, "disagg", False):
+            pool = [r for r in router.replicas if r.role == "prefill"]
+            out["prefill"] = {
+                "target": self.prefill_target,
+                "actual": sum(1 for r in pool if r.state == "up"),
+                "servable": sum(1 for r in pool if r.servable()),
+                "queue_depth": getattr(router, "_disagg_inflight", 0),
+                "up_streak": self._prefill_up_streak,
+                "down_streak": self._prefill_down_streak,
+                "cooldown_remaining_s": round(
+                    max(0.0, self._prefill_cooldown_until
+                        - time.monotonic()), 3),
+            }
         if self.stt_tier is not None:
             tier = self.stt_tier
             out["stt"] = {

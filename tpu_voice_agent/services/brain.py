@@ -263,6 +263,9 @@ class BatchedEngineParser:
         self._pending_cap = (self.transcripts.max_sessions
                              if self.transcripts is not None else 64)
         self._plock = threading.Lock()
+        # disagg adopt streams (ISSUE 20): stream_id -> StreamAdopter;
+        # touched only on the serving-loop thread (adopt_stream submits)
+        self._disagg_adopt: "OrderedDict[str, object]" = OrderedDict()
         # per-session resource attribution (ISSUE 17): every finished
         # request's cost ledger folds into a session-keyed LRU — the meter
         # /debug/costs names top-cost sessions from (and the fair-share
@@ -391,6 +394,73 @@ class BatchedEngineParser:
             return {"ok": False, "reason": "cancelled"}
         except TimeoutError:
             return {"ok": False, "reason": "timeout"}
+        except Exception as e:
+            return {"ok": False, "reason": f"{type(e).__name__}: {e}"}
+
+    # prefill/decode disaggregation (ISSUE 20): a prefill-pool replica runs
+    # the prefill-only EXPORT admission (feed_prefix generalized — the
+    # chain is gathered and streamed out segment by segment while later
+    # chunks still compute) and a decode-pool replica installs the stream
+    # behind its pinned root via the per-stream adopter. Both halves run on
+    # the serving-loop thread like every other allocator/radix touch.
+    supports_disagg = True
+
+    def disagg_prefill(self, text: str, context: dict,
+                       session_id: str | None = None, *,
+                       stream_blocks: int = 4, emit=None,
+                       stream_id: str | None = None) -> dict:
+        if self.transcripts is not None and session_id:
+            # render through the same prompt_for path a real parse uses:
+            # when this replica knows the session the export is token-exact
+            # for it; an unknown session renders turn-1 style, which the
+            # decode home's radix simply matches as far as it agrees
+            prompt = self.transcripts.prompt_for(session_id, text, context)
+        else:
+            prompt = render_prompt(text, context)
+        if self._too_long(prompt):
+            return {"ok": False, "reason": "too_long"}
+        fut = self.runtime.submit_call(
+            lambda: self.batcher.prefill_export(
+                prompt, stream_blocks=stream_blocks, emit=emit,
+                stream_id=stream_id))
+        try:
+            return fut.result(timeout=self.timeout_s)
+        except Exception as e:
+            return {"ok": False, "reason": f"{type(e).__name__}: {e}"}
+
+    _DISAGG_STREAMS_CAP = 4
+
+    def adopt_stream(self, stream_id: str, blob: bytes) -> dict:
+        """Install ONE disagg stream blob (kv_seg segment or kv_end
+        commit) for ``stream_id``. Per-stream adopter state is LRU-capped:
+        an abandoned stream's adopter is closed (partial commit + refs
+        freed — zero leaked blocks) when newer streams push it out. All
+        mutation happens on the serving-loop thread, so the dict needs no
+        lock of its own."""
+        from ..serve import handoff
+
+        def run() -> dict:
+            ad = self._disagg_adopt.get(stream_id)
+            if ad is None:
+                ad = handoff.StreamAdopter(self.engine)
+                self._disagg_adopt[stream_id] = ad
+                while len(self._disagg_adopt) > self._DISAGG_STREAMS_CAP:
+                    _, old = self._disagg_adopt.popitem(last=False)
+                    old.abandon()
+            else:
+                self._disagg_adopt.move_to_end(stream_id)
+            try:
+                out = ad.feed(blob)
+            except ValueError as e:
+                self._disagg_adopt.pop(stream_id, None)
+                return {"ok": False, "reason": str(e)}
+            if out.get("final"):
+                self._disagg_adopt.pop(stream_id, None)
+            return out
+
+        fut = self.runtime.submit_call(run)
+        try:
+            return fut.result(timeout=self.timeout_s)
         except Exception as e:
             return {"ok": False, "reason": f"{type(e).__name__}: {e}"}
 
@@ -1168,7 +1238,13 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
         """ok / degraded (saturated but serving) / unhealthy (dead worker)."""
         body = {"ok": True, "service": "brain",
                 "inflight": admission.inflight,
-                "max_inflight": admission.max_inflight}
+                "max_inflight": admission.max_inflight,
+                # disagg pool membership (ISSUE 20): BRAIN_ROLE tags this
+                # replica prefill/decode/both; the router's prober reads it
+                # off this field and places accordingly when ROUTER_DISAGG
+                # is on (and ignores it entirely when off)
+                "role": os.environ.get("BRAIN_ROLE", "both"),
+                "disagg": bool(getattr(parser, "supports_disagg", False))}
         if drain_state["draining"]:
             body["draining"] = True
             body["drained"] = _drained()
@@ -1473,14 +1549,136 @@ def build_app(parser: IntentParser, tracer: Tracer | None = None,
                      "limit_bytes": _HANDOFF_MAX_BYTES}, status=413)
             chunks.append(chunk)
         blob = b"".join(chunks)
+        from ..serve import handoff as _frames
+
+        if blob.startswith(_frames.FRAME_MAGIC):
+            # HANDOFF_FRAMED wire (ISSUE 20): the SAME warm blob shipped as
+            # sequence-numbered parts. Sniffed, never negotiated — a raw
+            # TVAH1 blob takes the unchanged path, and a torn/reordered
+            # frame body is a COUNTED clean cold fallback, not an install
+            # of torn bytes.
+            try:
+                blob = _frames.deframe(blob)
+            except ValueError as e:
+                get_metrics().inc("handoff.adopt_fallbacks")
+                return web.json_response(
+                    {"ok": True, "adopted_tokens": 0,
+                     "reason": f"bad frames: {e}"})
         loop = asyncio.get_running_loop()
         adopted = await loop.run_in_executor(None, adopter, blob)
         return web.json_response({"ok": True,
                                   "adopted_tokens": int(adopted)})
 
+    # disagg KV stream endpoints (ISSUE 20). /admin/disagg/prefill runs a
+    # prefill-only EXPORT admission and answers a chunked body of
+    # sequence-numbered frames — kv_seg segments as the chain computes,
+    # then a kv_end summary on the FINAL frame. A shed before any segment
+    # answers plain JSON (no stream to tear). /admin/disagg/adopt installs
+    # one forwarded blob per POST into the stream's adopter.
+    async def admin_disagg_prefill(req: web.Request) -> web.Response:
+        exporter = getattr(parser, "disagg_prefill", None)
+        if exporter is None:
+            return web.json_response({"error": "disagg_unsupported"},
+                                     status=404)
+        from ..serve import handoff as _frames
+
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": "invalid_request", "detail": "body must be JSON"},
+                status=400)
+        text = str(body.get("text") or "")
+        context = body.get("context") or {}
+        sid = body.get("session_id") or None
+        stream_id = str(body.get("stream") or new_trace_id())
+        stream_blocks = max(1, int(body.get("stream_blocks") or 4))
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def emit(blob: bytes) -> None:
+            # called from the serving-loop thread mid-prefill: bridge each
+            # gathered segment onto the event loop without blocking compute
+            loop.call_soon_threadsafe(q.put_nowait, blob)
+
+        fut = loop.run_in_executor(parse_pool, lambda: exporter(
+            text, context, sid, stream_blocks=stream_blocks, emit=emit,
+            stream_id=stream_id))
+        fut.add_done_callback(lambda _f: q.put_nowait(None))
+        first = await q.get()
+        if first is None:
+            # export finished before any segment shipped: shed / too_long /
+            # tiny prompt — answer JSON, the router falls back or proceeds
+            try:
+                out = fut.result()  # analyze: ok[async-blocking] -- the None sentinel only enters the queue from fut's done callback, so the future is already resolved
+            except Exception as e:
+                out = {"ok": False, "reason": f"{type(e).__name__}: {e}"}
+            return web.json_response({"disagg_prefill": True, **(out or {})})
+        from ..utils.chaos import chaos_fire
+
+        resp = web.StreamResponse(
+            status=200, headers={"content-type": "application/x-tva-frames",
+                                 "x-disagg-stream": stream_id})
+        resp.enable_chunked_encoding()
+        await resp.prepare(req)
+        seq = 0
+        item: bytes | None = first
+        while item is not None:
+            # satellite drill (prefill_replica_kill): the prefill replica
+            # dies MID-KV-STREAM — between frame writes, after earlier
+            # segments already landed — the decode home must serve the
+            # parse clean-or-cold off whatever partial frontier arrived
+            if chaos_fire("prefill_replica_kill"):
+                if req.transport is not None:
+                    req.transport.close()
+                raise asyncio.CancelledError("chaos: prefill replica killed")
+            await resp.write(_frames.frame_pack(seq, item))
+            seq += 1
+            item = await q.get()
+        try:
+            out = fut.result()  # analyze: ok[async-blocking] -- the None sentinel only enters the queue from fut's done callback, so the future is already resolved
+        except Exception as e:
+            out = {"ok": False, "reason": f"{type(e).__name__}: {e}"}
+        summary = {k: v for k, v in (out or {}).items()
+                   if k in ("ok", "reason", "prompt_tokens", "cached_tokens",
+                            "chain_tokens", "segments")}
+        await resp.write(_frames.frame_pack(
+            seq, _frames.pack_kv_end(stream_id, summary), final=True))
+        await resp.write_eof()
+        return resp
+
+    async def admin_disagg_adopt(req: web.Request) -> web.Response:
+        adopter = getattr(parser, "adopt_stream", None)
+        if adopter is None:
+            return web.json_response({"error": "disagg_unsupported"},
+                                     status=404)
+        stream_id = req.headers.get("x-disagg-stream")
+        if not stream_id:
+            return web.json_response(
+                {"error": "invalid_request",
+                 "detail": "x-disagg-stream header required"}, status=400)
+        chunks: list[bytes] = []
+        total = 0
+        while True:
+            chunk = await req.content.read(1 << 20)
+            if not chunk:
+                break
+            total += len(chunk)
+            if total > _HANDOFF_MAX_BYTES:
+                return web.json_response(
+                    {"error": "handoff_too_large",
+                     "limit_bytes": _HANDOFF_MAX_BYTES}, status=413)
+            chunks.append(chunk)
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, adopter, stream_id,
+                                         b"".join(chunks))
+        return web.json_response(out)
+
     app.router.add_get("/health", health)
     app.router.add_get("/admin/handoff/{session_id}", admin_handoff_get)
     app.router.add_post("/admin/handoff", admin_handoff_post)
+    app.router.add_post("/admin/disagg/prefill", admin_disagg_prefill)
+    app.router.add_post("/admin/disagg/adopt", admin_disagg_adopt)
     from ..utils.tracing import (
         make_flightrecorder_handler,
         make_metrics_handler,

@@ -219,7 +219,7 @@ class Replica:
                  "gray", "gray_streak", "ok_streak", "outlier_score",
                  "outlier_signal", "gray_evidence", "gray_held_since",
                  "signals", "signal_ages", "fwd_acc", "ts_seq",
-                 "clock_skew_s")
+                 "clock_skew_s", "role")
 
     def __init__(self, idx: int, url: str, breaker_threshold: int,
                  breaker_reset_s: float):
@@ -272,6 +272,13 @@ class Replica:
         # multi-service flight dumps can be merged on one clock)
         self.ts_seq = 0
         self.clock_skew_s = 0.0
+        # serving role (ISSUE 20 disaggregation): "both" serves any
+        # traffic; "prefill" members run long cold prefills and stream the
+        # KV out, so the router keeps STICKY sessions off them; "decode"
+        # is documentation-only today (a decode member behaves like
+        # "both"). Set by the owning tier from a `url#role` key tag or a
+        # probe body's self-reported role — the ring core never parses.
+        self.role = "both"
 
     def admitting(self) -> bool:
         """May receive NEW sessions (and anonymous parses)."""
@@ -292,6 +299,8 @@ class Replica:
                "clock_skew_s": round(self.clock_skew_s, 4)}
         if self.outlier_signal:
             out["outlier_signal"] = self.outlier_signal
+        if self.role != "both":
+            out["role"] = self.role
         return out
 
 
@@ -328,6 +337,13 @@ class ReplicaSet:
         self.gray_min_peers = max(2, gray_min_peers)
         self.gray_hold_s = gray_hold_s
         self.last_fleet: dict | None = None
+        # roles placement must avoid (ISSUE 20): the disaggregating router
+        # sets {"prefill"} so general traffic lands only on decode-capable
+        # members. Empty (the default) keeps _pick byte-identical to the
+        # pre-disagg build. Like pressure/gray avoidance, an empty filtered
+        # pool falls back to the whole admitting set: a fleet that is ALL
+        # prefill-tagged still serves, it never errors here.
+        self.exclude_roles: set[str] = set()
         # kept for elastic membership (ISSUE 16): add_member builds every
         # later Replica with the same breaker discipline the seed got
         self.breaker_threshold = breaker_threshold
@@ -396,6 +412,16 @@ class ReplicaSet:
                  if r.admitting() and r.url not in exclude]
         if not cands:
             return None
+        if self.exclude_roles:
+            # role filter (ISSUE 20): excluded-role members leave the
+            # placement UNIVERSE (not just the preference pool) so a
+            # prefill member never becomes a rendezvous "top" choice that
+            # inflates shed counters — unless filtering would empty the
+            # ring, in which case every member serves (degraded placement
+            # beats an error, same contract as all-over pressure).
+            keep = [r for r in cands if r.role not in self.exclude_roles]
+            if keep:
+                cands = keep
         avoid = {r.url for r in cands if r.gray}
         if self.shed_pressure is not None:
             avoid |= {r.url for r in cands if r.pressure >= self.shed_pressure}
@@ -713,6 +739,14 @@ class ReplicaSet:
             r.probe_fails = 0
             if body:
                 r.last_health = body
+                # a member's self-reported serving role (ISSUE 20) refines
+                # the ring's view — but only an EXPLICIT role lands:
+                # "both" is also the BRAIN_ROLE env default, so a member
+                # that never set it must not clear a router-side
+                # `url#prefill` key tag with its first probe
+                role = body.get("role")
+                if role in ("prefill", "decode"):
+                    r.role = role
             if r.state == "down":
                 # recovered (or restarted after a drain): rejoin the ring.
                 # Its old sessions stay where they re-homed (stickiness);

@@ -83,6 +83,25 @@ Design:
 - **Full outage.** Every replica out of the ring → ``503 + Retry-After``,
   which the voice service already maps to the RuleBasedParser degraded
   mode: quality degrades, sessions survive.
+
+- **Prefill/decode disaggregation (ISSUE 20).** ``ROUTER_DISAGG=1`` splits
+  the ring into a *prefill pool* (members tagged ``url#prefill`` in
+  ``BRAIN_REPLICAS``, listed in ``ROUTER_PREFILL_REPLICAS``, or self-
+  reporting ``BRAIN_ROLE=prefill`` through /health) and a *decode pool*
+  (everyone else). Sessions place only on decode members; a parse whose
+  uncached-prompt estimate clears ``DISAGG_MIN_TOKENS`` first runs a
+  prefill-only export on a prefill member and pumps the resulting KV
+  frames — chunk-pipelined, ``DISAGG_STREAM_BLOCKS`` per segment — into
+  the decode home's stream adopter, so the home admits warm and its decode
+  step loop never eats a barrier prefill. Prefix feeds ride the same wire
+  (a feed IS a prefill-only admission; the fed chain lands on the session's
+  decode home), and speculative parses forward to the prefill pool — their
+  decode burst stays off the latency-critical replicas and their prefill
+  warms the pool's cache for the final's export. EVERY failure (prefill
+  death mid-stream, adopt refusal, tier mismatch, budget overrun) falls
+  back to the plain forward — clean-or-cold, counted ``disagg.fallbacks``,
+  never an error. With ``ROUTER_DISAGG`` unset every path here is
+  byte-identical to the pre-disagg build.
 """
 
 from __future__ import annotations
@@ -92,6 +111,7 @@ import json
 import os
 import time
 import urllib.parse
+from collections import deque
 
 from aiohttp import web
 
@@ -149,10 +169,48 @@ class BrainRouter(ReplicaSet):
                  fleet_mad: float | None = None,
                  fleet_windows: int | None = None,
                  fleet_min_peers: int | None = None,
-                 fleet_hold_s: float | None = None):
+                 fleet_hold_s: float | None = None,
+                 disagg: bool | None = None,
+                 disagg_min_tokens: int | None = None,
+                 disagg_stream_blocks: int | None = None,
+                 prefill_urls: list[str] | None = None):
         if not replica_urls:
             raise ValueError("BRAIN_REPLICAS must name at least one replica")
         env = os.environ.get
+        # prefill/decode disaggregation (ISSUE 20): members may carry a
+        # ``url#role`` tag in the replica list; ROUTER_PREFILL_REPLICAS
+        # appends prefill-tagged members. The ring's keys stay bare urls —
+        # roles land on the Replica objects after construction.
+        roles: dict[str, str] = {}
+        keys: list[str] = []
+        for u in replica_urls:
+            base, _, tag = str(u).strip().partition("#")
+            base = base.rstrip("/")
+            if not base:
+                continue
+            keys.append(base)
+            if tag in ("prefill", "decode", "both"):
+                roles[base] = tag
+        if prefill_urls is None:
+            prefill_urls = [u.strip() for u in
+                            env("ROUTER_PREFILL_REPLICAS", "").split(",")
+                            if u.strip()]
+        for u in prefill_urls:
+            base = str(u).partition("#")[0].rstrip("/")
+            if not base:
+                continue
+            if base not in keys:
+                keys.append(base)
+            roles[base] = "prefill"
+        self.disagg = disagg if disagg is not None \
+            else env("ROUTER_DISAGG") == "1"
+        self.disagg_min_tokens = disagg_min_tokens \
+            if disagg_min_tokens is not None \
+            else int(env("DISAGG_MIN_TOKENS", "256"))
+        self.disagg_stream_blocks = disagg_stream_blocks \
+            if disagg_stream_blocks is not None \
+            else int(env("DISAGG_STREAM_BLOCKS", "4"))
+        self.handoff_framed = env("HANDOFF_FRAMED", "0") == "1"
         # fleet gray-failure detection (ISSUE 14): the prober additionally
         # scrapes each member's /debug/timeseries deltas and demotes
         # sustained peer-relative outliers (services/replicaset.py)
@@ -174,7 +232,7 @@ class BrainRouter(ReplicaSet):
             if handoff_timeout_s is not None \
             else float(env("HANDOFF_TIMEOUT_S", "5.0"))
         super().__init__(
-            replica_urls,
+            keys,
             probe_fails_limit=(probe_fails if probe_fails is not None
                                else int(env("ROUTER_PROBE_FAILS", "2"))),
             breaker_threshold=(breaker_threshold
@@ -194,6 +252,22 @@ class BrainRouter(ReplicaSet):
             gray_hold_s=(fleet_hold_s if fleet_hold_s is not None
                          else float(env("FLEET_GRAY_HOLD_S", "300"))),
             log_name="tpu_voice_agent.router")
+        for base, role in roles.items():
+            member = self._by_url.get(base)
+            if member is not None:
+                member.role = role
+        if self.disagg:
+            # general placement avoids the prefill pool (falls back to the
+            # whole ring if that would empty it — replicaset contract)
+            self.exclude_roles = {"prefill"}
+        # disagg orchestration state: per-session (home, prompt, cached)
+        # token history from response headers — the uncached-prompt
+        # estimator's memory; a rolling (monotonic t, blocks) window
+        # feeding the /health streamed-blocks/s roll-up; and the live
+        # export count behind the prefill-queue gauge
+        self._session_tokens: "dict[str, tuple[str, int, int]]" = {}
+        self._stream_win: "deque[tuple[float, int]]" = deque()
+        self._disagg_inflight = 0
         self._http = None  # httpx.AsyncClient, created on the app's loop
         self._probe_task: asyncio.Task | None = None
         # the contract counters/gauges exist from construction (the breaker
@@ -214,8 +288,17 @@ class BrainRouter(ReplicaSet):
         m.inc("fleet.shed_gray", 0.0)
         m.inc("router.replicas_added", 0.0)
         m.inc("router.replicas_removed", 0.0)
+        m.inc("disagg.admissions", 0.0)
+        m.inc("disagg.fallbacks", 0.0)
+        m.inc("disagg.feeds_routed", 0.0)
+        m.inc("disagg.spec_routed", 0.0)
+        m.inc("disagg.frames_streamed", 0.0)
+        m.inc("disagg.tokens_prewarmed", 0.0)
         m.set_gauge("fleet.gray_replicas", 0.0)
         m.set_gauge("fleet.outlier_score_max", 0.0)
+        m.set_gauge("disagg.prefill_replicas", 0.0)
+        m.set_gauge("disagg.decode_replicas", 0.0)
+        m.set_gauge("disagg.prefill_queue", 0.0)
         self._update_health_gauge()
 
     # ---------------------------------------------- replica-set hooks
@@ -298,6 +381,14 @@ class BrainRouter(ReplicaSet):
         for r in self.replicas:
             self._maybe_finish_drain(r)
         self._update_health_gauge()
+        if self.disagg:
+            m = get_metrics()
+            m.set_gauge("disagg.prefill_replicas",
+                        sum(1 for r in self.replicas
+                            if r.role == "prefill" and r.servable()))
+            m.set_gauge("disagg.decode_replicas",
+                        sum(1 for r in self.replicas
+                            if r.role != "prefill" and r.servable()))
         if self.gray_mad is not None:
             await self._fleet_scrape()
 
@@ -577,8 +668,18 @@ class BrainRouter(ReplicaSet):
                                         timeout=budget)
             if resp.status_code != 200 or not resp.content:
                 return False
+            content = resp.content
+            if self.handoff_framed:
+                # HANDOFF_FRAMED=1 (ISSUE 20): the warm re-home rides the
+                # same sequence-numbered, CRC-checked multi-part frame the
+                # disagg KV stream uses; the adopt endpoint sniffs the
+                # frame magic and reassembles (a torn/reordered body maps
+                # to the clean cold fallback there, never a bad install)
+                from ..serve.handoff import frame_split
+
+                content = b"".join(frame_split(content, 256 << 10))
             resp2 = await self._http.post(
-                new_url + "/admin/handoff", content=resp.content,
+                new_url + "/admin/handoff", content=content,
                 headers={"Content-Type": "application/octet-stream"},
                 timeout=budget)
             if resp2.status_code != 200:
@@ -628,6 +729,202 @@ class BrainRouter(ReplicaSet):
         except (httpx.HTTPError, OSError, ValueError, asyncio.TimeoutError):
             return 0
 
+    # ------------------------------------------- disagg orchestration
+    # (ISSUE 20; every method below is a no-op surface when self.disagg
+    # is False — forward_parse never calls them, keeping the unset build
+    # byte-identical)
+
+    def _pick_prefill(self, exclude=()) -> Replica | None:
+        """Least-inflight admitting prefill-pool member (prefill work is
+        anonymous from the ring's view: no session should ever stick to a
+        prefill replica, so placement is pure load balancing)."""
+        pool = [r for r in self.replicas
+                if r.role == "prefill" and r.admitting()
+                and r.url not in exclude]
+        if not pool:
+            return None
+        return min(pool, key=lambda r: r.inflight)
+
+    def _note_session_tokens(self, session_id: str | None, served_url: str,
+                             resp) -> None:
+        """Record a served parse's (home, prompt, cached) token headers —
+        the uncached-prompt estimator's per-session memory. Rides the
+        session table's own LRU budget."""
+        if not session_id or resp is None:
+            return
+        try:
+            pt = int(resp.headers.get("x-prompt-tokens", ""))
+        except (TypeError, ValueError):
+            return
+        try:
+            ct = int(resp.headers.get("x-cached-tokens", "0") or 0)
+        except (TypeError, ValueError):
+            ct = 0
+        self._session_tokens[session_id] = (served_url, pt, ct)
+        while len(self._session_tokens) > self.max_sessions:
+            self._session_tokens.pop(next(iter(self._session_tokens)))
+
+    def _uncached_estimate(self, session_id: str | None, body: dict) -> int:
+        """How many UNCACHED prompt tokens this parse will likely admit on
+        its decode home — the disagg placement signal. A session's last
+        ``x-prompt-tokens``/``x-cached-tokens`` answer anchors the known
+        part; the new utterance adds ~len/4 tokens. A session with no
+        history (cold: the long-prompt admission disagg exists for) is
+        estimated from its text alone, and a session whose home moved
+        since that answer counts the WHOLE last prompt as uncached — the
+        new home has none of it."""
+        text = str(body.get("text") or "")
+        ctx = body.get("context")
+        est_new = (len(text) + (len(str(ctx)) if ctx else 0)) // 4 + 8
+        if not session_id:
+            return est_new
+        rec = self._session_tokens.get(session_id)
+        if rec is None:
+            return est_new
+        url, prompt_toks, cached_toks = rec
+        if self._sessions.get(session_id) != url:
+            return prompt_toks + est_new
+        return max(0, prompt_toks - cached_toks) + est_new
+
+    async def _adopt_one(self, home: Replica, stream_id: str,
+                         blob: bytes) -> dict | None:
+        """POST one stream blob to the decode home's adopter. None on any
+        transport/HTTP failure (→ the caller aborts the stream)."""
+        import httpx
+
+        try:
+            resp = await self._http.post(
+                home.url + "/admin/disagg/adopt", content=blob,
+                headers={"Content-Type": "application/octet-stream",
+                         "x-disagg-stream": stream_id},
+                timeout=self.handoff_timeout_s)
+            if resp.status_code != 200:
+                return None
+            return resp.json()
+        except (httpx.HTTPError, OSError, ValueError):
+            return None
+
+    async def _disagg_stream(self, pf: Replica, home: Replica, body: dict,
+                             deadline: Deadline) -> dict | None:
+        """Run one prefill-pool export and pump its KV frames into the
+        decode home's stream adopter as they arrive (chunk-pipelined:
+        early blocks install on the home while later chunks still prefill
+        on ``pf``). Returns the FINAL adopt summary (``adopted_tokens``)
+        or None on ANY failure — prefill death mid-stream, a torn tail, a
+        refused adopt, budget overrun — and the caller's fallback is
+        always the plain forward: clean-or-cold, never an error. The
+        home-side adopter is zero-leak on every abort path (partial
+        commit + LRU abandon, serve.handoff.StreamAdopter)."""
+        import httpx
+
+        from ..serve.handoff import frame_feed
+
+        m = get_metrics()
+        stream_id = new_trace_id()
+        # the stream must leave room for the actual forward behind it: cap
+        # it at 60% of the remaining budget — an overrun falls back and
+        # the home still has >⅓ of the deadline to cold-prefill
+        budget = max(0.05, deadline.remaining_s() * 0.6)
+        t_end = time.monotonic() + budget
+        payload = {"text": str(body.get("text") or ""),
+                   "context": body.get("context") or {},
+                   "session_id": body.get("session_id") or None,
+                   "stream": stream_id,
+                   "stream_blocks": self.disagg_stream_blocks}
+        pf.inflight += 1
+        self._disagg_inflight += 1
+        m.set_gauge("disagg.prefill_queue", float(self._disagg_inflight))
+        final_out: dict | None = None
+        adopted_any = False
+        try:
+            async with self._http.stream(
+                    "POST", pf.url + "/admin/disagg/prefill",
+                    json=payload, timeout=budget) as resp:
+                if resp.status_code != 200:
+                    return None
+                if "x-disagg-stream" not in resp.headers:
+                    # shed before any segment (busy/no-slot/too-long):
+                    # plain JSON body, nothing streamed, nothing to abort
+                    await resp.aread()
+                    return None
+                buf = b""
+                saw_final = False
+                async for chunk in resp.aiter_bytes():
+                    buf += chunk
+                    frames, buf = frame_feed(buf)
+                    for _seq, blob, final in frames:
+                        if time.monotonic() > t_end:
+                            return None
+                        out = await self._adopt_one(home, stream_id, blob)
+                        if out is None or not out.get("ok", False):
+                            return None
+                        adopted_any = True
+                        m.inc("disagg.frames_streamed")
+                        self._stream_win.append(
+                            (time.monotonic(), int(out.get("blocks", 0))))
+                        if final:
+                            saw_final = True
+                            final_out = out
+                if buf or not saw_final:
+                    return None  # torn tail / stream died before FINAL
+        except (httpx.HTTPError, OSError, asyncio.TimeoutError):
+            # prefill replica died mid-stream: transport evidence feeds
+            # its breaker like any failed forward; the home keeps the
+            # partial frontier its adopter already committed
+            pf.breaker.record_failure()
+            return None
+        except ValueError:
+            return None  # corrupt frame (bad magic/CRC): abort clean
+        finally:
+            # atomic-section: router.disagg-release -- the prefill member's inflight decrement and its drain-completion check must be one step, same contract as router.inflight-release
+            pf.inflight -= 1
+            self._maybe_finish_drain(pf)
+            self._disagg_inflight -= 1
+            m.set_gauge("disagg.prefill_queue", float(self._disagg_inflight))
+            # end-atomic-section
+            if adopted_any and final_out is None:
+                # the stream died after segments landed: close the home's
+                # adopter NOW with an end-of-stream abort — it commits the
+                # partial frontier as ordinary warm cache and frees every
+                # held block ref (zero-leak), instead of lingering in the
+                # home's LRU until cap pressure evicts it
+                try:
+                    from ..serve.handoff import pack_kv_end
+                    await self._adopt_one(
+                        home, stream_id,
+                        pack_kv_end(stream_id, {"ok": False,
+                                                "aborted": True}))
+                except Exception:
+                    pass
+        pf.breaker.record_success()
+        adopted = int(final_out.get("adopted_tokens", 0) or 0)
+        if adopted > 0:
+            m.inc("disagg.tokens_prewarmed", float(adopted))
+        return final_out
+
+    def disagg_stats(self) -> dict:
+        """The /health per-pool roll-up: member counts per role, the live
+        export queue depth, and streamed KV blocks/s over a 30 s window
+        (fleetview renders exactly this block)."""
+        now = time.monotonic()
+        while self._stream_win and now - self._stream_win[0][0] > 30.0:
+            self._stream_win.popleft()
+        blocks = sum(n for _, n in self._stream_win)
+        pf = [r for r in self.replicas if r.role == "prefill"]
+        dec = [r for r in self.replicas if r.role != "prefill"]
+        return {
+            "enabled": self.disagg,
+            "min_tokens": self.disagg_min_tokens,
+            "stream_blocks": self.disagg_stream_blocks,
+            "prefill": {"total": len(pf),
+                        "admitting": sum(1 for r in pf if r.admitting()),
+                        "queue_depth": self._disagg_inflight,
+                        "urls": [r.url for r in pf]},
+            "decode": {"total": len(dec),
+                       "admitting": sum(1 for r in dec if r.admitting())},
+            "streamed_blocks_per_s": round(blocks / 30.0, 3),
+        }
+
     async def forward_parse(self, raw: bytes, body: dict,
                             headers: dict) -> tuple:
         """The full /parse policy: route → (on a forced move, warm-state
@@ -654,6 +951,49 @@ class BrainRouter(ReplicaSet):
             # so the new home's very first turn admits against warm state
             await self._rehome_handoff(session_id, rehomed_from, home,
                                        deadline)
+        if self.disagg:
+            pf = self._pick_prefill(exclude={home.url})
+            if pf is not None and speculative:
+                # a speculative parse is throwaway work whose latency
+                # nobody awaits: run it on the prefill pool, keeping its
+                # decode burst off the latency-critical replicas — and its
+                # prefill warms the pool's radix for the final's export.
+                # Never replayed on failure: the 409 discard contract.
+                get_metrics().inc("disagg.spec_routed")
+                try:
+                    resp = await self._guarded(
+                        pf, raw, headers, deadline,
+                        max(0.05, deadline.remaining_s()))
+                    return resp, pf, None
+                except ReplicaFailed:
+                    get_metrics().inc("router.spec_discarded")
+                    return None, None, "spec_discarded"
+            if pf is not None and feed:
+                # a prefix feed IS a prefill-only admission: export it on
+                # the prefill pool and ship the chain to the session's
+                # decode home, which is where the final will land warm
+                out = await self._disagg_stream(pf, home, body, deadline)
+                if out is not None:
+                    import httpx
+
+                    get_metrics().inc("disagg.feeds_routed")
+                    resp = httpx.Response(200, json={
+                        "prefix_feed": True, "ok": True, "disagg": True,
+                        "adopted_tokens":
+                            int(out.get("adopted_tokens", 0) or 0)})
+                    return resp, home, None
+                get_metrics().inc("disagg.fallbacks")
+                # fall through: the home runs the feed locally, as before
+            elif pf is not None and not speculative \
+                    and self._uncached_estimate(session_id, body) \
+                    >= self.disagg_min_tokens:
+                # a long/cold admission: prefill it on the pool and stream
+                # the KV in; whether or not the stream lands, the forward
+                # below proceeds — warm on success, cold on fallback
+                get_metrics().inc("disagg.admissions")
+                if await self._disagg_stream(pf, home, body,
+                                             deadline) is None:
+                    get_metrics().inc("disagg.fallbacks")
         # a retry can only follow a non-speculative attempt with somewhere
         # else to go; cap the first attempt at half the remaining budget in
         # that case so the retry is guaranteed to fit (mid-flight ejection
@@ -667,6 +1007,8 @@ class BrainRouter(ReplicaSet):
             resp, served, _hedged = await self._attempt(
                 home, session_id, raw, headers, deadline,
                 max(0.05, budget), idempotent)
+            if self.disagg:
+                self._note_session_tokens(session_id, served.url, resp)
             return resp, served, None
         except ReplicaFailed as e:
             if speculative:
@@ -698,6 +1040,8 @@ class BrainRouter(ReplicaSet):
                 resp, served, _h = await self._attempt(
                     home2, session_id, raw, headers, deadline,
                     max(0.05, deadline.remaining_s()), idempotent=False)
+                if self.disagg:
+                    self._note_session_tokens(session_id, served.url, resp)
                 return resp, served, None
             except ReplicaFailed as e2:
                 return None, None, f"retry_failed: {e2}"
@@ -853,6 +1197,11 @@ def build_app(router: BrainRouter, tracer: Tracer | None = None) -> web.Applicat
         }
         if router.last_fleet is not None:
             body["fleet"] = router.last_fleet
+        if router.disagg:
+            # the per-pool roll-up (ISSUE 20): prefill vs decode member
+            # counts, live export queue depth, streamed KV blocks/s —
+            # fleetview's disagg line reads exactly this block
+            body["disagg"] = router.disagg_stats()
         # the engine microscope rides along from a representative healthy
         # replica's last probe body, so the voice /health forward (and the
         # web HUD behind it) keeps its compile-sentinel / step-ledger / HBM

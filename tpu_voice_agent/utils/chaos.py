@@ -96,6 +96,17 @@ against the fleet autopilot's elastic-capacity loop):
                       (AUTOPILOT_JOIN_TIMEOUT_S), retire the stuck
                       member, and retry WITHOUT dropping the capacity
                       target or ever admitting the member cold
+
+Disaggregation points (ISSUE 20 — drilled by ``benches/bench_disagg.py``
+against the prefill/decode split):
+
+    prefill_replica_kill  the prefill replica's KV-stream connection dies
+                      mid-stream (transport closed before a frame write,
+                      ``@k`` counts frame writes) — the decode home must
+                      keep whatever segments landed as ordinary warm
+                      cache, fall back clean-or-cold to a local prefill,
+                      answer token-identically, and leak zero blocks on
+                      EITHER side
 """
 
 from __future__ import annotations
@@ -108,7 +119,7 @@ KNOWN_POINTS = ("nan_logits", "dead_fsm", "prefill_exc", "alloc_fail",
                 "stall_step", "drop_frame", "replica_kill", "replica_hang",
                 "replica_slow", "replica_degrade", "stt_replica_kill",
                 "stt_replica_hang", "stt_garble", "intent_downgrade",
-                "replica_join_stall")
+                "replica_join_stall", "prefill_replica_kill")
 
 
 class ChaosError(RuntimeError):

@@ -131,6 +131,7 @@ declare("STT_SHED_PRESSURE", "0.9", "queue-occupancy fraction past which new utt
 declare("HANDOFF_ENABLE", None, "1 ships warm session state (transcript + radix KV) on re-home/drain", table=RESILIENCE)
 declare("HANDOFF_TIMEOUT_S", "5.0", "per-hop budget for one warm-state handoff transfer", table=RESILIENCE)
 declare("HANDOFF_KV", "1", "0 ships the transcript WITHOUT KV bytes (the cold-re-home ablation baseline)", table=RESILIENCE)
+declare("HANDOFF_FRAMED", "0", "1 ships warm re-home state as sequence-numbered CRC-checked frames (the disagg KV-stream wire; 0 = raw blob, byte-identical)", table=RESILIENCE)
 declare("ROUTER_SHED_PRESSURE", "0.9", "pressure score past which new sessions avoid a brain replica", table=RESILIENCE)
 
 # fleet autopilot (ISSUE 16): closed-loop elastic capacity
@@ -202,6 +203,14 @@ declare("PREFIX_FEED_ENABLE", None, "1 streams stabilized STT partial prefixes t
 declare("PREFIX_FEED_STABLE_K", "3", "consecutive partials a transcript prefix must survive before it is fed", table=PERF)
 declare("PREFIX_FEED_MIN_CHARS", "8", "minimum committed-prefix growth (chars) before another feed fires", table=PERF)
 declare("PREFILL_CHUNK_TOKENS", None, "split prompt admissions into this many-token prefill chunks interleaved with decode chunks (unset = one-shot barrier prefill, byte-identical path)", table=PERF)
+
+# prefill/decode disaggregation (ISSUE 20): a prefill pool streams KV
+# blocks to decode replicas over the framed handoff wire
+declare("ROUTER_DISAGG", None, "1 splits the brain ring into prefill/decode pools and routes long cold admissions through the KV stream (unset = off, every touched path byte-identical)", table=PERF)
+declare("DISAGG_MIN_TOKENS", "256", "estimated uncached prompt tokens at/over which an admission takes the disagg prefill path", table=PERF)
+declare("DISAGG_STREAM_BLOCKS", "4", "KV blocks per streamed segment — the chunk-pipelining grain (first segments ship while later chunks still prefill)", table=PERF)
+declare("BRAIN_ROLE", "both", "this replica's serving role reported via /health: prefill | decode | both", table=PERF)
+declare("ROUTER_PREFILL_REPLICAS", None, "comma-separated brain base URLs appended to the ring as prefill-pool members (equivalent to `url#prefill` tags in BRAIN_REPLICAS)", table=PERF)
 
 # ========================================================= observability
 # docs/OBSERVABILITY.md — SLO tracker, step ledger, sentinel, HBM ledger,
